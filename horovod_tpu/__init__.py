@@ -1,0 +1,41 @@
+"""horovod_tpu: TPU-native synchronous data-parallel training framework.
+
+A ground-up, TPU-first rebuild of the capabilities of Horovod v0.13.11
+(reference: zhangzhao156/horovod).  A single-device training script becomes a
+multi-chip / multi-host one with five changes, exactly as in the reference
+(/root/reference/README.md:80-105):
+
+    import horovod_tpu as hvd
+    hvd.init()                         # rank/size from pod metadata, not MPI
+    ...  # pin device by hvd.local_rank(); scale LR by hvd.size()
+
+Collectives are named, asynchronously enqueued into a C++ background engine
+that negotiates readiness across ranks through a rank-0 TCP coordinator,
+fuses small tensors, and executes ring collectives over the host network
+(DCN), while the compiled JAX path (`horovod_tpu.jax`) lowers the same API to
+XLA collectives over ICI inside `jit`.
+
+The top-level module exposes the process-control API plus numpy collectives;
+per-framework submodules add `DistributedOptimizer` wrappers and broadcast
+helpers on top of this substrate.
+"""
+
+from horovod_tpu.common import (  # noqa: F401
+    HorovodInternalError,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    broadcast,
+    broadcast_async,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+
+__version__ = "0.1.0"

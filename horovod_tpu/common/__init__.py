@@ -1,0 +1,318 @@
+"""Python seam to the native collective engine.
+
+Counterpart of the reference's horovod/common/__init__.py (ctypes CDLL load,
+init/shutdown/rank/size/local_rank/... wrappers raising ValueError when
+uninitialized) plus the numpy-level async collective API that every framework
+binding builds on (the role the torch cffi interface plays in the reference,
+/root/reference/horovod/torch/interface.h).
+"""
+
+from __future__ import annotations
+
+import atexit
+import ctypes
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from horovod_tpu.common import dtypes
+from horovod_tpu.common.basics import ProcessSet, resolve_process_set
+from horovod_tpu.common.config import Config
+
+# Op codes shared with the C++ engine (engine/cc/wire.h OpType).
+OP_ALLREDUCE = 0
+OP_ALLGATHER = 1
+OP_BROADCAST = 2
+
+# Status codes (engine/cc/wire.h StatusCode).
+ST_OK = 0
+ST_UNKNOWN = 1
+ST_PRECONDITION = 2
+ST_ABORTED = 3
+ST_INVALID = 4
+ST_PENDING = 5
+
+
+class HorovodInternalError(RuntimeError):
+    """An unrecoverable engine error (transport failure, shutdown race)."""
+
+
+_lib = None
+_lib_lock = threading.Lock()
+_process_set: Optional[ProcessSet] = None
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        from horovod_tpu.engine.build import build
+
+        path = build()
+        lib = ctypes.CDLL(path, mode=ctypes.RTLD_GLOBAL)
+        lib.hvd_tpu_init.restype = ctypes.c_int
+        lib.hvd_tpu_init.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_double,
+            ctypes.c_longlong, ctypes.c_double, ctypes.c_char_p]
+        lib.hvd_tpu_init_error.restype = ctypes.c_char_p
+        lib.hvd_tpu_enqueue.restype = ctypes.c_longlong
+        lib.hvd_tpu_enqueue.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int]
+        lib.hvd_tpu_poll.restype = ctypes.c_int
+        lib.hvd_tpu_poll.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_wait.restype = ctypes.c_int
+        lib.hvd_tpu_wait.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_status.restype = ctypes.c_int
+        lib.hvd_tpu_status.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_error.restype = ctypes.c_char_p
+        lib.hvd_tpu_error.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_result_nbytes.restype = ctypes.c_longlong
+        lib.hvd_tpu_result_nbytes.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_result_dim0.restype = ctypes.c_longlong
+        lib.hvd_tpu_result_dim0.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_copy_result.restype = ctypes.c_int
+        lib.hvd_tpu_copy_result.argtypes = [
+            ctypes.c_longlong, ctypes.c_void_p, ctypes.c_longlong]
+        lib.hvd_tpu_release.argtypes = [ctypes.c_longlong]
+        _lib = lib
+        return lib
+
+
+def init(comm: Optional[Sequence[int]] = None) -> None:
+    """Initialize the engine.
+
+    ``comm`` optionally restricts the job to a subset of launcher ranks,
+    mirroring ``hvd.init(comm=[...])`` in the reference
+    (/root/reference/horovod/common/__init__.py:51-62).
+    """
+    global _process_set
+    lib = _load_lib()
+    if lib.hvd_tpu_initialized():
+        return
+    ps = resolve_process_set(comm)
+    cfg = Config.from_env()
+    timeline = cfg.timeline_path if ps.rank == 0 else ""
+    data = ",".join(ps.data_endpoints) if ps.data_endpoints else ""
+    rc = lib.hvd_tpu_init(
+        ps.rank, ps.size, ps.local_rank, ps.local_size,
+        (ps.coord_endpoint or "").encode(), data.encode(),
+        cfg.cycle_time_ms, cfg.fusion_threshold, cfg.stall_warning_sec,
+        timeline.encode())
+    if rc != 0:
+        raise HorovodInternalError(
+            "engine initialization failed: "
+            + lib.hvd_tpu_init_error().decode())
+    _process_set = ps
+    atexit.register(shutdown)
+
+
+def shutdown() -> None:
+    global _process_set
+    if _lib is not None and _lib.hvd_tpu_initialized():
+        _lib.hvd_tpu_shutdown()
+    _process_set = None
+
+
+def _check_initialized(lib) -> None:
+    if not lib.hvd_tpu_initialized():
+        raise ValueError(
+            "Horovod-TPU has not been initialized; use hvd.init().")
+
+
+def is_initialized() -> bool:
+    return _lib is not None and bool(_lib.hvd_tpu_initialized())
+
+
+def rank() -> int:
+    lib = _load_lib()
+    _check_initialized(lib)
+    return lib.hvd_tpu_rank()
+
+
+def size() -> int:
+    lib = _load_lib()
+    _check_initialized(lib)
+    return lib.hvd_tpu_size()
+
+
+def local_rank() -> int:
+    lib = _load_lib()
+    _check_initialized(lib)
+    return lib.hvd_tpu_local_rank()
+
+
+def local_size() -> int:
+    lib = _load_lib()
+    _check_initialized(lib)
+    return lib.hvd_tpu_local_size()
+
+
+def mpi_threads_supported() -> bool:
+    """Compatibility shim: there is no MPI; the engine is always
+    thread-safe for concurrent enqueues (the property this reference API,
+    /root/reference/horovod/common/__init__.py:142-153, reported)."""
+    _check_initialized(_load_lib())
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Async numpy collectives -- the substrate for all framework bindings.
+# ---------------------------------------------------------------------------
+
+
+class Handle:
+    """An outstanding collective.  Poll with :meth:`done`, finish with
+    :meth:`wait`.  Keeps input/output arrays alive while the engine may
+    still touch their memory (the reference pins tensors in _handle_map,
+    /root/reference/horovod/torch/mpi_ops.py:28-31)."""
+
+    def __init__(self, raw: int, op: int, inp: np.ndarray,
+                 out: Optional[np.ndarray], name: str):
+        self._raw = raw
+        self._op = op
+        self._in = inp
+        self._out = out
+        self._name = name
+        self._finished = False
+
+    def done(self) -> bool:
+        if self._finished:
+            return True
+        return _lib.hvd_tpu_poll(self._raw) != 0
+
+    def wait(self) -> np.ndarray:
+        if self._finished:
+            raise ValueError(f"handle for '{self._name}' already waited on")
+        code = _lib.hvd_tpu_wait(self._raw)
+        try:
+            if code != ST_OK:
+                msg = _lib.hvd_tpu_error(self._raw).decode()
+                raise _status_error(code, msg, self._name)
+            if self._op == OP_ALLGATHER:
+                nbytes = _lib.hvd_tpu_result_nbytes(self._raw)
+                dim0 = _lib.hvd_tpu_result_dim0(self._raw)
+                shape = (int(dim0),) + self._in.shape[1:]
+                out = np.empty(shape, dtype=self._in.dtype)
+                assert out.nbytes == nbytes, (out.nbytes, nbytes)
+                if nbytes:
+                    _lib.hvd_tpu_copy_result(
+                        self._raw, out.ctypes.data_as(ctypes.c_void_p), nbytes)
+                return out
+            return self._out
+        finally:
+            self._finished = True
+            _lib.hvd_tpu_release(self._raw)
+
+
+def _status_error(code: int, msg: str, name: str) -> Exception:
+    prefix = f"collective '{name}' failed: "
+    if code == ST_PRECONDITION:
+        return ValueError(prefix + msg)
+    if code == ST_ABORTED:
+        return HorovodInternalError(prefix + msg)
+    return HorovodInternalError(prefix + (msg or f"status {code}"))
+
+
+def _as_c_dims(shape) -> tuple:
+    arr = (ctypes.c_longlong * len(shape))(*shape)
+    return arr, len(shape)
+
+
+_name_counter = [0]
+_name_lock = threading.Lock()
+
+
+def _auto_name(prefix: str) -> str:
+    with _name_lock:
+        _name_counter[0] += 1
+        return f"{prefix}.noname.{_name_counter[0]}"
+
+
+def _check_out(out: np.ndarray, array: np.ndarray) -> None:
+    if out.shape != array.shape or out.dtype != array.dtype:
+        raise ValueError(
+            f"output buffer mismatch: expected shape {array.shape} dtype "
+            f"{array.dtype}, got shape {out.shape} dtype {out.dtype}")
+    if not out.flags["C_CONTIGUOUS"] or not out.flags["WRITEABLE"]:
+        raise ValueError("output buffer must be C-contiguous and writeable")
+
+
+def allreduce_async(array: np.ndarray, average: bool = True,
+                    name: Optional[str] = None,
+                    out: Optional[np.ndarray] = None) -> Handle:
+    lib = _load_lib()
+    _check_initialized(lib)
+    array = np.ascontiguousarray(array)
+    if out is None:
+        out = np.empty_like(array)
+    else:
+        _check_out(out, array)
+    name = name or _auto_name("allreduce")
+    dims, ndim = _as_c_dims(array.shape)
+    raw = lib.hvd_tpu_enqueue(
+        OP_ALLREDUCE, name.encode(),
+        array.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        dims, ndim, dtypes.numpy_to_code(array.dtype), -1, int(average))
+    if raw < 0:
+        raise HorovodInternalError("engine is shut down")
+    return Handle(raw, OP_ALLREDUCE, array, out, name)
+
+
+def allgather_async(array: np.ndarray, name: Optional[str] = None) -> Handle:
+    lib = _load_lib()
+    _check_initialized(lib)
+    array = np.ascontiguousarray(array)
+    if array.ndim == 0:
+        raise ValueError("allgather requires tensors of rank >= 1")
+    name = name or _auto_name("allgather")
+    dims, ndim = _as_c_dims(array.shape)
+    raw = lib.hvd_tpu_enqueue(
+        OP_ALLGATHER, name.encode(),
+        array.ctypes.data_as(ctypes.c_void_p), None,
+        dims, ndim, dtypes.numpy_to_code(array.dtype), -1, 0)
+    if raw < 0:
+        raise HorovodInternalError("engine is shut down")
+    return Handle(raw, OP_ALLGATHER, array, None, name)
+
+
+def broadcast_async(array: np.ndarray, root_rank: int,
+                    name: Optional[str] = None,
+                    out: Optional[np.ndarray] = None) -> Handle:
+    lib = _load_lib()
+    _check_initialized(lib)
+    array = np.ascontiguousarray(array)
+    if out is None:
+        out = np.empty_like(array)
+    else:
+        _check_out(out, array)
+    name = name or _auto_name("broadcast")
+    dims, ndim = _as_c_dims(array.shape)
+    raw = lib.hvd_tpu_enqueue(
+        OP_BROADCAST, name.encode(),
+        array.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        dims, ndim, dtypes.numpy_to_code(array.dtype), root_rank, 0)
+    if raw < 0:
+        raise HorovodInternalError("engine is shut down")
+    return Handle(raw, OP_BROADCAST, array, out, name)
+
+
+def allreduce(array: np.ndarray, average: bool = True,
+              name: Optional[str] = None) -> np.ndarray:
+    return allreduce_async(array, average, name).wait()
+
+
+def allgather(array: np.ndarray, name: Optional[str] = None) -> np.ndarray:
+    return allgather_async(array, name).wait()
+
+
+def broadcast(array: np.ndarray, root_rank: int,
+              name: Optional[str] = None) -> np.ndarray:
+    return broadcast_async(array, root_rank, name).wait()

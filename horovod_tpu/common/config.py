@@ -1,0 +1,47 @@
+"""Runtime configuration knobs, read from the environment.
+
+TPU-native counterpart of the env config block read in the reference's
+background thread (/root/reference/horovod/common/operations.cc:1393-1420).
+Both the reference's historical names (``HOROVOD_*``) and the new
+``HVD_TPU_*`` names are honoured, new names winning, so reference scripts and
+docs carry over unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024  # bytes, same default as reference
+DEFAULT_CYCLE_TIME_MS = 5.0
+DEFAULT_STALL_WARNING_SEC = 60.0
+
+
+def _get(new: str, old: str) -> Optional[str]:
+    return os.environ.get(new, os.environ.get(old))
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    fusion_threshold: int = DEFAULT_FUSION_THRESHOLD
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    stall_warning_sec: float = DEFAULT_STALL_WARNING_SEC
+    timeline_path: str = ""          # Chrome-tracing JSON output, rank 0
+    hierarchical_allreduce: bool = False
+
+    @staticmethod
+    def from_env() -> "Config":
+        fusion = _get("HVD_TPU_FUSION_THRESHOLD", "HOROVOD_FUSION_THRESHOLD")
+        cycle = _get("HVD_TPU_CYCLE_TIME", "HOROVOD_CYCLE_TIME")
+        stall = _get("HVD_TPU_STALL_WARNING_SEC", "HOROVOD_STALL_WARNING_SEC")
+        timeline = _get("HVD_TPU_TIMELINE", "HOROVOD_TIMELINE")
+        hier = _get("HVD_TPU_HIERARCHICAL_ALLREDUCE",
+                    "HOROVOD_HIERARCHICAL_ALLREDUCE")
+        return Config(
+            fusion_threshold=int(fusion) if fusion else DEFAULT_FUSION_THRESHOLD,
+            cycle_time_ms=float(cycle) if cycle else DEFAULT_CYCLE_TIME_MS,
+            stall_warning_sec=float(stall) if stall else DEFAULT_STALL_WARNING_SEC,
+            timeline_path=timeline or "",
+            hierarchical_allreduce=bool(hier and hier not in ("0", "false", "")),
+        )

@@ -1,0 +1,50 @@
+"""Dtype codes shared with the C++ engine (engine/cc/wire.h DataType)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+UINT8 = 0
+INT8 = 1
+INT32 = 2
+INT64 = 3
+FLOAT16 = 4
+FLOAT32 = 5
+FLOAT64 = 6
+BFLOAT16 = 7
+BOOL = 8
+UINT16 = 9
+
+_NUMPY_TO_CODE = {
+    np.dtype(np.uint8): UINT8,
+    np.dtype(np.int8): INT8,
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.float16): FLOAT16,
+    np.dtype(np.float32): FLOAT32,
+    np.dtype(np.float64): FLOAT64,
+    np.dtype(np.bool_): BOOL,
+    np.dtype(np.uint16): UINT16,
+}
+
+_CODE_TO_NUMPY = {v: k for k, v in _NUMPY_TO_CODE.items()}
+
+try:  # ml_dtypes ships with jax; gives us a numpy bfloat16
+    import ml_dtypes
+
+    _NUMPY_TO_CODE[np.dtype(ml_dtypes.bfloat16)] = BFLOAT16
+    _CODE_TO_NUMPY[BFLOAT16] = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    pass
+
+
+def numpy_to_code(dtype) -> int:
+    dtype = np.dtype(dtype)
+    code = _NUMPY_TO_CODE.get(dtype)
+    if code is None:
+        raise ValueError(f"unsupported dtype for collective: {dtype}")
+    return code
+
+
+def code_to_numpy(code: int):
+    return _CODE_TO_NUMPY[code]

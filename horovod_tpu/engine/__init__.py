@@ -1,0 +1,3 @@
+"""Native collective engine: C++ sources, build, and ctypes bindings."""
+
+from horovod_tpu.engine.build import build, lib_path  # noqa: F401

@@ -1,0 +1,66 @@
+"""Build the native collective engine (libhvdtpu.so).
+
+Counterpart of the reference's setup.py extension build
+(/root/reference/setup.py:31-34,210-425), radically simplified: no MPI/CUDA/
+NCCL feature probing is needed because the engine's only system dependencies
+are POSIX sockets and pthreads.  The library is compiled on first import and
+cached next to the sources; rebuilt when any source is newer than the binary.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+_CC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cc")
+_SOURCES = ["net.cc", "wire.cc", "timeline.cc", "engine.cc", "c_api.cc"]
+_LIB_NAME = "libhvdtpu.so"
+
+
+def lib_path() -> str:
+    return os.path.join(_CC_DIR, _LIB_NAME)
+
+
+def needs_build() -> bool:
+    lib = lib_path()
+    if not os.path.exists(lib):
+        return True
+    lib_mtime = os.path.getmtime(lib)
+    for fname in os.listdir(_CC_DIR):
+        if fname.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(_CC_DIR, fname)) > lib_mtime:
+                return True
+    return False
+
+
+def build(verbose: bool = False) -> str:
+    """Compile the engine; returns the .so path.  Raises on failure."""
+    lib = lib_path()
+    if not needs_build():
+        return lib
+    cxx = os.environ.get("CXX", "g++")
+    srcs = [os.path.join(_CC_DIR, s) for s in _SOURCES]
+    # Build into a temp file then atomically rename, so concurrent test
+    # processes racing to build don't load a half-written .so.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CC_DIR)
+    os.close(fd)
+    cmd = [cxx, "-std=c++17", "-O2", "-g", "-fPIC", "-shared", "-pthread",
+           "-Wall", "-Wextra", "-Wno-unused-parameter",
+           "-o", tmp] + srcs
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"failed to build {_LIB_NAME}:\n{proc.stderr}")
+        os.replace(tmp, lib)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    if verbose:
+        print(f"[horovod_tpu] built {lib}")
+    return lib
+
+
+if __name__ == "__main__":
+    build(verbose=True)

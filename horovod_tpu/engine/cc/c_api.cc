@@ -1,0 +1,128 @@
+// C API exported to Python over ctypes.  Counterpart of the reference's
+// extern "C" block (/root/reference/horovod/common/operations.cc:1731-1813)
+// plus the torch handle API (/root/reference/horovod/torch/interface.h:16-75),
+// unified: every framework binding (numpy/jax-eager/tf-eager/torch) talks to
+// the engine through these same dozen functions.
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "engine.h"
+
+using hvdtpu::Engine;
+using hvdtpu::EngineOptions;
+using hvdtpu::GlobalEngine;
+
+namespace {
+std::mutex g_err_mu;
+std::string g_init_error;
+thread_local std::string tl_error;
+
+std::vector<std::string> SplitCommas(const char* s) {
+  std::vector<std::string> out;
+  if (!s) return out;
+  std::string cur;
+  for (const char* p = s; *p; ++p) {
+    if (*p == ',') {
+      if (!cur.empty()) out.push_back(cur);
+      cur.clear();
+    } else {
+      cur += *p;
+    }
+  }
+  if (!cur.empty()) out.push_back(cur);
+  return out;
+}
+}  // namespace
+
+extern "C" {
+
+int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
+                 const char* coord_endpoint, const char* data_endpoints,
+                 double cycle_time_ms, long long fusion_threshold,
+                 double stall_warning_sec, const char* timeline_path) {
+  EngineOptions opts;
+  opts.rank = rank;
+  opts.size = size;
+  opts.local_rank = local_rank;
+  opts.local_size = local_size;
+  opts.coord_endpoint = coord_endpoint ? coord_endpoint : "";
+  opts.data_endpoints = SplitCommas(data_endpoints);
+  opts.cycle_time_ms = cycle_time_ms;
+  opts.fusion_threshold = fusion_threshold;
+  opts.stall_warning_sec = stall_warning_sec;
+  opts.timeline_path = timeline_path ? timeline_path : "";
+  std::string err;
+  int rc = GlobalEngine()->Init(opts, &err);
+  if (rc != 0) {
+    std::lock_guard<std::mutex> lk(g_err_mu);
+    g_init_error = err;
+  }
+  return rc;
+}
+
+const char* hvd_tpu_init_error() {
+  std::lock_guard<std::mutex> lk(g_err_mu);
+  return g_init_error.c_str();
+}
+
+void hvd_tpu_shutdown() { GlobalEngine()->Shutdown(); }
+
+int hvd_tpu_initialized() { return GlobalEngine()->Initialized() ? 1 : 0; }
+int hvd_tpu_rank() {
+  return GlobalEngine()->Initialized() ? GlobalEngine()->rank() : -1;
+}
+int hvd_tpu_size() {
+  return GlobalEngine()->Initialized() ? GlobalEngine()->size() : -1;
+}
+int hvd_tpu_local_rank() {
+  return GlobalEngine()->Initialized() ? GlobalEngine()->local_rank() : -1;
+}
+int hvd_tpu_local_size() {
+  return GlobalEngine()->Initialized() ? GlobalEngine()->local_size() : -1;
+}
+
+// op: 0=allreduce 1=allgather 2=broadcast; dtype: see wire.h DataType.
+// Returns handle >= 0, or -1 if the engine is not running.
+long long hvd_tpu_enqueue(int op, const char* name, const void* in, void* out,
+                          const long long* dims, int ndim, int dtype,
+                          int root_rank, int average) {
+  std::vector<int64_t> d(dims, dims + ndim);
+  return GlobalEngine()->Enqueue(static_cast<uint8_t>(op), name ? name : "",
+                                 in, out, d, static_cast<uint8_t>(dtype),
+                                 root_rank, average != 0);
+}
+
+int hvd_tpu_poll(long long handle) {
+  return GlobalEngine()->Poll(handle);
+}
+
+int hvd_tpu_wait(long long handle) {
+  return GlobalEngine()->Wait(handle);
+}
+
+int hvd_tpu_status(long long handle) {
+  return GlobalEngine()->StatusOf(handle, nullptr);
+}
+
+const char* hvd_tpu_error(long long handle) {
+  GlobalEngine()->StatusOf(handle, &tl_error);
+  return tl_error.c_str();
+}
+
+long long hvd_tpu_result_nbytes(long long handle) {
+  return GlobalEngine()->ResultBytes(handle);
+}
+
+long long hvd_tpu_result_dim0(long long handle) {
+  return GlobalEngine()->ResultDim0(handle);
+}
+
+int hvd_tpu_copy_result(long long handle, void* dst, long long nbytes) {
+  return GlobalEngine()->CopyResult(handle, dst, nbytes) ? 0 : 1;
+}
+
+void hvd_tpu_release(long long handle) { GlobalEngine()->Release(handle); }
+
+}  // extern "C"

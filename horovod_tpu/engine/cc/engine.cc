@@ -1,0 +1,989 @@
+#include "engine.h"
+
+#include <string.h>
+
+#include <algorithm>
+#include <cstdio>
+
+#include "net.h"
+
+namespace hvdtpu {
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Dtype arithmetic helpers (reduction + half-precision staging).
+// The reference delegated these to MPI_SUM / ncclSum; a TCP data plane has to
+// do its own math.  f16/bf16 are staged through f32 (better numerics than
+// reducing in half precision, and the MXU-friendly layout for any future
+// on-device path).
+// ---------------------------------------------------------------------------
+
+template <typename T>
+void AddInto(T* dst, const T* src, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+void AccumulateSum(void* dst, const void* src, int64_t n, uint8_t dtype) {
+  switch (dtype) {
+    case HVD_UINT8:
+      AddInto(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(src), n);
+      break;
+    case HVD_INT8:
+      AddInto(static_cast<int8_t*>(dst), static_cast<const int8_t*>(src), n);
+      break;
+    case HVD_UINT16:
+      AddInto(static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src),
+              n);
+      break;
+    case HVD_INT32:
+      AddInto(static_cast<int32_t*>(dst), static_cast<const int32_t*>(src), n);
+      break;
+    case HVD_INT64:
+      AddInto(static_cast<int64_t*>(dst), static_cast<const int64_t*>(src), n);
+      break;
+    case HVD_FLOAT32:
+      AddInto(static_cast<float*>(dst), static_cast<const float*>(src), n);
+      break;
+    case HVD_FLOAT64:
+      AddInto(static_cast<double*>(dst), static_cast<const double*>(src), n);
+      break;
+    case HVD_BOOL: {
+      // Sum on bool saturates to logical OR (what MPI_SUM on C bool gives).
+      uint8_t* d = static_cast<uint8_t*>(dst);
+      const uint8_t* s = static_cast<const uint8_t*>(src);
+      for (int64_t i = 0; i < n; ++i) d[i] = (d[i] || s[i]) ? 1 : 0;
+      break;
+    }
+    default:
+      break;  // f16/bf16 never reach the wire: staged through f32
+  }
+}
+
+template <typename T>
+void DivideBy(T* dst, int64_t n, double divisor) {
+  for (int64_t i = 0; i < n; ++i)
+    dst[i] = static_cast<T>(dst[i] / divisor);
+}
+
+void DivideBuffer(void* buf, int64_t n, uint8_t dtype, double divisor) {
+  switch (dtype) {
+    case HVD_FLOAT32:
+      DivideBy(static_cast<float*>(buf), n, divisor);
+      break;
+    case HVD_FLOAT64:
+      DivideBy(static_cast<double*>(buf), n, divisor);
+      break;
+    case HVD_INT32:
+      DivideBy(static_cast<int32_t*>(buf), n, divisor);
+      break;
+    case HVD_INT64:
+      DivideBy(static_cast<int64_t*>(buf), n, divisor);
+      break;
+    case HVD_UINT8:
+      DivideBy(static_cast<uint8_t*>(buf), n, divisor);
+      break;
+    case HVD_INT8:
+      DivideBy(static_cast<int8_t*>(buf), n, divisor);
+      break;
+    case HVD_UINT16:
+      DivideBy(static_cast<uint16_t*>(buf), n, divisor);
+      break;
+    default:
+      break;  // bool: averaging is meaningless; result is the OR
+  }
+}
+
+template <typename T>
+void ScaleBy(T* dst, int64_t n, double scale) {
+  for (int64_t i = 0; i < n; ++i) dst[i] = static_cast<T>(dst[i] * scale);
+}
+
+float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t man = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while (!(man & 0x400u)) {
+        man <<= 1;
+        --exp;
+      }
+      man &= 0x3ffu;
+      bits = sign | (exp << 23) | (man << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7f800000u | (man << 13);
+  } else {
+    bits = sign | ((exp + 127 - 15) << 23) | (man << 13);
+  }
+  float f;
+  memcpy(&f, &bits, 4);
+  return f;
+}
+
+uint16_t FloatToHalf(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  uint16_t sign = static_cast<uint16_t>((bits >> 16) & 0x8000u);
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t man = bits & 0x7fffffu;
+  if (((bits >> 23) & 0xff) == 0xff) {  // inf/nan
+    return sign | 0x7c00u | (man ? 0x200u : 0);
+  }
+  if (exp >= 31) return sign | 0x7c00u;  // overflow -> inf
+  if (exp <= 0) {                        // subnormal / underflow
+    if (exp < -10) return sign;
+    man |= 0x800000u;
+    int shift = 14 - exp;
+    uint32_t half_man = man >> shift;
+    uint32_t rem = man & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half_man & 1))) ++half_man;
+    return static_cast<uint16_t>(sign | half_man);
+  }
+  uint32_t half_man = man >> 13;
+  uint32_t rem = man & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half_man & 1))) {
+    ++half_man;
+    if (half_man == 0x400u) {
+      half_man = 0;
+      ++exp;
+      if (exp >= 31) return sign | 0x7c00u;
+    }
+  }
+  return static_cast<uint16_t>(sign | (exp << 10) | half_man);
+}
+
+float Bf16ToFloat(uint16_t b) {
+  uint32_t bits = static_cast<uint32_t>(b) << 16;
+  float f;
+  memcpy(&f, &bits, 4);
+  return f;
+}
+
+uint16_t FloatToBf16(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  if ((bits & 0x7f800000u) == 0x7f800000u && (bits & 0x7fffffu))
+    return static_cast<uint16_t>((bits >> 16) | 0x40);  // quiet nan
+  uint32_t rounded = bits + 0x7fffu + ((bits >> 16) & 1);
+  return static_cast<uint16_t>(rounded >> 16);
+}
+
+void HalfBufToFloat(const void* src, float* dst, int64_t n, uint8_t dtype) {
+  const uint16_t* s = static_cast<const uint16_t*>(src);
+  if (dtype == HVD_FLOAT16)
+    for (int64_t i = 0; i < n; ++i) dst[i] = HalfToFloat(s[i]);
+  else
+    for (int64_t i = 0; i < n; ++i) dst[i] = Bf16ToFloat(s[i]);
+}
+
+void FloatBufToHalf(const float* src, void* dst, int64_t n, uint8_t dtype) {
+  uint16_t* d = static_cast<uint16_t*>(dst);
+  if (dtype == HVD_FLOAT16)
+    for (int64_t i = 0; i < n; ++i) d[i] = FloatToHalf(src[i]);
+  else
+    for (int64_t i = 0; i < n; ++i) d[i] = FloatToBf16(src[i]);
+}
+
+int64_t NumElements(const std::vector<int64_t>& dims) {
+  int64_t n = 1;
+  for (int64_t d : dims) n *= d;
+  return n;
+}
+
+std::string DimsToString(const std::vector<int64_t>& dims) {
+  std::string s = "[";
+  for (size_t i = 0; i < dims.size(); ++i) {
+    if (i) s += ", ";
+    s += std::to_string(dims[i]);
+  }
+  return s + "]";
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Coordinator state (rank 0).  Analogue of the reference MessageTable +
+// IncrementTensorCount/ConstructMPIResponse
+// (/root/reference/horovod/common/operations.cc:101,268,301).
+// ---------------------------------------------------------------------------
+
+struct Engine::Coordinator {
+  struct PendingTensor {
+    std::vector<Request> requests;  // one per rank that announced, any order
+    std::chrono::steady_clock::time_point first_seen;
+    uint64_t order = 0;
+  };
+  std::unordered_map<std::string, PendingTensor> message_table;
+  std::vector<std::string> ready;  // names with all ranks announced, in order
+  uint64_t next_order = 0;
+  bool shutdown_requested = false;
+};
+
+Engine* GlobalEngine() {
+  // Intentionally leaked: outlives any Python teardown order, mirroring the
+  // reference's never-destructed HorovodGlobalState.
+  static Engine* engine = new Engine();
+  return engine;
+}
+
+Engine::~Engine() { Shutdown(); }
+
+int Engine::Init(const EngineOptions& opts, std::string* err) {
+  if (initialized_.load()) return 0;
+  opts_ = opts;
+  shut_down_.store(false);
+  loop_exited_.store(false);
+  coord_.reset(new Coordinator());
+  if (opts_.rank == 0) timeline_.Initialize(opts_.timeline_path);
+  std::string setup_err;
+  if (!SetupSockets(&setup_err)) {
+    *err = setup_err;
+    TeardownSockets();
+    return 1;
+  }
+  last_stall_check_ = std::chrono::steady_clock::now();
+  initialized_.store(true);
+  background_ = std::thread([this]() { BackgroundLoop(); });
+  return 0;
+}
+
+bool Engine::SetupSockets(std::string* err) {
+  if (opts_.size == 1) return true;
+  std::string host;
+  int port;
+  const double kTimeout = 120.0;
+  // Control plane: rank-0 star.
+  if (opts_.rank == 0) {
+    if (!ParseEndpoint(opts_.coord_endpoint, &host, &port)) {
+      *err = "bad coordinator endpoint " + opts_.coord_endpoint;
+      return false;
+    }
+    coord_listen_fd_ = Listen("0.0.0.0", port, err);
+    if (coord_listen_fd_ < 0) return false;
+  }
+  // Data plane: every rank listens on its endpoint.
+  if (!ParseEndpoint(opts_.data_endpoints[opts_.rank], &host, &port)) {
+    *err = "bad data endpoint " + opts_.data_endpoints[opts_.rank];
+    return false;
+  }
+  data_listen_fd_ = Listen("0.0.0.0", port, err);
+  if (data_listen_fd_ < 0) return false;
+
+  if (opts_.rank == 0) {
+    coord_fds_.assign(opts_.size, -1);
+    for (int i = 1; i < opts_.size; ++i) {
+      int fd = AcceptOne(coord_listen_fd_, kTimeout, err);
+      if (fd < 0) return false;
+      uint32_t peer_rank;
+      if (!RecvAll(fd, &peer_rank, 4) || peer_rank >= (uint32_t)opts_.size) {
+        *err = "bad hello from worker";
+        return false;
+      }
+      coord_fds_[peer_rank] = fd;
+    }
+  } else {
+    if (!ParseEndpoint(opts_.coord_endpoint, &host, &port)) {
+      *err = "bad coordinator endpoint " + opts_.coord_endpoint;
+      return false;
+    }
+    coord_fd_ = ConnectRetry(host, port, kTimeout, err);
+    if (coord_fd_ < 0) return false;
+    uint32_t my_rank = static_cast<uint32_t>(opts_.rank);
+    if (!SendAll(coord_fd_, &my_rank, 4)) {
+      *err = "hello send failed";
+      return false;
+    }
+  }
+  // Ring: connect to the right neighbour, accept from the left.
+  int right = (opts_.rank + 1) % opts_.size;
+  if (!ParseEndpoint(opts_.data_endpoints[right], &host, &port)) {
+    *err = "bad data endpoint " + opts_.data_endpoints[right];
+    return false;
+  }
+  right_fd_ = ConnectRetry(host, port, kTimeout, err);
+  if (right_fd_ < 0) return false;
+  left_fd_ = AcceptOne(data_listen_fd_, kTimeout, err);
+  if (left_fd_ < 0) return false;
+  return true;
+}
+
+void Engine::TeardownSockets() {
+  CloseFd(coord_listen_fd_);
+  CloseFd(coord_fd_);
+  for (int fd : coord_fds_) CloseFd(fd);
+  coord_fds_.clear();
+  CloseFd(data_listen_fd_);
+  CloseFd(left_fd_);
+  CloseFd(right_fd_);
+  coord_listen_fd_ = coord_fd_ = data_listen_fd_ = left_fd_ = right_fd_ = -1;
+}
+
+void Engine::Shutdown() {
+  if (!initialized_.load()) return;
+  shut_down_.store(true);
+  if (background_.joinable()) background_.join();
+  // Fail anything still pending.
+  std::vector<TableEntry> leftovers;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : table_) leftovers.push_back(kv.second);
+    table_.clear();
+    queue_.clear();
+  }
+  for (auto& e : leftovers)
+    CompleteEntry(e, ST_ABORTED,
+                  "Horovod-TPU has been shut down. This was caused by an "
+                  "exception on one of the ranks or an earlier shutdown.");
+  timeline_.Shutdown();
+  TeardownSockets();
+  initialized_.store(false);
+}
+
+void Engine::BackgroundLoop() {
+  while (RunLoopOnce()) {
+  }
+  // Drain: fail everything still pending so blocked Wait() calls return
+  // (the reference's SHUT_DOWN_ERROR drain on loop exit,
+  // operations.cc:1446-1461).  loop_exited_ flips under mu_ so a racing
+  // Enqueue either lands before the drain (and is failed here) or observes
+  // the flag and is rejected.
+  std::vector<TableEntry> leftovers;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    loop_exited_.store(true);
+    for (auto& kv : table_) leftovers.push_back(kv.second);
+    table_.clear();
+    queue_.clear();
+  }
+  for (auto& e : leftovers)
+    CompleteEntry(e, ST_ABORTED,
+                  "Horovod-TPU has been shut down. This was caused by an "
+                  "exception on one of the ranks or an earlier shutdown.");
+}
+
+int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
+                        void* out, const std::vector<int64_t>& dims,
+                        uint8_t dtype, int root_rank, bool average,
+                        double prescale) {
+  if (!initialized_.load()) return -1;
+  auto status = std::make_shared<HandleStatus>();
+  int64_t handle = next_handle_.fetch_add(1);
+  {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    handles_[handle] = status;
+  }
+  TableEntry e;
+  e.name = name;
+  e.op = op;
+  e.dtype = dtype;
+  e.dims = dims;
+  e.in = in;
+  e.out = out;
+  e.root_rank = root_rank;
+  e.average = average;
+  e.prescale = prescale;
+  e.handle = handle;
+  e.enqueued_at = std::chrono::steady_clock::now();
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (loop_exited_.load()) {
+      status->error =
+          "Horovod-TPU has been shut down; no further collectives can run.";
+      status->code.store(ST_ABORTED);
+      handles_cv_.notify_all();
+      return handle;
+    }
+    if (table_.count(name)) {
+      // Same duplicate-name precondition as the reference enqueue
+      // (operations.cc:1827-1833).
+      status->error = "A collective with name '" + name +
+                      "' is already in progress; names must be unique per "
+                      "outstanding operation.";
+      status->code.store(ST_PRECONDITION);
+      handles_cv_.notify_all();
+      return handle;
+    }
+    table_.emplace(name, std::move(e));
+    Request req;
+    req.rank = opts_.rank;
+    req.op = op;
+    req.dtype = dtype;
+    req.root_rank = root_rank;
+    req.name = name;
+    req.dims = dims;
+    queue_.push_back(std::move(req));
+  }
+  return handle;
+}
+
+// ---------------------------------------------------------------------------
+// Negotiation tick.
+// ---------------------------------------------------------------------------
+
+bool Engine::RunLoopOnce() {
+  auto tick_start = std::chrono::steady_clock::now();
+
+  RequestList my_requests;
+  my_requests.shutdown = shut_down_.load();
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    while (!queue_.empty()) {
+      my_requests.requests.push_back(std::move(queue_.front()));
+      queue_.pop_front();
+    }
+  }
+
+  ResponseList responses;
+  if (opts_.size == 1) {
+    // Single-process: everything is immediately "negotiated".
+    coord_->shutdown_requested |= my_requests.shutdown;
+    CoordinatorHandle(my_requests, 0);
+    responses = CoordinatorTick();
+  } else if (opts_.rank == 0) {
+    coord_->shutdown_requested |= my_requests.shutdown;
+    CoordinatorHandle(my_requests, 0);
+    for (int r = 1; r < opts_.size; ++r) {
+      std::vector<uint8_t> buf;
+      if (!RecvFrame(coord_fds_[r], &buf)) {
+        // A worker died: tear the job down (coordinated shutdown, the
+        // reference's SHUT_DOWN_ERROR path, operations.cc:1579-1605).
+        coord_->shutdown_requested = true;
+        continue;
+      }
+      RequestList rl;
+      if (ParseRequestList(buf, &rl)) {
+        coord_->shutdown_requested |= rl.shutdown;
+        CoordinatorHandle(rl, r);
+      }
+    }
+    responses = CoordinatorTick();
+    std::vector<uint8_t> out = SerializeResponseList(responses);
+    for (int r = 1; r < opts_.size; ++r) SendFrame(coord_fds_[r], out);
+  } else {
+    if (!SendFrame(coord_fd_, SerializeRequestList(my_requests))) {
+      responses.shutdown = true;
+    } else {
+      std::vector<uint8_t> buf;
+      if (!RecvFrame(coord_fd_, &buf) || !ParseResponseList(buf, &responses))
+        responses.shutdown = true;
+    }
+  }
+
+  for (const auto& resp : responses.responses) PerformOperation(resp);
+
+  if (opts_.rank == 0) CheckForStalledTensors();
+
+  if (responses.shutdown) return false;
+
+  auto elapsed = std::chrono::steady_clock::now() - tick_start;
+  auto cycle = std::chrono::duration<double, std::milli>(opts_.cycle_time_ms);
+  if (elapsed < cycle)
+    std::this_thread::sleep_for(cycle - elapsed);
+  return true;
+}
+
+void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
+  for (const auto& req : rl.requests) {
+    auto& pt = coord_->message_table[req.name];
+    if (pt.requests.empty()) {
+      pt.first_seen = std::chrono::steady_clock::now();
+      pt.order = coord_->next_order++;
+      timeline_.NegotiateStart(req.name, req.op);
+    }
+    timeline_.NegotiateRankReady(req.name, from_rank);
+    pt.requests.push_back(req);
+    if (static_cast<int>(pt.requests.size()) == opts_.size) {
+      timeline_.NegotiateEnd(req.name);
+      coord_->ready.push_back(req.name);
+    }
+  }
+}
+
+Response Engine::BuildResponse(const std::string& name) {
+  // Cross-rank consistency validation, mirroring the checks in the
+  // reference's ConstructMPIResponse (operations.cc:301-503): op, dtype,
+  // shape (exact for allreduce/broadcast, all-but-dim-0 for allgather) and
+  // broadcast root must agree across ranks.
+  auto it = coord_->message_table.find(name);
+  Response resp;
+  resp.names.push_back(name);
+  auto& reqs = it->second.requests;
+  const Request& first = reqs[0];
+  std::string error;
+  for (size_t i = 1; i < reqs.size() && error.empty(); ++i) {
+    const Request& r = reqs[i];
+    if (r.op != first.op)
+      error = "Mismatched collective operations: rank " +
+              std::to_string(r.rank) + " requested " + OpName(r.op) +
+              ", rank " + std::to_string(first.rank) + " requested " +
+              OpName(first.op) + ".";
+    else if (r.dtype != first.dtype)
+      error = std::string("Mismatched data types: one rank sent ") +
+              DataTypeName(r.dtype) + ", another sent " +
+              DataTypeName(first.dtype) + ".";
+    else if (first.op == OP_ALLREDUCE && r.dims != first.dims)
+      error = "Mismatched allreduce tensor shapes: one rank sent " +
+              DimsToString(r.dims) + ", another sent " +
+              DimsToString(first.dims) + ".";
+    else if (first.op == OP_BROADCAST &&
+             (r.dims != first.dims || r.root_rank != first.root_rank))
+      error = r.root_rank != first.root_rank
+                  ? "Mismatched broadcast root ranks: one rank requested root " +
+                        std::to_string(r.root_rank) +
+                        ", another requested root " +
+                        std::to_string(first.root_rank) + "."
+                  : "Mismatched broadcast tensor shapes: one rank sent " +
+                        DimsToString(r.dims) + ", another sent " +
+                        DimsToString(first.dims) + ".";
+    else if (first.op == OP_ALLGATHER) {
+      if (r.dims.size() != first.dims.size() || r.dims.empty())
+        error = "Mismatched allgather tensor ranks (all ranks must send "
+                "tensors of the same rank, with rank >= 1).";
+      else
+        for (size_t d = 1; d < r.dims.size(); ++d)
+          if (r.dims[d] != first.dims[d]) {
+            error = "Mismatched allgather tensor shapes: dimensions beyond "
+                    "the first must agree across ranks (" +
+                    DimsToString(r.dims) + " vs " + DimsToString(first.dims) +
+                    ").";
+            break;
+          }
+    }
+  }
+  if (first.op == OP_ALLGATHER && first.dims.empty())
+    error = "Allgather requires tensors of rank >= 1.";
+  if (first.op == OP_BROADCAST &&
+      (first.root_rank < 0 || first.root_rank >= opts_.size))
+    error = "Broadcast root rank " + std::to_string(first.root_rank) +
+            " out of range [0, " + std::to_string(opts_.size) + ").";
+  if (!error.empty()) {
+    resp.type = RESP_ERROR;
+    resp.error_message = error;
+  } else if (first.op == OP_ALLREDUCE) {
+    resp.type = RESP_ALLREDUCE;
+  } else if (first.op == OP_BROADCAST) {
+    resp.type = RESP_BROADCAST;
+  } else {
+    resp.type = RESP_ALLGATHER;
+    resp.rank_dim0.assign(opts_.size, 0);
+    for (const Request& r : reqs) resp.rank_dim0[r.rank] = r.dims[0];
+  }
+  coord_->message_table.erase(it);
+  return resp;
+}
+
+ResponseList Engine::CoordinatorTick() {
+  ResponseList out;
+  out.shutdown = coord_->shutdown_requested;
+  if (coord_->ready.empty()) return out;
+  std::vector<std::string> ready;
+  ready.swap(coord_->ready);
+  std::vector<Response> responses;
+  std::vector<int64_t> nbytes;  // per response, for fusion accounting
+  for (const auto& name : ready) {
+    // Byte size must be computed before BuildResponse erases the table entry.
+    auto& pt = coord_->message_table[name];
+    const Request& first = pt.requests[0];
+    int64_t bytes = NumElements(first.dims) *
+                    static_cast<int64_t>(DataTypeSize(first.dtype));
+    uint8_t dtype = first.dtype;
+    Response r = BuildResponse(name);
+    // Tensor fusion: merge consecutive same-dtype allreduces while the fused
+    // payload stays under the threshold (operations.cc:1607-1642).
+    if (r.type == RESP_ALLREDUCE && !responses.empty() &&
+        responses.back().type == RESP_ALLREDUCE &&
+        responses.back().names.size() < 1024 && last_fused_dtype_ == dtype &&
+        nbytes.back() + bytes <= opts_.fusion_threshold) {
+      responses.back().names.push_back(name);
+      nbytes.back() += bytes;
+    } else {
+      responses.push_back(std::move(r));
+      nbytes.push_back(bytes);
+      last_fused_dtype_ = dtype;
+    }
+  }
+  out.responses = std::move(responses);
+  return out;
+}
+
+void Engine::CheckForStalledTensors() {
+  auto now = std::chrono::steady_clock::now();
+  if (now - last_stall_check_ <
+      std::chrono::duration<double>(opts_.stall_warning_sec))
+    return;
+  last_stall_check_ = now;
+  bool preamble = false;
+  for (const auto& kv : coord_->message_table) {
+    if (now - kv.second.first_seen <
+        std::chrono::duration<double>(opts_.stall_warning_sec))
+      continue;
+    if (!preamble) {
+      fprintf(stderr,
+              "[horovod_tpu] WARNING: One or more tensors were submitted to "
+              "be reduced, gathered or broadcasted by subset of ranks and are "
+              "waiting for remainder of ranks for more than %.0f seconds. "
+              "This may indicate that different ranks are trying to submit "
+              "different tensors or that only subset of ranks is submitting "
+              "tensors, which will cause deadlock.\nStalled ops:\n",
+              opts_.stall_warning_sec);
+      preamble = true;
+    }
+    std::vector<bool> present(opts_.size, false);
+    for (const auto& r : kv.second.requests) present[r.rank] = true;
+    std::string missing;
+    for (int r = 0; r < opts_.size; ++r)
+      if (!present[r]) missing += (missing.empty() ? "" : ", ") + std::to_string(r);
+    fprintf(stderr, "%s [missing ranks: %s]\n", kv.first.c_str(),
+            missing.c_str());
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Execution.
+// ---------------------------------------------------------------------------
+
+void Engine::PerformOperation(const Response& resp) {
+  std::vector<TableEntry> entries;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& name : resp.names) {
+      auto it = table_.find(name);
+      if (it == table_.end()) continue;  // should not happen
+      entries.push_back(std::move(it->second));
+      table_.erase(it);
+    }
+  }
+  if (entries.empty()) return;
+
+  if (resp.type == RESP_ERROR) {
+    for (auto& e : entries) CompleteEntry(e, ST_PRECONDITION, resp.error_message);
+    return;
+  }
+  switch (resp.type) {
+    case RESP_ALLREDUCE:
+      ExecuteAllreduce(resp, entries);
+      break;
+    case RESP_ALLGATHER:
+      ExecuteAllgather(resp, entries[0]);
+      break;
+    case RESP_BROADCAST:
+      ExecuteBroadcast(resp, entries[0]);
+      break;
+    default:
+      for (auto& e : entries)
+        CompleteEntry(e, ST_UNKNOWN, "unknown response type");
+  }
+}
+
+void Engine::ExecuteAllreduce(const Response& resp,
+                              std::vector<TableEntry>& entries) {
+  uint8_t dtype = entries[0].dtype;
+  bool half = (dtype == HVD_FLOAT16 || dtype == HVD_BFLOAT16);
+  uint8_t wire_dtype = half ? HVD_FLOAT32 : dtype;
+  size_t esize = DataTypeSize(dtype);
+  size_t wsize = DataTypeSize(wire_dtype);
+
+  int64_t total_elems = 0;
+  for (auto& e : entries) total_elems += NumElements(e.dims);
+  for (auto& e : entries) timeline_.Start(e.name, "ALLREDUCE");
+
+  std::string err;
+  bool ok = true;
+  if (entries.size() == 1 && !half) {
+    // Single unfused tensor: skip the fusion buffer, reduce in place on the
+    // output (the reference's single-entry in-place path,
+    // operations.cc:1186).
+    TableEntry& e = entries[0];
+    if (e.out != e.in)
+      memcpy(e.out, e.in, static_cast<size_t>(total_elems) * esize);
+    timeline_.ActivityStart(e.name, "RING_ALLREDUCE");
+    ok = RingAllreduce(e.out, total_elems, wire_dtype, &err);
+    timeline_.ActivityEnd(e.name);
+    if (ok && e.average) DivideBuffer(e.out, total_elems, dtype, opts_.size);
+  } else {
+    // Fuse into one contiguous buffer, one ring pass, scatter back out --
+    // the reference's fusion-buffer dance (operations.cc:1109-1186) with
+    // half types widened to f32 for the reduction.
+    if (fusion_buffer_.size() < static_cast<size_t>(total_elems) * wsize)
+      fusion_buffer_.resize(static_cast<size_t>(total_elems) * wsize);
+    char* fb = fusion_buffer_.data();
+    int64_t off = 0;
+    for (auto& e : entries) {
+      timeline_.ActivityStart(e.name, "MEMCPY_IN_FUSION_BUFFER");
+      int64_t n = NumElements(e.dims);
+      if (half)
+        HalfBufToFloat(e.in, reinterpret_cast<float*>(fb) + off, n, dtype);
+      else
+        memcpy(fb + off * esize, e.in, static_cast<size_t>(n) * esize);
+      off += n;
+      timeline_.ActivityEnd(e.name);
+    }
+    timeline_.ActivityStart(entries[0].name, "RING_ALLREDUCE");
+    ok = RingAllreduce(fb, total_elems, wire_dtype, &err);
+    timeline_.ActivityEnd(entries[0].name);
+    if (ok) {
+      off = 0;
+      for (auto& e : entries) {
+        timeline_.ActivityStart(e.name, "MEMCPY_OUT_FUSION_BUFFER");
+        int64_t n = NumElements(e.dims);
+        // `average` is a per-tensor attribute, so divide per segment: fused
+        // neighbours may mix averaged and summed reductions.
+        if (half) {
+          float* seg = reinterpret_cast<float*>(fb) + off;
+          if (e.average) DivideBuffer(seg, n, HVD_FLOAT32, opts_.size);
+          FloatBufToHalf(seg, e.out, n, dtype);
+        } else {
+          memcpy(e.out, fb + off * esize, static_cast<size_t>(n) * esize);
+          if (e.average) DivideBuffer(e.out, n, dtype, opts_.size);
+        }
+        off += n;
+        timeline_.ActivityEnd(e.name);
+      }
+    }
+  }
+  for (auto& e : entries) {
+    timeline_.End(e.name, NumElements(e.dims) * static_cast<int64_t>(esize));
+    if (ok)
+      CompleteEntry(e, ST_OK, "");
+    else
+      CompleteEntry(e, ST_UNKNOWN, "ring allreduce failed: " + err);
+  }
+}
+
+void Engine::ExecuteAllgather(const Response& resp, TableEntry& e) {
+  timeline_.Start(e.name, "ALLGATHER");
+  size_t esize = DataTypeSize(e.dtype);
+  int64_t row_elems = 1;
+  for (size_t d = 1; d < e.dims.size(); ++d) row_elems *= e.dims[d];
+  int64_t row_bytes = row_elems * static_cast<int64_t>(esize);
+
+  std::vector<int64_t> block_bytes(opts_.size);
+  int64_t total_dim0 = 0;
+  for (int r = 0; r < opts_.size; ++r) {
+    block_bytes[r] = resp.rank_dim0[r] * row_bytes;
+    total_dim0 += resp.rank_dim0[r];
+  }
+  int64_t total_bytes = total_dim0 * row_bytes;
+
+  std::shared_ptr<HandleStatus> status;
+  {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    auto it = handles_.find(e.handle);
+    if (it != handles_.end()) status = it->second;
+  }
+  if (!status) return;
+  status->gathered.resize(static_cast<size_t>(total_bytes));
+  status->out_dim0 = total_dim0;
+  char* buf = status->gathered.data();
+  int64_t my_off = 0;
+  for (int r = 0; r < opts_.rank; ++r) my_off += block_bytes[r];
+  timeline_.ActivityStart(e.name, "MEMCPY_IN_FUSION_BUFFER");
+  memcpy(buf + my_off, e.in, static_cast<size_t>(block_bytes[opts_.rank]));
+  timeline_.ActivityEnd(e.name);
+
+  std::string err;
+  timeline_.ActivityStart(e.name, "RING_ALLGATHER");
+  bool ok = RingAllgather(buf, block_bytes, &err);
+  timeline_.ActivityEnd(e.name);
+  if (ok && e.out != nullptr)
+    memcpy(e.out, buf, static_cast<size_t>(total_bytes));
+  timeline_.End(e.name, total_bytes);
+  if (ok)
+    CompleteEntry(e, ST_OK, "");
+  else
+    CompleteEntry(e, ST_UNKNOWN, "ring allgather failed: " + err);
+}
+
+void Engine::ExecuteBroadcast(const Response& resp, TableEntry& e) {
+  timeline_.Start(e.name, "BROADCAST");
+  int64_t nbytes = NumElements(e.dims) * static_cast<int64_t>(DataTypeSize(e.dtype));
+  if (opts_.rank == e.root_rank && e.out != e.in && e.out != nullptr)
+    memcpy(e.out, e.in, static_cast<size_t>(nbytes));
+  void* buf = e.out != nullptr ? e.out : const_cast<void*>(e.in);
+  std::string err;
+  timeline_.ActivityStart(e.name, "RING_BROADCAST");
+  bool ok = RingBroadcast(buf, nbytes, e.root_rank, &err);
+  timeline_.ActivityEnd(e.name);
+  timeline_.End(e.name, nbytes);
+  if (ok)
+    CompleteEntry(e, ST_OK, "");
+  else
+    CompleteEntry(e, ST_UNKNOWN, "ring broadcast failed: " + err);
+}
+
+void Engine::CompleteEntry(const TableEntry& e, int32_t code,
+                           const std::string& error) {
+  std::shared_ptr<HandleStatus> status;
+  {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    auto it = handles_.find(e.handle);
+    if (it != handles_.end()) status = it->second;
+  }
+  if (!status) return;
+  status->error = error;
+  status->code.store(code);
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  handles_cv_.notify_all();
+}
+
+// ---------------------------------------------------------------------------
+// Ring data plane.
+// ---------------------------------------------------------------------------
+
+bool Engine::RingAllreduce(void* buf, int64_t count, uint8_t dtype,
+                           std::string* err) {
+  int N = opts_.size;
+  if (N == 1 || count == 0) return true;
+  size_t esize = DataTypeSize(dtype);
+  char* data = static_cast<char*>(buf);
+  int64_t base = count / N, rem = count % N;
+  auto seg_start = [&](int i) -> int64_t {
+    return i * base + std::min<int64_t>(i, rem);
+  };
+  auto seg_count = [&](int i) -> int64_t { return base + (i < rem ? 1 : 0); };
+  int64_t max_seg = base + (rem ? 1 : 0);
+  std::vector<char> tmp(static_cast<size_t>(max_seg) * esize);
+  int r = opts_.rank;
+  // Phase 1: reduce-scatter.  After N-1 steps rank r owns the fully reduced
+  // segment (r+1) mod N.
+  for (int step = 0; step < N - 1; ++step) {
+    int ss = ((r - step) % N + N) % N;
+    int rs = ((r - step - 1) % N + N) % N;
+    if (!Exchange(right_fd_, data + seg_start(ss) * esize,
+                  static_cast<size_t>(seg_count(ss)) * esize, left_fd_,
+                  tmp.data(), static_cast<size_t>(seg_count(rs)) * esize)) {
+      *err = "neighbour exchange failed (reduce-scatter step " +
+             std::to_string(step) + ")";
+      return false;
+    }
+    AccumulateSum(data + seg_start(rs) * esize, tmp.data(), seg_count(rs),
+                  dtype);
+  }
+  // Phase 2: allgather of reduced segments.
+  for (int step = 0; step < N - 1; ++step) {
+    int ss = ((r + 1 - step) % N + N) % N;
+    int rs = ((r - step) % N + N) % N;
+    if (!Exchange(right_fd_, data + seg_start(ss) * esize,
+                  static_cast<size_t>(seg_count(ss)) * esize, left_fd_,
+                  data + seg_start(rs) * esize,
+                  static_cast<size_t>(seg_count(rs)) * esize)) {
+      *err = "neighbour exchange failed (allgather step " +
+             std::to_string(step) + ")";
+      return false;
+    }
+  }
+  return true;
+}
+
+bool Engine::RingAllgather(char* buf, const std::vector<int64_t>& block_bytes,
+                           std::string* err) {
+  int N = opts_.size;
+  if (N == 1) return true;
+  std::vector<int64_t> off(N, 0);
+  for (int i = 1; i < N; ++i) off[i] = off[i - 1] + block_bytes[i - 1];
+  int r = opts_.rank;
+  for (int step = 0; step < N - 1; ++step) {
+    int ss = ((r - step) % N + N) % N;
+    int rs = ((r - step - 1) % N + N) % N;
+    if (!Exchange(right_fd_, buf + off[ss],
+                  static_cast<size_t>(block_bytes[ss]), left_fd_,
+                  buf + off[rs], static_cast<size_t>(block_bytes[rs]))) {
+      *err = "neighbour exchange failed (allgather step " +
+             std::to_string(step) + ")";
+      return false;
+    }
+  }
+  return true;
+}
+
+bool Engine::RingBroadcast(void* buf, int64_t nbytes, int root,
+                           std::string* err) {
+  int N = opts_.size;
+  if (N == 1 || nbytes == 0) return true;
+  const int64_t kChunk = 1 << 20;  // pipeline at 1 MiB granularity
+  int dist = ((opts_.rank - root) % N + N) % N;
+  bool recv_from_left = dist != 0;
+  bool send_to_right = dist != N - 1;
+  char* p = static_cast<char*>(buf);
+  for (int64_t o = 0; o < nbytes; o += kChunk) {
+    int64_t len = std::min(kChunk, nbytes - o);
+    if (recv_from_left && !RecvAll(left_fd_, p + o, static_cast<size_t>(len))) {
+      *err = "broadcast recv failed";
+      return false;
+    }
+    if (send_to_right && !SendAll(right_fd_, p + o, static_cast<size_t>(len))) {
+      *err = "broadcast send failed";
+      return false;
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Handle API.
+// ---------------------------------------------------------------------------
+
+int Engine::Poll(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return -1;
+  return it->second->code.load() == ST_PENDING ? 0 : 1;
+}
+
+int32_t Engine::Wait(int64_t handle) {
+  std::unique_lock<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return ST_INVALID;
+  auto status = it->second;
+  handles_cv_.wait(lk, [&]() { return status->code.load() != ST_PENDING; });
+  return status->code.load();
+}
+
+int32_t Engine::StatusOf(int64_t handle, std::string* error) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return ST_INVALID;
+  if (error) *error = it->second->error;
+  return it->second->code.load();
+}
+
+int64_t Engine::ResultBytes(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return -1;
+  return static_cast<int64_t>(it->second->gathered.size());
+}
+
+int64_t Engine::ResultDim0(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return -1;
+  return it->second->out_dim0;
+}
+
+bool Engine::CopyResult(int64_t handle, void* dst, int64_t nbytes) {
+  std::shared_ptr<HandleStatus> status;
+  {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    auto it = handles_.find(handle);
+    if (it == handles_.end()) return false;
+    status = it->second;
+  }
+  if (nbytes != static_cast<int64_t>(status->gathered.size())) return false;
+  memcpy(dst, status->gathered.data(), static_cast<size_t>(nbytes));
+  return true;
+}
+
+void Engine::Release(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  handles_.erase(handle);
+}
+
+}  // namespace hvdtpu

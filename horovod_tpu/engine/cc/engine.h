@@ -1,0 +1,172 @@
+// Core collective engine: enqueue -> negotiate -> fuse -> execute -> complete.
+//
+// TPU-native redesign of the reference engine
+// (/root/reference/horovod/common/operations.cc):
+//   * rank/size come from the launcher / pod-slice metadata, not MPI_Init
+//   * control plane: rank-0 TCP coordinator (star), replacing
+//     MPI_Gather/MPI_Bcast negotiation (operations.cc:1541-1678)
+//   * data plane: bandwidth-optimal ring allreduce / allgather / pipelined
+//     broadcast over direct TCP between ring neighbours, replacing
+//     MPI_Allreduce/MPI_Allgatherv/MPI_Bcast (operations.cc:1144,828,1211);
+//     on a TPU pod these host-side collectives ride DCN while the compiled
+//     JAX path (horovod_tpu/jax) rides ICI via XLA collectives.
+//   * completion: polling handle table (the reference's torch handle manager,
+//     /root/reference/horovod/torch/handle_manager.cc, promoted to the core
+//     so every framework binding shares it) -- no CUDA events.
+// Tensor fusion, the coordinator's consistency checks, stall detection and
+// the timeline keep the reference's semantics (operations.cc:1607-1642,
+// :301-503, :1231-1276).
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "timeline.h"
+#include "wire.h"
+
+namespace hvdtpu {
+
+struct EngineOptions {
+  int rank = 0;
+  int size = 1;
+  int local_rank = 0;
+  int local_size = 1;
+  std::string coord_endpoint;               // "host:port" (rank 0 listens)
+  std::vector<std::string> data_endpoints;  // one per rank
+  double cycle_time_ms = 5.0;
+  int64_t fusion_threshold = 64 * 1024 * 1024;
+  double stall_warning_sec = 60.0;
+  std::string timeline_path;
+};
+
+struct HandleStatus {
+  std::atomic<int32_t> code{ST_PENDING};
+  std::string error;
+  // Allgather result storage (engine-owned; copied out by the caller).
+  std::vector<char> gathered;
+  int64_t out_dim0 = 0;
+};
+
+// One enqueued tensor awaiting negotiation + execution.
+struct TableEntry {
+  std::string name;
+  uint8_t op = OP_ALLREDUCE;
+  uint8_t dtype = HVD_FLOAT32;
+  std::vector<int64_t> dims;
+  const void* in = nullptr;
+  void* out = nullptr;
+  int root_rank = -1;
+  bool average = false;
+  bool prescale_applied = false;
+  double prescale = 1.0;
+  int64_t handle = -1;
+  std::chrono::steady_clock::time_point enqueued_at;
+};
+
+class Engine {
+ public:
+  ~Engine();
+
+  // Starts the background thread and blocks until sockets are connected (or
+  // failed).  Returns 0 on success; on failure err holds the reason.
+  int Init(const EngineOptions& opts, std::string* err);
+  void Shutdown();
+
+  bool Initialized() const { return initialized_.load(); }
+  int rank() const { return opts_.rank; }
+  int size() const { return opts_.size; }
+  int local_rank() const { return opts_.local_rank; }
+  int local_size() const { return opts_.local_size; }
+
+  // Returns a handle (>=0) or -1 if the engine is not initialized / shut
+  // down.  For allgather, `out` may be null; the result is kept engine-side
+  // until CopyResult.  `average` divides the allreduce result by size.
+  int64_t Enqueue(uint8_t op, const std::string& name, const void* in,
+                  void* out, const std::vector<int64_t>& dims, uint8_t dtype,
+                  int root_rank, bool average, double prescale = 1.0);
+
+  // 1 = done, 0 = pending, -1 = unknown handle.
+  int Poll(int64_t handle);
+  // Blocks until done; returns status code.
+  int32_t Wait(int64_t handle);
+  int32_t StatusOf(int64_t handle, std::string* error);
+  int64_t ResultBytes(int64_t handle);
+  int64_t ResultDim0(int64_t handle);
+  bool CopyResult(int64_t handle, void* dst, int64_t nbytes);
+  void Release(int64_t handle);
+
+ private:
+  struct Coordinator;  // rank-0 only state
+
+  void BackgroundLoop();
+  bool RunLoopOnce();
+  bool SetupSockets(std::string* err);
+  void TeardownSockets();
+
+  // Coordinator (rank 0) helpers.
+  void CoordinatorHandle(const RequestList& rl, int from_rank);
+  ResponseList CoordinatorTick();
+  Response BuildResponse(const std::string& name);
+  void CheckForStalledTensors();
+
+  // Execution.
+  void PerformOperation(const Response& resp);
+  void ExecuteAllreduce(const Response& resp,
+                        std::vector<TableEntry>& entries);
+  void ExecuteAllgather(const Response& resp, TableEntry& e);
+  void ExecuteBroadcast(const Response& resp, TableEntry& e);
+  void CompleteEntry(const TableEntry& e, int32_t code,
+                     const std::string& error);
+
+  // Data plane primitives (ring over TCP).
+  bool RingAllreduce(void* buf, int64_t count, uint8_t dtype,
+                     std::string* err);
+  bool RingAllgather(char* buf, const std::vector<int64_t>& block_bytes,
+                     std::string* err);
+  bool RingBroadcast(void* buf, int64_t nbytes, int root, std::string* err);
+
+  EngineOptions opts_;
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> shut_down_{false};
+  std::atomic<bool> loop_exited_{false};
+  std::thread background_;
+
+  std::mutex mu_;  // guards queue_, table_, handles_ map shape
+  std::deque<Request> queue_;
+  std::unordered_map<std::string, TableEntry> table_;
+
+  std::mutex handles_mu_;
+  std::condition_variable handles_cv_;
+  std::unordered_map<int64_t, std::shared_ptr<HandleStatus>> handles_;
+  std::atomic<int64_t> next_handle_{0};
+
+  // Sockets.
+  int coord_listen_fd_ = -1;                 // rank 0
+  std::vector<int> coord_fds_;               // rank 0: fd per worker rank
+  int coord_fd_ = -1;                        // workers: fd to rank 0
+  int data_listen_fd_ = -1;
+  int left_fd_ = -1, right_fd_ = -1;         // ring neighbours
+
+  // Fusion buffer (lazily grown; analogue of the reference's persistent
+  // fusion buffer, operations.cc:696-749).
+  std::vector<char> fusion_buffer_;
+  std::vector<char> stage_buffer_;  // f16/bf16 -> f32 staging
+
+  std::unique_ptr<Coordinator> coord_;
+  uint8_t last_fused_dtype_ = 255;  // dtype of the current fusion group
+  Timeline timeline_;
+  std::chrono::steady_clock::time_point last_stall_check_;
+};
+
+Engine* GlobalEngine();
+
+}  // namespace hvdtpu

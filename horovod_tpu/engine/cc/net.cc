@@ -1,0 +1,221 @@
+#include "net.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <thread>
+
+namespace hvdtpu {
+
+namespace {
+
+double NowSec() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void SetCommonOpts(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+bool ResolveAddr(const std::string& host, int port, sockaddr_in* addr) {
+  memset(addr, 0, sizeof(*addr));
+  addr->sin_family = AF_INET;
+  addr->sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host.c_str(), &addr->sin_addr) == 1) return true;
+  struct addrinfo hints, *res = nullptr;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res)
+    return false;
+  addr->sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+  freeaddrinfo(res);
+  return true;
+}
+
+}  // namespace
+
+bool ParseEndpoint(const std::string& ep, std::string* host, int* port) {
+  size_t colon = ep.rfind(':');
+  if (colon == std::string::npos || colon == 0 || colon + 1 >= ep.size())
+    return false;
+  *host = ep.substr(0, colon);
+  char* end = nullptr;
+  long p = strtol(ep.c_str() + colon + 1, &end, 10);
+  if (*end != '\0' || p <= 0 || p > 65535) return false;
+  *port = static_cast<int>(p);
+  return true;
+}
+
+int Listen(const std::string& host, int port, std::string* err) {
+  sockaddr_in addr;
+  if (!ResolveAddr(host, port, &addr)) {
+    *err = "cannot resolve " + host;
+    return -1;
+  }
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    *err = strerror(errno);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    *err = std::string("bind/listen ") + host + ":" + std::to_string(port) +
+           ": " + strerror(errno);
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int AcceptOne(int listen_fd, double timeout_sec, std::string* err) {
+  struct pollfd p = {listen_fd, POLLIN, 0};
+  int r = poll(&p, 1, static_cast<int>(timeout_sec * 1000));
+  if (r <= 0) {
+    *err = r == 0 ? "accept timeout" : strerror(errno);
+    return -1;
+  }
+  int fd = accept(listen_fd, nullptr, nullptr);
+  if (fd < 0) {
+    *err = strerror(errno);
+    return -1;
+  }
+  SetCommonOpts(fd);
+  return fd;
+}
+
+int ConnectRetry(const std::string& host, int port, double timeout_sec,
+                 std::string* err) {
+  sockaddr_in addr;
+  if (!ResolveAddr(host, port, &addr)) {
+    *err = "cannot resolve " + host;
+    return -1;
+  }
+  double deadline = NowSec() + timeout_sec;
+  while (true) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) {
+      *err = strerror(errno);
+      return -1;
+    }
+    if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      SetCommonOpts(fd);
+      return fd;
+    }
+    close(fd);
+    if (NowSec() >= deadline) {
+      *err = std::string("connect ") + host + ":" + std::to_string(port) +
+             " timed out: " + strerror(errno);
+      return -1;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+}
+
+bool SendAll(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool SendFrame(int fd, const std::vector<uint8_t>& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  uint8_t hdr[4] = {static_cast<uint8_t>(len), static_cast<uint8_t>(len >> 8),
+                    static_cast<uint8_t>(len >> 16),
+                    static_cast<uint8_t>(len >> 24)};
+  if (!SendAll(fd, hdr, 4)) return false;
+  return payload.empty() || SendAll(fd, payload.data(), payload.size());
+}
+
+bool RecvFrame(int fd, std::vector<uint8_t>* payload) {
+  uint8_t hdr[4];
+  if (!RecvAll(fd, hdr, 4)) return false;
+  uint32_t len = static_cast<uint32_t>(hdr[0]) |
+                 (static_cast<uint32_t>(hdr[1]) << 8) |
+                 (static_cast<uint32_t>(hdr[2]) << 16) |
+                 (static_cast<uint32_t>(hdr[3]) << 24);
+  payload->resize(len);
+  return len == 0 || RecvAll(fd, payload->data(), len);
+}
+
+bool Exchange(int send_fd, const void* sbuf, size_t slen, int recv_fd,
+              void* rbuf, size_t rlen) {
+  const char* sp = static_cast<const char*>(sbuf);
+  char* rp = static_cast<char*>(rbuf);
+  size_t sent = 0, recvd = 0;
+  // Same fd for both directions is fine: poll events are independent.
+  while (sent < slen || recvd < rlen) {
+    struct pollfd fds[2];
+    int n = 0;
+    int si = -1, ri = -1;
+    if (sent < slen) {
+      fds[n] = {send_fd, POLLOUT, 0};
+      si = n++;
+    }
+    if (recvd < rlen) {
+      fds[n] = {recv_fd, POLLIN, 0};
+      ri = n++;
+    }
+    int r = poll(fds, static_cast<nfds_t>(n), 30000);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (r == 0) return false;  // 30s of total silence: peer is gone
+    if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t w = send(send_fd, sp + sent, slen - sent, MSG_NOSIGNAL);
+      if (w < 0 && errno != EINTR && errno != EAGAIN) return false;
+      if (w > 0) sent += static_cast<size_t>(w);
+    }
+    if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t g = recv(recv_fd, rp + recvd, rlen - recvd, 0);
+      if (g == 0) return false;
+      if (g < 0 && errno != EINTR && errno != EAGAIN) return false;
+      if (g > 0) recvd += static_cast<size_t>(g);
+    }
+  }
+  return true;
+}
+
+void CloseFd(int fd) {
+  if (fd >= 0) close(fd);
+}
+
+}  // namespace hvdtpu

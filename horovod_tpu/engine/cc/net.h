@@ -1,0 +1,47 @@
+// TCP transport for the collective engine: control plane (rank-0 coordinator
+// star) and data plane (ring).  TPU-native replacement for the reference's
+// use of MPI as both planes (/root/reference/horovod/common/operations.cc:
+// 1541-1678 control, :1144/:828/:1211 data) -- on TPU pods the cross-host
+// fabric is plain IP (DCN), so the engine speaks TCP directly and needs no
+// MPI launcher.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+// Parse "host:port".  Returns false on malformed input.
+bool ParseEndpoint(const std::string& ep, std::string* host, int* port);
+
+// Create a listening socket bound to host:port.  Returns fd or -1.
+int Listen(const std::string& host, int port, std::string* err);
+
+// Accept one connection (blocking, with timeout_sec).  Returns fd or -1.
+int AcceptOne(int listen_fd, double timeout_sec, std::string* err);
+
+// Connect to host:port, retrying until timeout_sec elapses (peers may not be
+// up yet -- the analogue of MPI_Init's implicit rendezvous).  fd or -1.
+int ConnectRetry(const std::string& host, int port, double timeout_sec,
+                 std::string* err);
+
+// Blocking full-buffer send/recv.  Return false on error/EOF.
+bool SendAll(int fd, const void* buf, size_t len);
+bool RecvAll(int fd, void* buf, size_t len);
+
+// Length-prefixed message framing ([u32 little-endian length][payload]).
+bool SendFrame(int fd, const std::vector<uint8_t>& payload);
+bool RecvFrame(int fd, std::vector<uint8_t>* payload);
+
+// Full-duplex exchange: send `slen` bytes on send_fd while receiving `rlen`
+// bytes from recv_fd, multiplexed with poll(2) so neighbouring ranks can
+// stream large ring segments to each other without deadlocking on full
+// kernel socket buffers.
+bool Exchange(int send_fd, const void* sbuf, size_t slen,
+              int recv_fd, void* rbuf, size_t rlen);
+
+void CloseFd(int fd);
+
+}  // namespace hvdtpu

@@ -1,0 +1,133 @@
+#include "timeline.h"
+
+#include <cstdio>
+
+namespace hvdtpu {
+
+namespace {
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+}  // namespace
+
+void Timeline::Initialize(const std::string& path) {
+  if (path.empty()) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  file_.open(path, std::ios::out | std::ios::trunc);
+  if (!file_.is_open()) {
+    fprintf(stderr, "[horovod_tpu] WARNING: cannot open timeline file %s\n",
+            path.c_str());
+    return;
+  }
+  file_ << "[\n";
+  start_ = std::chrono::steady_clock::now();
+  last_flush_ = start_;
+  enabled_ = true;
+}
+
+int64_t Timeline::NowUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - start_)
+      .count();
+}
+
+int64_t Timeline::TensorPid(const std::string& name) {
+  auto it = tensor_pids_.find(name);
+  if (it != tensor_pids_.end()) return it->second;
+  int64_t pid = static_cast<int64_t>(tensor_pids_.size()) + 1;
+  tensor_pids_[name] = pid;
+  // Metadata event labels the pid row with the tensor name.
+  file_ << "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":" << pid
+        << ",\"args\":{\"name\":\"" << JsonEscape(name) << "\"}},\n";
+  return pid;
+}
+
+void Timeline::WriteEvent(const std::string& name, char phase,
+                          const std::string& args,
+                          const std::string& category) {
+  int64_t pid = TensorPid(name);
+  file_ << "{\"ph\":\"" << phase << "\",\"ts\":" << NowUs()
+        << ",\"pid\":" << pid << ",\"tid\":0";
+  if (!category.empty())
+    file_ << ",\"name\":\"" << JsonEscape(category) << "\"";
+  if (!args.empty()) file_ << ",\"args\":{" << args << "}";
+  file_ << "},\n";
+  auto now = std::chrono::steady_clock::now();
+  if (now - last_flush_ > std::chrono::seconds(1)) {
+    file_.flush();
+    last_flush_ = now;
+  }
+}
+
+void Timeline::NegotiateStart(const std::string& name, uint8_t op) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  WriteEvent(name, 'B', "", "NEGOTIATE");
+}
+
+void Timeline::NegotiateRankReady(const std::string& name, int rank) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  WriteEvent(name, 'i',
+             "\"rank\":" + std::to_string(rank), "RANK_READY");
+}
+
+void Timeline::NegotiateEnd(const std::string& name) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  WriteEvent(name, 'E', "", "");
+}
+
+void Timeline::Start(const std::string& name, const std::string& op_name) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  WriteEvent(name, 'B', "", op_name);
+}
+
+void Timeline::ActivityStart(const std::string& name,
+                             const std::string& activity) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  WriteEvent(name, 'B', "", activity);
+}
+
+void Timeline::ActivityEnd(const std::string& name) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  WriteEvent(name, 'E', "", "");
+}
+
+void Timeline::End(const std::string& name, int64_t bytes) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  WriteEvent(name, 'E', "\"bytes\":" + std::to_string(bytes), "");
+}
+
+void Timeline::Shutdown() {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!enabled_) return;
+  // Chrome's trace parser tolerates the trailing comma / missing "]".
+  file_.flush();
+  file_.close();
+  enabled_ = false;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,45 @@
+// Chrome-tracing timeline writer.  Same event model as the reference's
+// Horovod Timeline (/root/reference/horovod/common/timeline.{h,cc}): one
+// trace "pid" per tensor name, NEGOTIATE -> op -> activity nesting, JSON
+// written incrementally and flushed periodically; load the output in
+// chrome://tracing or Perfetto.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <fstream>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+namespace hvdtpu {
+
+class Timeline {
+ public:
+  void Initialize(const std::string& path);
+  bool Enabled() const { return enabled_; }
+
+  void NegotiateStart(const std::string& name, uint8_t op);
+  void NegotiateRankReady(const std::string& name, int rank);
+  void NegotiateEnd(const std::string& name);
+  void Start(const std::string& name, const std::string& op_name);
+  void ActivityStart(const std::string& name, const std::string& activity);
+  void ActivityEnd(const std::string& name);
+  void End(const std::string& name, int64_t bytes);
+  void Shutdown();
+
+ private:
+  void WriteEvent(const std::string& name, char phase, const std::string& args,
+                  const std::string& category);
+  int64_t TensorPid(const std::string& name);
+  int64_t NowUs() const;
+
+  bool enabled_ = false;
+  std::ofstream file_;
+  std::mutex mu_;
+  std::unordered_map<std::string, int64_t> tensor_pids_;
+  std::chrono::steady_clock::time_point start_{};
+  std::chrono::steady_clock::time_point last_flush_{};
+};
+
+}  // namespace hvdtpu

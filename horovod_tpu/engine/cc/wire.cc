@@ -1,0 +1,177 @@
+#include "wire.h"
+
+namespace hvdtpu {
+
+size_t DataTypeSize(uint8_t dtype) {
+  switch (dtype) {
+    case HVD_UINT8:
+    case HVD_INT8:
+    case HVD_BOOL:
+      return 1;
+    case HVD_FLOAT16:
+    case HVD_BFLOAT16:
+    case HVD_UINT16:
+      return 2;
+    case HVD_INT32:
+    case HVD_FLOAT32:
+      return 4;
+    case HVD_INT64:
+    case HVD_FLOAT64:
+      return 8;
+    default:
+      return 0;
+  }
+}
+
+const char* DataTypeName(uint8_t dtype) {
+  switch (dtype) {
+    case HVD_UINT8: return "uint8";
+    case HVD_INT8: return "int8";
+    case HVD_INT32: return "int32";
+    case HVD_INT64: return "int64";
+    case HVD_FLOAT16: return "float16";
+    case HVD_FLOAT32: return "float32";
+    case HVD_FLOAT64: return "float64";
+    case HVD_BFLOAT16: return "bfloat16";
+    case HVD_BOOL: return "bool";
+    case HVD_UINT16: return "uint16";
+    default: return "<unknown dtype>";
+  }
+}
+
+const char* OpName(uint8_t op) {
+  switch (op) {
+    case OP_ALLREDUCE: return "allreduce";
+    case OP_ALLGATHER: return "allgather";
+    case OP_BROADCAST: return "broadcast";
+    default: return "<unknown op>";
+  }
+}
+
+namespace {
+
+class Writer {
+ public:
+  std::vector<uint8_t> buf;
+  void U8(uint8_t v) { buf.push_back(v); }
+  void I32(int32_t v) {
+    for (int i = 0; i < 4; ++i) buf.push_back((static_cast<uint32_t>(v) >> (8 * i)) & 0xff);
+  }
+  void U32(uint32_t v) {
+    for (int i = 0; i < 4; ++i) buf.push_back((v >> (8 * i)) & 0xff);
+  }
+  void I64(int64_t v) {
+    for (int i = 0; i < 8; ++i) buf.push_back((static_cast<uint64_t>(v) >> (8 * i)) & 0xff);
+  }
+  void Str(const std::string& s) {
+    U32(static_cast<uint32_t>(s.size()));
+    buf.insert(buf.end(), s.begin(), s.end());
+  }
+};
+
+class Reader {
+ public:
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+  Reader(const std::vector<uint8_t>& b) : p(b.data()), end(b.data() + b.size()) {}
+  bool Need(size_t n) {
+    if (static_cast<size_t>(end - p) < n) { ok = false; return false; }
+    return true;
+  }
+  uint8_t U8() { if (!Need(1)) return 0; return *p++; }
+  uint32_t U32() {
+    if (!Need(4)) return 0;
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) v |= static_cast<uint32_t>(*p++) << (8 * i);
+    return v;
+  }
+  int32_t I32() { return static_cast<int32_t>(U32()); }
+  int64_t I64() {
+    if (!Need(8)) return 0;
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v |= static_cast<uint64_t>(*p++) << (8 * i);
+    return static_cast<int64_t>(v);
+  }
+  std::string Str() {
+    uint32_t n = U32();
+    if (!Need(n)) return "";
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+};
+
+}  // namespace
+
+std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
+  Writer w;
+  w.U8(rl.shutdown ? 1 : 0);
+  w.U32(static_cast<uint32_t>(rl.requests.size()));
+  for (const auto& r : rl.requests) {
+    w.I32(r.rank);
+    w.U8(r.op);
+    w.U8(r.dtype);
+    w.I32(r.root_rank);
+    w.Str(r.name);
+    w.U8(static_cast<uint8_t>(r.dims.size()));
+    for (int64_t d : r.dims) w.I64(d);
+  }
+  return std::move(w.buf);
+}
+
+bool ParseRequestList(const std::vector<uint8_t>& buf, RequestList* rl) {
+  Reader rd(buf);
+  rl->shutdown = rd.U8() != 0;
+  uint32_t n = rd.U32();
+  rl->requests.clear();
+  rl->requests.reserve(n);
+  for (uint32_t i = 0; i < n && rd.ok; ++i) {
+    Request r;
+    r.rank = rd.I32();
+    r.op = rd.U8();
+    r.dtype = rd.U8();
+    r.root_rank = rd.I32();
+    r.name = rd.Str();
+    uint8_t nd = rd.U8();
+    for (uint8_t j = 0; j < nd; ++j) r.dims.push_back(rd.I64());
+    rl->requests.push_back(std::move(r));
+  }
+  return rd.ok;
+}
+
+std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
+  Writer w;
+  w.U8(rl.shutdown ? 1 : 0);
+  w.U32(static_cast<uint32_t>(rl.responses.size()));
+  for (const auto& r : rl.responses) {
+    w.U8(r.type);
+    w.U32(static_cast<uint32_t>(r.names.size()));
+    for (const auto& nm : r.names) w.Str(nm);
+    w.Str(r.error_message);
+    w.U32(static_cast<uint32_t>(r.rank_dim0.size()));
+    for (int64_t d : r.rank_dim0) w.I64(d);
+  }
+  return std::move(w.buf);
+}
+
+bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
+  Reader rd(buf);
+  rl->shutdown = rd.U8() != 0;
+  uint32_t n = rd.U32();
+  rl->responses.clear();
+  rl->responses.reserve(n);
+  for (uint32_t i = 0; i < n && rd.ok; ++i) {
+    Response r;
+    r.type = rd.U8();
+    uint32_t nn = rd.U32();
+    for (uint32_t j = 0; j < nn; ++j) r.names.push_back(rd.Str());
+    r.error_message = rd.Str();
+    uint32_t ns = rd.U32();
+    for (uint32_t j = 0; j < ns; ++j) r.rank_dim0.push_back(rd.I64());
+    rl->responses.push_back(std::move(r));
+  }
+  return rd.ok;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,90 @@
+// Compact binary wire format for the coordinator protocol.  Plays the role of
+// the reference's FlatBuffers MPIRequest/MPIResponse schema
+// (/root/reference/horovod/common/wire/mpi_message.fbs:36-100,
+//  /root/reference/horovod/common/mpi_message.{h,cc}) but hand-rolled:
+// little-endian scalars + length-prefixed strings, no external codegen.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+// Dtype codes -- shared with Python (horovod_tpu/common/dtypes.py).
+enum DataType : uint8_t {
+  HVD_UINT8 = 0,
+  HVD_INT8 = 1,
+  HVD_INT32 = 2,
+  HVD_INT64 = 3,
+  HVD_FLOAT16 = 4,
+  HVD_FLOAT32 = 5,
+  HVD_FLOAT64 = 6,
+  HVD_BFLOAT16 = 7,
+  HVD_BOOL = 8,
+  HVD_UINT16 = 9,
+};
+
+enum OpType : uint8_t {
+  OP_ALLREDUCE = 0,
+  OP_ALLGATHER = 1,
+  OP_BROADCAST = 2,
+};
+
+// Status codes -- shared with Python.
+enum StatusCode : int32_t {
+  ST_OK = 0,
+  ST_UNKNOWN = 1,
+  ST_PRECONDITION = 2,
+  ST_ABORTED = 3,
+  ST_INVALID = 4,
+  ST_PENDING = 5,
+};
+
+size_t DataTypeSize(uint8_t dtype);
+const char* DataTypeName(uint8_t dtype);
+const char* OpName(uint8_t op);
+
+// One rank's readiness announcement for one named tensor.
+struct Request {
+  int32_t rank = 0;
+  uint8_t op = OP_ALLREDUCE;
+  uint8_t dtype = HVD_FLOAT32;
+  int32_t root_rank = -1;  // broadcast only
+  std::string name;
+  std::vector<int64_t> dims;
+};
+
+struct RequestList {
+  bool shutdown = false;
+  std::vector<Request> requests;
+};
+
+enum ResponseType : uint8_t {
+  RESP_ALLREDUCE = 0,
+  RESP_ALLGATHER = 1,
+  RESP_BROADCAST = 2,
+  RESP_ERROR = 3,
+};
+
+// Coordinator verdict: either an (optionally fused) operation every rank must
+// now execute in lockstep, or a typed error for one tensor.
+struct Response {
+  uint8_t type = RESP_ALLREDUCE;
+  std::vector<std::string> names;  // >1 => fused allreduce
+  std::string error_message;
+  // Allgather only: dim-0 size contributed by each rank, indexed by rank.
+  std::vector<int64_t> rank_dim0;
+};
+
+struct ResponseList {
+  bool shutdown = false;
+  std::vector<Response> responses;
+};
+
+std::vector<uint8_t> SerializeRequestList(const RequestList& rl);
+bool ParseRequestList(const std::vector<uint8_t>& buf, RequestList* rl);
+std::vector<uint8_t> SerializeResponseList(const ResponseList& rl);
+bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl);
+
+}  // namespace hvdtpu

@@ -1,0 +1,18 @@
+"""hvdrun: process launcher (the reference's `mpirun` replacement).
+
+The reference delegates launching to an external `mpirun`
+(/root/reference/docs/running.md); TPU pods have no MPI, so horovod_tpu ships
+its own launcher.  It allocates the control/data-plane TCP endpoints, exports
+the HVD_TPU_* environment consumed by horovod_tpu.common.basics, spawns one
+process per rank, and tears the job down if any rank fails.
+
+CLI:  python -m horovod_tpu.runner -np 4 python train.py
+API:  from horovod_tpu.runner import run_command / launch_fn
+"""
+
+from horovod_tpu.runner.launch import (  # noqa: F401
+    RankResult,
+    launch_fn,
+    make_rank_env,
+    run_command,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from horovod_tpu.runner.launch import main
+
+sys.exit(main())

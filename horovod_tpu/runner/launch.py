@@ -1,0 +1,156 @@
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+from typing import Callable, Dict, List, Optional, Sequence
+
+from horovod_tpu.common.basics import pick_free_port
+
+
+@dataclasses.dataclass
+class RankResult:
+    rank: int
+    returncode: int
+    stdout: str
+    stderr: str
+
+
+def make_rank_env(rank: int, size: int, coord: str, data: Sequence[str],
+                  base_env: Optional[Dict[str, str]] = None,
+                  local_rank: Optional[int] = None,
+                  local_size: Optional[int] = None) -> Dict[str, str]:
+    env = dict(base_env if base_env is not None else os.environ)
+    env["HVD_TPU_RANK"] = str(rank)
+    env["HVD_TPU_SIZE"] = str(size)
+    env["HVD_TPU_LOCAL_RANK"] = str(local_rank if local_rank is not None else rank)
+    env["HVD_TPU_LOCAL_SIZE"] = str(local_size if local_size is not None else size)
+    env["HVD_TPU_COORD"] = coord
+    env["HVD_TPU_DATA"] = ",".join(data)
+    return env
+
+
+def allocate_endpoints(size: int, host: str = "127.0.0.1"):
+    coord = f"{host}:{pick_free_port(host)}"
+    data = [f"{host}:{pick_free_port(host)}" for _ in range(size)]
+    return coord, data
+
+
+def run_command(cmd: Sequence[str], np: int,
+                env: Optional[Dict[str, str]] = None,
+                timeout: float = 300.0,
+                capture: bool = False,
+                host: str = "127.0.0.1") -> List[RankResult]:
+    """Launch `cmd` as `np` local ranks; wait for all; kill all on any
+    failure.  Returns per-rank results (stdout/stderr only if capture)."""
+    coord, data = allocate_endpoints(np, host)
+    procs = []
+    for r in range(np):
+        rank_env = make_rank_env(r, np, coord, data, env)
+        procs.append(subprocess.Popen(
+            list(cmd),
+            env=rank_env,
+            stdout=subprocess.PIPE if capture else None,
+            stderr=subprocess.PIPE if capture else None,
+            text=True))
+    import time
+
+    # Poll all ranks; when one fails, give the rest a grace period (the
+    # engine cascades a coordinated shutdown to every rank) and then kill
+    # stragglers -- the fail-fast the reference left to mpirun.
+    deadline = time.monotonic() + timeout
+    grace_deadline = None
+    timed_out = False
+    while any(p.poll() is None for p in procs):
+        now = time.monotonic()
+        if grace_deadline is None and any(
+                p.returncode not in (None, 0) for p in procs):
+            grace_deadline = now + 15.0
+        if now >= deadline or (grace_deadline and now >= grace_deadline):
+            timed_out = now >= deadline
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            break
+        time.sleep(0.05)
+    results = []
+    for r, p in enumerate(procs):
+        out, errout = p.communicate()
+        rc = p.returncode if p.returncode is not None else -9
+        results.append(RankResult(r, rc, out or "", errout or ""))
+    if timed_out:
+        raise subprocess.TimeoutExpired(cmd, timeout)
+    return results
+
+
+_FN_RUNNER = """\
+import pickle, sys
+with open(sys.argv[1], 'rb') as f:
+    fn = pickle.load(f)
+fn()
+"""
+
+
+def launch_fn(fn: Callable[[], None], np: int,
+              env: Optional[Dict[str, str]] = None,
+              timeout: float = 300.0) -> List[RankResult]:
+    """Run a picklable zero-arg callable on every rank (test convenience)."""
+    import pickle
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".pkl", delete=False) as f:
+        pickle.dump(fn, f)
+        pkl = f.name
+    with tempfile.NamedTemporaryFile(
+            mode="w", suffix=".py", delete=False) as f:
+        f.write(_FN_RUNNER)
+        runner = f.name
+    try:
+        return run_command([sys.executable, runner, pkl], np, env=env,
+                           timeout=timeout, capture=True)
+    finally:
+        os.unlink(pkl)
+        os.unlink(runner)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu training job (mpirun replacement).")
+    parser.add_argument("-np", "--num-proc", type=int, required=True,
+                        help="number of ranks to launch on this host")
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="bind address for coordinator/data endpoints")
+    parser.add_argument("--timeout", type=float, default=0.0,
+                        help="kill the job after this many seconds (0 = none)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="training command, e.g. python train.py")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    try:
+        results = run_command(cmd, args.num_proc, host=args.host,
+                              timeout=args.timeout or 3e7)
+    except subprocess.TimeoutExpired:
+        print("hvdrun: job timed out", file=sys.stderr)
+        return 124
+    rc = 0
+    for r in results:
+        if r.returncode != 0:
+            print(f"hvdrun: rank {r.rank} exited with {r.returncode}",
+                  file=sys.stderr)
+            if rc == 0:
+                # Signal deaths have negative returncodes; report 128+sig
+                # like a shell would so the job never masks as success.
+                rc = r.returncode if r.returncode > 0 else 128 - r.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

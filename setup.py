@@ -1,0 +1,44 @@
+"""Package build for horovod_tpu.
+
+Counterpart of the reference's setup.py (/root/reference/setup.py), without
+its MPI/CUDA/NCCL probing: the native engine depends only on POSIX sockets
+and pthreads, and is compiled by horovod_tpu/engine/build.py (invoked here at
+build time, and lazily at first import otherwise).
+"""
+
+import os
+import subprocess
+import sys
+
+from setuptools import find_packages, setup
+from setuptools.command.build_py import build_py
+
+
+class BuildEngineAndPy(build_py):
+    def run(self):
+        subprocess.check_call(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "horovod_tpu", "engine", "build.py")])
+        super().run()
+
+
+setup(
+    name="horovod_tpu",
+    version="0.1.0",
+    description=("TPU-native synchronous data-parallel training framework "
+                 "(Horovod-capability rebuild on JAX/XLA)"),
+    packages=find_packages(include=["horovod_tpu", "horovod_tpu.*"]),
+    package_data={"horovod_tpu.engine": ["cc/*.cc", "cc/*.h", "cc/*.so"]},
+    python_requires=">=3.9",
+    install_requires=["numpy"],
+    extras_require={
+        "jax": ["jax", "flax", "optax"],
+        "torch": ["torch"],
+        "tensorflow": ["tensorflow"],
+    },
+    entry_points={
+        "console_scripts": ["hvdrun = horovod_tpu.runner.launch:main"],
+    },
+    cmdclass={"build_py": BuildEngineAndPy},
+)

@@ -1,0 +1,36 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform *before* jax is imported
+anywhere, so sharding/mesh tests exercise real multi-device SPMD without TPU
+hardware (the strategy the task mandates; the reference instead reran its
+suite under `mpirun -np 2`, /root/reference/.travis.yml:96-103 -- our
+equivalent lives in tests/distributed.py, which respawns ranks as processes).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep XLA's CPU threadpools small: tests run many processes.
+os.environ.setdefault("XLA_CPU_MULTI_THREAD_EIGEN", "false")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def single_process_hvd():
+    """hvd.init() at size 1 (no env), shut down afterwards."""
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA"):
+        os.environ.pop(var, None)
+    import horovod_tpu as hvd
+
+    hvd.init()
+    yield hvd
+    hvd.shutdown()

@@ -1,0 +1,130 @@
+"""Process-model and single-process API tests.
+
+Mirrors the reference's test_common.py (env-truth rank/size checks,
+uninitialized errors; /root/reference/test/test_common.py:26-58)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.basics import ProcessSet, resolve_process_set
+
+
+def test_single_process_defaults(monkeypatch):
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "TPU_WORKER_ID",
+                "TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID"):
+        monkeypatch.delenv(var, raising=False)
+    ps = resolve_process_set()
+    assert (ps.rank, ps.size, ps.local_rank, ps.local_size) == (0, 1, 0, 1)
+
+
+def test_launcher_env_resolution(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_RANK", "2")
+    monkeypatch.setenv("HVD_TPU_SIZE", "4")
+    monkeypatch.setenv("HVD_TPU_LOCAL_RANK", "0")
+    monkeypatch.setenv("HVD_TPU_LOCAL_SIZE", "1")
+    monkeypatch.setenv("HVD_TPU_COORD", "10.0.0.1:1234")
+    monkeypatch.setenv("HVD_TPU_DATA",
+                       "10.0.0.1:70,10.0.0.2:70,10.0.0.3:70,10.0.0.4:70")
+    ps = resolve_process_set()
+    assert ps.rank == 2 and ps.size == 4
+    assert ps.local_rank == 0 and ps.local_size == 1
+    assert ps.coord_endpoint == "10.0.0.1:1234"
+    assert len(ps.data_endpoints) == 4
+
+
+def test_tpu_pod_metadata_resolution(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_RANK", raising=False)
+    monkeypatch.delenv("HVD_TPU_SIZE", raising=False)
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1,host2")
+    ps = resolve_process_set()
+    assert ps.rank == 1 and ps.size == 3
+    assert ps.local_rank == 0 and ps.local_size == 1
+    assert ps.coord_endpoint.startswith("host0:")
+    assert [e.rsplit(":", 1)[0] for e in ps.data_endpoints] == [
+        "host0", "host1", "host2"]
+
+
+def test_rank_subset(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_RANK", "3")
+    monkeypatch.setenv("HVD_TPU_SIZE", "4")
+    monkeypatch.setenv("HVD_TPU_COORD", "h0:1")
+    monkeypatch.setenv("HVD_TPU_DATA", "h0:2,h1:2,h2:2,h3:2")
+    ps = resolve_process_set(ranks=[1, 3])
+    assert ps.rank == 1 and ps.size == 2
+    assert list(ps.data_endpoints) == ["h1:2", "h3:2"]
+    with pytest.raises(ValueError):
+        resolve_process_set(ranks=[0, 2])  # our rank not in subset
+
+
+def test_invalid_process_set():
+    with pytest.raises(ValueError):
+        ProcessSet(rank=2, size=2, local_rank=0, local_size=1).validate()
+    with pytest.raises(ValueError):
+        ProcessSet(rank=0, size=2, local_rank=0, local_size=1).validate()
+
+
+def test_uninitialized_raises():
+    import horovod_tpu as hvd
+
+    if hvd.is_initialized():
+        pytest.skip("engine already initialized in this process")
+    with pytest.raises(ValueError):
+        hvd.rank()
+    with pytest.raises(ValueError):
+        hvd.size()
+
+
+def test_single_process_collectives(single_process_hvd):
+    hvd = single_process_hvd
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.mpi_threads_supported()
+
+    x = np.random.randn(4, 5).astype(np.float32)
+    assert np.array_equal(hvd.allreduce(x, average=False, name="t0"), x)
+    assert np.array_equal(hvd.allreduce(x, average=True, name="t1"), x)
+    assert np.array_equal(hvd.allgather(x, name="t2"), x)
+    assert np.array_equal(hvd.broadcast(x, root_rank=0, name="t3"), x)
+
+
+def test_duplicate_name_rejected(monkeypatch):
+    import horovod_tpu as hvd
+
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA"):
+        monkeypatch.delenv(var, raising=False)
+    # Slow the engine cycle so both enqueues land in the same tick window.
+    monkeypatch.setenv("HVD_TPU_CYCLE_TIME", "100")
+    hvd.init()
+    try:
+        x = np.zeros(1000, np.float32)
+        h1 = hvd.allreduce_async(x, name="dup")
+        h2 = hvd.allreduce_async(x, name="dup")
+        outcomes = []
+        for h in (h1, h2):
+            try:
+                h.wait()
+                outcomes.append("ok")
+            except ValueError:
+                outcomes.append("dup")
+        # The second enqueue must be rejected while the first is pending.
+        assert outcomes == ["ok", "dup"], outcomes
+    finally:
+        hvd.shutdown()
+
+
+def test_config_env(monkeypatch):
+    from horovod_tpu.common.config import Config
+
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1024")
+    monkeypatch.setenv("HVD_TPU_CYCLE_TIME", "2.5")
+    monkeypatch.setenv("HOROVOD_TIMELINE", "/tmp/tl.json")
+    cfg = Config.from_env()
+    assert cfg.fusion_threshold == 1024
+    assert cfg.cycle_time_ms == 2.5
+    assert cfg.timeline_path == "/tmp/tl.json"
