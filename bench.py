@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-101 synthetic-data training throughput per chip.
+
+Reproduces the reference's benchmark protocol
+(/root/reference/docs/benchmarks.md:22-38: tf_cnn_benchmarks ResNet-101,
+batch 64 per accelerator, synthetic ImageNet data) on one TPU chip.  The
+reference's published number is 1656.82 images/sec on 16 Pascal GPUs =
+103.55 images/sec/GPU; `vs_baseline` is our per-chip throughput over that.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+Env knobs: BENCH_MODEL (resnet101|resnet50|mnist), BENCH_BATCH, BENCH_STEPS,
+BENCH_WARMUP, BENCH_IMAGE (side length).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REFERENCE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.md:22-38
+
+
+def main() -> None:
+    import jax
+
+    # BENCH_PLATFORM=cpu forces the CPU backend even where a site hook
+    # pre-registers a TPU platform through jax.config (test environments).
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu import models
+
+    model_name = os.environ.get("BENCH_MODEL", "resnet101")
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    side = int(os.environ.get("BENCH_IMAGE", "224"))
+
+    if model_name == "mnist":
+        model = models.MnistCNN()
+        side, classes = 28, 10
+        shape = (batch, side, side, 1)
+    else:
+        cls = {"resnet50": models.ResNet50, "resnet101": models.ResNet101,
+               "resnet18": models.ResNet18}[model_name]
+        model = cls(num_classes=1000, dtype=jnp.bfloat16)
+        classes = 1000
+        shape = (batch, side, side, 3)
+
+    rng = jax.random.PRNGKey(0)
+    images = jnp.asarray(np.random.RandomState(0).rand(*shape), jnp.float32)
+    labels = jnp.asarray(np.random.RandomState(1).randint(0, classes, batch),
+                         jnp.int32)
+    variables = model.init(rng, images, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    has_bn = bool(batch_stats)
+    dropout_rng = jax.random.PRNGKey(2)
+
+    def loss_fn(params, batch_stats, images, labels):
+        variables = {"params": params}
+        kwargs = {}
+        if has_bn:
+            variables["batch_stats"] = batch_stats
+            kwargs["mutable"] = ["batch_stats"]
+        else:
+            kwargs["rngs"] = {"dropout": dropout_rng}
+        out = model.apply(variables, images, train=True, **kwargs)
+        logits, new_stats = out if has_bn else (out, batch_stats)
+        new_stats = new_stats["batch_stats"] if has_bn else new_stats
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        return loss, new_stats
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, loss
+
+    for _ in range(warmup):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    # Force completion by fetching a value: on remote-tunneled backends
+    # block_until_ready can return before the computation has run.
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    # The final loss depends on every step's params, so one scalar fetch
+    # drains the whole chain.
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), final_loss
+
+    value = batch * steps / dt
+    print(json.dumps({
+        "metric": f"{model_name}_train_images_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / REFERENCE_IMG_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

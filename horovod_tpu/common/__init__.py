@@ -97,6 +97,14 @@ def init(comm: Optional[Sequence[int]] = None) -> None:
         return
     ps = resolve_process_set(comm)
     cfg = Config.from_env()
+    if cfg.hierarchical_allreduce and ps.rank == 0:
+        import warnings
+
+        warnings.warn(
+            "HOROVOD_HIERARCHICAL_ALLREDUCE is set but the engine's ring "
+            "data plane has no hierarchical mode yet; the flag is ignored. "
+            "The compiled JAX path gets the ICI/DCN split from "
+            "horovod_tpu.parallel.hierarchical_mesh instead.")
     timeline = cfg.timeline_path if ps.rank == 0 else ""
     data = ",".join(ps.data_endpoints) if ps.data_endpoints else ""
     rc = lib.hvd_tpu_init(

@@ -328,19 +328,10 @@ void Engine::TeardownSockets() {
 void Engine::Shutdown() {
   if (!initialized_.load()) return;
   shut_down_.store(true);
+  // BackgroundLoop's exit path drains the table and fails pending entries;
+  // after join there is nothing left to complete (new Enqueues are rejected
+  // once loop_exited_ flips under mu_).
   if (background_.joinable()) background_.join();
-  // Fail anything still pending.
-  std::vector<TableEntry> leftovers;
-  {
-    std::lock_guard<std::mutex> lk(mu_);
-    for (auto& kv : table_) leftovers.push_back(kv.second);
-    table_.clear();
-    queue_.clear();
-  }
-  for (auto& e : leftovers)
-    CompleteEntry(e, ST_ABORTED,
-                  "Horovod-TPU has been shut down. This was caused by an "
-                  "exception on one of the ranks or an earlier shutdown.");
   timeline_.Shutdown();
   TeardownSockets();
   initialized_.store(false);
@@ -370,8 +361,7 @@ void Engine::BackgroundLoop() {
 
 int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
                         void* out, const std::vector<int64_t>& dims,
-                        uint8_t dtype, int root_rank, bool average,
-                        double prescale) {
+                        uint8_t dtype, int root_rank, bool average) {
   if (!initialized_.load()) return -1;
   auto status = std::make_shared<HandleStatus>();
   int64_t handle = next_handle_.fetch_add(1);
@@ -388,7 +378,6 @@ int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
   e.out = out;
   e.root_rank = root_rank;
   e.average = average;
-  e.prescale = prescale;
   e.handle = handle;
   e.enqueued_at = std::chrono::steady_clock::now();
   {

@@ -66,8 +66,6 @@ struct TableEntry {
   void* out = nullptr;
   int root_rank = -1;
   bool average = false;
-  bool prescale_applied = false;
-  double prescale = 1.0;
   int64_t handle = -1;
   std::chrono::steady_clock::time_point enqueued_at;
 };
@@ -92,7 +90,7 @@ class Engine {
   // until CopyResult.  `average` divides the allreduce result by size.
   int64_t Enqueue(uint8_t op, const std::string& name, const void* in,
                   void* out, const std::vector<int64_t>& dims, uint8_t dtype,
-                  int root_rank, bool average, double prescale = 1.0);
+                  int root_rank, bool average);
 
   // 1 = done, 0 = pending, -1 = unknown handle.
   int Poll(int64_t handle);

@@ -200,7 +200,12 @@ bool Exchange(int send_fd, const void* sbuf, size_t slen, int recv_fd,
     }
     if (r == 0) return false;  // 30s of total silence: peer is gone
     if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      ssize_t w = send(send_fd, sp + sent, slen - sent, MSG_NOSIGNAL);
+      // MSG_DONTWAIT: the fds are blocking sockets; without it this send
+      // would block until the whole remaining segment is buffered, stalling
+      // the recv leg and deadlocking the ring when segments exceed kernel
+      // socket buffering (all ranks sending, none draining).
+      ssize_t w = send(send_fd, sp + sent, slen - sent,
+                       MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EINTR && errno != EAGAIN) return false;
       if (w > 0) sent += static_cast<size_t>(w);
     }

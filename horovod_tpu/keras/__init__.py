@@ -1,0 +1,139 @@
+"""Keras binding: DistributedOptimizer, value collectives, load_model.
+
+Counterpart of /root/reference/horovod/keras/__init__.py, redesigned for
+Keras 3: the optimizer wrapper dynamically subclasses the wrapped
+optimizer's class — keeping its class name so checkpoints save/load without
+horovod installed (reference lines 30-90 keep the same property) — and
+averages gradients across workers in `apply_gradients`.  `load_model`
+re-wraps any stock or custom optimizer on load (reference lines 150-196).
+Training callbacks live in `horovod_tpu.keras.callbacks`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import keras
+import numpy as np
+
+import horovod_tpu.common as _common
+from horovod_tpu.common import (  # noqa: F401  (process-control re-exports)
+    HorovodInternalError,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+
+
+def _tf_backend() -> bool:
+    return keras.backend.backend() == "tensorflow"
+
+
+def _average_gradients(grads):
+    if _common.size() == 1:
+        return list(grads)
+    if _tf_backend():
+        # Graph-safe path (model.fit traces train_step into a tf.function).
+        import horovod_tpu.tensorflow as hvd_tf
+
+        return [None if g is None else
+                hvd_tf.allreduce(g, average=True,
+                                 name=f"DistributedOptimizer.grad.{i}")
+                for i, g in enumerate(grads)]
+    out = []
+    for i, g in enumerate(grads):
+        if g is None:
+            out.append(None)
+            continue
+        arr = np.asarray(keras.ops.convert_to_numpy(g))
+        arr = _common.allreduce(arr, average=True,
+                                name=f"DistributedOptimizer.grad.{i}")
+        out.append(keras.ops.convert_to_tensor(arr))
+    return out
+
+
+class _DistributedKerasOptimizer:
+    """Method set grafted onto the wrapped optimizer's class."""
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        pairs = list(grads_and_vars)
+        grads = _average_gradients([g for g, _ in pairs])
+        return super(self.__class__, self).apply_gradients(
+            [(g, v) for g, (_, v) in zip(grads, pairs)], *args, **kwargs)
+
+
+def _wrap_optimizer_class(cls):
+    methods = {k: v for k, v in _DistributedKerasOptimizer.__dict__.items()
+               if k not in ("__dict__", "__weakref__")}
+    return type(cls.__name__, (cls,), methods)
+
+
+def DistributedOptimizer(optimizer: keras.optimizers.Optimizer):
+    """Wrap a Keras optimizer so gradients are allreduce-averaged across
+    workers before being applied."""
+    cls = _wrap_optimizer_class(optimizer.__class__)
+    return cls.from_config(optimizer.get_config())
+
+
+def _stock_optimizer_classes():
+    out = []
+    for name in dir(keras.optimizers):
+        obj = getattr(keras.optimizers, name)
+        if isinstance(obj, type) and issubclass(obj, keras.optimizers.Optimizer) \
+                and obj is not keras.optimizers.Optimizer:
+            out.append(obj)
+    return out
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compile: bool = True):
+    """Load a saved model with every stock (or listed custom) optimizer
+    class re-wrapped in DistributedOptimizer."""
+    objects = {cls.__name__: _wrap_optimizer_class(cls)
+               for cls in _stock_optimizer_classes()}
+    for cls in (custom_optimizers or []):
+        objects[cls.__name__] = _wrap_optimizer_class(cls)
+    objects.update(custom_objects or {})
+    return keras.models.load_model(filepath, custom_objects=objects,
+                                   compile=compile)
+
+
+def _value_collective(fn, value, **kw):
+    arr = np.ascontiguousarray(np.asarray(value))
+    return fn(arr, **kw)
+
+
+def allreduce(value, average: bool = True, name: Optional[str] = None):
+    """Allreduce on eager values/arrays (the reference's session-based
+    helper, /root/reference/horovod/keras/__init__.py:104-123)."""
+    return _value_collective(_common.allreduce, value, average=average,
+                             name=name)
+
+
+def allgather(value, name: Optional[str] = None):
+    return _value_collective(_common.allgather, value, name=name)
+
+
+def broadcast(value, root_rank: int, name: Optional[str] = None):
+    return _value_collective(_common.broadcast, value, root_rank=root_rank,
+                             name=name)
+
+
+def broadcast_global_variables(root_rank: int = 0, model=None) -> None:
+    """Broadcast a model's (and its optimizer's) variables from root."""
+    if model is None:
+        raise ValueError("Keras 3 has no global-variable registry; pass "
+                         "model= (or use BroadcastGlobalVariablesCallback)")
+    variables = list(model.weights)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None:
+        variables += list(opt.variables)
+    for i, var in enumerate(variables):
+        arr = np.asarray(keras.ops.convert_to_numpy(var))
+        out = _common.broadcast(arr, root_rank, name=f"broadcast_model.{i}")
+        var.assign(np.asarray(out).reshape(arr.shape))

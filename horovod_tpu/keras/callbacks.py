@@ -1,0 +1,171 @@
+"""Keras training callbacks.
+
+Counterparts of /root/reference/horovod/keras/callbacks.py:
+`BroadcastGlobalVariablesCallback` (rank-0 state replication at train
+start), `MetricAverageCallback` (epoch-end cross-worker metric averaging),
+`LearningRateScheduleCallback` (epoch/batch-granular LR multiplier with
+momentum correction), and `LearningRateWarmupCallback` (the Goyal et al.
+linear warmup ``lr/size → lr``, reference lines 202-259).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import keras
+import numpy as np
+
+import horovod_tpu.common as _common
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast model + optimizer state from ``root_rank`` once, at the
+    start of training (reference lines 8-34)."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_train_begin(self, logs=None):  # noqa: D401
+        if self.broadcast_done:
+            return
+        from horovod_tpu.keras import broadcast_global_variables
+
+        broadcast_global_variables(self.root_rank, model=self.model)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch-end metrics (e.g. validation scores computed on each
+    worker's shard) over all workers (reference lines 37-87)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or _common.size() == 1:
+            return
+        for key in sorted(logs):
+            value = logs[key]
+            if isinstance(value, (int, float, np.floating, np.integer)):
+                out = _common.allreduce(
+                    np.asarray(float(value)), average=True,
+                    name=f"MetricAverageCallback.{key}")
+                logs[key] = float(out)
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Multiply the initial LR by ``multiplier`` (a constant or a function
+    of epoch).  ``staircase=True`` applies at epoch granularity; otherwise
+    per batch using fractional epochs (requires ``steps_per_epoch`` or an
+    inferable one).  When the LR changes and the optimizer carries momentum
+    buffers, they are rescaled by ``old_lr/new_lr`` so the effective update
+    velocity ``lr * m`` stays continuous across the change — the momentum
+    correction of Goyal et al. the reference applies (lines 90-199)."""
+
+    def __init__(self, multiplier: Union[float, Callable[[float], float]],
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None,
+                 initial_lr: Optional[float] = None):
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.initial_lr = initial_lr
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+
+    # -- helpers ----------------------------------------------------------
+
+    def _lr(self) -> float:
+        return float(keras.ops.convert_to_numpy(
+            self.model.optimizer.learning_rate))
+
+    def _set_lr(self, lr: float) -> None:
+        opt = self.model.optimizer
+        old = self._lr()
+        if old == lr:
+            return
+        opt.learning_rate = lr
+        if self.momentum_correction and lr != 0:
+            momentums = getattr(opt, "momentums", None)
+            if momentums:
+                scale = old / lr
+                for buf in momentums:
+                    buf.assign(buf * scale)
+
+    def _in_window(self, epoch: float) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        if self.end_epoch is None:
+            return True
+        # Continuous schedules include the window's right edge so e.g. a
+        # warmup's final batch lands exactly on the full multiplier.
+        return epoch < self.end_epoch if self.staircase \
+            else epoch <= self.end_epoch
+
+    def _apply(self, epoch: float) -> None:
+        if self._in_window(epoch):
+            self._set_lr(self.initial_lr * self.multiplier(epoch))
+
+    # -- keras hooks ------------------------------------------------------
+
+    def on_train_begin(self, logs=None):
+        if self.initial_lr is None:
+            self.initial_lr = self._lr()
+        if not self.staircase and self.steps_per_epoch is None:
+            self.steps_per_epoch = (self.params or {}).get("steps")
+            if self.steps_per_epoch is None:
+                raise ValueError(
+                    "steps_per_epoch is required for batch-granular "
+                    "(staircase=False) LR schedules")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase:
+            self._apply(epoch)
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if not self.staircase:
+            # batch+1 so the final warmup batch reaches the full multiplier.
+            self._apply(self.current_epoch +
+                        (batch + 1) / self.steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = self._lr()
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Linear warmup from ``initial_lr / size`` to ``initial_lr`` over the
+    first ``warmup_epochs`` epochs, batch-granular (reference lines
+    202-259: the large-batch recipe of Goyal et al., arXiv:1706.02677)."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0,
+                 initial_lr: Optional[float] = None):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+        n = max(_common.size(), 1) if _common.is_initialized() else 1
+
+        def multiplier(epoch: float) -> float:
+            progress = min(epoch / warmup_epochs, 1.0) if warmup_epochs else 1.0
+            return 1.0 / n + progress * (1.0 - 1.0 / n)
+
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch,
+                         initial_lr=initial_lr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if self.verbose and epoch == self.warmup_epochs - 1 \
+                and _common.rank() == 0:
+            print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self._lr():.6g}.")

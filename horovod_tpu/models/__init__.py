@@ -1,0 +1,18 @@
+"""Model zoo backing the examples and benchmarks.
+
+The reference ships no model library — its acceptance surface is the
+`examples/` scripts (ResNet-50 via `keras.applications`, MNIST convnets,
+word2vec; /root/reference/examples/).  Those architectures live here as
+first-class flax modules so the examples, the benchmark harness, and the
+driver's graft entry all share one TPU-tuned implementation.
+"""
+
+from horovod_tpu.models.mnist import MnistCNN  # noqa: F401
+from horovod_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)

@@ -1,0 +1,32 @@
+"""MNIST convnet matching the reference example architectures
+(/root/reference/examples/keras_mnist.py:31-41,
+/root/reference/examples/pytorch_mnist.py:49-63): two convs, max-pool,
+dropout, two dense layers."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,18 @@
+"""Device-mesh and sharding utilities: the TPU-native communicator layer.
+
+The reference's communicator model — `mpi_comm` (global), `local_comm`
+(per-node), `cross_comm` (one rank per node)
+(/root/reference/horovod/common/operations.cc:181-189,1364-1389) — maps on
+TPU to a `jax.sharding.Mesh` whose axes separate ICI (chips within a slice)
+from DCN (across slices/hosts).  Collectives laid out along the ICI axis ride
+the high-bandwidth interconnect; the DCN axis carries the hierarchical
+(cross-host) step, exactly the split the reference's hierarchical allreduce
+exploits (/root/reference/horovod/common/operations.cc:1003-1048).
+"""
+
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    data_parallel_mesh,
+    hierarchical_mesh,
+    replicate,
+    shard_batch,
+)

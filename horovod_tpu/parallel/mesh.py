@@ -1,0 +1,59 @@
+"""Mesh construction and data sharding helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def data_parallel_mesh(devices: Optional[Sequence] = None,
+                       axis_name: str = "hvd") -> Mesh:
+    """A 1-D mesh over all (or the given) devices — pure data parallelism,
+    the single strategy the reference implements (SURVEY §2.7)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def hierarchical_mesh(devices: Optional[Sequence] = None,
+                      outer_axis: str = "dcn",
+                      inner_axis: str = "ici",
+                      num_slices: Optional[int] = None) -> Mesh:
+    """A 2-D (hosts/slices × chips-per-slice) mesh.
+
+    The TPU analogue of the reference's `cross_comm` × `local_comm` split:
+    reductions along ``inner_axis`` stay on ICI; the ``outer_axis`` step
+    crosses DCN.  ``num_slices`` defaults to the process count (one process
+    per host) or to the device `slice_index` topology when available.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    if num_slices is None:
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+        num_slices = len(slice_ids) if len(slice_ids) > 1 else (
+            jax.process_count() if jax.process_count() > 1 else 1)
+    if len(devices) % num_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not divide into {num_slices} slices")
+    arr = np.array(devices).reshape(num_slices, len(devices) // num_slices)
+    return Mesh(arr, (outer_axis, inner_axis))
+
+
+def shard_batch(mesh: Mesh, batch, axis_name: Optional[str] = None):
+    """Place a host batch onto the mesh, sharded along its leading dim.
+
+    ``axis_name`` defaults to all mesh axes (fully data-parallel layout over
+    a hierarchical mesh).  The per-worker data sharding the reference gets
+    from `DistributedSampler` / per-rank input pipelines happens here instead
+    via sharded `device_put`.
+    """
+    axes = (axis_name,) if axis_name else tuple(mesh.axis_names)
+    sharding = NamedSharding(mesh, P(axes))
+    return jax.device_put(batch, sharding)
+
+
+def replicate(mesh: Mesh, tree):
+    """Fully replicate a pytree (parameters, optimizer state) on the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)

@@ -1,0 +1,253 @@
+"""TensorFlow binding: collectives, DistributedOptimizer, broadcast hooks.
+
+Counterpart of /root/reference/horovod/tensorflow/__init__.py (allreduce with
+sparse IndexedSlices support, `broadcast_global_variables`,
+`BroadcastGlobalVariablesHook`, `DistributedOptimizer`) redesigned for TF2:
+
+* Collectives run through the shared C++ engine.  Eager tensors take a
+  direct numpy path; inside `tf.function` the op is a `tf.py_function`
+  (host-side, like every engine collective).  Gradients are registered via
+  `tf.custom_gradient` with the same algebra the reference registers for its
+  graph ops (/root/reference/horovod/tensorflow/mpi_ops.py:81-170):
+  allreduce' = allreduce, allgather' = reduce-then-slice, broadcast' =
+  reduce, zeroed off-root.
+* On TPU, TF training should run via the JAX path; this binding serves
+  TF-CPU loops and state replication, the same division of labor as the
+  torch binding.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.common as _common
+from horovod_tpu.common import (  # noqa: F401  (process-control re-exports)
+    HorovodInternalError,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+
+_name_lock = threading.Lock()
+_name_counter = [0]
+
+
+def _auto_name(prefix: str) -> str:
+    with _name_lock:
+        _name_counter[0] += 1
+        return f"{prefix}.HorovodAuto_{_name_counter[0]}"
+
+
+def _np_collective(kind: str, name: str, **kw):
+    def run(x: np.ndarray) -> np.ndarray:
+        if kind == "allreduce":
+            return _common.allreduce(x, average=False, name=name)
+        if kind == "allgather":
+            return _common.allgather(x, name=name)
+        return _common.broadcast(x, kw["root_rank"], name=name)
+    return run
+
+
+def _through_engine(kind: str, tensor: tf.Tensor, name: str, **kw):
+    run = _np_collective(kind, name, **kw)
+    if isinstance(tensor, tf.Tensor) and hasattr(tensor, "numpy"):
+        return tf.constant(run(tensor.numpy()))
+    # Graph (tf.function) mode: host round-trip as a py_function.
+    out = tf.py_function(lambda x: run(x.numpy()), [tensor], tensor.dtype,
+                         name=name.replace(".", "_"))
+    if kind != "allgather":
+        out.set_shape(tensor.shape)
+    else:
+        out.set_shape([None] + list(tensor.shape[1:]))
+    return out
+
+
+def _allreduce(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
+    """Raw sum across ranks (the reference's `_allreduce`,
+    /root/reference/horovod/tensorflow/mpi_ops.py:65-78)."""
+    name = name or _auto_name("allreduce")
+
+    @tf.custom_gradient
+    def op(x):
+        y = _through_engine("allreduce", x, name)
+
+        def grad(dy):
+            return _allreduce(dy, name=f"{name}.grad")
+        return y, grad
+
+    return op(tensor)
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              device_dense: str = "", device_sparse: str = ""):
+    """Average (or sum) across ranks.  `tf.IndexedSlices` are handled as the
+    reference does — allgather values and indices instead of densifying
+    (/root/reference/horovod/tensorflow/__init__.py:50-86)."""
+    if isinstance(tensor, tf.IndexedSlices):
+        values = allgather(tensor.values, name=(name or _auto_name("ar")) + ".values")
+        indices = allgather(tensor.indices, name=(name or _auto_name("ar")) + ".indices")
+        if average:
+            values = tf.math.divide(values, float(_common.size()))
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    summed = _allreduce(tensor, name=name)
+    if average:
+        return tf.math.divide(summed, float(_common.size()))
+    return summed
+
+
+def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
+    """Concatenation of every rank's tensor along dim 0 (ranks may differ in
+    dim 0)."""
+    name = name or _auto_name("allgather")
+
+    @tf.custom_gradient
+    def op(x):
+        y = _through_engine("allgather", x, name)
+        dim0 = tf.shape(x)[0]
+
+        def grad(dy):
+            summed = _allreduce(dy, name=f"{name}.grad")
+            sizes = _through_engine(
+                "allgather", tf.reshape(tf.cast(dim0, tf.int64), [1]),
+                f"{name}.grad.sizes")
+            offset = tf.reduce_sum(sizes[:_common.rank()])
+            return tf.slice(summed, [tf.cast(offset, tf.int32)] +
+                            [0] * (len(x.shape) - 1),
+                            tf.shape(x))
+        return y, grad
+
+    return op(tensor)
+
+
+def broadcast(tensor: tf.Tensor, root_rank: int,
+              name: Optional[str] = None) -> tf.Tensor:
+    """Every rank receives root_rank's value; gradient is summed to the root
+    and zeroed elsewhere (/root/reference/horovod/tensorflow/mpi_ops.py:155-170)."""
+    name = name or _auto_name("broadcast")
+
+    @tf.custom_gradient
+    def op(x):
+        y = _through_engine("broadcast", x, name, root_rank=root_rank)
+
+        def grad(dy):
+            summed = _allreduce(dy, name=f"{name}.grad")
+            if _common.rank() == root_rank:
+                return summed
+            return tf.zeros_like(summed)
+        return y, grad
+
+    return op(tensor)
+
+
+def broadcast_global_variables(root_rank: int = 0):
+    """Assign rank ``root_rank``'s value to every global variable.  Eager:
+    acts immediately on `tf.compat.v1.global_variables()` plus any tracked
+    module variables; graph mode: returns the grouped assign op
+    (/root/reference/horovod/tensorflow/__init__.py:89-98)."""
+    variables = tf.compat.v1.global_variables()
+    return broadcast_variables(variables, root_rank)
+
+
+def broadcast_variables(variables, root_rank: int = 0):
+    ops = []
+    for i, var in enumerate(variables):
+        value = broadcast(tf.convert_to_tensor(var), root_rank,
+                          name=f"broadcast_var.{i}.{var.name.replace(':', '_')}")
+        ops.append(var.assign(value))
+    if ops and isinstance(ops[0], tf.Operation):
+        return tf.group(*ops)
+    return ops
+
+
+class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+    """Session hook broadcasting all global variables from root once after
+    session creation (/root/reference/horovod/tensorflow/__init__.py:100-131)."""
+
+    def __init__(self, root_rank: int = 0, device: str = ""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.bcast_op = None
+        self.device = device
+
+    def begin(self):
+        self.bcast_op = broadcast_global_variables(self.root_rank)
+
+    def after_create_session(self, session, coord):
+        if self.bcast_op is not None:
+            session.run(self.bcast_op)
+
+
+class _DistributedOptimizer(tf.compat.v1.train.Optimizer):
+    """Wraps a `tf.compat.v1.train.Optimizer`; `compute_gradients` returns
+    allreduce-averaged gradients
+    (/root/reference/horovod/tensorflow/__init__.py:134-208)."""
+
+    def __init__(self, optimizer, name=None, use_locking=False,
+                 device_dense="", device_sparse=""):
+        if name is None:
+            name = f"Distributed{type(optimizer).__name__}"
+        super().__init__(name=name, use_locking=use_locking)
+        self._optimizer = optimizer
+        self._device_dense = device_dense
+        self._device_sparse = device_sparse
+
+    def compute_gradients(self, *args, **kwargs):
+        gradients = self._optimizer.compute_gradients(*args, **kwargs)
+        if _common.size() == 1:
+            return gradients
+        averaged = []
+        for i, (grad, var) in enumerate(gradients):
+            if grad is None:
+                averaged.append((None, var))
+                continue
+            averaged.append((allreduce(
+                grad, average=True,
+                name=f"DistributedOptimizer.grad.{i}",
+                device_dense=self._device_dense,
+                device_sparse=self._device_sparse), var))
+        return averaged
+
+    def apply_gradients(self, *args, **kwargs):
+        return self._optimizer.apply_gradients(*args, **kwargs)
+
+    def get_slot(self, *args, **kwargs):
+        return self._optimizer.get_slot(*args, **kwargs)
+
+    def get_slot_names(self, *args, **kwargs):
+        return self._optimizer.get_slot_names(*args, **kwargs)
+
+    def variables(self, *args, **kwargs):
+        return self._optimizer.variables(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, name=None, use_locking=False,
+                         device_dense="", device_sparse=""):
+    return _DistributedOptimizer(optimizer, name, use_locking, device_dense,
+                                 device_sparse)
+
+
+class DistributedGradientTape(tf.GradientTape):
+    """TF2-native gradient averaging: `tape.gradient` results are
+    allreduce-averaged — the eager-mode face of DistributedOptimizer."""
+
+    def __init__(self, persistent=False, watch_accessed_variables=True):
+        super().__init__(persistent=persistent,
+                         watch_accessed_variables=watch_accessed_variables)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = super().gradient(target, sources, output_gradients)
+        if _common.size() == 1:
+            return grads
+        return [None if g is None else
+                allreduce(g, average=True, name=f"DistributedTape.grad.{i}")
+                for i, g in enumerate(grads)]

@@ -1,0 +1,190 @@
+"""PyTorch user API: DistributedOptimizer, parameter/optimizer-state broadcast.
+
+Counterpart of /root/reference/horovod/torch/__init__.py: the optimizer
+wrapper overlaps gradient allreduce with backprop via per-parameter hooks
+(reference lines 64-89), `step()` drains the outstanding handles first, and
+`broadcast_parameters` / `broadcast_optimizer_state` replicate rank 0's
+state at startup (reference lines 127-228).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+import torch
+
+import horovod_tpu.common as _common
+from horovod_tpu.common import (  # noqa: F401  (process-control re-exports)
+    HorovodInternalError,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu.torch.mpi_ops import (  # noqa: F401
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    poll,
+    synchronize,
+)
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Mixin methods grafted onto the wrapped optimizer's class by
+    :func:`DistributedOptimizer` (dynamic-subclass pattern, keeping
+    `isinstance(opt, OriginalClass)` true, as the reference does at
+    /root/reference/horovod/torch/__init__.py:92-124)."""
+
+    def __init__(self, params, named_parameters=None):
+        super(self.__class__, self).__init__(params)
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = []
+        self._param_names = {id(p): name for name, p in named}
+        self._handles = {}
+        self._hook_registrations = []
+        self._register_hooks()
+
+    def _grad_name(self, p) -> str:
+        name = self._param_names.get(id(p))
+        if name is None:
+            # Deterministic across ranks: parameter order in param_groups.
+            idx = 0
+            for group in self.param_groups:
+                for q in group["params"]:
+                    if q is p:
+                        return f"DistributedOptimizer.grad.{idx}"
+                    idx += 1
+            raise ValueError("parameter not found in optimizer param groups")
+        return f"DistributedOptimizer.grad.{name}"
+
+    def _register_hooks(self) -> None:
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    reg = p.register_post_accumulate_grad_hook(
+                        self._make_hook())
+                    self._hook_registrations.append(reg)
+
+    def _make_hook(self):
+        def hook(p):
+            if p in self._handles:
+                return
+            self._handles[p] = allreduce_async_(
+                p.grad.data, average=True, name=self._grad_name(p))
+        return hook
+
+    def synchronize(self) -> None:
+        """Wait for every outstanding gradient allreduce; enqueue any grads
+        whose hook never fired (e.g. grads produced outside autograd)."""
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.grad is not None and p not in self._handles:
+                    self._handles[p] = allreduce_async_(
+                        p.grad.data, average=True, name=self._grad_name(p))
+        for p, handle in list(self._handles.items()):
+            handle.synchronize()
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return super(self.__class__, self).step(closure)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters: Optional[Iterator[Tuple[str, torch.nn.Parameter]]] = None):
+    """Wrap a torch optimizer: gradients are allreduce-averaged across
+    workers as backprop produces them; `step()` waits for them first."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a `state_dict()` or iterable of (name, tensor).
+
+    Reference: /root/reference/horovod/torch/__init__.py:127-158.
+    """
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None:
+            continue
+        if not isinstance(p, torch.Tensor):
+            raise ValueError(
+                f"broadcast_parameters got non-tensor for '{name}'; use "
+                "broadcast_optimizer_state for mixed state")
+        handles.append(broadcast_async_(p.data if hasattr(p, "data") else p,
+                                        root_rank,
+                                        name=f"broadcast_parameters.{name}"))
+    for h in handles:
+        h.synchronize()
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Replicate rank ``root_rank``'s optimizer state dict on every worker,
+    round-tripping scalar hyperparameters through tensors.
+
+    Reference: /root/reference/horovod/torch/__init__.py:161-228 (including
+    the empty-state bootstrap via a zero-gradient dummy step and the LBFGS
+    rejection — LBFGS keeps non-broadcastable closure state).
+    """
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+
+    state_dict = optimizer.state_dict()
+    if not state_dict["state"]:
+        # New optimizers have empty per-param state; materialize it with a
+        # zero-grad step so every rank has the same structure to fill.
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = torch.zeros_like(p)
+        optimizer.step()
+        state_dict = optimizer.state_dict()
+
+    scalars = {}       # key -> broadcast scalar value
+    handles = []
+
+    def visit(prefix: str, container, key) -> None:
+        value = container[key]
+        name = f"broadcast_opt_state.{prefix}"
+        if isinstance(value, torch.Tensor):
+            handles.append(broadcast_async_(value, root_rank, name=name))
+        elif isinstance(value, (bool, int, float)):
+            arr = np.asarray(value)
+            out = _common.broadcast(arr, root_rank, name=name)
+            container[key] = type(value)(out.item())
+            scalars[prefix] = container[key]
+
+    for pid, pstate in sorted(state_dict["state"].items(),
+                              key=lambda kv: str(kv[0])):
+        for key in sorted(pstate, key=str):
+            visit(f"state.{pid}.{key}", pstate, key)
+    for gi, group in enumerate(state_dict["param_groups"]):
+        for key in sorted(group, key=str):
+            if key == "params":
+                continue
+            visit(f"group.{gi}.{key}", group, key)
+    for h in handles:
+        h.synchronize()
+    optimizer.load_state_dict(state_dict)

@@ -1,0 +1,223 @@
+"""PyTorch collective ops: async handles, in-place variants, autograd.
+
+The surface of the reference's torch binding
+(/root/reference/horovod/torch/mpi_ops.py: allreduce{,_async}{,_},
+allgather{,_async}, broadcast{,_async}{,_}, poll, synchronize) rebuilt on the
+shared C++ engine through zero-copy numpy views of CPU tensors — the cffi
+per-dtype function table (/root/reference/horovod/torch/interface.h) is
+unnecessary because dtype travels as a runtime tag.
+
+TPU note: tensors live on host here; the engine moves them over DCN.  Models
+whose compute runs on TPU via the JAX path exchange gradients in compiled
+XLA collectives instead — this binding serves torch-CPU training loops and
+eager state replication (the role of the reference's CudaOnCPU staging path,
+/root/reference/horovod/torch/mpi_ops.cc:72-101).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import torch
+
+import horovod_tpu.common as _common
+
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BF16 = None
+
+# Handles still outstanding; pins tensors against GC while the engine may
+# write to their memory (the reference's _handle_map,
+# /root/reference/horovod/torch/mpi_ops.py:28-31).
+_outstanding = {}
+
+
+def _np_view(tensor: torch.Tensor) -> np.ndarray:
+    """A zero-copy numpy view of a contiguous CPU tensor."""
+    if tensor.dtype == torch.bfloat16:
+        if _BF16 is None:
+            raise ValueError("bfloat16 collectives require ml_dtypes")
+        return tensor.view(torch.uint16).numpy().view(_BF16)
+    return tensor.numpy()
+
+
+def _check_tensor(tensor: torch.Tensor, inplace: bool) -> torch.Tensor:
+    if tensor.device.type != "cpu":
+        raise ValueError(
+            f"horovod_tpu.torch collectives operate on CPU tensors; got "
+            f"device {tensor.device}. TPU-resident compute should use the "
+            f"compiled horovod_tpu.jax path.")
+    if not tensor.is_contiguous():
+        if inplace:
+            raise ValueError(
+                "in-place collectives require a contiguous tensor")
+        tensor = tensor.contiguous()
+    return tensor
+
+
+class TorchHandle:
+    """Outstanding torch collective; resolves to a tensor on synchronize."""
+
+    def __init__(self, inner, result_tensor: Optional[torch.Tensor],
+                 template: Optional[torch.Tensor] = None):
+        self._inner = inner
+        self._result = result_tensor     # pre-bound output (allreduce/bcast)
+        self._template = template        # dtype/shape donor for allgather
+        _outstanding[id(self)] = self
+
+    def poll(self) -> bool:
+        return self._inner.done()
+
+    def synchronize(self) -> torch.Tensor:
+        try:
+            out = self._inner.wait()
+        finally:
+            _outstanding.pop(id(self), None)
+        if self._result is not None:
+            return self._result
+        # Allgather: engine returned a fresh numpy array.
+        t = self._template
+        if t is not None and t.dtype == torch.bfloat16:
+            return torch.from_numpy(out.view(np.uint16).copy()).view(
+                torch.bfloat16)
+        return torch.from_numpy(out)
+
+
+def poll(handle: TorchHandle) -> bool:
+    return handle.poll()
+
+
+def synchronize(handle: TorchHandle) -> torch.Tensor:
+    return handle.synchronize()
+
+
+# --- allreduce ---------------------------------------------------------------
+
+
+def allreduce_async(tensor: torch.Tensor, average: bool = True,
+                    name: Optional[str] = None) -> TorchHandle:
+    tensor = _check_tensor(tensor, inplace=False)
+    output = torch.empty_like(tensor)
+    inner = _common.allreduce_async(_np_view(tensor), average=average,
+                                    name=name, out=_np_view(output))
+    return TorchHandle(inner, output)
+
+
+def allreduce_async_(tensor: torch.Tensor, average: bool = True,
+                     name: Optional[str] = None) -> TorchHandle:
+    tensor = _check_tensor(tensor, inplace=True)
+    view = _np_view(tensor)
+    inner = _common.allreduce_async(view, average=average, name=name,
+                                    out=view)
+    return TorchHandle(inner, tensor)
+
+
+class _AllreduceFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name):
+        ctx.average = average
+        return allreduce_async(tensor, average, name).synchronize()
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        return (allreduce_async(grad_output.contiguous(),
+                                ctx.average).synchronize(), None, None)
+
+
+def allreduce(tensor: torch.Tensor, average: bool = True,
+              name: Optional[str] = None) -> torch.Tensor:
+    """Differentiable allreduce: the gradient is itself allreduced, as in the
+    reference (/root/reference/horovod/torch/mpi_ops.py:83-94)."""
+    return _AllreduceFunction.apply(tensor, average, name)
+
+
+def allreduce_(tensor: torch.Tensor, average: bool = True,
+               name: Optional[str] = None) -> torch.Tensor:
+    return allreduce_async_(tensor, average, name).synchronize()
+
+
+# --- allgather ---------------------------------------------------------------
+
+
+def allgather_async(tensor: torch.Tensor,
+                    name: Optional[str] = None) -> TorchHandle:
+    tensor = _check_tensor(tensor, inplace=False)
+    inner = _common.allgather_async(_np_view(tensor), name=name)
+    return TorchHandle(inner, None, template=tensor)
+
+
+class _AllgatherFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim0 = tensor.shape[0] if tensor.dim() else 0
+        return allgather_async(tensor, name).synchronize()
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # d(concat_r x_r)/dx_me: sum every rank's grad_output, then take this
+        # rank's row block.  Row offsets come from an allgather of per-rank
+        # dim0 (ranks may contribute different dim0).
+        grad_sum = allreduce_async(grad_output.contiguous(),
+                                   average=False).synchronize()
+        sizes = allgather_async(
+            torch.tensor([ctx.dim0], dtype=torch.int64)).synchronize()
+        offset = int(sizes[:_common.rank()].sum())
+        return grad_sum.narrow(0, offset, ctx.dim0), None
+
+
+def allgather(tensor: torch.Tensor,
+              name: Optional[str] = None) -> torch.Tensor:
+    """Differentiable concatenation of every rank's tensor along dim 0."""
+    return _AllgatherFunction.apply(tensor, name)
+
+
+# --- broadcast ---------------------------------------------------------------
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> TorchHandle:
+    tensor = _check_tensor(tensor, inplace=False)
+    output = torch.empty_like(tensor)
+    inner = _common.broadcast_async(_np_view(tensor), root_rank, name=name,
+                                    out=_np_view(output))
+    return TorchHandle(inner, output)
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> TorchHandle:
+    tensor = _check_tensor(tensor, inplace=True)
+    view = _np_view(tensor)
+    inner = _common.broadcast_async(view, root_rank, name=name, out=view)
+    return TorchHandle(inner, tensor)
+
+
+class _BroadcastFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return broadcast_async(tensor, root_rank, name).synchronize()
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad = allreduce_async(grad_output.contiguous(),
+                               average=False).synchronize()
+        if _common.rank() != ctx.root_rank:
+            grad = grad * 0
+        return grad, None, None
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    """Differentiable broadcast; non-root ranks get zero gradient, as in the
+    reference's gradient registration
+    (/root/reference/horovod/tensorflow/mpi_ops.py:155-170)."""
+    return _BroadcastFunction.apply(tensor, root_rank, name)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return broadcast_async_(tensor, root_rank, name).synchronize()

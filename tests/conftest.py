@@ -10,7 +10,12 @@ equivalent lives in tests/distributed.py, which respawns ranks as processes).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Unconditional: the ambient environment may point JAX at a real TPU
+# (JAX_PLATFORMS=axon); the test suite always runs on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Env vars inherited by the rank subprocesses of tests/distributed.py.
+os.environ.pop("TPU_WORKER_HOSTNAMES", None)
+os.environ.pop("TPU_WORKER_ID", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,6 +24,16 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("XLA_CPU_MULTI_THREAD_EIGEN", "false")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import jax  # noqa: E402
+
+    # A site-customize hook in some environments force-registers a TPU
+    # platform through jax.config (overriding JAX_PLATFORMS); undo it before
+    # any backend initializes so the virtual 8-CPU mesh above takes effect.
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # engine/launcher tests run without jax installed
+    pass
 
 import pytest  # noqa: E402
 

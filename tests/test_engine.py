@@ -77,12 +77,15 @@ def test_allreduce_fusion_many_small():
 
 @distributed_test()
 def test_allreduce_large_tensor():
-    """Multi-megabyte payload crosses many ring chunk boundaries."""
+    """Payload whose ring segments exceed kernel socket buffering: all ranks
+    send simultaneously, so the data plane must keep draining its recv leg
+    while its send leg backs up (full-duplex Exchange), or the ring
+    deadlocks."""
     hvd = _init()
     r, n = hvd.rank(), hvd.size()
-    x = np.random.RandomState(r).randn(1 << 20).astype(np.float32)
+    x = np.random.RandomState(r).randn(1 << 23).astype(np.float32)  # 32 MiB
     out = hvd.allreduce(x, average=False, name="big")
-    want = sum(np.random.RandomState(i).randn(1 << 20).astype(np.float32)
+    want = sum(np.random.RandomState(i).randn(1 << 23).astype(np.float32)
                for i in range(n))
     assert np.allclose(out, want, atol=1e-4), r
 

@@ -1,0 +1,159 @@
+"""Keras binding tests.
+
+Mirrors the reference Keras suite (/root/reference/test/test_keras.py):
+load_model round-trips with stock and custom optimizers, plus distributed
+training equivalence and the callback set.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.distributed import distributed_test
+
+
+def _init():
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+    return hvd
+
+
+@distributed_test(np_=2, timeout=400)
+def test_keras_distributed_optimizer_sync():
+    import keras
+
+    hvd = _init()
+    r, n = hvd.rank(), hvd.size()
+    keras.utils.set_random_seed(42)  # identical init on all ranks
+
+    model = keras.Sequential([keras.layers.Input((4,)),
+                              keras.layers.Dense(1)])
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=0.1))
+    assert isinstance(opt, keras.optimizers.SGD)
+    assert opt.__class__.__name__ == "SGD"
+    model.compile(optimizer=opt, loss="mse")
+
+    x = np.random.RandomState(r).randn(8, 4).astype(np.float32)
+    y = np.random.RandomState(100 + r).randn(8, 1).astype(np.float32)
+    model.fit(x, y, batch_size=8, epochs=2, verbose=0)
+
+    # Averaged gradients => identical weights on every rank despite
+    # different local data.
+    w = model.get_weights()[0].reshape(1, -1)
+    gathered = hvd.allgather(w, name="k.sync")
+    for i in range(n):
+        assert np.allclose(gathered[i], gathered[0], atol=1e-6), r
+
+
+@distributed_test(np_=2, timeout=400)
+def test_keras_callbacks_broadcast_and_metric_average():
+    import keras
+
+    hvd = _init()
+    r, n = hvd.rank(), hvd.size()
+    keras.utils.set_random_seed(1000 + r)  # different init per rank
+
+    model = keras.Sequential([keras.layers.Input((3,)),
+                              keras.layers.Dense(2)])
+    model.compile(optimizer=hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.01)), loss="mse")
+
+    from horovod_tpu.keras.callbacks import (BroadcastGlobalVariablesCallback,
+                                             MetricAverageCallback)
+
+    x = np.random.RandomState(r).randn(4, 3).astype(np.float32)
+    y = np.random.RandomState(r).randn(4, 2).astype(np.float32)
+    history = model.fit(
+        x, y, batch_size=4, epochs=1, verbose=0,
+        callbacks=[BroadcastGlobalVariablesCallback(0),
+                   MetricAverageCallback()])
+
+    # Metric averaging: every rank reports the same (averaged) loss.
+    loss = np.asarray(history.history["loss"][-1]).reshape(1)
+    gathered = hvd.allgather(loss, name="k.metric")
+    assert np.allclose(gathered, gathered[0], atol=1e-6), r
+
+
+@distributed_test(np_=2, timeout=400)
+def test_keras_lr_warmup():
+    import keras
+
+    hvd = _init()
+    n = hvd.size()
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([keras.layers.Input((2,)),
+                              keras.layers.Dense(1)])
+    base_lr = 0.1 * n  # the reference recipe: scale LR by size
+    model.compile(optimizer=hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=base_lr)), loss="mse")
+
+    from horovod_tpu.keras.callbacks import LearningRateWarmupCallback
+
+    warmup = LearningRateWarmupCallback(warmup_epochs=2, steps_per_epoch=2)
+    x = np.random.RandomState(0).randn(8, 2).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 1).astype(np.float32)
+    history = model.fit(x, y, batch_size=4, epochs=3, verbose=0,
+                        callbacks=[warmup])
+    lrs = history.history["lr"]
+    # During warmup the LR is below base; by the end it reaches base.
+    assert lrs[0] < base_lr
+    assert np.isclose(lrs[-1], base_lr, rtol=1e-5), lrs
+
+
+def test_keras_load_model_roundtrip(tmp_path, single_process_hvd):
+    import keras
+
+    import horovod_tpu.keras as hvd_keras
+
+    keras.utils.set_random_seed(3)
+    model = keras.Sequential([keras.layers.Input((4,)),
+                              keras.layers.Dense(2)])
+    opt = hvd_keras.DistributedOptimizer(
+        keras.optimizers.Adam(learning_rate=0.003))
+    model.compile(optimizer=opt, loss="mse")
+    x = np.random.randn(8, 4).astype(np.float32)
+    y = np.random.randn(8, 2).astype(np.float32)
+    model.fit(x, y, epochs=1, verbose=0)
+
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+
+    loaded = hvd_keras.load_model(path)
+    assert loaded.optimizer.__class__.__name__ == "Adam"
+    assert float(keras.ops.convert_to_numpy(
+        loaded.optimizer.learning_rate)) == pytest.approx(0.003)
+    for a, b in zip(model.get_weights(), loaded.get_weights()):
+        assert np.allclose(a, b)
+    # Wrapped optimizer still trains after reload.
+    loaded.fit(x, y, epochs=1, verbose=0)
+
+
+def test_keras_momentum_correction(single_process_hvd):
+    import keras
+
+    from horovod_tpu.keras.callbacks import LearningRateScheduleCallback
+
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([keras.layers.Input((2,)),
+                              keras.layers.Dense(1)])
+    opt = keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)
+    model.compile(optimizer=opt, loss="mse")
+    x = np.random.randn(4, 2).astype(np.float32)
+    y = np.random.randn(4, 1).astype(np.float32)
+    model.fit(x, y, epochs=1, verbose=0)  # build momentum buffers
+
+    before = [np.asarray(keras.ops.convert_to_numpy(m)).copy()
+              for m in opt.momentums]
+    cb = LearningRateScheduleCallback(multiplier=0.5, momentum_correction=True)
+    cb.set_model(model)
+    cb.on_train_begin()
+    cb.on_epoch_begin(0)
+    after = [np.asarray(keras.ops.convert_to_numpy(m)) for m in opt.momentums]
+    assert float(keras.ops.convert_to_numpy(opt.learning_rate)) == \
+        pytest.approx(0.05)
+    for b, a in zip(before, after):
+        if np.abs(b).max() > 0:
+            # lr halved => buffers doubled (old_lr/new_lr = 2).
+            assert np.allclose(a, b * 2.0, rtol=1e-5)
