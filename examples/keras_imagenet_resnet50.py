@@ -1,0 +1,143 @@
+"""Distributed Keras ResNet-50 ImageNet training — the headline workload.
+
+Counterpart of /root/reference/examples/keras_imagenet_resnet50.py (the
+BASELINE.json north-star config): ResNet-50, per-worker batch, LR scaled by
+size with gradual warmup then 30/60/80-epoch staircase decay, cross-worker
+metric averaging, rank-0 checkpointing, and resume-from-epoch broadcast.
+
+Run:  python -m horovod_tpu.runner -np 4 -- \
+          python examples/keras_imagenet_resnet50.py --synthetic-batches 8
+Real data: pass --train-dir/--val-dir with an ImageNet directory layout.
+"""
+
+import argparse
+import math
+import os
+
+import keras
+import numpy as np
+
+import horovod_tpu.keras as hvd
+from horovod_tpu.keras import callbacks as hvd_callbacks
+
+parser = argparse.ArgumentParser(description="Keras ImageNet ResNet-50")
+parser.add_argument("--train-dir", default=None,
+                    help="ImageNet train directory (synthetic data if unset)")
+parser.add_argument("--val-dir", default=None)
+parser.add_argument("--checkpoint-format", default="./checkpoint-{epoch}.keras")
+parser.add_argument("--batch-size", type=int, default=32,
+                    help="per-worker training batch size")
+parser.add_argument("--val-batch-size", type=int, default=32)
+parser.add_argument("--epochs", type=int, default=90)
+parser.add_argument("--base-lr", type=float, default=0.0125,
+                    help="per-worker base learning rate")
+parser.add_argument("--warmup-epochs", type=int, default=5)
+parser.add_argument("--momentum", type=float, default=0.9)
+parser.add_argument("--wd", type=float, default=5e-5)
+parser.add_argument("--synthetic-batches", type=int, default=32,
+                    help="per-epoch batches of synthetic data when no "
+                         "--train-dir is given")
+parser.add_argument("--image-size", type=int, default=224)
+args = parser.parse_args()
+
+hvd.init()
+
+resume_from_epoch = 0
+for try_epoch in range(args.epochs, 0, -1):
+    if os.path.exists(args.checkpoint_format.format(epoch=try_epoch)):
+        resume_from_epoch = try_epoch
+        break
+# All workers resume from rank 0's view of the latest checkpoint.
+resume_from_epoch = int(hvd.broadcast(
+    np.asarray(resume_from_epoch), 0, name="resume_from_epoch"))
+
+verbose = 1 if hvd.rank() == 0 else 0
+
+
+def synthetic_dataset(batches, batch_size, image_size, seed):
+    rng = np.random.RandomState(seed)
+    n = batches * batch_size
+    images = rng.rand(n, image_size, image_size, 3).astype(np.float32)
+    labels = keras.utils.to_categorical(rng.randint(0, 1000, n), 1000)
+    return images, labels
+
+
+if args.train_dir:
+    from keras.utils import image_dataset_from_directory
+
+    train_ds = image_dataset_from_directory(
+        args.train_dir, image_size=(args.image_size, args.image_size),
+        batch_size=args.batch_size, label_mode="categorical", seed=42)
+    val_ds = image_dataset_from_directory(
+        args.val_dir, image_size=(args.image_size, args.image_size),
+        batch_size=args.val_batch_size, label_mode="categorical", seed=42)
+    train_data = train_ds.shard(hvd.size(), hvd.rank())
+    val_data = val_ds.shard(hvd.size(), hvd.rank())
+    fit_kwargs = {}
+else:
+    x, y = synthetic_dataset(args.synthetic_batches, args.batch_size,
+                             args.image_size, seed=1234)
+    train_data = (x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()])
+    xv, yv = synthetic_dataset(max(args.synthetic_batches // 4, 1),
+                               args.val_batch_size, args.image_size, seed=4321)
+    val_data = (xv[hvd.rank()::hvd.size()], yv[hvd.rank()::hvd.size()])
+    fit_kwargs = {"batch_size": args.batch_size}
+
+if resume_from_epoch > 0 and hvd.rank() == 0:
+    # Restore on rank 0; the broadcast callback replicates to every worker.
+    model = hvd.load_model(args.checkpoint_format.format(epoch=resume_from_epoch))
+else:
+    model = keras.applications.ResNet50(
+        weights=None, classes=1000,
+        input_shape=(args.image_size, args.image_size, 3))
+    # LR scaled by the worker count (arXiv:1706.02677).
+    opt = keras.optimizers.SGD(learning_rate=args.base_lr * hvd.size(),
+                               momentum=args.momentum,
+                               weight_decay=args.wd)
+    opt = hvd.DistributedOptimizer(opt)
+    model.compile(loss=keras.losses.categorical_crossentropy,
+                  optimizer=opt,
+                  metrics=["accuracy", "top_k_categorical_accuracy"])
+
+callbacks = [
+    hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+    hvd_callbacks.MetricAverageCallback(),
+    # Warmup to base_lr*size over the first epochs, then the standard
+    # ImageNet staircase: x0.1 at 30/60/80.
+    hvd_callbacks.LearningRateWarmupCallback(
+        warmup_epochs=args.warmup_epochs, verbose=verbose),
+    hvd_callbacks.LearningRateScheduleCallback(
+        multiplier=1.0, start_epoch=args.warmup_epochs, end_epoch=30),
+    hvd_callbacks.LearningRateScheduleCallback(
+        multiplier=1e-1, start_epoch=30, end_epoch=60),
+    hvd_callbacks.LearningRateScheduleCallback(
+        multiplier=1e-2, start_epoch=60, end_epoch=80),
+    hvd_callbacks.LearningRateScheduleCallback(
+        multiplier=1e-3, start_epoch=80),
+]
+if hvd.rank() == 0:
+    callbacks.append(keras.callbacks.ModelCheckpoint(args.checkpoint_format))
+
+if isinstance(train_data, tuple):
+    model.fit(train_data[0], train_data[1],
+              callbacks=callbacks,
+              epochs=args.epochs,
+              initial_epoch=resume_from_epoch,
+              verbose=verbose,
+              validation_data=val_data,
+              **fit_kwargs)
+else:
+    model.fit(train_data,
+              callbacks=callbacks,
+              epochs=args.epochs,
+              initial_epoch=resume_from_epoch,
+              verbose=verbose,
+              validation_data=val_data)
+
+if isinstance(val_data, tuple):
+    score = model.evaluate(val_data[0], val_data[1], verbose=0)
+else:
+    score = model.evaluate(val_data, verbose=0)
+if hvd.rank() == 0:
+    print("Validation loss:", score[0])
+    print("Validation accuracy:", score[1])

@@ -1,0 +1,82 @@
+"""Distributed Keras MNIST training with horovod_tpu.
+
+Counterpart of /root/reference/examples/keras_mnist.py: wrap the optimizer in
+hvd.DistributedOptimizer, scale the LR by size, broadcast initial weights via
+callback, shard the epoch by size, checkpoint on rank 0 only.
+
+Run:  python -m horovod_tpu.runner -np 4 -- python examples/keras_mnist.py
+"""
+
+import argparse
+
+import keras
+import numpy as np
+
+import horovod_tpu.keras as hvd
+from horovod_tpu.keras import callbacks as hvd_callbacks
+
+parser = argparse.ArgumentParser(description="Keras MNIST Example")
+parser.add_argument("--batch-size", type=int, default=128)
+parser.add_argument("--epochs", type=int, default=4)
+parser.add_argument("--lr", type=float, default=1.0)
+parser.add_argument("--train-samples", type=int, default=4096)
+args = parser.parse_args()
+
+hvd.init()
+
+
+def synthetic_mnist(n, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    images = rng.rand(n, 28, 28, 1).astype(np.float32) * 0.25
+    for i, y in enumerate(labels):
+        r, c = divmod(int(y), 5)
+        images[i, r * 14:(r + 1) * 14, c * 5:(c + 1) * 5, 0] += 0.75
+    return images, keras.utils.to_categorical(labels, 10)
+
+
+x_train, y_train = synthetic_mnist(args.train_samples, seed=1234)
+x_test, y_test = synthetic_mnist(args.train_samples // 4, seed=4321)
+# Shard the training data by rank.
+x_train = x_train[hvd.rank()::hvd.size()]
+y_train = y_train[hvd.rank()::hvd.size()]
+
+model = keras.Sequential([
+    keras.layers.Conv2D(32, (3, 3), activation="relu",
+                        input_shape=(28, 28, 1)),
+    keras.layers.Conv2D(64, (3, 3), activation="relu"),
+    keras.layers.MaxPooling2D(pool_size=(2, 2)),
+    keras.layers.Dropout(0.25),
+    keras.layers.Flatten(),
+    keras.layers.Dense(128, activation="relu"),
+    keras.layers.Dropout(0.5),
+    keras.layers.Dense(10, activation="softmax"),
+])
+
+# Adjust learning rate based on number of workers.
+opt = keras.optimizers.Adadelta(learning_rate=args.lr * hvd.size())
+opt = hvd.DistributedOptimizer(opt)
+
+model.compile(loss=keras.losses.categorical_crossentropy,
+              optimizer=opt, metrics=["accuracy"])
+
+callbacks = [
+    # Replicate rank 0's initial weights on every worker.
+    hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+]
+# Checkpoint only on rank 0 to prevent conflicting writes.
+if hvd.rank() == 0:
+    callbacks.append(keras.callbacks.ModelCheckpoint(
+        "./checkpoint-{epoch}.keras"))
+
+model.fit(x_train, y_train,
+          batch_size=args.batch_size,
+          callbacks=callbacks,
+          epochs=args.epochs,
+          verbose=1 if hvd.rank() == 0 else 0,
+          validation_data=(x_test, y_test))
+
+score = model.evaluate(x_test, y_test, verbose=0)
+if hvd.rank() == 0:
+    print("Test loss:", score[0])
+    print("Test accuracy:", score[1])

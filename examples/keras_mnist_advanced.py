@@ -1,0 +1,91 @@
+"""Distributed Keras MNIST with the full callback suite.
+
+Counterpart of /root/reference/examples/keras_mnist_advanced.py: broadcast
+callback, cross-worker metric averaging, gradual LR warmup (Goyal et al.),
+epochs scaled down by size so total work is constant as workers are added.
+
+Run:  python -m horovod_tpu.runner -np 4 -- python examples/keras_mnist_advanced.py
+"""
+
+import argparse
+import math
+
+import keras
+import numpy as np
+
+import horovod_tpu.keras as hvd
+from horovod_tpu.keras import callbacks as hvd_callbacks
+
+parser = argparse.ArgumentParser(description="Keras MNIST Advanced Example")
+parser.add_argument("--batch-size", type=int, default=128)
+parser.add_argument("--base-epochs", type=int, default=8,
+                    help="epoch budget at size 1; divided by hvd.size()")
+parser.add_argument("--warmup-epochs", type=int, default=2)
+parser.add_argument("--lr", type=float, default=0.05)
+parser.add_argument("--train-samples", type=int, default=4096)
+args = parser.parse_args()
+
+hvd.init()
+
+
+def synthetic_mnist(n, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    images = rng.rand(n, 28, 28, 1).astype(np.float32) * 0.25
+    for i, y in enumerate(labels):
+        r, c = divmod(int(y), 5)
+        images[i, r * 14:(r + 1) * 14, c * 5:(c + 1) * 5, 0] += 0.75
+    return images, keras.utils.to_categorical(labels, 10)
+
+
+x_train, y_train = synthetic_mnist(args.train_samples, seed=1234)
+x_test, y_test = synthetic_mnist(args.train_samples // 4, seed=4321)
+x_train = x_train[hvd.rank()::hvd.size()]
+y_train = y_train[hvd.rank()::hvd.size()]
+
+# Adjust epochs down and LR up with the worker count: same total work,
+# same effective batch dynamics as the single-worker run.
+epochs = int(math.ceil(args.base_epochs / hvd.size()))
+
+model = keras.Sequential([
+    keras.layers.Conv2D(32, (3, 3), activation="relu",
+                        input_shape=(28, 28, 1)),
+    keras.layers.Conv2D(64, (3, 3), activation="relu"),
+    keras.layers.MaxPooling2D(pool_size=(2, 2)),
+    keras.layers.Dropout(0.25),
+    keras.layers.Flatten(),
+    keras.layers.Dense(128, activation="relu"),
+    keras.layers.Dropout(0.5),
+    keras.layers.Dense(10, activation="softmax"),
+])
+
+opt = keras.optimizers.SGD(learning_rate=args.lr * hvd.size(), momentum=0.9)
+opt = hvd.DistributedOptimizer(opt)
+model.compile(loss=keras.losses.categorical_crossentropy,
+              optimizer=opt, metrics=["accuracy"])
+
+callbacks = [
+    # Replicate rank 0's initial state.
+    hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+    # Average validation metrics across workers' shards.
+    hvd_callbacks.MetricAverageCallback(),
+    # Warm the LR up from lr/size to lr over the first epochs: large
+    # effective batches need it to stay stable (arXiv:1706.02677).
+    hvd_callbacks.LearningRateWarmupCallback(
+        warmup_epochs=args.warmup_epochs, verbose=1),
+]
+if hvd.rank() == 0:
+    callbacks.append(keras.callbacks.ModelCheckpoint(
+        "./checkpoint-{epoch}.keras"))
+
+model.fit(x_train, y_train,
+          batch_size=args.batch_size,
+          callbacks=callbacks,
+          epochs=epochs,
+          verbose=1 if hvd.rank() == 0 else 0,
+          validation_data=(x_test, y_test))
+
+score = model.evaluate(x_test, y_test, verbose=0)
+if hvd.rank() == 0:
+    print("Test loss:", score[0])
+    print("Test accuracy:", score[1])

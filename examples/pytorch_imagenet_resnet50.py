@@ -1,0 +1,263 @@
+"""Distributed PyTorch ResNet-50 ImageNet training.
+
+Counterpart of /root/reference/examples/pytorch_imagenet_resnet50.py: LR
+scaled by size with gradual warmup + 30/60/80 staircase, cross-worker metric
+averaging via allreduce, rank-0 checkpointing, resume-from-epoch broadcast,
+and optimizer-state broadcast on (re)start.
+
+Run:  python -m horovod_tpu.runner -np 4 -- \
+          python examples/pytorch_imagenet_resnet50.py --synthetic-batches 4
+"""
+
+import argparse
+import os
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+import torch.utils.data
+import torch.utils.data.distributed
+
+import horovod_tpu.torch as hvd
+
+try:
+    import torchvision.models as models
+
+    def make_resnet50():
+        return models.resnet50()
+except ImportError:
+    # Self-contained ResNet-50 (v1.5 bottleneck) so the example runs
+    # without torchvision.
+    class Bottleneck(nn.Module):
+        expansion = 4
+
+        def __init__(self, inplanes, planes, stride=1, downsample=None):
+            super().__init__()
+            self.conv1 = nn.Conv2d(inplanes, planes, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(planes)
+            self.conv2 = nn.Conv2d(planes, planes, 3, stride=stride,
+                                   padding=1, bias=False)
+            self.bn2 = nn.BatchNorm2d(planes)
+            self.conv3 = nn.Conv2d(planes, planes * 4, 1, bias=False)
+            self.bn3 = nn.BatchNorm2d(planes * 4)
+            self.downsample = downsample
+            self.stride = stride
+
+        def forward(self, x):
+            identity = x
+            out = F.relu(self.bn1(self.conv1(x)))
+            out = F.relu(self.bn2(self.conv2(out)))
+            out = self.bn3(self.conv3(out))
+            if self.downsample is not None:
+                identity = self.downsample(x)
+            return F.relu(out + identity)
+
+    class ResNet50(nn.Module):
+        def __init__(self, num_classes=1000):
+            super().__init__()
+            self.inplanes = 64
+            self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False)
+            self.bn1 = nn.BatchNorm2d(64)
+            self.maxpool = nn.MaxPool2d(3, stride=2, padding=1)
+            self.layer1 = self._make_layer(64, 3)
+            self.layer2 = self._make_layer(128, 4, stride=2)
+            self.layer3 = self._make_layer(256, 6, stride=2)
+            self.layer4 = self._make_layer(512, 3, stride=2)
+            self.avgpool = nn.AdaptiveAvgPool2d((1, 1))
+            self.fc = nn.Linear(512 * 4, num_classes)
+
+        def _make_layer(self, planes, blocks, stride=1):
+            downsample = None
+            if stride != 1 or self.inplanes != planes * 4:
+                downsample = nn.Sequential(
+                    nn.Conv2d(self.inplanes, planes * 4, 1, stride=stride,
+                              bias=False),
+                    nn.BatchNorm2d(planes * 4))
+            layers = [Bottleneck(self.inplanes, planes, stride, downsample)]
+            self.inplanes = planes * 4
+            layers += [Bottleneck(self.inplanes, planes)
+                       for _ in range(1, blocks)]
+            return nn.Sequential(*layers)
+
+        def forward(self, x):
+            x = self.maxpool(F.relu(self.bn1(self.conv1(x))))
+            x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+            x = torch.flatten(self.avgpool(x), 1)
+            return self.fc(x)
+
+    def make_resnet50():
+        return ResNet50()
+
+parser = argparse.ArgumentParser(description="PyTorch ImageNet ResNet-50")
+parser.add_argument("--train-dir", default=None,
+                    help="ImageNet train directory (synthetic data if unset)")
+parser.add_argument("--val-dir", default=None)
+parser.add_argument("--checkpoint-format", default="checkpoint-{epoch}.pth.tar")
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--val-batch-size", type=int, default=32)
+parser.add_argument("--epochs", type=int, default=90)
+parser.add_argument("--base-lr", type=float, default=0.0125)
+parser.add_argument("--warmup-epochs", type=float, default=5)
+parser.add_argument("--momentum", type=float, default=0.9)
+parser.add_argument("--wd", type=float, default=5e-5)
+parser.add_argument("--seed", type=int, default=42)
+parser.add_argument("--synthetic-batches", type=int, default=16,
+                    help="per-epoch per-worker batches of synthetic data")
+parser.add_argument("--image-size", type=int, default=224)
+args = parser.parse_args()
+
+hvd.init()
+torch.manual_seed(args.seed)
+
+# Restore from the latest checkpoint rank 0 can see; broadcast the decision
+# so every worker resumes from the same epoch.
+resume_from_epoch = 0
+for try_epoch in range(args.epochs, 0, -1):
+    if os.path.exists(args.checkpoint_format.format(epoch=try_epoch)):
+        resume_from_epoch = try_epoch
+        break
+resume_from_epoch = int(hvd.broadcast(
+    torch.tensor(resume_from_epoch), root_rank=0, name="resume_from_epoch"))
+
+verbose = 1 if hvd.rank() == 0 else 0
+
+
+def make_dataset(train, seed):
+    if args.train_dir:
+        import torchvision.transforms as transforms
+        from torchvision import datasets
+
+        tfm = transforms.Compose([
+            transforms.RandomResizedCrop(args.image_size) if train
+            else transforms.CenterCrop(args.image_size),
+            transforms.ToTensor(),
+            transforms.Normalize(mean=[0.485, 0.456, 0.406],
+                                 std=[0.229, 0.224, 0.225]),
+        ])
+        return datasets.ImageFolder(
+            args.train_dir if train else args.val_dir, tfm)
+    rng = np.random.RandomState(seed)
+    n = args.synthetic_batches * args.batch_size * hvd.size()
+    images = torch.from_numpy(
+        rng.rand(n, 3, args.image_size, args.image_size).astype(np.float32))
+    labels = torch.from_numpy(rng.randint(0, 1000, n)).long()
+    return torch.utils.data.TensorDataset(images, labels)
+
+
+train_dataset = make_dataset(train=True, seed=1234)
+val_dataset = make_dataset(train=False, seed=4321)
+
+train_sampler = torch.utils.data.distributed.DistributedSampler(
+    train_dataset, num_replicas=hvd.size(), rank=hvd.rank())
+train_loader = torch.utils.data.DataLoader(
+    train_dataset, batch_size=args.batch_size, sampler=train_sampler)
+val_sampler = torch.utils.data.distributed.DistributedSampler(
+    val_dataset, num_replicas=hvd.size(), rank=hvd.rank())
+val_loader = torch.utils.data.DataLoader(
+    val_dataset, batch_size=args.val_batch_size, sampler=val_sampler)
+
+model = make_resnet50()
+
+optimizer = torch.optim.SGD(model.parameters(),
+                            lr=args.base_lr * hvd.size(),
+                            momentum=args.momentum, weight_decay=args.wd)
+optimizer = hvd.DistributedOptimizer(
+    optimizer, named_parameters=model.named_parameters())
+
+if resume_from_epoch > 0 and hvd.rank() == 0:
+    checkpoint = torch.load(
+        args.checkpoint_format.format(epoch=resume_from_epoch))
+    model.load_state_dict(checkpoint["model"])
+    optimizer.load_state_dict(checkpoint["optimizer"])
+
+# Replicate rank 0's (possibly restored) state on every worker.
+hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+
+def adjust_learning_rate(epoch, batch_idx):
+    """Warmup from base_lr to base_lr*size, then 30/60/80 staircase."""
+    if epoch < args.warmup_epochs:
+        epoch_f = epoch + float(batch_idx + 1) / len(train_loader)
+        lr_adj = (1.0 / hvd.size()
+                  * (epoch_f * (hvd.size() - 1) / args.warmup_epochs + 1))
+    elif epoch < 30:
+        lr_adj = 1.0
+    elif epoch < 60:
+        lr_adj = 1e-1
+    elif epoch < 80:
+        lr_adj = 1e-2
+    else:
+        lr_adj = 1e-3
+    for param_group in optimizer.param_groups:
+        param_group["lr"] = args.base_lr * hvd.size() * lr_adj
+
+
+def accuracy(output, target):
+    pred = output.max(1, keepdim=True)[1]
+    return pred.eq(target.view_as(pred)).float().mean()
+
+
+class Metric:
+    """Running cross-worker average of a scalar (reference's Metric pattern,
+    /root/reference/examples/pytorch_imagenet_resnet50.py:227-239)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.sum = torch.tensor(0.0)
+        self.n = torch.tensor(0.0)
+
+    def update(self, val):
+        self.sum += hvd.allreduce(val.detach().cpu(), name=self.name)
+        self.n += 1
+
+    @property
+    def avg(self):
+        return (self.sum / max(self.n, torch.tensor(1.0))).item()
+
+
+def train(epoch):
+    model.train()
+    train_sampler.set_epoch(epoch)
+    train_loss = Metric("train_loss")
+    train_accuracy = Metric("train_accuracy")
+    for batch_idx, (data, target) in enumerate(train_loader):
+        adjust_learning_rate(epoch, batch_idx)
+        optimizer.zero_grad()
+        output = model(data)
+        loss = F.cross_entropy(output, target)
+        loss.backward()
+        optimizer.step()
+        train_loss.update(loss)
+        train_accuracy.update(accuracy(output, target))
+        if verbose and batch_idx % 10 == 0:
+            print(f"Epoch {epoch} [{batch_idx}/{len(train_loader)}] "
+                  f"loss {train_loss.avg:.4f} acc {train_accuracy.avg:.4f}")
+
+
+def validate(epoch):
+    model.eval()
+    val_loss = Metric("val_loss")
+    val_accuracy = Metric("val_accuracy")
+    with torch.no_grad():
+        for data, target in val_loader:
+            output = model(data)
+            val_loss.update(F.cross_entropy(output, target))
+            val_accuracy.update(accuracy(output, target))
+    if verbose:
+        print(f"Epoch {epoch} validation: loss {val_loss.avg:.4f} "
+              f"acc {val_accuracy.avg:.4f}")
+
+
+def save_checkpoint(epoch):
+    if hvd.rank() == 0:
+        torch.save({"model": model.state_dict(),
+                    "optimizer": optimizer.state_dict()},
+                   args.checkpoint_format.format(epoch=epoch + 1))
+
+
+for epoch in range(resume_from_epoch, args.epochs):
+    train(epoch)
+    validate(epoch)
+    save_checkpoint(epoch)

@@ -1,0 +1,96 @@
+"""Distributed TensorFlow (TF2) MNIST training with horovod_tpu.
+
+TF2-native rewrite of the reference's acceptance script
+(/root/reference/examples/tensorflow_mnist.py, which used tf.contrib layers +
+MonitoredTrainingSession): same recipe — init, shard the data by rank, scale
+the LR by size, average gradients across workers, broadcast initial variables
+from rank 0, checkpoint only on rank 0.
+
+Run:  python -m horovod_tpu.runner -np 4 -- python examples/tensorflow_mnist.py
+Synthetic MNIST-like data by default (no downloads needed).
+"""
+
+import argparse
+import os
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+parser = argparse.ArgumentParser(description="TensorFlow MNIST Example")
+parser.add_argument("--batch-size", type=int, default=100)
+parser.add_argument("--steps", type=int, default=200)
+parser.add_argument("--lr", type=float, default=0.001)
+parser.add_argument("--train-samples", type=int, default=4096)
+parser.add_argument("--checkpoint-dir", default="./checkpoints")
+args = parser.parse_args()
+
+hvd.init()
+tf.random.set_seed(42 + hvd.rank())
+
+
+def synthetic_mnist(n, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    images = rng.rand(n, 28, 28, 1).astype(np.float32) * 0.25
+    for i, y in enumerate(labels):
+        r, c = divmod(int(y), 5)
+        images[i, r * 14:(r + 1) * 14, c * 5:(c + 1) * 5, 0] += 0.75
+    return images, labels.astype(np.int64)
+
+
+images, labels = synthetic_mnist(args.train_samples, seed=1234)
+# Shard the dataset by rank (the role DistributedSampler plays for torch).
+dataset = (tf.data.Dataset.from_tensor_slices((images, labels))
+           .shard(hvd.size(), hvd.rank())
+           .shuffle(1024, seed=42)
+           .repeat()
+           .batch(args.batch_size))
+
+model = tf.keras.Sequential([
+    tf.keras.layers.Conv2D(32, 5, activation="relu"),
+    tf.keras.layers.MaxPooling2D(2),
+    tf.keras.layers.Conv2D(64, 5, activation="relu"),
+    tf.keras.layers.MaxPooling2D(2),
+    tf.keras.layers.Flatten(),
+    tf.keras.layers.Dense(1024, activation="relu"),
+    tf.keras.layers.Dropout(0.4),
+    tf.keras.layers.Dense(10),
+])
+loss_obj = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+
+# Scale learning rate by the number of workers.
+opt = tf.keras.optimizers.SGD(args.lr * hvd.size())
+
+
+@tf.function
+def train_step(images, labels):
+    with tf.GradientTape() as tape:
+        logits = model(images, training=True)
+        loss = loss_obj(labels, logits)
+    grads = tape.gradient(loss, model.trainable_variables)
+    # Average gradients across workers through the collective engine.
+    grads = [hvd.allreduce(g, average=True, name=f"grad.{i}")
+             for i, g in enumerate(grads)]
+    opt.apply_gradients(zip(grads, model.trainable_variables))
+    return loss
+
+
+ckpt_dir = args.checkpoint_dir if hvd.rank() == 0 else None
+checkpoint = tf.train.Checkpoint(model=model, optimizer=opt)
+
+for step, (batch_images, batch_labels) in enumerate(
+        dataset.take(args.steps // hvd.size())):
+    loss = train_step(batch_images, batch_labels)
+    if step == 0:
+        # Replicate rank 0's initial variable values on every worker
+        # (after the first step has created the optimizer slots).
+        hvd.broadcast_variables(model.variables, root_rank=0)
+        hvd.broadcast_variables(opt.variables, root_rank=0)
+    if step % 10 == 0 and hvd.rank() == 0:
+        print(f"Step #{step}\tLoss: {float(loss):.6f}")
+
+# Checkpoint only on rank 0 so workers don't corrupt each other's writes.
+if ckpt_dir:
+    checkpoint.save(os.path.join(ckpt_dir, "ckpt"))

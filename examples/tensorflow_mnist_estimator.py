@@ -1,0 +1,122 @@
+"""Distributed tf.estimator MNIST training with horovod_tpu.
+
+Counterpart of /root/reference/examples/tensorflow_mnist_estimator.py: a
+`tf.estimator.Estimator` whose `model_fn` wraps the optimizer in
+`hvd.DistributedOptimizer`, with `BroadcastGlobalVariablesHook` replicating
+rank 0's variables after session creation and a model_dir only on rank 0.
+
+Run:  python -m horovod_tpu.runner -np 2 -- \
+          python examples/tensorflow_mnist_estimator.py
+Requires tf.estimator (present through TF 2.15; on newer TF use
+examples/tensorflow_mnist.py instead).
+"""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+if not hasattr(tf, "estimator"):
+    raise SystemExit(
+        "tf.estimator was removed from this TensorFlow build (>= 2.16); "
+        "use examples/tensorflow_mnist.py (the TF2-native loop) instead.")
+
+parser = argparse.ArgumentParser(description="TF Estimator MNIST Example")
+parser.add_argument("--batch-size", type=int, default=100)
+parser.add_argument("--steps", type=int, default=200)
+parser.add_argument("--lr", type=float, default=0.001)
+parser.add_argument("--train-samples", type=int, default=4096)
+parser.add_argument("--model-dir", default="./mnist_convnet_model")
+args = parser.parse_args()
+
+
+def cnn_model_fn(features, labels, mode):
+    """Conv-pool x2 -> dense -> logits, the reference's architecture."""
+    input_layer = tf.reshape(features["x"], [-1, 28, 28, 1])
+    conv1 = tf.compat.v1.layers.conv2d(input_layer, 32, [5, 5],
+                                       padding="same",
+                                       activation=tf.nn.relu)
+    pool1 = tf.compat.v1.layers.max_pooling2d(conv1, [2, 2], 2)
+    conv2 = tf.compat.v1.layers.conv2d(pool1, 64, [5, 5], padding="same",
+                                       activation=tf.nn.relu)
+    pool2 = tf.compat.v1.layers.max_pooling2d(conv2, [2, 2], 2)
+    pool2_flat = tf.reshape(pool2, [-1, 7 * 7 * 64])
+    dense = tf.compat.v1.layers.dense(pool2_flat, 1024,
+                                      activation=tf.nn.relu)
+    dropout = tf.compat.v1.layers.dropout(
+        dense, rate=0.4, training=mode == tf.estimator.ModeKeys.TRAIN)
+    logits = tf.compat.v1.layers.dense(dropout, 10)
+
+    predictions = {
+        "classes": tf.argmax(input=logits, axis=1),
+        "probabilities": tf.nn.softmax(logits, name="softmax_tensor"),
+    }
+    if mode == tf.estimator.ModeKeys.PREDICT:
+        return tf.estimator.EstimatorSpec(mode=mode, predictions=predictions)
+
+    loss = tf.compat.v1.losses.sparse_softmax_cross_entropy(
+        labels=labels, logits=logits)
+
+    if mode == tf.estimator.ModeKeys.TRAIN:
+        # Scale LR by size; average gradients across workers.
+        optimizer = tf.compat.v1.train.MomentumOptimizer(
+            learning_rate=args.lr * hvd.size(), momentum=0.9)
+        optimizer = hvd.DistributedOptimizer(optimizer)
+        train_op = optimizer.minimize(
+            loss=loss, global_step=tf.compat.v1.train.get_global_step())
+        return tf.estimator.EstimatorSpec(mode=mode, loss=loss,
+                                          train_op=train_op)
+
+    eval_metric_ops = {"accuracy": tf.compat.v1.metrics.accuracy(
+        labels=labels, predictions=predictions["classes"])}
+    return tf.estimator.EstimatorSpec(mode=mode, loss=loss,
+                                      eval_metric_ops=eval_metric_ops)
+
+
+def synthetic_mnist(n, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    images = rng.rand(n, 28 * 28).astype(np.float32) * 0.25
+    grid = images.reshape(n, 28, 28)
+    for i, y in enumerate(labels):
+        r, c = divmod(int(y), 5)
+        grid[i, r * 14:(r + 1) * 14, c * 5:(c + 1) * 5] += 0.75
+    return grid.reshape(n, 28 * 28), labels.astype(np.int32)
+
+
+def main(_):
+    hvd.init()
+
+    train_data, train_labels = synthetic_mnist(args.train_samples, seed=1234)
+    eval_data, eval_labels = synthetic_mnist(args.train_samples // 4,
+                                             seed=4321)
+    # Shard by rank.
+    train_data = train_data[hvd.rank()::hvd.size()]
+    train_labels = train_labels[hvd.rank()::hvd.size()]
+
+    # Only rank 0 writes checkpoints; others pass a None model_dir.
+    model_dir = args.model_dir if hvd.rank() == 0 else None
+    mnist_classifier = tf.estimator.Estimator(
+        model_fn=cnn_model_fn, model_dir=model_dir)
+
+    train_input_fn = tf.compat.v1.estimator.inputs.numpy_input_fn(
+        x={"x": train_data}, y=train_labels,
+        batch_size=args.batch_size, num_epochs=None, shuffle=True)
+    # Broadcast initial variables from rank 0 after session creation;
+    # divide steps by size (workers share the work).
+    bcast_hook = hvd.BroadcastGlobalVariablesHook(0)
+    mnist_classifier.train(input_fn=train_input_fn,
+                           steps=args.steps // hvd.size(),
+                           hooks=[bcast_hook])
+
+    eval_input_fn = tf.compat.v1.estimator.inputs.numpy_input_fn(
+        x={"x": eval_data}, y=eval_labels, num_epochs=1, shuffle=False)
+    eval_results = mnist_classifier.evaluate(input_fn=eval_input_fn)
+    if hvd.rank() == 0:
+        print(eval_results)
+
+
+if __name__ == "__main__":
+    tf.compat.v1.app.run()

@@ -1,0 +1,113 @@
+"""Distributed word2vec (skip-gram) training with horovod_tpu.
+
+Counterpart of /root/reference/examples/tensorflow_word2vec.py.  The
+embedding gradients are `tf.IndexedSlices`, so this script exercises the
+sparse allreduce path (allgather of values+indices instead of densifying,
+as in /root/reference/horovod/tensorflow/__init__.py:68-79).
+
+Run:  python -m horovod_tpu.runner -np 2 -- python examples/tensorflow_word2vec.py
+Synthetic Zipf-distributed corpus by default (no downloads needed).
+"""
+
+import argparse
+import collections
+import random
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+parser = argparse.ArgumentParser(description="TensorFlow word2vec Example")
+parser.add_argument("--batch-size", type=int, default=128)
+parser.add_argument("--steps", type=int, default=200)
+parser.add_argument("--embedding-size", type=int, default=64)
+parser.add_argument("--vocabulary-size", type=int, default=500)
+parser.add_argument("--skip-window", type=int, default=1)
+parser.add_argument("--num-skips", type=int, default=2)
+parser.add_argument("--num-sampled", type=int, default=16)
+parser.add_argument("--corpus-words", type=int, default=20000)
+parser.add_argument("--lr", type=float, default=1.0)
+args = parser.parse_args()
+
+hvd.init()
+
+# Each worker sees a different slice of the corpus (different seed), the
+# role the reference's random starting offsets play.
+rng = np.random.RandomState(1000 + hvd.rank())
+data = rng.zipf(1.5, args.corpus_words).clip(0, args.vocabulary_size - 1)
+
+data_index = 0
+
+
+def generate_batch(batch_size, num_skips, skip_window):
+    """Standard skip-gram batcher over the local corpus slice."""
+    global data_index
+    assert batch_size % num_skips == 0 and num_skips <= 2 * skip_window
+    batch = np.ndarray(shape=(batch_size,), dtype=np.int32)
+    labels = np.ndarray(shape=(batch_size, 1), dtype=np.int32)
+    span = 2 * skip_window + 1
+    buffer = collections.deque(maxlen=span)
+    for _ in range(span):
+        buffer.append(data[data_index])
+        data_index = (data_index + 1) % len(data)
+    for i in range(batch_size // num_skips):
+        targets_to_avoid = [skip_window]
+        target = skip_window
+        for j in range(num_skips):
+            while target in targets_to_avoid:
+                target = random.randint(0, span - 1)
+            targets_to_avoid.append(target)
+            batch[i * num_skips + j] = buffer[skip_window]
+            labels[i * num_skips + j, 0] = buffer[target]
+        buffer.append(data[data_index])
+        data_index = (data_index + 1) % len(data)
+    return batch, labels
+
+
+embeddings = tf.Variable(tf.random.uniform(
+    [args.vocabulary_size, args.embedding_size], -1.0, 1.0, seed=42))
+nce_weights = tf.Variable(tf.random.truncated_normal(
+    [args.vocabulary_size, args.embedding_size],
+    stddev=1.0 / np.sqrt(args.embedding_size), seed=42))
+nce_biases = tf.Variable(tf.zeros([args.vocabulary_size]))
+variables = [embeddings, nce_weights, nce_biases]
+
+# LR scaled by the number of workers.
+opt = tf.keras.optimizers.SGD(args.lr * hvd.size())
+
+
+def train_step(inputs, labels):
+    with tf.GradientTape() as tape:
+        embed = tf.nn.embedding_lookup(embeddings, inputs)
+        loss = tf.reduce_mean(tf.nn.nce_loss(
+            weights=nce_weights, biases=nce_biases, labels=labels,
+            inputs=embed, num_sampled=args.num_sampled,
+            num_classes=args.vocabulary_size))
+    grads = tape.gradient(loss, variables)
+    # Embedding gradients arrive as IndexedSlices -> sparse gather path.
+    grads = [hvd.allreduce(g, average=True, name=f"w2v.grad.{i}")
+             for i, g in enumerate(grads)]
+    opt.apply_gradients(zip(grads, variables))
+    return loss
+
+
+# Replicate rank 0's initial embeddings.
+hvd.broadcast_variables(variables, root_rank=0)
+
+average_loss = 0.0
+for step in range(args.steps // hvd.size()):
+    batch_inputs, batch_labels = generate_batch(
+        args.batch_size, args.num_skips, args.skip_window)
+    loss = train_step(tf.constant(batch_inputs),
+                      tf.constant(batch_labels, dtype=tf.int64))
+    average_loss += float(loss)
+    if step % 50 == 49 and hvd.rank() == 0:
+        print(f"Average loss at step {step + 1}: {average_loss / 50:.3f}")
+        average_loss = 0.0
+
+# Final embeddings, L2-normalized (what the reference visualized with t-SNE).
+norm = tf.sqrt(tf.reduce_sum(tf.square(embeddings), 1, keepdims=True))
+normalized_embeddings = embeddings / norm
+if hvd.rank() == 0:
+    print("trained embeddings:", normalized_embeddings.shape)

@@ -242,6 +242,15 @@ def _auto_name(prefix: str) -> str:
         return f"{prefix}.noname.{_name_counter[0]}"
 
 
+def _as_contig(array) -> np.ndarray:
+    """C-contiguous ndarray view/copy that preserves 0-d shapes
+    (`np.ascontiguousarray` would promote scalars to shape (1,))."""
+    array = np.asarray(array)
+    if not array.flags["C_CONTIGUOUS"]:
+        array = np.ascontiguousarray(array)
+    return array
+
+
 def _check_out(out: np.ndarray, array: np.ndarray) -> None:
     if out.shape != array.shape or out.dtype != array.dtype:
         raise ValueError(
@@ -256,7 +265,7 @@ def allreduce_async(array: np.ndarray, average: bool = True,
                     out: Optional[np.ndarray] = None) -> Handle:
     lib = _load_lib()
     _check_initialized(lib)
-    array = np.ascontiguousarray(array)
+    array = _as_contig(array)
     if out is None:
         out = np.empty_like(array)
     else:
@@ -276,7 +285,7 @@ def allreduce_async(array: np.ndarray, average: bool = True,
 def allgather_async(array: np.ndarray, name: Optional[str] = None) -> Handle:
     lib = _load_lib()
     _check_initialized(lib)
-    array = np.ascontiguousarray(array)
+    array = _as_contig(array)
     if array.ndim == 0:
         raise ValueError("allgather requires tensors of rank >= 1")
     name = name or _auto_name("allgather")
@@ -295,7 +304,7 @@ def broadcast_async(array: np.ndarray, root_rank: int,
                     out: Optional[np.ndarray] = None) -> Handle:
     lib = _load_lib()
     _check_initialized(lib)
-    array = np.ascontiguousarray(array)
+    array = _as_contig(array)
     if out is None:
         out = np.empty_like(array)
     else:

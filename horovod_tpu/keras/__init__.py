@@ -104,8 +104,7 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
 
 
 def _value_collective(fn, value, **kw):
-    arr = np.ascontiguousarray(np.asarray(value))
-    return fn(arr, **kw)
+    return fn(_common._as_contig(np.asarray(value)), **kw)
 
 
 def allreduce(value, average: bool = True, name: Optional[str] = None):

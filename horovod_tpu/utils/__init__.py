@@ -1,0 +1,3 @@
+"""Shared utilities: platform control."""
+
+from horovod_tpu.utils.platform import apply_env_platform  # noqa: F401

@@ -1,0 +1,25 @@
+"""JAX platform selection that survives site-customization hooks.
+
+Some environments pre-register an accelerator platform through `jax.config`
+at interpreter startup, which silently overrides the documented
+``JAX_PLATFORMS`` environment variable.  :func:`apply_env_platform`
+re-asserts the environment variable's choice through `jax.config` so that
+``JAX_PLATFORMS=cpu python examples/...`` always means CPU (e.g. for the
+virtual ``--xla_force_host_platform_device_count=N`` test mesh).
+
+Must run before any JAX backend initializes (first `jax.devices()` /
+computation).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_env_platform() -> None:
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platforms)
