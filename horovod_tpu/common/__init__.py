@@ -57,7 +57,8 @@ def _load_lib():
         lib.hvd_tpu_init.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_double,
-            ctypes.c_longlong, ctypes.c_double, ctypes.c_char_p]
+            ctypes.c_longlong, ctypes.c_double, ctypes.c_char_p,
+            ctypes.c_int]
         lib.hvd_tpu_init_error.restype = ctypes.c_char_p
         lib.hvd_tpu_enqueue.restype = ctypes.c_longlong
         lib.hvd_tpu_enqueue.argtypes = [
@@ -97,21 +98,13 @@ def init(comm: Optional[Sequence[int]] = None) -> None:
         return
     ps = resolve_process_set(comm)
     cfg = Config.from_env()
-    if cfg.hierarchical_allreduce and ps.rank == 0:
-        import warnings
-
-        warnings.warn(
-            "HOROVOD_HIERARCHICAL_ALLREDUCE is set but the engine's ring "
-            "data plane has no hierarchical mode yet; the flag is ignored. "
-            "The compiled JAX path gets the ICI/DCN split from "
-            "horovod_tpu.parallel.hierarchical_mesh instead.")
     timeline = cfg.timeline_path if ps.rank == 0 else ""
     data = ",".join(ps.data_endpoints) if ps.data_endpoints else ""
     rc = lib.hvd_tpu_init(
         ps.rank, ps.size, ps.local_rank, ps.local_size,
         (ps.coord_endpoint or "").encode(), data.encode(),
         cfg.cycle_time_ms, cfg.fusion_threshold, cfg.stall_warning_sec,
-        timeline.encode())
+        timeline.encode(), int(cfg.hierarchical_allreduce))
     if rc != 0:
         raise HorovodInternalError(
             "engine initialization failed: "

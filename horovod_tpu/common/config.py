@@ -28,9 +28,10 @@ class Config:
     cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
     stall_warning_sec: float = DEFAULT_STALL_WARNING_SEC
     timeline_path: str = ""          # Chrome-tracing JSON output, rank 0
-    # Accepted for reference compatibility; the engine's ring data plane
-    # does not yet have a two-level (intra-host ring + cross-host ring)
-    # mode, so init() warns when this is set.
+    # Two-level allreduce: node-local reduce to the leader, ring across
+    # leaders, node-local broadcast (requires the hvdrun contiguous-block
+    # rank layout).  The engine analogue of the reference's
+    # HOROVOD_HIERARCHICAL_ALLREDUCE (operations.cc:1003-1048).
     hierarchical_allreduce: bool = False
 
     @staticmethod

@@ -41,7 +41,8 @@ extern "C" {
 int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
                  const char* coord_endpoint, const char* data_endpoints,
                  double cycle_time_ms, long long fusion_threshold,
-                 double stall_warning_sec, const char* timeline_path) {
+                 double stall_warning_sec, const char* timeline_path,
+                 int hierarchical_allreduce) {
   EngineOptions opts;
   opts.rank = rank;
   opts.size = size;
@@ -53,6 +54,7 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
   opts.fusion_threshold = fusion_threshold;
   opts.stall_warning_sec = stall_warning_sec;
   opts.timeline_path = timeline_path ? timeline_path : "";
+  opts.hierarchical_allreduce = hierarchical_allreduce != 0;
   std::string err;
   int rc = GlobalEngine()->Init(opts, &err);
   if (rc != 0) {

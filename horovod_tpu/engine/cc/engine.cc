@@ -238,6 +238,14 @@ Engine::~Engine() { Shutdown(); }
 int Engine::Init(const EngineOptions& opts, std::string* err) {
   if (initialized_.load()) return 0;
   opts_ = opts;
+  if (opts_.hierarchical_allreduce && opts_.size == 1)
+    opts_.hierarchical_allreduce = false;
+  // The multi-rank layout validation (ranks in contiguous blocks of
+  // local_size, the hvdrun layout — analogue of the reference's
+  // MPI_Comm_split_type shared-memory split, operations.cc:1364-1373)
+  // happens inside SetupSockets over the coordinator star, so that every
+  // rank reaches the SAME hierarchical/flat decision before any data-plane
+  // topology is built.
   shut_down_.store(false);
   loop_exited_.store(false);
   coord_.reset(new Coordinator());
@@ -301,16 +309,142 @@ bool Engine::SetupSockets(std::string* err) {
       return false;
     }
   }
-  // Ring: connect to the right neighbour, accept from the left.
+  // Topology agreement: every rank reports (local_rank, local_size,
+  // hierarchical-requested) to rank 0, which validates the contiguous-block
+  // layout globally and broadcasts one job-wide hierarchical/flat decision.
+  // A per-rank decision could diverge (e.g. interleaved placement passing
+  // the modular check on some ranks only) and deadlock the socket setup.
+  {
+    uint32_t mine[3] = {(uint32_t)opts_.local_rank, (uint32_t)opts_.local_size,
+                        opts_.hierarchical_allreduce ? 1u : 0u};
+    uint8_t decision = 0;
+    if (opts_.rank == 0) {
+      std::vector<uint32_t> lr(opts_.size), ls(opts_.size), hr(opts_.size);
+      lr[0] = mine[0]; ls[0] = mine[1]; hr[0] = mine[2];
+      for (int r = 1; r < opts_.size; ++r) {
+        uint32_t peer[3];
+        if (!RecvAll(coord_fds_[r], peer, sizeof peer)) {
+          *err = "topology agreement recv failed";
+          return false;
+        }
+        lr[r] = peer[0]; ls[r] = peer[1]; hr[r] = peer[2];
+      }
+      bool want = true, valid = true;
+      for (int r = 0; r < opts_.size; ++r) want = want && hr[r] != 0;
+      uint32_t L = ls[0];
+      if (L < 1 || opts_.size % (int)L != 0) valid = false;
+      for (int r = 0; valid && r < opts_.size; ++r)
+        if (ls[r] != L || lr[r] != (uint32_t)(r % (int)L)) valid = false;
+      if (want && !valid)
+        fprintf(stderr,
+                "[horovod_tpu] WARNING: hierarchical allreduce requires "
+                "equal local_size on every rank and ranks grouped in "
+                "contiguous blocks of local_size; falling back to the flat "
+                "ring.\n");
+      decision = (want && valid) ? 1 : 0;
+      for (int r = 1; r < opts_.size; ++r) {
+        if (!SendAll(coord_fds_[r], &decision, 1)) {
+          *err = "topology agreement send failed";
+          return false;
+        }
+      }
+    } else {
+      if (!SendAll(coord_fd_, mine, sizeof mine) ||
+          !RecvAll(coord_fd_, &decision, 1)) {
+        *err = "topology agreement exchange failed";
+        return false;
+      }
+    }
+    opts_.hierarchical_allreduce = decision != 0;
+  }
+  node_id_ = opts_.hierarchical_allreduce ? opts_.rank / opts_.local_size : 0;
+  n_nodes_ = opts_.hierarchical_allreduce ? opts_.size / opts_.local_size : 1;
+
+  // Data-plane connections.  Every outgoing connection announces itself
+  // with a 4-byte hello (kind in the high byte, sender id in the low 24
+  // bits) so one listen socket can serve the global ring, the node-local
+  // star, and the cross-node leader ring.  Kernel listen backlogs complete
+  // handshakes before accept(2), so every rank can finish all its connects
+  // before starting its accepts without deadlock.
+  const uint32_t kHelloRing = 0u << 24;
+  const uint32_t kHelloLocal = 1u << 24;
+  const uint32_t kHelloCross = 2u << 24;
+  auto connect_hello = [&](const std::string& ep, uint32_t hello,
+                           std::string* err) -> int {
+    std::string h;
+    int p;
+    if (!ParseEndpoint(ep, &h, &p)) {
+      *err = "bad data endpoint " + ep;
+      return -1;
+    }
+    int fd = ConnectRetry(h, p, kTimeout, err);
+    if (fd < 0) return -1;
+    if (!SendAll(fd, &hello, 4)) {
+      *err = "data-plane hello send failed";
+      CloseFd(fd);
+      return -1;
+    }
+    return fd;
+  };
+
+  bool hier = opts_.hierarchical_allreduce;
+  bool leader = opts_.local_rank == 0;
+  // Connect to the right global-ring neighbour.
   int right = (opts_.rank + 1) % opts_.size;
-  if (!ParseEndpoint(opts_.data_endpoints[right], &host, &port)) {
-    *err = "bad data endpoint " + opts_.data_endpoints[right];
+  right_fd_ = connect_hello(opts_.data_endpoints[right],
+                            kHelloRing | (uint32_t)opts_.rank, err);
+  if (right_fd_ < 0) return false;
+  if (hier && !leader) {
+    // Member: connect to this node's leader.
+    int leader_rank = opts_.rank - opts_.local_rank;
+    local_leader_fd_ = connect_hello(
+        opts_.data_endpoints[leader_rank],
+        kHelloLocal | (uint32_t)opts_.local_rank, err);
+    if (local_leader_fd_ < 0) return false;
+  }
+  if (hier && leader && n_nodes_ > 1) {
+    // Leader: connect to the next node's leader (cross ring).
+    int peer = ((node_id_ + 1) % n_nodes_) * opts_.local_size;
+    cross_right_fd_ = connect_hello(opts_.data_endpoints[peer],
+                                    kHelloCross | (uint32_t)node_id_, err);
+    if (cross_right_fd_ < 0) return false;
+  }
+
+  int expected = 1;  // left global-ring neighbour
+  if (hier && leader) {
+    expected += opts_.local_size - 1;
+    if (n_nodes_ > 1) expected += 1;
+  }
+  if (hier && leader) local_member_fds_.assign(opts_.local_size, -1);
+  for (int i = 0; i < expected; ++i) {
+    int fd = AcceptOne(data_listen_fd_, kTimeout, err);
+    if (fd < 0) return false;
+    uint32_t hello;
+    if (!RecvAll(fd, &hello, 4)) {
+      *err = "data-plane hello recv failed";
+      CloseFd(fd);
+      return false;
+    }
+    uint32_t kind = hello & 0xff000000u;
+    uint32_t id = hello & 0x00ffffffu;
+    if (kind == kHelloRing && left_fd_ < 0) {
+      left_fd_ = fd;
+    } else if (kind == kHelloLocal && hier && leader && id > 0 &&
+               id < (uint32_t)opts_.local_size &&
+               local_member_fds_[id] < 0) {
+      local_member_fds_[id] = fd;
+    } else if (kind == kHelloCross && hier && leader && cross_left_fd_ < 0) {
+      cross_left_fd_ = fd;
+    } else {
+      *err = "unexpected data-plane hello " + std::to_string(hello);
+      CloseFd(fd);
+      return false;
+    }
+  }
+  if (left_fd_ < 0) {
+    *err = "global ring left neighbour never connected";
     return false;
   }
-  right_fd_ = ConnectRetry(host, port, kTimeout, err);
-  if (right_fd_ < 0) return false;
-  left_fd_ = AcceptOne(data_listen_fd_, kTimeout, err);
-  if (left_fd_ < 0) return false;
   return true;
 }
 
@@ -322,7 +456,13 @@ void Engine::TeardownSockets() {
   CloseFd(data_listen_fd_);
   CloseFd(left_fd_);
   CloseFd(right_fd_);
+  for (int fd : local_member_fds_) CloseFd(fd);
+  local_member_fds_.clear();
+  CloseFd(local_leader_fd_);
+  CloseFd(cross_left_fd_);
+  CloseFd(cross_right_fd_);
   coord_listen_fd_ = coord_fd_ = data_listen_fd_ = left_fd_ = right_fd_ = -1;
+  local_leader_fd_ = cross_left_fd_ = cross_right_fd_ = -1;
 }
 
 void Engine::Shutdown() {
@@ -685,6 +825,13 @@ void Engine::ExecuteAllreduce(const Response& resp,
 
   std::string err;
   bool ok = true;
+  bool hier = opts_.hierarchical_allreduce && opts_.size > 1;
+  const char* reduce_activity =
+      hier ? "HIERARCHICAL_ALLREDUCE" : "RING_ALLREDUCE";
+  auto do_allreduce = [&](void* buf, int64_t n, std::string* e) {
+    return hier ? HierarchicalAllreduce(buf, n, wire_dtype, e)
+                : RingAllreduce(buf, n, wire_dtype, e);
+  };
   if (entries.size() == 1 && !half) {
     // Single unfused tensor: skip the fusion buffer, reduce in place on the
     // output (the reference's single-entry in-place path,
@@ -692,8 +839,8 @@ void Engine::ExecuteAllreduce(const Response& resp,
     TableEntry& e = entries[0];
     if (e.out != e.in)
       memcpy(e.out, e.in, static_cast<size_t>(total_elems) * esize);
-    timeline_.ActivityStart(e.name, "RING_ALLREDUCE");
-    ok = RingAllreduce(e.out, total_elems, wire_dtype, &err);
+    timeline_.ActivityStart(e.name, reduce_activity);
+    ok = do_allreduce(e.out, total_elems, &err);
     timeline_.ActivityEnd(e.name);
     if (ok && e.average) DivideBuffer(e.out, total_elems, dtype, opts_.size);
   } else {
@@ -714,8 +861,8 @@ void Engine::ExecuteAllreduce(const Response& resp,
       off += n;
       timeline_.ActivityEnd(e.name);
     }
-    timeline_.ActivityStart(entries[0].name, "RING_ALLREDUCE");
-    ok = RingAllreduce(fb, total_elems, wire_dtype, &err);
+    timeline_.ActivityStart(entries[0].name, reduce_activity);
+    ok = do_allreduce(fb, total_elems, &err);
     timeline_.ActivityEnd(entries[0].name);
     if (ok) {
       off = 0;
@@ -828,7 +975,13 @@ void Engine::CompleteEntry(const TableEntry& e, int32_t code,
 
 bool Engine::RingAllreduce(void* buf, int64_t count, uint8_t dtype,
                            std::string* err) {
-  int N = opts_.size;
+  return RingAllreduceOn(buf, count, dtype, opts_.size, opts_.rank, left_fd_,
+                         right_fd_, err);
+}
+
+bool Engine::RingAllreduceOn(void* buf, int64_t count, uint8_t dtype, int N,
+                             int index, int left_fd, int right_fd,
+                             std::string* err) {
   if (N == 1 || count == 0) return true;
   size_t esize = DataTypeSize(dtype);
   char* data = static_cast<char*>(buf);
@@ -839,14 +992,14 @@ bool Engine::RingAllreduce(void* buf, int64_t count, uint8_t dtype,
   auto seg_count = [&](int i) -> int64_t { return base + (i < rem ? 1 : 0); };
   int64_t max_seg = base + (rem ? 1 : 0);
   std::vector<char> tmp(static_cast<size_t>(max_seg) * esize);
-  int r = opts_.rank;
+  int r = index;
   // Phase 1: reduce-scatter.  After N-1 steps rank r owns the fully reduced
   // segment (r+1) mod N.
   for (int step = 0; step < N - 1; ++step) {
     int ss = ((r - step) % N + N) % N;
     int rs = ((r - step - 1) % N + N) % N;
-    if (!Exchange(right_fd_, data + seg_start(ss) * esize,
-                  static_cast<size_t>(seg_count(ss)) * esize, left_fd_,
+    if (!Exchange(right_fd, data + seg_start(ss) * esize,
+                  static_cast<size_t>(seg_count(ss)) * esize, left_fd,
                   tmp.data(), static_cast<size_t>(seg_count(rs)) * esize)) {
       *err = "neighbour exchange failed (reduce-scatter step " +
              std::to_string(step) + ")";
@@ -859,8 +1012,8 @@ bool Engine::RingAllreduce(void* buf, int64_t count, uint8_t dtype,
   for (int step = 0; step < N - 1; ++step) {
     int ss = ((r + 1 - step) % N + N) % N;
     int rs = ((r - step) % N + N) % N;
-    if (!Exchange(right_fd_, data + seg_start(ss) * esize,
-                  static_cast<size_t>(seg_count(ss)) * esize, left_fd_,
+    if (!Exchange(right_fd, data + seg_start(ss) * esize,
+                  static_cast<size_t>(seg_count(ss)) * esize, left_fd,
                   data + seg_start(rs) * esize,
                   static_cast<size_t>(seg_count(rs)) * esize)) {
       *err = "neighbour exchange failed (allgather step " +
@@ -869,6 +1022,92 @@ bool Engine::RingAllreduce(void* buf, int64_t count, uint8_t dtype,
     }
   }
   return true;
+}
+
+bool Engine::HierarchicalAllreduce(void* buf, int64_t count, uint8_t dtype,
+                                   std::string* err) {
+  // Three phases, the reference's ncclReduce -> cross MPI_Allreduce ->
+  // ncclBcast (operations.cc:1003-1048) over TCP: node-local star reduce to
+  // the leader, ring allreduce across leaders (the DCN hop), node-local
+  // broadcast.  Sum semantics throughout; averaging stays the caller's
+  // divide-by-global-size.
+  if (opts_.size == 1 || count == 0) return true;
+  size_t esize = DataTypeSize(dtype);
+  char* data = static_cast<char*>(buf);
+  int64_t nbytes = count * static_cast<int64_t>(esize);
+  const int64_t kChunk = 4 << 20;
+  bool leader = opts_.local_rank == 0;
+
+  bool ok = true;
+  if (opts_.local_size > 1) {
+    if (leader) {
+      // Round-robin chunked accumulate: each member streams its whole
+      // buffer; consuming in chunk order bounds leader memory and keeps
+      // every member's stream draining.
+      int64_t chunk_elems = std::max<int64_t>(kChunk / (int64_t)esize, 1);
+      std::vector<char> tmp(
+          static_cast<size_t>(std::min(chunk_elems, count)) * esize);
+      for (int64_t off = 0; ok && off < count; off += chunk_elems) {
+        int64_t n = std::min(chunk_elems, count - off);
+        for (int m = 1; m < opts_.local_size; ++m) {
+          if (!RecvAll(local_member_fds_[m], tmp.data(),
+                       static_cast<size_t>(n) * esize)) {
+            *err = "local reduce recv failed (member " + std::to_string(m) +
+                   ")";
+            ok = false;
+            break;
+          }
+          AccumulateSum(data + off * esize, tmp.data(), n, dtype);
+        }
+      }
+    } else {
+      if (!SendAll(local_leader_fd_, data, static_cast<size_t>(nbytes))) {
+        *err = "local reduce send failed";
+        return false;
+      }
+    }
+  }
+
+  if (ok && leader && n_nodes_ > 1) {
+    ok = RingAllreduceOn(buf, count, dtype, n_nodes_, node_id_,
+                         cross_left_fd_, cross_right_fd_, err);
+  }
+
+  if (opts_.local_size > 1) {
+    if (leader) {
+      // One status byte ahead of the payload: on a leader-side failure
+      // (cross-ring or local-reduce) members must get an abort instead of
+      // blocking forever in an untimed RecvAll on the payload.
+      uint8_t status = ok ? 0 : 1;
+      for (int m = 1; m < opts_.local_size; ++m) {
+        bool sent = SendAll(local_member_fds_[m], &status, 1) &&
+                    (!ok || SendAll(local_member_fds_[m], data,
+                                    static_cast<size_t>(nbytes)));
+        if (!sent && ok) {
+          *err = "local broadcast send failed (member " + std::to_string(m) +
+                 ")";
+          ok = false;
+          // Keep aborting the remaining members.
+          status = 1;
+        }
+      }
+    } else {
+      uint8_t status;
+      if (!RecvAll(local_leader_fd_, &status, 1)) {
+        *err = "local broadcast recv failed";
+        return false;
+      }
+      if (status != 0) {
+        *err = "node leader aborted the allreduce (cross-node failure)";
+        return false;
+      }
+      if (!RecvAll(local_leader_fd_, data, static_cast<size_t>(nbytes))) {
+        *err = "local broadcast recv failed";
+        return false;
+      }
+    }
+  }
+  return ok;
 }
 
 bool Engine::RingAllgather(char* buf, const std::vector<int64_t>& block_bytes,

@@ -46,6 +46,12 @@ struct EngineOptions {
   int64_t fusion_threshold = 64 * 1024 * 1024;
   double stall_warning_sec = 60.0;
   std::string timeline_path;
+  // Two-level allreduce: reduce to the node-local leader, ring-allreduce
+  // across leaders, broadcast back within the node — the reference's
+  // HOROVOD_HIERARCHICAL_ALLREDUCE (operations.cc:1003-1048) mapped to
+  // intra-host loopback + cross-host DCN.  Requires ranks grouped in
+  // contiguous blocks of local_size (the hvdrun layout).
+  bool hierarchical_allreduce = false;
 };
 
 struct HandleStatus {
@@ -128,6 +134,15 @@ class Engine {
   // Data plane primitives (ring over TCP).
   bool RingAllreduce(void* buf, int64_t count, uint8_t dtype,
                      std::string* err);
+  // Ring allreduce over an arbitrary participant ring (used for both the
+  // global ring and the cross-node leader ring).
+  bool RingAllreduceOn(void* buf, int64_t count, uint8_t dtype, int n,
+                       int index, int left_fd, int right_fd,
+                       std::string* err);
+  // Two-level: local star-reduce to the leader, leader ring across nodes,
+  // local broadcast back.
+  bool HierarchicalAllreduce(void* buf, int64_t count, uint8_t dtype,
+                             std::string* err);
   bool RingAllgather(char* buf, const std::vector<int64_t>& block_bytes,
                      std::string* err);
   bool RingBroadcast(void* buf, int64_t nbytes, int root, std::string* err);
@@ -153,6 +168,12 @@ class Engine {
   int coord_fd_ = -1;                        // workers: fd to rank 0
   int data_listen_fd_ = -1;
   int left_fd_ = -1, right_fd_ = -1;         // ring neighbours
+  // Hierarchical topology (only when opts_.hierarchical_allreduce):
+  int node_id_ = 0;                          // rank / local_size
+  int n_nodes_ = 1;                          // size / local_size
+  std::vector<int> local_member_fds_;        // leader: fd per local member
+  int local_leader_fd_ = -1;                 // member: fd to its leader
+  int cross_left_fd_ = -1, cross_right_fd_ = -1;  // leader ring
 
   // Fusion buffer (lazily grown; analogue of the reference's persistent
   // fusion buffer, operations.cc:696-749).

@@ -187,6 +187,73 @@ def test_interleaved_order_independent():
         assert np.allclose(out, float(int(nm.split(".")[1])) * n), (r, nm)
 
 
+def _hier_env(local_size):
+    """Re-shape this rank's env into `local_size`-sized nodes and enable the
+    two-level allreduce, before hvd.init() reads it."""
+    import os
+
+    rank = int(os.environ["HVD_TPU_RANK"])
+    os.environ["HVD_TPU_LOCAL_SIZE"] = str(local_size)
+    os.environ["HVD_TPU_LOCAL_RANK"] = str(rank % local_size)
+    os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+
+
+@distributed_test(np_=4)
+def test_hierarchical_allreduce_two_nodes():
+    """4 ranks as 2 nodes x 2 local: local star reduce -> leader ring ->
+    local broadcast must equal the flat ring result (the reference's
+    HOROVOD_HIERARCHICAL_ALLREDUCE, operations.cc:1003-1048)."""
+    _hier_env(local_size=2)
+    hvd = _init()
+    r, n = hvd.rank(), hvd.size()
+    for i, count in enumerate((1, 7, 1000, 100003)):
+        x = (np.arange(count) * 0.01 + r).astype(np.float32)
+        out = hvd.allreduce(x, average=False, name=f"hier.{i}")
+        want = sum((np.arange(count) * 0.01 + j).astype(np.float32)
+                   for j in range(n))
+        assert np.allclose(out, want, rtol=1e-5), (r, count)
+    # Average + fusion path.
+    handles = [hvd.allreduce_async(np.full(11, float(r), np.float32),
+                                   average=True, name=f"hier.avg.{i}")
+               for i in range(20)]
+    for h in handles:
+        assert np.allclose(h.wait(), sum(range(n)) / n)
+    # Other collectives still ride the flat ring alongside.
+    g = hvd.allgather(np.full((1, 2), float(r), np.float32), name="hier.g")
+    assert g.shape == (n, 2)
+
+
+@distributed_test(np_=4)
+def test_hierarchical_bad_layout_falls_back():
+    """An interleaved (non-contiguous) rank layout must not deadlock: the
+    topology agreement makes every rank fall back to the flat ring."""
+    import os
+
+    rank = int(os.environ["HVD_TPU_RANK"])
+    os.environ["HVD_TPU_LOCAL_SIZE"] = "2"
+    # Wrong layout: local_rank = rank // 2 passes the modular check on some
+    # ranks only -- exactly the divergence case.
+    os.environ["HVD_TPU_LOCAL_RANK"] = str(rank // 2)
+    os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    hvd = _init()
+    r, n = hvd.rank(), hvd.size()
+    out = hvd.allreduce(np.full(33, float(r + 1), np.float32),
+                        average=False, name="fallback")
+    assert np.allclose(out, sum(range(1, n + 1)))
+
+
+@distributed_test(np_=3)
+def test_hierarchical_single_node():
+    """All ranks on one node: the cross ring degenerates to nothing and the
+    result is a pure star reduce + broadcast."""
+    _hier_env(local_size=3)
+    hvd = _init()
+    r, n = hvd.rank(), hvd.size()
+    out = hvd.allreduce(np.full(257, 1.5 * (r + 1), np.float64),
+                        average=False, name="hier1")
+    assert np.allclose(out, 1.5 * sum(range(1, n + 1)))
+
+
 def test_timeline_written(tmp_path):
     """Timeline (Chrome tracing) is written on rank 0 when enabled --
     reference aux subsystem /root/reference/horovod/common/timeline.{h,cc}."""
