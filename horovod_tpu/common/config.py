@@ -42,10 +42,12 @@ class Config:
         timeline = _get("HVD_TPU_TIMELINE", "HOROVOD_TIMELINE")
         hier = _get("HVD_TPU_HIERARCHICAL_ALLREDUCE",
                     "HOROVOD_HIERARCHICAL_ALLREDUCE")
+        falsy = hier is None or hier.strip().lower() in (
+            "", "0", "false", "no", "off")
         return Config(
             fusion_threshold=int(fusion) if fusion else DEFAULT_FUSION_THRESHOLD,
             cycle_time_ms=float(cycle) if cycle else DEFAULT_CYCLE_TIME_MS,
             stall_warning_sec=float(stall) if stall else DEFAULT_STALL_WARNING_SEC,
             timeline_path=timeline or "",
-            hierarchical_allreduce=bool(hier and hier not in ("0", "false", "")),
+            hierarchical_allreduce=not falsy,
         )

@@ -332,10 +332,12 @@ bool Engine::SetupSockets(std::string* err) {
       bool want = true, valid = true;
       for (int r = 0; r < opts_.size; ++r) want = want && hr[r] != 0;
       uint32_t L = ls[0];
-      if (L < 1 || opts_.size % (int)L != 0) valid = false;
+      // L == 1 would make the leader ring an exact duplicate of the flat
+      // global ring; fall back rather than double the data-plane sockets.
+      if (L < 2 || opts_.size % (int)L != 0) valid = false;
       for (int r = 0; valid && r < opts_.size; ++r)
         if (ls[r] != L || lr[r] != (uint32_t)(r % (int)L)) valid = false;
-      if (want && !valid)
+      if (want && !valid && L >= 2)
         fprintf(stderr,
                 "[horovod_tpu] WARNING: hierarchical allreduce requires "
                 "equal local_size on every rank and ranks grouped in "
@@ -1043,21 +1045,26 @@ bool Engine::HierarchicalAllreduce(void* buf, int64_t count, uint8_t dtype,
     if (leader) {
       // Round-robin chunked accumulate: each member streams its whole
       // buffer; consuming in chunk order bounds leader memory and keeps
-      // every member's stream draining.
+      // every member's stream draining.  On a member failure, keep
+      // draining the *other* members to the end — their untimed SendAll
+      // must complete before they can read the abort status byte.
       int64_t chunk_elems = std::max<int64_t>(kChunk / (int64_t)esize, 1);
       std::vector<char> tmp(
           static_cast<size_t>(std::min(chunk_elems, count)) * esize);
-      for (int64_t off = 0; ok && off < count; off += chunk_elems) {
+      std::vector<bool> dead(opts_.local_size, false);
+      for (int64_t off = 0; off < count; off += chunk_elems) {
         int64_t n = std::min(chunk_elems, count - off);
         for (int m = 1; m < opts_.local_size; ++m) {
+          if (dead[m]) continue;
           if (!RecvAll(local_member_fds_[m], tmp.data(),
                        static_cast<size_t>(n) * esize)) {
             *err = "local reduce recv failed (member " + std::to_string(m) +
                    ")";
             ok = false;
-            break;
+            dead[m] = true;
+            continue;
           }
-          AccumulateSum(data + off * esize, tmp.data(), n, dtype);
+          if (ok) AccumulateSum(data + off * esize, tmp.data(), n, dtype);
         }
       }
     } else {

@@ -1,0 +1,276 @@
+"""Fused attention: Pallas TPU kernel + differentiable blockwise fallback.
+
+Layout convention: ``(batch, num_heads, seq, head_dim)`` throughout.
+
+The Pallas kernel tiles queries and keys into MXU-sized blocks and keeps the
+online-softmax state (running max, normalizer, accumulator) in VMEM scratch
+across the key-block grid dimension, so attention needs O(block) on-chip
+memory instead of materializing the (seq, seq) score matrix in HBM.  The
+backward pass recomputes through :func:`blockwise_attention` (same math,
+pure JAX), trading FLOPs for memory exactly like `jax.checkpoint`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30  # big-negative instead of -inf: keeps exp() NaN-free when a
+# whole row is masked (fully-masked causal blocks)
+
+
+def mha_reference(q, k, v, causal: bool = False,
+                  sm_scale: Optional[float] = None):
+    """O(seq^2)-memory reference attention (for tests and tiny shapes)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    # precision="highest": on TPU the default matmul precision truncates f32
+    # operands to bf16 passes; the reference must be at least as accurate as
+    # the kernels it validates.
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   precision="highest").astype(jnp.float32) * sm_scale
+    if causal:
+        q_pos = jnp.arange(q.shape[2])[:, None]
+        k_pos = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                      precision="highest")
+
+
+# ---------------------------------------------------------------------------
+# Blockwise attention: lax.scan online softmax.  Differentiable on any
+# backend; the building block ring_attention reuses per ring step.
+# ---------------------------------------------------------------------------
+
+
+def _block_attend(q, k, v, m, l, acc, mask, sm_scale):
+    """One online-softmax update of (m, l, acc) with a (q_len, k_len) block.
+
+    ``mask`` is True where attention is allowed (or None for dense).
+    Shapes: q (..., q_len, d), k/v (..., k_len, d); m/l (..., q_len);
+    acc (..., q_len, d); all statistics in float32.
+    """
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # Guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1.
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l_new = l * alpha + p.sum(axis=-1)
+    acc_new = acc * alpha[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def _finalize(m, l, acc, dtype):
+    # Fully-masked rows have l == 0; emit zeros, not NaN.
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / safe_l[..., None]).astype(dtype)
+
+
+def blockwise_attention(q, k, v, causal: bool = False,
+                        sm_scale: Optional[float] = None,
+                        block_size: int = 512,
+                        q_offset=0, k_offset=0):
+    """Memory-efficient attention as a `lax.scan` over key/value blocks.
+
+    ``q_offset``/``k_offset`` give the global sequence positions of the
+    first query/key row — this is what lets :func:`ring_attention` apply a
+    correct causal mask to rotated K/V shards.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    q_len, k_len = q.shape[-2], k.shape[-2]
+    block = min(block_size, k_len)
+    n_blocks = (k_len + block - 1) // block
+    pad = n_blocks * block - k_len
+    if pad:
+        kp = jnp.pad(k, [(0, 0)] * (k.ndim - 2) + [(0, pad), (0, 0)])
+        vp = jnp.pad(v, [(0, 0)] * (v.ndim - 2) + [(0, pad), (0, 0)])
+    else:
+        kp, vp = k, v
+    kb = kp.reshape(*k.shape[:-2], n_blocks, block, k.shape[-1])
+    vb = vp.reshape(*v.shape[:-2], n_blocks, block, v.shape[-1])
+    # scan over the block axis: move it to the front.
+    kb = jnp.moveaxis(kb, -3, 0)
+    vb = jnp.moveaxis(vb, -3, 0)
+
+    q_pos = q_offset + jnp.arange(q_len)
+    m0 = jnp.full(q.shape[:-1], NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    acc0 = jnp.zeros(q.shape[:-2] + (q_len, q.shape[-1]), jnp.float32)
+
+    def step(carry, inputs):
+        m, l, acc = carry
+        i, kblk, vblk = inputs
+        k_pos = k_offset + i * block + jnp.arange(block)
+        valid = k_pos < k_offset + k_len  # padding rows
+        mask = valid[None, :]
+        if causal:
+            mask = mask & (q_pos[:, None] >= k_pos[None, :])
+        m, l, acc = _block_attend(q, kblk, vblk, m, l, acc, mask, sm_scale)
+        return (m, l, acc), None
+
+    (m, l, acc), _ = lax.scan(
+        step, (m0, l0, acc0), (jnp.arange(n_blocks), kb, vb))
+    return _finalize(m, l, acc, q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel.
+# ---------------------------------------------------------------------------
+
+try:  # Pallas is TPU-oriented; import lazily so CPU-only installs still work
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
+                  acc_scratch, *, sm_scale, causal, block_q, block_k,
+                  num_k_blocks):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scratch[...] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # Causal pruning: skip key blocks entirely above the diagonal.
+    run = True if not causal else k_start <= q_start + block_q - 1
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]  # (block_q, d)
+        k = k_ref[0]  # (block_k, d)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scratch[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        l_new = l_scratch[:, 0] * alpha + p.sum(axis=-1)
+        acc_scratch[...] = (
+            acc_scratch[...] * alpha[:, None]
+            + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        m_scratch[...] = jnp.broadcast_to(m_new[:, None], m_scratch.shape)
+        l_scratch[...] = jnp.broadcast_to(l_new[:, None], l_scratch.shape)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _():
+        l = l_scratch[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scratch[...] / safe_l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    batch, heads, q_len, d = q.shape
+    k_len = k.shape[2]
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, k_len)
+    if q_len % block_q or k_len % block_k:
+        # Ragged tails: the blockwise path handles them without padding
+        # gymnastics (the kernel targets the aligned hot path).
+        return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    bh = batch * heads
+    qr = q.reshape(bh, q_len, d)
+    kr = k.reshape(bh, k_len, d)
+    vr = v.reshape(bh, k_len, d)
+    num_q = q_len // block_q
+    num_k = k_len // block_k
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_k_blocks=num_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer
+            pltpu.VMEM((block_q, d), jnp.float32),    # output accumulator
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(batch, heads, q_len, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    # Recompute through the blockwise path (identical math): flash memory
+    # savings in forward, lax.scan rematerialization in backward.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale,
+            block_size=max(block_k, 128)), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused multi-head attention, ``(batch, heads, seq, head_dim)``.
+
+    On TPU this is a Pallas kernel (MXU-tiled blocks, VMEM online-softmax
+    state); elsewhere (and for ragged block tails) it falls back to the
+    mathematically identical :func:`blockwise_attention`.  Differentiable;
+    the VJP recomputes blockwise.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if not _HAS_PALLAS:
+        return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_attention(q, k, v, causal, sm_scale, block_q, block_k,
+                            interpret)

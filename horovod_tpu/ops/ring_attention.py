@@ -1,0 +1,75 @@
+"""Ring attention: sequence-parallel attention over a mesh axis.
+
+Long-context strategy (Liu et al., Ring Attention with Blockwise
+Transformers, arXiv:2310.01889), absent from the reference (SURVEY §5.7)
+and added here as a first-class TPU capability: the sequence dimension is
+sharded over the mesh axis; each device keeps its query shard and passes
+its key/value shard around the ring with `lax.ppermute` (which XLA lowers
+to ICI neighbour transfers overlapped with the attention compute), merging
+partial results with the same online-softmax statistics the flash kernel
+uses.  Peak memory per device is O(seq/N) — context length scales linearly
+with the ring size.
+
+Use inside `shard_map` with the sequence dimension sharded along
+``axis_name``; differentiable end-to-end (ppermute transposes to the
+reverse rotation).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.ops.attention import (
+    NEG_INF,
+    _block_attend,
+    _finalize,
+)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Attention over a sequence sharded along ``axis_name``.
+
+    Args:
+      q, k, v: local shards, ``(batch, heads, seq_local, head_dim)``; the
+        global sequence is the concatenation of shards in mesh-axis order.
+      axis_name: the mapped mesh axis carrying the sequence shards.
+      causal: apply a causal mask over *global* positions.
+      sm_scale: softmax scale; default ``head_dim ** -0.5``.
+
+    Returns:
+      The local output shard, same shape/dtype as ``q``.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    seq_local = q.shape[-2]
+
+    q_pos = my_idx * seq_local + jnp.arange(seq_local)
+    m0 = jnp.full(q.shape[:-1], NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    acc0 = jnp.zeros(q.shape[:-2] + (seq_local, q.shape[-1]), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Unrolled ring loop (n is the static mesh-axis size): each step's
+    # ppermute can then be scheduled by XLA as an async collective-permute
+    # overlapped with the next step's attention compute, which a
+    # lax.fori_loop carry would serialize.
+    k_cur, v_cur, m, l, acc = k, v, m0, l0, acc0
+    for t in range(n):
+        # After t right-rotations this device holds the shard that
+        # originated on device (my_idx - t) mod n.
+        kv_idx = (my_idx - t) % n
+        mask = None
+        if causal:
+            k_pos = kv_idx * seq_local + jnp.arange(seq_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        m, l, acc = _block_attend(q, k_cur, v_cur, m, l, acc, mask, sm_scale)
+        if t < n - 1:  # rotate K/V to the right neighbour
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    return _finalize(m, l, acc, q.dtype)

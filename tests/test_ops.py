@@ -1,0 +1,116 @@
+"""Attention kernel tests: flash/blockwise vs the dense reference, and
+ring attention (sequence parallel over the virtual 8-device mesh) vs the
+full-sequence result — values and gradients."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.ops import (blockwise_attention, flash_attention,
+                             mha_reference, ring_attention)
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def _qkv(batch=2, heads=2, seq=256, d=64, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (batch, heads, seq, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_matches_reference(causal):
+    q, k, v = _qkv(seq=192, d=32)
+    want = mha_reference(q, k, v, causal=causal)
+    got = blockwise_attention(q, k, v, causal=causal, block_size=64)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    q, k, v = _qkv(seq=256, d=64)
+    want = mha_reference(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_ragged_tail_falls_back():
+    q, k, v = _qkv(seq=100, d=32)  # not a multiple of the block size
+    want = mha_reference(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_gradients_match_reference():
+    q, k, v = _qkv(seq=128, d=32)
+
+    def loss_ref(q, k, v):
+        return (mha_reference(q, k, v, causal=True) ** 2).sum()
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True) ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def _ring_apply(fn, q, k, v, mesh, axis):
+    spec = P(None, None, axis, None)  # shard the sequence dimension
+    return jax.jit(shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest forces an 8-device CPU platform"
+    mesh = Mesh(np.array(devices[:8]), ("sp",))
+    q, k, v = _qkv(batch=1, heads=2, seq=8 * 32, d=16)
+    want = mha_reference(q, k, v, causal=causal)
+    got = _ring_apply(
+        functools.partial(ring_attention, axis_name="sp", causal=causal),
+        q, k, v, mesh, "sp")
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_gradients():
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices[:4]), ("sp",))
+    q, k, v = _qkv(batch=1, heads=1, seq=4 * 16, d=8)
+    spec = P(None, None, "sp", None)
+
+    def ring_loss(q, k, v):
+        out = shard_map(
+            functools.partial(ring_attention, axis_name="sp", causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+        return (out ** 2).sum()
+
+    def ref_loss(q, k, v):
+        return (mha_reference(q, k, v, causal=True) ** 2).sum()
+
+    g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_blockwise_offsets_compose():
+    """Shifted-window blockwise calls (the ring building block) agree with
+    one global causal call."""
+    q, k, v = _qkv(batch=1, heads=1, seq=64, d=16)
+    full = blockwise_attention(q, k, v, causal=True, block_size=16)
+    # Second half of queries attending over both halves of keys, via two
+    # offset calls merged by hand is exactly what ring_attention does; here
+    # just check the offset mask itself.
+    got = blockwise_attention(q[:, :, 32:], k, v, causal=True,
+                              block_size=16, q_offset=32, k_offset=0)
+    np.testing.assert_allclose(got, full[:, :, 32:], atol=2e-5, rtol=2e-5)
