@@ -1075,9 +1075,24 @@ bool Engine::HierarchicalAllreduce(void* buf, int64_t count, uint8_t dtype,
     }
   }
 
-  if (ok && leader && n_nodes_ > 1) {
-    ok = RingAllreduceOn(buf, count, dtype, n_nodes_, node_id_,
-                         cross_left_fd_, cross_right_fd_, err);
+  if (leader && n_nodes_ > 1) {
+    if (ok && (cross_left_fd_ < 0 || cross_right_fd_ < 0)) {
+      *err = "cross-node ring closed after an earlier failure";
+      ok = false;
+    }
+    if (ok) {
+      ok = RingAllreduceOn(buf, count, dtype, n_nodes_, node_id_,
+                           cross_left_fd_, cross_right_fd_, err);
+    }
+    if (!ok) {
+      // Never feed partial sums into the ring (peers would report
+      // success on wrong values); closing the cross fds instead makes
+      // the peer leaders' Exchange fail fast with EOF rather than stall
+      // out their 30 s silence timeout.
+      CloseFd(cross_left_fd_);
+      CloseFd(cross_right_fd_);
+      cross_left_fd_ = cross_right_fd_ = -1;
+    }
   }
 
   if (opts_.local_size > 1) {

@@ -81,16 +81,21 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
     per-shard contributions — allreduce is idempotent, like the engine path.
     """
     if axis_name is not None:
+        # One mesh axis or several (e.g. ("dp", "sp") for a 2-D mesh).
+        axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
         vma = getattr(getattr(tensor, "aval", None), "vma", None)
-        if vma is not None and axis_name not in vma:
-            # Already reduced across the axis (e.g. by the grad transpose's
-            # automatic psum): the value is the cross-worker sum.
-            if average:
-                return tensor / lax.axis_size(axis_name)
-            return tensor
+        # Axes absent from the varying set are already reduced (e.g. by the
+        # grad transpose's automatic psum): the value is the cross-worker
+        # sum over them, so only psum the still-varying axes and divide by
+        # the full participant count when averaging.
+        present = axes if vma is None else tuple(a for a in axes if a in vma)
+        out = lax.psum(tensor, present) if present else tensor
         if average:
-            return lax.pmean(tensor, axis_name)
-        return lax.psum(tensor, axis_name)
+            denom = 1
+            for a in axes:
+                denom *= lax.axis_size(a)
+            out = out / denom
+        return out
     if _is_tracer(tensor):
         raise ValueError(
             "allreduce of a traced value requires axis_name= (the mapped "

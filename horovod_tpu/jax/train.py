@@ -45,6 +45,8 @@ def build_train_step(loss_fn: Callable, optimizer, mesh: Mesh,
     axis_name = axis_name or mesh.axis_names[0]
     if batch_spec is None:
         batch_spec = P(axis_name)
+    # `axis_name` may be one mesh axis or a tuple (e.g. ("dp", "sp")):
+    # gradient averaging and loss reporting reduce over all of them.
     import optax
 
     dist_opt = DistributedOptimizer(optimizer, axis_name=axis_name)

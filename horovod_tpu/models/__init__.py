@@ -16,3 +16,7 @@ from horovod_tpu.models.resnet import (  # noqa: F401
     ResNet101,
     ResNet152,
 )
+from horovod_tpu.models.transformer import (  # noqa: F401
+    TransformerLM,
+    next_token_loss,
+)

@@ -195,8 +195,10 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     k_len = k.shape[2]
     block_q = min(block_q, q_len)
     block_k = min(block_k, k_len)
-    if q_len % block_q or k_len % block_k:
-        # Ragged tails: the blockwise path handles them without padding
+    if (q_len % block_q or k_len % block_k
+            or block_q % 8 or block_k % 128):
+        # Ragged tails or blocks off the TPU tiling grid (f32 sublane 8,
+        # lane 128): the blockwise path handles them without padding
         # gymnastics (the kernel targets the aligned hot path).
         return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     bh = batch * heads
