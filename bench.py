@@ -10,8 +10,10 @@ reference's published number is 1656.82 images/sec on 16 Pascal GPUs =
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
 
-Env knobs: BENCH_MODEL (resnet101|resnet50|mnist), BENCH_BATCH, BENCH_STEPS,
-BENCH_WARMUP, BENCH_IMAGE (side length).
+Env knobs: BENCH_MODEL (resnet101|resnet50|resnet18|mnist|transformer|
+allreduce), BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
+length); transformer adds BENCH_SEQ/BENCH_VOCAB/BENCH_D_MODEL/BENCH_LAYERS/
+BENCH_HEADS; allreduce adds BENCH_NP/BENCH_BYTES/BENCH_ITERS.
 """
 
 from __future__ import annotations
@@ -24,6 +26,101 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 REFERENCE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.md:22-38
+
+
+def bench_transformer() -> None:
+    """LM training throughput (tokens/sec/chip), flash attention + bf16."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models import TransformerLM, next_token_loss
+
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
+    model = TransformerLM(
+        vocab_size=vocab,
+        d_model=int(os.environ.get("BENCH_D_MODEL", "512")),
+        n_layers=int(os.environ.get("BENCH_LAYERS", "8")),
+        n_heads=int(os.environ.get("BENCH_HEADS", "8")))
+
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, vocab, (batch, seq + 1)))
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    params = model.init(jax.random.PRNGKey(0), inputs[:, :128])["params"]
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, inputs, targets):
+        def loss_fn(p):
+            return next_token_loss(
+                model.apply({"params": p}, inputs), targets)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(max(warmup, 1)):
+        params, opt_state, loss = step(params, opt_state, inputs, targets)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, inputs, targets)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), final_loss
+    value = batch * seq * steps / dt
+    print(json.dumps({
+        "metric": "transformer_train_tokens_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,  # the reference has no LM benchmark
+    }))
+
+
+def bench_allreduce() -> None:
+    """Engine eager ring-allreduce bandwidth over NP local ranks."""
+    import subprocess
+    import sys
+
+    np_ = int(os.environ.get("BENCH_NP", "2"))
+    nbytes = int(os.environ.get("BENCH_BYTES", str(64 * 1024 * 1024)))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    code = f"""
+import time, numpy as np, horovod_tpu as hvd
+hvd.init()
+x = np.ones({nbytes} // 4, np.float32)
+hvd.allreduce(x, average=False, name="warmup")
+t0 = time.perf_counter()
+for i in range({iters}):
+    hvd.allreduce(x, average=False, name=f"bench.{{i}}")
+dt = time.perf_counter() - t0
+if hvd.rank() == 0:
+    # Ring allreduce moves 2*(N-1)/N * nbytes per rank per iteration.
+    n = hvd.size()
+    algo_bytes = 2 * (n - 1) / n * {nbytes} * {iters}
+    print("BW_GBPS", algo_bytes / dt / 1e9, flush=True)
+"""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ,
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_), "--",
+         sys.executable, "-c", code],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    bw = next(float(line.split()[1]) for line in out.stdout.splitlines()
+              if line.startswith("BW_GBPS"))
+    print(json.dumps({
+        "metric": f"engine_ring_allreduce_bandwidth_np{np_}",
+        "value": round(bw, 3),
+        "unit": "GB/s",
+        "vs_baseline": None,  # the reference published no allreduce number
+    }))
 
 
 def main() -> None:
@@ -42,6 +139,10 @@ def main() -> None:
     from horovod_tpu import models
 
     model_name = os.environ.get("BENCH_MODEL", "resnet101")
+    if model_name == "transformer":
+        return bench_transformer()
+    if model_name == "allreduce":
+        return bench_allreduce()
     batch = int(os.environ.get("BENCH_BATCH", "64"))
     steps = int(os.environ.get("BENCH_STEPS", "30"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))

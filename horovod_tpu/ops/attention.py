@@ -55,7 +55,12 @@ def _block_attend(q, k, v, m, l, acc, mask, sm_scale):
     Shapes: q (..., q_len, d), k/v (..., k_len, d); m/l (..., q_len);
     acc (..., q_len, d); all statistics in float32.
     """
-    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
+    # preferred_element_type=f32: half-precision operands ride the MXU's
+    # native passes while the accumulation (and, crucially, the backward
+    # cotangents) stay float32 — a bf16 result here is both less accurate
+    # and produces NaN gradients in the transposed scan on TPU.
+    s = jnp.einsum("...qd,...kd->...qk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))
@@ -66,7 +71,8 @@ def _block_attend(q, k, v, m, l, acc, mask, sm_scale):
         p = jnp.where(mask, p, 0.0)
     l_new = l * alpha + p.sum(axis=-1)
     acc_new = acc * alpha[..., None] + jnp.einsum(
-        "...qk,...kd->...qd", p, v.astype(jnp.float32))
+        "...qk,...kd->...qd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
     return m_new, l_new, acc_new
 
 
