@@ -20,3 +20,5 @@ from horovod_tpu.models.transformer import (  # noqa: F401
     TransformerLM,
     next_token_loss,
 )
+from horovod_tpu.models.vgg import VGG11, VGG16, VGG19  # noqa: F401
+from horovod_tpu.models.inception import InceptionV3  # noqa: F401

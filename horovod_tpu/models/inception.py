@@ -1,0 +1,161 @@
+"""Inception V3, TPU-tuned flax implementation.
+
+Inception V3 is one of the reference's published scaling benchmarks (90%
+efficiency at 512 GPUs, /root/reference/README.md:45-51,
+docs/benchmarks.md:5-6).  Structure follows Szegedy et al.
+(arXiv:1512.00567): stem, 3x InceptionA (35x35), ReductionA, 4x InceptionB
+(17x17), ReductionB, 2x InceptionC (8x8).  NHWC, bfloat16 compute, f32
+params/BN; the auxiliary classifier is omitted (it only aids very long
+from-scratch schedules and the reference benchmarks never used its loss).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+def _pool(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = conv(64, (1, 1))(x, train)
+        b5 = conv(48, (1, 1))(x, train)
+        b5 = conv(64, (5, 5))(b5, train)
+        b3 = conv(64, (1, 1))(x, train)
+        b3 = conv(96, (3, 3))(b3, train)
+        b3 = conv(96, (3, 3))(b3, train)
+        bp = conv(self.pool_features, (1, 1))(_pool(x), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class ReductionA(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        b3 = conv(384, (3, 3), (2, 2), "VALID")(x, train)
+        bd = conv(64, (1, 1))(x, train)
+        bd = conv(96, (3, 3))(bd, train)
+        bd = conv(96, (3, 3), (2, 2), "VALID")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        c = self.channels_7x7
+        b1 = conv(192, (1, 1))(x, train)
+        b7 = conv(c, (1, 1))(x, train)
+        b7 = conv(c, (1, 7))(b7, train)
+        b7 = conv(192, (7, 1))(b7, train)
+        b77 = conv(c, (1, 1))(x, train)
+        b77 = conv(c, (7, 1))(b77, train)
+        b77 = conv(c, (1, 7))(b77, train)
+        b77 = conv(c, (7, 1))(b77, train)
+        b77 = conv(192, (1, 7))(b77, train)
+        bp = conv(192, (1, 1))(_pool(x), train)
+        return jnp.concatenate([b1, b7, b77, bp], axis=-1)
+
+
+class ReductionB(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        b3 = conv(192, (1, 1))(x, train)
+        b3 = conv(320, (3, 3), (2, 2), "VALID")(b3, train)
+        b7 = conv(192, (1, 1))(x, train)
+        b7 = conv(192, (1, 7))(b7, train)
+        b7 = conv(192, (7, 1))(b7, train)
+        b7 = conv(192, (3, 3), (2, 2), "VALID")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = conv(320, (1, 1))(x, train)
+        b3 = conv(384, (1, 1))(x, train)
+        b3a = conv(384, (1, 3))(b3, train)
+        b3b = conv(384, (3, 1))(b3, train)
+        bd = conv(448, (1, 1))(x, train)
+        bd = conv(384, (3, 3))(bd, train)
+        bda = conv(384, (1, 3))(bd, train)
+        bdb = conv(384, (3, 1))(bd, train)
+        bp = conv(192, (1, 1))(_pool(x), train)
+        return jnp.concatenate([b1, b3a, b3b, bda, bdb, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # Stem: 299x299x3 -> 35x35x192
+        x = conv(32, (3, 3), (2, 2), "VALID")(x, train)
+        x = conv(32, (3, 3), padding="VALID")(x, train)
+        x = conv(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = conv(80, (1, 1), padding="VALID")(x, train)
+        x = conv(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 3x InceptionA
+        x = InceptionA(32, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = ReductionA(self.dtype)(x, train)
+        # 4x InceptionB
+        x = InceptionB(128, self.dtype)(x, train)
+        x = InceptionB(160, self.dtype)(x, train)
+        x = InceptionB(160, self.dtype)(x, train)
+        x = InceptionB(192, self.dtype)(x, train)
+        x = ReductionB(self.dtype)(x, train)
+        # 2x InceptionC
+        x = InceptionC(self.dtype)(x, train)
+        x = InceptionC(self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))

@@ -53,6 +53,50 @@ def test_resnet50_param_count():
     assert 25.4e6 < n < 25.8e6, n
 
 
+def test_vgg16_param_count():
+    """VGG-16: ~138.36M parameters (the parameter-heavy benchmark of the
+    reference's scaling table, /root/reference/docs/benchmarks.md:6)."""
+    from horovod_tpu.models import VGG16
+
+    model = VGG16(num_classes=1000)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.ones((1, 224, 224, 3)), train=False))
+    n = sum(int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(variables["params"]))
+    assert 138.0e6 < n < 138.7e6, n
+
+
+def test_inception_v3_param_count_and_shape():
+    """Inception V3: ~23.8M parameters (sans aux head), 299x299 input
+    (the reference's 90%-efficiency benchmark, docs/benchmarks.md:5)."""
+    from horovod_tpu.models import InceptionV3
+
+    model = InceptionV3(num_classes=1000)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.ones((1, 299, 299, 3)), train=False))
+    n = sum(int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(variables["params"]))
+    assert 23.0e6 < n < 24.5e6, n
+    out = jax.eval_shape(
+        lambda: model.init_with_output(
+            jax.random.PRNGKey(0), jnp.ones((2, 299, 299, 3)),
+            train=False)[0])
+    assert out.shape == (2, 1000)
+
+
+def test_vgg_tiny_forward():
+    from horovod_tpu.models.vgg import VGG
+
+    model = VGG(stage_convs=(1, 1), num_classes=5, dtype=jnp.float32)
+    x = jnp.ones((2, 16, 16, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 5)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
 def test_dryrun_multichip_8():
     import __graft_entry__ as ge
 
