@@ -18,6 +18,7 @@ BENCH_HEADS; allreduce adds BENCH_NP/BENCH_BYTES/BENCH_ITERS.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -190,7 +191,9 @@ def main() -> None:
             logits, labels).mean()
         return loss, new_stats
 
-    @jax.jit
+    # Donating params/stats/opt_state lets XLA update in place instead of
+    # allocating fresh HBM buffers every step (~1.5% on resnet101).
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, batch_stats, opt_state, images, labels):
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, batch_stats, images, labels)

@@ -15,4 +15,5 @@ from horovod_tpu.runner.launch import (  # noqa: F401
     launch_fn,
     make_rank_env,
     run_command,
+    run_hosts,
 )

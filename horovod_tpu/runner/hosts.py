@@ -1,0 +1,129 @@
+"""Multi-host job planning for hvdrun (the `mpirun -H host1:2,host2:2`
+replacement, /root/reference/docs/running.md).
+
+A host spec assigns ranks to hosts in contiguous blocks (host order, then
+slot order), which both defines local_rank/local_size and satisfies the
+engine's hierarchical-allreduce layout contract.  Endpoints use fixed,
+configurable ports (free-port probing is impossible on remote hosts):
+the coordinator lives on the first host at ``port_base``; each rank's data
+endpoint is ``host:port_base + 1 + local_rank``.
+
+Remote ranks are started over ``ssh`` with the rank environment inlined
+into the remote command; local ranks spawn directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import socket
+from typing import Dict, List, Optional, Sequence
+
+DEFAULT_PORT_BASE = 58930
+
+
+@dataclasses.dataclass(frozen=True)
+class RankPlacement:
+    rank: int
+    host: str
+    local_rank: int
+    local_size: int
+    env: Dict[str, str]  # HVD_TPU_* for this rank
+
+    @property
+    def is_local(self) -> bool:
+        return is_local_host(self.host)
+
+
+def is_local_host(host: str) -> bool:
+    if host in ("localhost", "127.0.0.1", "::1"):
+        return True
+    try:
+        names = {socket.gethostname(), socket.getfqdn()}
+    except OSError:  # pragma: no cover
+        names = set()
+    return host in names
+
+
+def parse_hosts(spec: str) -> List:
+    """``"host1:2,host2:4"`` -> [("host1", 2), ("host2", 4)].  A bare host
+    means 1 slot; repeated hosts merge their slots (as mpirun's -H does),
+    keeping first-appearance order — duplicates must not produce colliding
+    local ranks/data ports."""
+    order: List[str] = []
+    slots_by_host: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.rsplit(":", 1)
+            n = int(slots)
+        else:
+            host, n = part, 1
+        if n < 1:
+            raise ValueError(f"bad slot count in host spec: {part!r}")
+        if host not in slots_by_host:
+            order.append(host)
+            slots_by_host[host] = 0
+        slots_by_host[host] += n
+    if not order:
+        raise ValueError(f"empty host spec: {spec!r}")
+    return [(h, slots_by_host[h]) for h in order]
+
+
+def plan(np_: int, hosts_spec: str,
+         port_base: int = DEFAULT_PORT_BASE) -> List[RankPlacement]:
+    """Assign `np_` ranks across the host spec in contiguous blocks."""
+    hosts = parse_hosts(hosts_spec)
+    capacity = sum(n for _, n in hosts)
+    if np_ > capacity:
+        raise ValueError(
+            f"-np {np_} exceeds the {capacity} slots in the host spec")
+    placements: List[tuple] = []  # (host, local_rank)
+    for host, slots in hosts:
+        for s in range(slots):
+            if len(placements) < np_:
+                placements.append((host, s))
+    # local_size = ranks actually placed on the host (last host may be
+    # partially filled).
+    per_host: Dict[str, int] = {}
+    for host, _ in placements:
+        per_host[host] = per_host.get(host, 0) + 1
+
+    coord = f"{placements[0][0]}:{port_base}"
+    data = [f"{host}:{port_base + 1 + lr}" for host, lr in placements]
+    out = []
+    for rank, (host, lr) in enumerate(placements):
+        env = {
+            "HVD_TPU_RANK": str(rank),
+            "HVD_TPU_SIZE": str(np_),
+            "HVD_TPU_LOCAL_RANK": str(lr),
+            "HVD_TPU_LOCAL_SIZE": str(per_host[host]),
+            "HVD_TPU_COORD": coord,
+            "HVD_TPU_DATA": ",".join(data),
+        }
+        out.append(RankPlacement(rank, host, lr, per_host[host], env))
+    return out
+
+
+def ssh_command(placement: RankPlacement, cmd: Sequence[str],
+                ssh_args: Sequence[str] = (),
+                extra_env: Optional[Dict[str, str]] = None,
+                cwd: Optional[str] = None) -> List[str]:
+    """The `ssh` argv that runs `cmd` on the placement's host.
+
+    The rank environment (plus ``extra_env``) is inlined into the remote
+    command; the remote shell first ``cd``s into ``cwd`` (default: the
+    local working directory) when that path exists there, matching
+    mpirun's working-directory propagation so relative script paths work.
+    """
+    env = dict(extra_env or {})
+    env.update(placement.env)
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in sorted(env.items()))
+    cwd = cwd if cwd is not None else os.getcwd()
+    remote = (f"cd {shlex.quote(cwd)} 2>/dev/null; env {exports} "
+              + " ".join(shlex.quote(c) for c in cmd))
+    return ["ssh", *ssh_args, placement.host, remote]

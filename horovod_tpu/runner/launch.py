@@ -54,6 +54,41 @@ def run_command(cmd: Sequence[str], np: int,
             stdout=subprocess.PIPE if capture else None,
             stderr=subprocess.PIPE if capture else None,
             text=True))
+    return _wait_all(cmd, procs, timeout)
+
+
+def run_hosts(cmd: Sequence[str], np: int, hosts_spec: str,
+              port_base: Optional[int] = None,
+              env: Optional[Dict[str, str]] = None,
+              timeout: float = 3e7,
+              capture: bool = False,
+              ssh_args: Sequence[str] = ()) -> List[RankResult]:
+    """Launch `cmd` across a host spec ("host1:2,host2:2"): local ranks
+    spawn directly, remote ranks over ssh (the `mpirun -H` replacement,
+    /root/reference/docs/running.md).  Keys of `env` that differ from this
+    process's environment are forwarded to remote ranks too (inlined into
+    the ssh command), so overrides like PYTHONPATH reach every rank."""
+    from horovod_tpu.runner.hosts import DEFAULT_PORT_BASE, plan, ssh_command
+
+    placements = plan(np, hosts_spec, port_base or DEFAULT_PORT_BASE)
+    base_env = dict(env if env is not None else os.environ)
+    overrides = {k: v for k, v in base_env.items()
+                 if os.environ.get(k) != v}
+    procs = []
+    for p in placements:
+        rank_env = dict(base_env)
+        rank_env.update(p.env)
+        argv = list(cmd) if p.is_local else ssh_command(
+            p, cmd, ssh_args, extra_env=overrides)
+        procs.append(subprocess.Popen(
+            argv, env=rank_env,
+            stdout=subprocess.PIPE if capture else None,
+            stderr=subprocess.PIPE if capture else None,
+            text=True))
+    return _wait_all(cmd, procs, timeout)
+
+
+def _wait_all(cmd: Sequence[str], procs, timeout: float) -> List[RankResult]:
     import time
 
     # Poll all ranks; when one fails, give the rest a grace period (the
@@ -121,9 +156,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prog="hvdrun",
         description="Launch a horovod_tpu training job (mpirun replacement).")
     parser.add_argument("-np", "--num-proc", type=int, required=True,
-                        help="number of ranks to launch on this host")
+                        help="number of ranks to launch")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help="host spec 'host1:slots,host2:slots' — ranks "
+                             "fill hosts in contiguous blocks; remote hosts "
+                             "are reached over ssh (the mpirun -H "
+                             "replacement). Default: all ranks local.")
+    parser.add_argument("--port-base", type=int, default=None,
+                        help="with -H: coordinator port (data ports follow)")
     parser.add_argument("--host", default="127.0.0.1",
-                        help="bind address for coordinator/data endpoints")
+                        help="bind address for coordinator/data endpoints "
+                             "(single-host mode)")
     parser.add_argument("--timeout", type=float, default=0.0,
                         help="kill the job after this many seconds (0 = none)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
@@ -135,8 +178,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
     try:
-        results = run_command(cmd, args.num_proc, host=args.host,
-                              timeout=args.timeout or 3e7)
+        if args.hosts:
+            results = run_hosts(cmd, args.num_proc, args.hosts,
+                                port_base=args.port_base,
+                                timeout=args.timeout or 3e7)
+        else:
+            results = run_command(cmd, args.num_proc, host=args.host,
+                                  timeout=args.timeout or 3e7)
     except subprocess.TimeoutExpired:
         print("hvdrun: job timed out", file=sys.stderr)
         return 124

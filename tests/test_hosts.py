@@ -1,0 +1,90 @@
+"""Multi-host launcher: placement planning and a live -H run (all slots on
+127.0.0.1, which exercises the fixed-port plan without ssh)."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.basics import pick_free_port
+from horovod_tpu.runner.hosts import parse_hosts, plan, ssh_command
+
+
+def test_parse_hosts():
+    assert parse_hosts("a:2,b:4") == [("a", 2), ("b", 4)]
+    assert parse_hosts("single") == [("single", 1)]
+    with pytest.raises(ValueError):
+        parse_hosts("a:0")
+    with pytest.raises(ValueError):
+        parse_hosts("")
+
+
+def test_plan_contiguous_blocks():
+    ps = plan(6, "hostA:2,hostB:4", port_base=50000)
+    assert [p.host for p in ps] == ["hostA"] * 2 + ["hostB"] * 4
+    assert [p.local_rank for p in ps] == [0, 1, 0, 1, 2, 3]
+    assert all(p.local_size == (2 if p.host == "hostA" else 4) for p in ps)
+    # Coordinator on the first host; data ports laid out by local rank.
+    assert all(p.env["HVD_TPU_COORD"] == "hostA:50000" for p in ps)
+    data = ps[0].env["HVD_TPU_DATA"].split(",")
+    assert data[0] == "hostA:50001" and data[2] == "hostB:50001"
+    assert data[5] == "hostB:50004"
+    # Hierarchical layout contract: rank blocks match local ranks.
+    for p in ps:
+        assert int(p.env["HVD_TPU_RANK"]) == p.rank
+
+
+def test_plan_partial_last_host():
+    ps = plan(3, "a:2,b:4")
+    assert [p.host for p in ps] == ["a", "a", "b"]
+    assert ps[2].local_size == 1  # only one rank actually landed on b
+
+
+def test_plan_overcommit_rejected():
+    with pytest.raises(ValueError, match="exceeds"):
+        plan(5, "a:2,b:2")
+
+
+def test_plan_merges_duplicate_hosts():
+    """Repeated hosts merge their slots (mpirun behavior) instead of
+    producing colliding local ranks / data ports."""
+    ps = plan(4, "a:2,a:2", port_base=52000)
+    assert [p.local_rank for p in ps] == [0, 1, 2, 3]
+    assert all(p.local_size == 4 for p in ps)
+    data = ps[0].env["HVD_TPU_DATA"].split(",")
+    assert len(set(data)) == 4  # all endpoints distinct
+
+
+def test_ssh_command_quotes_env_and_cds():
+    p = plan(2, "remotehost:2", port_base=51000)[1]
+    argv = ssh_command(p, ["python", "train.py", "--lr", "0.1"],
+                       extra_env={"PYTHONPATH": "/x y"}, cwd="/work dir")
+    assert argv[0] == "ssh" and argv[1] == "remotehost"
+    assert "HVD_TPU_RANK=1" in argv[2]
+    assert "PYTHONPATH='/x y'" in argv[2]
+    assert argv[2].startswith("cd '/work dir' 2>/dev/null; ")
+    assert "python train.py --lr 0.1" in argv[2]
+
+
+def test_run_hosts_local_live():
+    """-H with every slot on 127.0.0.1: the full fixed-port multi-host path
+    minus ssh.  Ranks do one engine allreduce to prove the plan's endpoints
+    are mutually consistent."""
+    from horovod_tpu.runner import run_hosts
+
+    code = (
+        "import numpy as np, horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "out = hvd.allreduce(np.ones(8, np.float32) * (hvd.rank() + 1),\n"
+        "                    average=False, name='h')\n"
+        "assert np.allclose(out, sum(range(1, hvd.size() + 1))), out\n"
+        "print('RANK_OK', hvd.rank(), hvd.local_rank(), hvd.local_size())\n"
+    )
+    port_base = pick_free_port()
+    results = run_hosts([sys.executable, "-c", code], 3, "127.0.0.1:3",
+                        port_base=port_base, timeout=120.0, capture=True)
+    assert all(r.returncode == 0 for r in results), \
+        [(r.rank, r.returncode, r.stderr[-500:]) for r in results]
+    lines = sorted(r.stdout.strip() for r in results)
+    assert lines == ["RANK_OK 0 0 3", "RANK_OK 1 1 3", "RANK_OK 2 2 3"]
