@@ -42,6 +42,13 @@ class HorovodInternalError(RuntimeError):
 _lib = None
 _lib_lock = threading.Lock()
 _process_set: Optional[ProcessSet] = None
+# XLA data plane (compiled collectives over the accelerator fabric) when
+# HVD_TPU_XLA_DATA_PLANE=1; None = disabled/unavailable -> TCP engine.
+_xla_plane = None
+# Dtypes the XLA plane accepts: jax's default (x64-disabled) world plus the
+# half types it widens; everything else (f64, bool, ...) stays on the engine.
+_XLA_PLANE_DTYPES = ("float32", "float16", "bfloat16", "int32", "int8",
+                     "uint8")
 
 
 def _load_lib():
@@ -110,14 +117,45 @@ def init(comm: Optional[Sequence[int]] = None) -> None:
             "engine initialization failed: "
             + lib.hvd_tpu_init_error().decode())
     _process_set = ps
+    if cfg.xla_data_plane:
+        global _xla_plane
+        plane = None
+        try:
+            from horovod_tpu.jax import eager_mesh
+
+            plane = eager_mesh.initialize(ps)
+        except ImportError as exc:
+            import warnings
+
+            warnings.warn(
+                f"HVD_TPU_XLA_DATA_PLANE=1 but jax is unavailable ({exc}); "
+                "eager collectives will use the TCP engine.")
+        if ps.size > 1:
+            # Job-wide agreement over the TCP engine (_xla_plane is still
+            # None, so this allreduce cannot ride the plane): a rank whose
+            # plane init failed must not diverge from ranks whose
+            # succeeded, or the job deadlocks across two transports.
+            total = allreduce(np.asarray(1 if plane else 0, np.int32),
+                              average=False, name="__xla_plane_agreement__")
+            if int(total) != ps.size:
+                if plane is not None:
+                    import warnings
+
+                    warnings.warn(
+                        "XLA data plane disabled: not every rank could "
+                        "initialize it; eager collectives use the TCP "
+                        "engine.")
+                plane = None
+        _xla_plane = plane
     atexit.register(shutdown)
 
 
 def shutdown() -> None:
-    global _process_set
+    global _process_set, _xla_plane
     if _lib is not None and _lib.hvd_tpu_initialized():
         _lib.hvd_tpu_shutdown()
     _process_set = None
+    _xla_plane = None
 
 
 def _check_initialized(lib) -> None:
@@ -253,6 +291,10 @@ def _check_out(out: np.ndarray, array: np.ndarray) -> None:
         raise ValueError("output buffer must be C-contiguous and writeable")
 
 
+def _plane_eligible(array: np.ndarray) -> bool:
+    return _xla_plane is not None and array.dtype.name in _XLA_PLANE_DTYPES
+
+
 def allreduce_async(array: np.ndarray, average: bool = True,
                     name: Optional[str] = None,
                     out: Optional[np.ndarray] = None) -> Handle:
@@ -264,6 +306,10 @@ def allreduce_async(array: np.ndarray, average: bool = True,
     else:
         _check_out(out, array)
     name = name or _auto_name("allreduce")
+    if _plane_eligible(array):
+        # Compiled XLA collective over the fabric; batched dispatches are
+        # name-ordered at flush, mirroring the engine's named negotiation.
+        return _xla_plane.allreduce_async(array, average, out, name)
     dims, ndim = _as_c_dims(array.shape)
     raw = lib.hvd_tpu_enqueue(
         OP_ALLREDUCE, name.encode(),
@@ -303,6 +349,10 @@ def broadcast_async(array: np.ndarray, root_rank: int,
     else:
         _check_out(out, array)
     name = name or _auto_name("broadcast")
+    if _plane_eligible(array):
+        if not (0 <= root_rank < (_process_set.size if _process_set else 1)):
+            raise ValueError(f"broadcast root rank {root_rank} out of range")
+        return _xla_plane.broadcast_async(array, root_rank, out, name)
     dims, ndim = _as_c_dims(array.shape)
     raw = lib.hvd_tpu_enqueue(
         OP_BROADCAST, name.encode(),

@@ -22,6 +22,13 @@ def _get(new: str, old: str) -> Optional[str]:
     return os.environ.get(new, os.environ.get(old))
 
 
+_FALSY = ("", "0", "false", "no", "off")
+
+
+def _flag(value: Optional[str]) -> bool:
+    return value is not None and value.strip().lower() not in _FALSY
+
+
 @dataclasses.dataclass(frozen=True)
 class Config:
     fusion_threshold: int = DEFAULT_FUSION_THRESHOLD
@@ -33,6 +40,12 @@ class Config:
     # rank layout).  The engine analogue of the reference's
     # HOROVOD_HIERARCHICAL_ALLREDUCE (operations.cc:1003-1048).
     hierarchical_allreduce: bool = False
+    # Execute eager allreduce/broadcast as compiled XLA collectives over the
+    # accelerator fabric (jax.distributed across the job) instead of the TCP
+    # ring — the TPU mapping of the reference's NCCL data plane
+    # (operations.cc:861-1100).  Allgather and unsupported dtypes stay on
+    # the TCP engine.
+    xla_data_plane: bool = False
 
     @staticmethod
     def from_env() -> "Config":
@@ -40,14 +53,13 @@ class Config:
         cycle = _get("HVD_TPU_CYCLE_TIME", "HOROVOD_CYCLE_TIME")
         stall = _get("HVD_TPU_STALL_WARNING_SEC", "HOROVOD_STALL_WARNING_SEC")
         timeline = _get("HVD_TPU_TIMELINE", "HOROVOD_TIMELINE")
-        hier = _get("HVD_TPU_HIERARCHICAL_ALLREDUCE",
-                    "HOROVOD_HIERARCHICAL_ALLREDUCE")
-        falsy = hier is None or hier.strip().lower() in (
-            "", "0", "false", "no", "off")
         return Config(
             fusion_threshold=int(fusion) if fusion else DEFAULT_FUSION_THRESHOLD,
             cycle_time_ms=float(cycle) if cycle else DEFAULT_CYCLE_TIME_MS,
             stall_warning_sec=float(stall) if stall else DEFAULT_STALL_WARNING_SEC,
             timeline_path=timeline or "",
-            hierarchical_allreduce=not falsy,
+            hierarchical_allreduce=_flag(
+                _get("HVD_TPU_HIERARCHICAL_ALLREDUCE",
+                     "HOROVOD_HIERARCHICAL_ALLREDUCE")),
+            xla_data_plane=_flag(os.environ.get("HVD_TPU_XLA_DATA_PLANE")),
         )

@@ -1,0 +1,212 @@
+"""XLA data plane for eager collectives.
+
+The north-star TPU mapping of the reference's NCCL data plane
+(/root/reference/horovod/common/operations.cc:861-1100): eagerly issued
+tensors execute as *compiled XLA collectives* over the accelerator fabric
+(ICI on a pod; gloo/gRPC on CPU test meshes) instead of the engine's TCP
+ring.  Enabled with ``HVD_TPU_XLA_DATA_PLANE=1``; the TCP engine remains
+the control plane (negotiation, allgather, error paths) and the fallback.
+
+Design: `jax.distributed` connects all processes (its coordinator endpoint
+comes from the launcher, `HVD_TPU_XLA_COORD`); one device per process forms
+a process-spanning mesh.  An eager allreduce turns the per-process value
+into a global array sharded over the process axis and runs a jitted
+``sum(axis=0)`` replicated out — XLA compiles that to an all-reduce over
+the fabric.  Executables cache by (op, shape, dtype), the analogue of the
+reference's NCCL-communicator cache (operations.cc:212).  Dispatch is
+async (JAX returns futures); `XlaHandle.wait()` materializes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+_lock = threading.Lock()
+_plane = None  # initialized XlaDataPlane, or False if init failed/disabled
+
+
+class XlaHandle:
+    """Duck-type of horovod_tpu.common.Handle for XLA-plane collectives.
+
+    Dispatch is deferred: the op sits in the plane's pending list until any
+    handle is polled/waited, at which point everything pending flushes in
+    **name order** — so ranks whose enqueue order differs (e.g. torch
+    backward hooks firing in different orders) still execute the same
+    collective sequence, the property the engine gets from name-based
+    negotiation."""
+
+    def __init__(self, plane, name: str, out: Optional[np.ndarray],
+                 average: bool, size: int, dtype):
+        self._plane = plane
+        self._name = name
+        self._result = None  # jax.Array once flushed
+        self._out = out
+        self._average = average
+        self._size = size
+        self._dtype = dtype
+        self._finished = False
+
+    def done(self) -> bool:
+        if self._finished:
+            return True
+        self._plane.flush()
+        return self._result.is_ready()
+
+    def wait(self) -> np.ndarray:
+        if self._finished:
+            raise ValueError(f"handle for '{self._name}' already waited on")
+        self._finished = True
+        self._plane.flush()
+        host = np.asarray(self._result)
+        if self._average:
+            if np.issubdtype(self._dtype, np.integer):
+                host = (host / self._size).astype(self._dtype)
+            else:
+                host = (host / np.asarray(self._size, host.dtype)).astype(
+                    self._dtype)
+        else:
+            host = host.astype(self._dtype, copy=False)
+        if self._out is not None:
+            np.copyto(self._out, host.reshape(self._out.shape))
+            return self._out
+        return host
+
+
+class XlaDataPlane:
+    def __init__(self, mesh, spec_sharded, spec_replicated, rank, size):
+        self._mesh = mesh
+        self._in_sharding = spec_sharded
+        self._out_sharding = spec_replicated
+        self._rank = rank
+        self._size = size
+        self._fns = {}
+        self._mu = threading.Lock()  # guards _fns and _pending
+        self._pending = []  # (name, op, payload, root, handle)
+
+    def _jit_for(self, op: str, shape, dtype, root: int = 0):
+        import jax
+
+        key = (op, shape, np.dtype(dtype).str, root)
+        fn = self._fns.get(key)
+        if fn is None:
+            if op == "allreduce":
+                fn = jax.jit(lambda a: a.sum(axis=0),
+                             out_shardings=self._out_sharding)
+            else:  # broadcast: every process receives root's block
+                fn = jax.jit(lambda a: a[root],
+                             out_shardings=self._out_sharding)
+            self._fns[key] = fn
+        return fn
+
+    def _global_array(self, array: np.ndarray):
+        import jax
+
+        local = array[np.newaxis]  # (1, ...) — this process's block
+        return jax.make_array_from_process_local_data(
+            self._in_sharding, local, (self._size,) + array.shape)
+
+    def flush(self) -> None:
+        """Dispatch every pending op, sorted by collective name (the
+        cross-rank matching key).  Dispatches go out back-to-back, so XLA
+        pipelines the transfers."""
+        with self._mu:
+            pending, self._pending = self._pending, []
+            pending.sort(key=lambda item: item[0])
+            for name, op, payload, root, handle in pending:
+                arr = self._global_array(payload)
+                fn = self._jit_for(op, payload.shape, payload.dtype, root)
+                handle._result = fn(arr)
+
+    def allreduce_async(self, array: np.ndarray, average: bool,
+                        out: Optional[np.ndarray], name: str) -> XlaHandle:
+        dtype = array.dtype
+        # bf16/f16 sum in f32, like the engine's staging (engine.cc); bf16
+        # from ml_dtypes reports kind "V".
+        compute = array.astype(np.float32) if dtype.itemsize == 2 \
+            and dtype.kind in ("f", "V") else array
+        handle = XlaHandle(self, name, out, average, self._size, dtype)
+        with self._mu:
+            self._pending.append((name, "allreduce", compute, 0, handle))
+        return handle
+
+    def broadcast_async(self, array: np.ndarray, root_rank: int,
+                        out: Optional[np.ndarray], name: str) -> XlaHandle:
+        handle = XlaHandle(self, name, out, False, self._size, array.dtype)
+        with self._mu:
+            self._pending.append(
+                (name, "broadcast", array, root_rank, handle))
+        return handle
+
+
+def _xla_coordinator(ps) -> Optional[str]:
+    ep = os.environ.get("HVD_TPU_XLA_COORD")
+    if ep:
+        return ep
+    if ps.coord_endpoint:
+        # Derive a port clear of both defaults: engine coordinator 58930
+        # and data 58931 (basics.py pod-metadata resolution).
+        host, port = ps.coord_endpoint.rsplit(":", 1)
+        offset = int(os.environ.get("HVD_TPU_XLA_COORD_PORT_OFFSET", "3"))
+        return f"{host}:{int(port) + offset}"
+    return None
+
+
+def initialize(ps) -> Optional[XlaDataPlane]:
+    """Connect jax.distributed across the job and build the process mesh.
+    Returns None (with a warning) when the fabric cannot be initialized —
+    callers fall back to the TCP engine."""
+    global _plane
+    with _lock:
+        if _plane is not None:
+            return _plane or None
+        try:
+            import jax
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec as P)
+
+            if ps.size > 1:
+                coord = _xla_coordinator(ps)
+                if coord is None:
+                    raise RuntimeError(
+                        "no XLA coordinator endpoint (HVD_TPU_XLA_COORD)")
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=ps.size, process_id=ps.rank)
+            devices = jax.devices()
+            # One device per process, ordered by rank.
+            by_proc = {}
+            for d in devices:
+                by_proc.setdefault(d.process_index, d)
+            if len(by_proc) != ps.size:
+                raise RuntimeError(
+                    f"{len(by_proc)} processes visible to JAX, expected "
+                    f"{ps.size}")
+            mesh_devices = [by_proc[i] for i in sorted(by_proc)]
+            mesh = Mesh(np.array(mesh_devices), ("hvd_proc",))
+            plane = XlaDataPlane(
+                mesh,
+                NamedSharding(mesh, P("hvd_proc")),
+                NamedSharding(mesh, P()),
+                ps.rank, ps.size)
+            _plane = plane
+            return plane
+        except Exception as exc:  # fall back to the TCP engine
+            import warnings
+
+            warnings.warn(
+                f"XLA data plane unavailable ({exc}); eager collectives "
+                "will use the TCP engine.")
+            _plane = False
+            return None
+
+
+def reset() -> None:
+    """Testing hook: forget the cached plane (jax.distributed state is
+    process-wide and cannot be re-initialized; use fresh processes)."""
+    global _plane
+    with _lock:
+        _plane = None

@@ -57,10 +57,13 @@ def parse_hosts(spec: str) -> List:
         part = part.strip()
         if not part:
             continue
-        if ":" in part:
-            host, slots = part.rsplit(":", 1)
+        if part.startswith("["):  # bracketed IPv6: "[::1]" or "[::1]:2"
+            host, rest = part[1:].split("]", 1)
+            n = int(rest[1:]) if rest.startswith(":") else 1
+        elif part.count(":") == 1:  # "host:slots"
+            host, slots = part.split(":")
             n = int(slots)
-        else:
+        else:  # bare host, incl. unbracketed IPv6 literals
             host, n = part, 1
         if n < 1:
             raise ValueError(f"bad slot count in host spec: {part!r}")
@@ -93,6 +96,9 @@ def plan(np_: int, hosts_spec: str,
         per_host[host] = per_host.get(host, 0) + 1
 
     coord = f"{placements[0][0]}:{port_base}"
+    # Data ports occupy port_base+1 .. port_base+slots; the XLA data
+    # plane's jax.distributed coordinator gets a port well clear of them.
+    xla_coord = f"{placements[0][0]}:{port_base + 500}"
     data = [f"{host}:{port_base + 1 + lr}" for host, lr in placements]
     out = []
     for rank, (host, lr) in enumerate(placements):
@@ -103,6 +109,7 @@ def plan(np_: int, hosts_spec: str,
             "HVD_TPU_LOCAL_SIZE": str(per_host[host]),
             "HVD_TPU_COORD": coord,
             "HVD_TPU_DATA": ",".join(data),
+            "HVD_TPU_XLA_COORD": xla_coord,
         }
         out.append(RankPlacement(rank, host, lr, per_host[host], env))
     return out

@@ -20,7 +20,8 @@ class RankResult:
 def make_rank_env(rank: int, size: int, coord: str, data: Sequence[str],
                   base_env: Optional[Dict[str, str]] = None,
                   local_rank: Optional[int] = None,
-                  local_size: Optional[int] = None) -> Dict[str, str]:
+                  local_size: Optional[int] = None,
+                  xla_coord: Optional[str] = None) -> Dict[str, str]:
     env = dict(base_env if base_env is not None else os.environ)
     env["HVD_TPU_RANK"] = str(rank)
     env["HVD_TPU_SIZE"] = str(size)
@@ -28,6 +29,8 @@ def make_rank_env(rank: int, size: int, coord: str, data: Sequence[str],
     env["HVD_TPU_LOCAL_SIZE"] = str(local_size if local_size is not None else size)
     env["HVD_TPU_COORD"] = coord
     env["HVD_TPU_DATA"] = ",".join(data)
+    if xla_coord:
+        env["HVD_TPU_XLA_COORD"] = xla_coord
     return env
 
 
@@ -45,9 +48,11 @@ def run_command(cmd: Sequence[str], np: int,
     """Launch `cmd` as `np` local ranks; wait for all; kill all on any
     failure.  Returns per-rank results (stdout/stderr only if capture)."""
     coord, data = allocate_endpoints(np, host)
+    xla_coord = f"{host}:{pick_free_port(host)}"
     procs = []
     for r in range(np):
-        rank_env = make_rank_env(r, np, coord, data, env)
+        rank_env = make_rank_env(r, np, coord, data, env,
+                                 xla_coord=xla_coord)
         procs.append(subprocess.Popen(
             list(cmd),
             env=rank_env,
