@@ -83,6 +83,85 @@ def bench_transformer() -> None:
     }))
 
 
+def bench_scaling() -> None:
+    """DP scaling efficiency: ResNet-50 shard_map step at 1 vs N devices.
+
+    The BASELINE.md tracked metric (scaling efficiency 8->256 chips on a
+    v5e pod) measured with the same methodology on whatever mesh is
+    available: efficiency = throughput(N) / (throughput(1) * N) with the
+    per-device batch held constant.  On a single-chip or CPU environment
+    this exercises the harness on a virtual device mesh.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import models
+    from horovod_tpu.jax.train import build_train_step
+    from horovod_tpu.parallel import (data_parallel_mesh, replicate,
+                                      shard_batch)
+
+    per_dev_batch = int(os.environ.get("BENCH_BATCH", "16"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    side = int(os.environ.get("BENCH_IMAGE", "96"))
+    n_dev = len(jax.devices())
+
+    def throughput(devices):
+        n = len(devices)
+        mesh = data_parallel_mesh(devices, axis_name="hvd")
+        model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                                axis_name="hvd")
+        batch = per_dev_batch * n
+        images = np.random.RandomState(0).rand(
+            batch, side, side, 3).astype(np.float32)
+        labels = np.random.RandomState(1).randint(0, 1000, batch)
+        variables = model.init(jax.random.PRNGKey(0), images[:2],
+                               train=False)
+        params, stats = variables["params"], variables["batch_stats"]
+
+        def loss_fn(params, b):
+            imgs, labs, stats = b
+            logits, upd = model.apply(
+                {"params": params, "batch_stats": stats}, imgs,
+                train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labs).mean()
+            return loss, upd["batch_stats"]
+
+        tx = optax.sgd(0.1, momentum=0.9)
+        step = build_train_step(loss_fn, tx, mesh, axis_name="hvd",
+                                has_aux=True,
+                                batch_spec=(P("hvd"), P("hvd"), P()))
+        params = replicate(mesh, params)
+        opt_state = replicate(mesh, tx.init(params))
+        b = (shard_batch(mesh, images),
+             shard_batch(mesh, jnp.asarray(labels, jnp.int32)),
+             replicate(mesh, stats))
+        for _ in range(max(warmup, 1)):
+            params, opt_state, loss, stats2 = step(params, opt_state, b)
+            b = (b[0], b[1], stats2)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss, stats2 = step(params, opt_state, b)
+            b = (b[0], b[1], stats2)
+        float(loss)
+        return batch * steps / (time.perf_counter() - t0)
+
+    base = throughput(jax.devices()[:1])
+    full = throughput(jax.devices())
+    efficiency = full / (base * n_dev)
+    print(json.dumps({
+        "metric": f"resnet50_dp_scaling_efficiency_1_to_{n_dev}",
+        "value": round(efficiency, 4),
+        "unit": "fraction",
+        "vs_baseline": round(efficiency / 0.88, 3),  # >= 0.88 is the target
+    }))
+
+
 def bench_allreduce() -> None:
     """Engine eager ring-allreduce bandwidth over NP local ranks."""
     import subprocess
@@ -144,6 +223,8 @@ def main() -> None:
         return bench_transformer()
     if model_name == "allreduce":
         return bench_allreduce()
+    if model_name == "scaling":
+        return bench_scaling()
     batch = int(os.environ.get("BENCH_BATCH", "64"))
     steps = int(os.environ.get("BENCH_STEPS", "30"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))

@@ -1,0 +1,60 @@
+"""Example scripts as system tests, the reference's acceptance pattern
+(/root/reference/.travis.yml:105-123 ran seds-smaller examples under
+mpirun -np 2).  Tiny configurations, 2 ranks, synthetic data."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(script, extra_args, np_=2, timeout=420):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA"):
+        env.pop(var, None)
+    cmd = [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_), "--",
+           sys.executable, os.path.join(REPO, "examples", script)] + extra_args
+    out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=timeout, cwd=REPO)
+    assert out.returncode == 0, (
+        f"{script} failed:\n--- stdout ---\n{out.stdout[-1500:]}\n"
+        f"--- stderr ---\n{out.stderr[-1500:]}")
+    return out.stdout
+
+
+def test_pytorch_mnist_example():
+    out = _run_example("pytorch_mnist.py",
+                       ["--epochs", "1", "--train-samples", "256",
+                        "--batch-size", "32"])
+    assert "Test set:" in out
+
+
+def test_tensorflow_mnist_example():
+    out = _run_example("tensorflow_mnist.py",
+                       ["--steps", "12", "--train-samples", "256"])
+    assert "Loss:" in out
+
+
+def test_jax_mnist_example():
+    """Single process, virtual 8-device mesh."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "jax_mnist.py"),
+         "--steps", "12", "--batch-size", "8"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "test accuracy" in out.stdout
+
+
+def test_word2vec_example_sparse_path():
+    out = _run_example("tensorflow_word2vec.py",
+                       ["--steps", "20", "--corpus-words", "2000"])
+    assert "trained embeddings" in out
