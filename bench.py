@@ -11,7 +11,7 @@ Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
 
 Env knobs: BENCH_MODEL (resnet101|resnet50|resnet18|vgg16|inception_v3|
-mnist|transformer|allreduce), BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
+mnist|transformer|allreduce|scaling), BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
 length); transformer adds BENCH_SEQ/BENCH_VOCAB/BENCH_D_MODEL/BENCH_LAYERS/
 BENCH_HEADS; allreduce adds BENCH_NP/BENCH_BYTES/BENCH_ITERS.
 """
@@ -151,11 +151,18 @@ def bench_scaling() -> None:
         float(loss)
         return batch * steps / (time.perf_counter() - t0)
 
-    base = throughput(jax.devices()[:1])
+    # Baseline at the smallest addressable granularity: one device in
+    # single-process jobs, this process's devices on a multi-host slice
+    # (a 1-global-device mesh would be non-addressable from other hosts).
+    local = [d for d in jax.devices()
+             if d.process_index == jax.process_index()]
+    base_devices = local[:1] if jax.process_count() == 1 else local
+    base = throughput(base_devices)
     full = throughput(jax.devices())
-    efficiency = full / (base * n_dev)
+    n_base = len(base_devices)
+    efficiency = full / (base * n_dev / n_base)
     print(json.dumps({
-        "metric": f"resnet50_dp_scaling_efficiency_1_to_{n_dev}",
+        "metric": f"resnet50_dp_scaling_efficiency_{n_base}_to_{n_dev}",
         "value": round(efficiency, 4),
         "unit": "fraction",
         "vs_baseline": round(efficiency / 0.88, 3),  # >= 0.88 is the target

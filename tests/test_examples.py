@@ -6,8 +6,6 @@ import os
 import subprocess
 import sys
 
-import pytest
-
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -17,7 +15,10 @@ def _run_example(script, extra_args, np_=2, timeout=420):
     for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
                 "HVD_TPU_DATA"):
         env.pop(var, None)
-    cmd = [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_), "--",
+    # --timeout makes the launcher kill every rank; the outer subprocess
+    # timeout alone would orphan them.
+    cmd = [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
+           "--timeout", str(timeout - 30), "--",
            sys.executable, os.path.join(REPO, "examples", script)] + extra_args
     out = subprocess.run(cmd, env=env, capture_output=True, text=True,
                          timeout=timeout, cwd=REPO)
