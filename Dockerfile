@@ -1,0 +1,22 @@
+# Containerized horovod_tpu (parity with /root/reference/Dockerfile, which
+# baked CUDA+MPI+NCCL; a TPU image needs none of that — just a toolchain for
+# the engine and the Python stack).  See docs/docker.md.
+FROM python:3.11-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make openssh-client \
+    && rm -rf /var/lib/apt/lists/*
+
+# Frameworks: JAX is required for the compiled path; torch/tf optional.
+RUN pip install --no-cache-dir \
+        "jax[tpu]" flax optax ml_dtypes numpy \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+COPY . /horovod_tpu
+RUN pip install --no-cache-dir -e /horovod_tpu
+
+WORKDIR /horovod_tpu
+# The engine builds on first import; force it at image build time.
+RUN python horovod_tpu/engine/build.py
+
+CMD ["/bin/bash"]

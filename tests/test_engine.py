@@ -254,6 +254,61 @@ def test_hierarchical_single_node():
     assert np.allclose(out, 1.5 * sum(range(1, n + 1)))
 
 
+def test_stall_warning_printed():
+    """Coordinator stall sweep: when a subset of ranks never announces a
+    tensor, rank 0 warns with the tensor name and the missing ranks
+    (operations.cc:1231-1276 behavior; untested in the reference)."""
+    import sys
+
+    from horovod_tpu.runner import run_command
+
+    code = (
+        "import os, time, numpy as np, horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "if hvd.rank() == 0:\n"
+        "    h = hvd.allreduce_async(np.ones(4, np.float32), name='lonely')\n"
+        "    time.sleep(3.0)\n"  # > 2x the 1s stall window
+        "else:\n"
+        "    time.sleep(3.0)\n"
+        "    h = hvd.allreduce_async(np.ones(4, np.float32), name='lonely')\n"
+        "h.wait()\n"
+    )
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, HVD_TPU_STALL_WARNING_SEC="1",
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    results = run_command([sys.executable, "-c", code], 2, env=env,
+                          timeout=120.0, capture=True)
+    assert all(r.returncode == 0 for r in results), \
+        [(r.rank, r.stderr[-300:]) for r in results]
+    rank0_err = results[0].stderr
+    assert "Stalled ops" in rank0_err, rank0_err[-500:]
+    assert "lonely" in rank0_err and "missing ranks: 1" in rank0_err
+
+
+@distributed_test(np_=3)
+def test_init_comm_subset():
+    """hvd.init(comm=[...]) restricts the job to a rank subset with dense
+    renumbering (the reference's init(comm=...) rank-list mode,
+    /root/reference/horovod/common/__init__.py:51-62)."""
+    import os
+
+    import horovod_tpu as hvd
+
+    launcher_rank = int(os.environ["HVD_TPU_RANK"])
+    if launcher_rank == 1:
+        return  # not in the subset; must not join
+    hvd.init(comm=[0, 2])
+    assert hvd.size() == 2
+    assert hvd.rank() == (0 if launcher_rank == 0 else 1)
+    out = hvd.allreduce(np.full(5, float(launcher_rank), np.float32),
+                        average=False, name="subset")
+    assert np.allclose(out, 2.0), out  # 0 + 2
+
+
 def test_timeline_written(tmp_path):
     """Timeline (Chrome tracing) is written on rank 0 when enabled --
     reference aux subsystem /root/reference/horovod/common/timeline.{h,cc}."""
