@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -58,17 +59,25 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     # Unrolled ring loop (n is the static mesh-axis size): each step's
     # ppermute can then be scheduled by XLA as an async collective-permute
     # overlapped with the next step's attention compute, which a
-    # lax.fori_loop carry would serialize.
-    k_cur, v_cur, m, l, acc = k, v, m0, l0, acc0
-    for t in range(n):
-        # After t right-rotations this device holds the shard that
-        # originated on device (my_idx - t) mod n.
-        kv_idx = (my_idx - t) % n
+    # lax.fori_loop carry would serialize.  Each step's attention is
+    # rematerialized in the backward pass (jax.checkpoint): without it the
+    # VJP saves every step's (seq_local, seq_local) probability block —
+    # O(seq^2 / n) per device, defeating the ring's memory scaling.  The
+    # mask is built *inside* the checkpointed step from the scalar shard
+    # index, so it is recomputed too, not stored as a residual.
+    def step_attend(q, k_cur, v_cur, m, l, acc, kv_idx):
         mask = None
         if causal:
             k_pos = kv_idx * seq_local + jnp.arange(seq_local)
             mask = q_pos[:, None] >= k_pos[None, :]
-        m, l, acc = _block_attend(q, k_cur, v_cur, m, l, acc, mask, sm_scale)
+        return _block_attend(q, k_cur, v_cur, m, l, acc, mask, sm_scale)
+
+    attend = jax.checkpoint(step_attend)
+    k_cur, v_cur, m, l, acc = k, v, m0, l0, acc0
+    for t in range(n):
+        # After t right-rotations this device holds the shard that
+        # originated on device (my_idx - t) mod n.
+        m, l, acc = attend(q, k_cur, v_cur, m, l, acc, (my_idx - t) % n)
         if t < n - 1:  # rotate K/V to the right neighbour
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
