@@ -60,8 +60,9 @@ def _is_tracer(x: Any) -> bool:
 
 def _eager_to_host(tensor) -> np.ndarray:
     # jax bfloat16 arrays convert to ml_dtypes.bfloat16 numpy arrays, which
-    # the engine's dtype table understands (common/dtypes.py).
-    return np.ascontiguousarray(np.asarray(tensor))
+    # the engine's dtype table understands (common/dtypes.py).  _as_contig
+    # preserves 0-d shapes (np.ascontiguousarray would promote to (1,)).
+    return _common._as_contig(np.asarray(tensor))
 
 
 def allreduce(tensor, average: bool = True, name: Optional[str] = None,
