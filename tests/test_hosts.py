@@ -67,6 +67,68 @@ def test_ssh_command_quotes_env_and_cds():
     assert "python train.py --lr 0.1" in argv[2]
 
 
+def _free_port_range(span: int = 501):
+    """A port base whose +1..+span offsets (data + XLA-coord layout of
+    hosts.plan) are also currently free."""
+    import socket
+
+    for _ in range(20):
+        base = pick_free_port()
+        if base + span > 65535:
+            continue
+        ok = True
+        for off in (1, 500):
+            with socket.socket() as s:
+                try:
+                    s.bind(("127.0.0.1", base + off))
+                except OSError:
+                    ok = False
+                    break
+        if ok:
+            return base
+    raise RuntimeError("no free port range found")
+
+
+def test_run_hosts_ssh_path_with_fake_ssh(tmp_path, monkeypatch):
+    """The remote branch end-to-end: a PATH-shimmed `ssh` executes the
+    remote command locally, proving the cd + env-inlining argv actually
+    runs a rank.  One rank only: two fake 'hosts' on one machine would
+    collide on the per-host data ports, which plan() legitimately reuses
+    across distinct hosts."""
+    import os
+    import stat
+
+    from horovod_tpu.runner import run_hosts
+
+    shim = tmp_path / "ssh"
+    # argv: ssh 127.0.0.2 '<remote command>' -> run it like a real ssh
+    # would: from $HOME-ish (cd /) with a scrubbed environment, so the
+    # assertions can only pass via ssh_command's inlined cd + env exports.
+    shim.write_text(
+        "#!/bin/sh\nshift\ncd /\nexec env -i PATH=\"$PATH\" sh -c \"$1\"\n")
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{tmp_path}{os.pathsep}" + os.environ["PATH"])
+
+    code = (
+        "import os, numpy as np, horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "out = hvd.allreduce(np.ones(4, np.float32), average=False,\n"
+        "                    name='s')\n"
+        "assert np.allclose(out, 1.0), out\n"
+        "print('SSH_RANK_OK', hvd.rank(), os.environ['MARKER'],\n"
+        "      os.getcwd())\n"
+    )
+    env = dict(os.environ, MARKER="made-it-through-ssh")
+    # 127.0.0.2 is resolvable loopback but not in the is_local set -> ssh.
+    results = run_hosts([sys.executable, "-c", code], 1, "127.0.0.2:1",
+                        port_base=_free_port_range(), timeout=120.0,
+                        capture=True, env=env)
+    assert results[0].returncode == 0, results[0].stderr[-400:]
+    # The ssh rank got the MARKER env override inlined and cd'd to cwd.
+    assert "SSH_RANK_OK 0 made-it-through-ssh" in results[0].stdout
+    assert os.path.realpath(os.getcwd()) in results[0].stdout
+
+
 def test_run_hosts_local_live():
     """-H with every slot on 127.0.0.1: the full fixed-port multi-host path
     minus ssh.  Ranks do one engine allreduce to prove the plan's endpoints
