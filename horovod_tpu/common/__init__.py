@@ -80,6 +80,12 @@ def _load_lib():
         lib.hvd_tpu_status.argtypes = [ctypes.c_longlong]
         lib.hvd_tpu_error.restype = ctypes.c_char_p
         lib.hvd_tpu_error.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_completion_seq.restype = ctypes.c_longlong
+        lib.hvd_tpu_completion_seq.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_completion_tick.restype = ctypes.c_longlong
+        lib.hvd_tpu_completion_tick.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_ticks_done.restype = ctypes.c_longlong
+        lib.hvd_tpu_ticks_done.argtypes = []
         lib.hvd_tpu_result_nbytes.restype = ctypes.c_longlong
         lib.hvd_tpu_result_nbytes.argtypes = [ctypes.c_longlong]
         lib.hvd_tpu_result_dim0.restype = ctypes.c_longlong
@@ -307,8 +313,8 @@ def allreduce_async(array: np.ndarray, average: bool = True,
         _check_out(out, array)
     name = name or _auto_name("allreduce")
     if _plane_eligible(array):
-        # Compiled XLA collective over the fabric; batched dispatches are
-        # name-ordered at flush, mirroring the engine's named negotiation.
+        # Compiled XLA collective over the fabric; dispatch order and
+        # shape/dtype consistency are negotiated over the control plane.
         return _xla_plane.allreduce_async(array, average, out, name)
     dims, ndim = _as_c_dims(array.shape)
     raw = lib.hvd_tpu_enqueue(
@@ -328,6 +334,10 @@ def allgather_async(array: np.ndarray, name: Optional[str] = None) -> Handle:
     if array.ndim == 0:
         raise ValueError("allgather requires tensors of rank >= 1")
     name = name or _auto_name("allgather")
+    if _plane_eligible(array):
+        # Compiled XLA all-gather over the fabric; ragged dim-0 geometry is
+        # exchanged by the plane's metadata negotiation.
+        return _xla_plane.allgather_async(array, name)
     dims, ndim = _as_c_dims(array.shape)
     raw = lib.hvd_tpu_enqueue(
         OP_ALLGATHER, name.encode(),

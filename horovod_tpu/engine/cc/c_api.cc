@@ -113,6 +113,18 @@ const char* hvd_tpu_error(long long handle) {
   return tl_error.c_str();
 }
 
+// Completion-order stamps for the XLA data plane's dispatch agreement.
+// -1 while the handle is pending or unknown.
+long long hvd_tpu_completion_seq(long long handle) {
+  return GlobalEngine()->CompletionSeq(handle);
+}
+
+long long hvd_tpu_completion_tick(long long handle) {
+  return GlobalEngine()->CompletionTick(handle);
+}
+
+long long hvd_tpu_ticks_done() { return GlobalEngine()->TicksDone(); }
+
 long long hvd_tpu_result_nbytes(long long handle) {
   return GlobalEngine()->ResultBytes(handle);
 }

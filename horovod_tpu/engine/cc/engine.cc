@@ -248,6 +248,8 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   // topology is built.
   shut_down_.store(false);
   loop_exited_.store(false);
+  completions_.store(0);
+  ticks_done_.store(0);
   coord_.reset(new Coordinator());
   if (opts_.rank == 0) timeline_.Initialize(opts_.timeline_path);
   std::string setup_err;
@@ -608,6 +610,10 @@ bool Engine::RunLoopOnce() {
   }
 
   for (const auto& resp : responses.responses) PerformOperation(resp);
+  // The response list (identical on every rank) is fully processed: close
+  // the tick.  Completions stamped with tick t are all visible once
+  // ticks_done_ > t, on every rank.
+  ticks_done_.fetch_add(1);
 
   if (opts_.rank == 0) CheckForStalledTensors();
 
@@ -965,6 +971,13 @@ void Engine::CompleteEntry(const TableEntry& e, int32_t code,
     if (it != handles_.end()) status = it->second;
   }
   if (!status) return;
+  // Stamp completion order before `code` flips (readers observe the stamps
+  // after seeing a non-pending code).  CompleteEntry only runs on the engine
+  // thread, in response-execution order, and response lists are broadcast
+  // from rank 0 — so the *relative* order of these stamps is identical
+  // across ranks for the same ops.
+  status->completion_seq = completions_.fetch_add(1);
+  status->completion_tick = ticks_done_.load();
   status->error = error;
   status->code.store(code);
   std::lock_guard<std::mutex> lk(handles_mu_);
@@ -1202,6 +1215,20 @@ int32_t Engine::StatusOf(int64_t handle, std::string* error) {
   if (it == handles_.end()) return ST_INVALID;
   if (error) *error = it->second->error;
   return it->second->code.load();
+}
+
+int64_t Engine::CompletionSeq(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end() || it->second->code.load() == ST_PENDING) return -1;
+  return it->second->completion_seq;
+}
+
+int64_t Engine::CompletionTick(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end() || it->second->code.load() == ST_PENDING) return -1;
+  return it->second->completion_tick;
 }
 
 int64_t Engine::ResultBytes(int64_t handle) {

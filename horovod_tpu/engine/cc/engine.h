@@ -60,6 +60,14 @@ struct HandleStatus {
   // Allgather result storage (engine-owned; copied out by the caller).
   std::vector<char> gathered;
   int64_t out_dim0 = 0;
+  // Completion order stamps, written by the engine thread before `code`
+  // flips.  Responses are built by rank 0 and broadcast, so both values are
+  // identical on every rank for the same op — the property the XLA data
+  // plane uses to agree on a cross-rank dispatch order without extra
+  // round-trips (the role MPIResponseList ordering plays in the reference,
+  // /root/reference/horovod/common/operations.cc:1644-1650).
+  int64_t completion_seq = -1;   // per-engine monotonic completion index
+  int64_t completion_tick = -1;  // index of the response list that carried it
 };
 
 // One enqueued tensor awaiting negotiation + execution.
@@ -103,6 +111,12 @@ class Engine {
   // Blocks until done; returns status code.
   int32_t Wait(int64_t handle);
   int32_t StatusOf(int64_t handle, std::string* error);
+  // Completion stamps for a finished handle (-1 while pending / unknown).
+  int64_t CompletionSeq(int64_t handle);
+  int64_t CompletionTick(int64_t handle);
+  // Number of fully processed response lists; a tick t is "closed" (all its
+  // completions are visible, on every rank) once TicksDone() > t.
+  int64_t TicksDone() const { return ticks_done_.load(); }
   int64_t ResultBytes(int64_t handle);
   int64_t ResultDim0(int64_t handle);
   bool CopyResult(int64_t handle, void* dst, int64_t nbytes);
@@ -161,6 +175,8 @@ class Engine {
   std::condition_variable handles_cv_;
   std::unordered_map<int64_t, std::shared_ptr<HandleStatus>> handles_;
   std::atomic<int64_t> next_handle_{0};
+  std::atomic<int64_t> completions_{0};  // CompleteEntry stamp counter
+  std::atomic<int64_t> ticks_done_{0};   // processed response lists
 
   // Sockets.
   int coord_listen_fd_ = -1;                 // rank 0

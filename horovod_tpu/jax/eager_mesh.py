@@ -5,23 +5,52 @@ The north-star TPU mapping of the reference's NCCL data plane
 tensors execute as *compiled XLA collectives* over the accelerator fabric
 (ICI on a pod; gloo/gRPC on CPU test meshes) instead of the engine's TCP
 ring.  Enabled with ``HVD_TPU_XLA_DATA_PLANE=1``; the TCP engine remains
-the control plane (negotiation, allgather, error paths) and the fallback.
+the control plane (negotiation, error paths) and the fallback for dtypes
+XLA does not carry (f64 with x64 disabled, bool).
 
-Design: `jax.distributed` connects all processes (its coordinator endpoint
-comes from the launcher, `HVD_TPU_XLA_COORD`); one device per process forms
-a process-spanning mesh.  An eager allreduce turns the per-process value
-into a global array sharded over the process axis and runs a jitted
-``sum(axis=0)`` replicated out — XLA compiles that to an all-reduce over
-the fabric.  Executables cache by (op, shape, dtype), the analogue of the
-reference's NCCL-communicator cache (operations.cc:212).  Dispatch is
-async (JAX returns futures); `XlaHandle.wait()` materializes.
+Dispatch-order agreement
+------------------------
+Every rank must issue the *same sequence* of compiled collectives or the
+fabric deadlocks.  Each plane op therefore enqueues a tiny int64 allreduce
+(``__xp.<name>``) through the TCP engine carrying a per-rank metadata slot:
+``vec[rank] = hash(op, dtype, shape, root)`` and ``vec[size+rank] = dim0``.
+The engine's coordinator negotiates these exactly like any other tensor
+(the reference's MPIRequest counting, operations.cc:268-301) and — because
+response lists are built by rank 0 and broadcast — completes them in an
+order that is identical on every rank.  The engine stamps each completion
+with a (tick, seq) pair (engine.cc CompleteEntry); the plane dispatches
+XLA programs only for ops in *closed* ticks, sorted by seq, with fusion
+buckets that never straddle a tick.  Any prefix a rank dispatches early is
+therefore a prefix of what every other rank will dispatch: interleaved
+poll-while-enqueue patterns (torch hooks firing in different orders,
+polling one handle while another rank enqueues more) cannot diverge.
+
+The metadata hash doubles as the cross-rank shape/dtype/root consistency
+check (the reference's ConstructMPIResponse validation,
+operations.cc:301-503): a mismatch surfaces as a typed ``ValueError`` on
+every rank instead of an opaque XLA error or a hang.  The per-rank dim0
+slots carry ragged allgather geometry, so eager allgather rides the plane
+too (the reference's MPI_Allgatherv displs, operations.cc:778-838).
+
+Tensor fusion
+-------------
+flush() concatenates consecutive same-dtype allreduces of one tick into a
+single flat buffer — one compiled all-reduce per bucket, the analogue of
+the fusion buffer (operations.cc:1607-1642, docs/tensor-fusion.md) — under
+``HOROVOD_FUSION_THRESHOLD``.  Executables cache by (op, padded length,
+dtype), the NCCL-communicator-cache analogue (operations.cc:212); buffer
+lengths are padded to ~12.5%-granular pseudo-log buckets so steady-state
+training reuses one executable per step.
 """
 
 from __future__ import annotations
 
+import ctypes
+import hashlib
 import os
 import threading
-from typing import Optional
+import time
+from typing import List, Optional
 
 import numpy as np
 
@@ -29,117 +58,371 @@ _lock = threading.Lock()
 _plane = None  # initialized XlaDataPlane, or False if init failed/disabled
 
 
+def _meta_hash(kind: str, dtype, shape, root: int) -> int:
+    payload = repr((kind, np.dtype(dtype).str, tuple(shape), root)).encode()
+    digest = hashlib.blake2b(payload, digest_size=8).digest()
+    return int.from_bytes(digest, "little") & ((1 << 62) - 1)
+
+
+def _bucket_len(n: int, minimum: int = 256) -> int:
+    """Pad a flat buffer length to a pseudo-log bucket (8 steps per octave,
+    <=12.5% waste) so the executable cache stays small without doubling
+    fabric traffic the way pure power-of-two padding would."""
+    if n <= minimum:
+        return minimum
+    p = 1 << (int(n) - 1).bit_length()  # next power of two >= n
+    half = p >> 1
+    step = max(half // 8, 1)
+    return half + -(-(n - half) // step) * step
+
+
+class _Batch:
+    """One dispatched XLA program; its host copy is materialized once and
+    shared by every handle whose segment lives in it."""
+
+    def __init__(self, arr):
+        self._arr = arr
+        self._host = None
+
+    def ready(self) -> bool:
+        return self._host is not None or self._arr.is_ready()
+
+    def host(self) -> np.ndarray:
+        if self._host is None:
+            self._host = np.asarray(self._arr)
+            self._arr = None
+        return self._host
+
+
+class _PlaneOp:
+    __slots__ = ("name", "kind", "payload", "root", "handle", "neg_raw",
+                 "neg_in", "neg_out", "my_hash", "seq", "tick", "dim0s")
+
+    def __init__(self, name, kind, payload, root, handle):
+        self.name = name
+        self.kind = kind  # "ar" | "bc" | "ag"
+        self.payload = payload  # compute-dtype, C-contiguous
+        self.root = root
+        self.handle = handle
+        self.neg_raw = -1
+        self.neg_in = None  # pinned until negotiation completes
+        self.neg_out = None
+        self.my_hash = 0
+        self.seq = None  # engine completion stamps once negotiated
+        self.tick = None
+        self.dim0s = None  # per-rank dim0 (allgather geometry)
+
+
 class XlaHandle:
-    """Duck-type of horovod_tpu.common.Handle for XLA-plane collectives.
+    """Duck-type of horovod_tpu.common.Handle for XLA-plane collectives."""
 
-    Dispatch is deferred: the op sits in the plane's pending list until any
-    handle is polled/waited, at which point everything pending flushes in
-    **name order** — so ranks whose enqueue order differs (e.g. torch
-    backward hooks firing in different orders) still execute the same
-    collective sequence, the property the engine gets from name-based
-    negotiation."""
-
-    def __init__(self, plane, name: str, out: Optional[np.ndarray],
-                 average: bool, size: int, dtype):
+    def __init__(self, plane, op_kind: str, name: str,
+                 out: Optional[np.ndarray], average: bool, size: int,
+                 dtype, shape):
         self._plane = plane
+        self._kind = op_kind
         self._name = name
-        self._result = None  # jax.Array once flushed
         self._out = out
         self._average = average
         self._size = size
-        self._dtype = dtype
+        self._dtype = dtype  # caller-visible dtype (pre-widening)
+        self._shape = tuple(shape)
+        self._batch: Optional[_Batch] = None
+        self._off = 0
+        self._n = 0
+        self._ag_pad = 0  # allgather: padded per-rank dim0
+        self._ag_dim0s = None
+        self._error: Optional[Exception] = None
         self._finished = False
 
+    # plane-side plumbing -------------------------------------------------
+    def _fail(self, err: Exception) -> None:
+        self._error = err
+
+    def _set_result(self, batch: _Batch, off: int, n: int) -> None:
+        self._batch = batch
+        self._off = off
+        self._n = n
+
+    # public handle API ---------------------------------------------------
     def done(self) -> bool:
         if self._finished:
             return True
         self._plane.flush()
-        return self._result.is_ready()
+        if self._error is not None:
+            return True
+        return self._batch is not None and self._batch.ready()
 
     def wait(self) -> np.ndarray:
         if self._finished:
             raise ValueError(f"handle for '{self._name}' already waited on")
         self._finished = True
-        self._plane.flush()
-        host = np.asarray(self._result)
+        self._plane._wait_dispatch(self)
+        if self._error is not None:
+            raise self._error
+        host = self._batch.host()
+        if self._kind == "ag":
+            pad = self._ag_pad
+            blocks = [host[r * pad:r * pad + int(d)]
+                      for r, d in enumerate(self._ag_dim0s)]
+            return np.concatenate(blocks).reshape(self._shape)
+        seg = host[self._off:self._off + self._n].reshape(self._shape)
         if self._average:
             if np.issubdtype(self._dtype, np.integer):
-                host = (host / self._size).astype(self._dtype)
+                seg = (seg / self._size).astype(self._dtype)
             else:
-                host = (host / np.asarray(self._size, host.dtype)).astype(
+                seg = (seg / np.asarray(self._size, seg.dtype)).astype(
                     self._dtype)
         else:
-            host = host.astype(self._dtype, copy=False)
+            seg = seg.astype(self._dtype, copy=False)
         if self._out is not None:
-            np.copyto(self._out, host.reshape(self._out.shape))
+            np.copyto(self._out, seg)
             return self._out
-        return host
+        return np.ascontiguousarray(seg) if seg.ndim else seg.copy()
 
 
 class XlaDataPlane:
-    def __init__(self, mesh, spec_sharded, spec_replicated, rank, size):
+    def __init__(self, mesh, spec_sharded, spec_replicated, rank, size,
+                 fusion_threshold):
         self._mesh = mesh
         self._in_sharding = spec_sharded
         self._out_sharding = spec_replicated
         self._rank = rank
         self._size = size
+        self._fusion_threshold = int(fusion_threshold)
         self._fns = {}
-        self._mu = threading.Lock()  # guards _fns and _pending
-        self._pending = []  # (name, op, payload, root, handle)
+        self._mu = threading.RLock()  # guards _fns, _pending, _local_seq
+        self._pending: List[_PlaneOp] = []
+        self._local_seq = 0  # single-process ordering (no negotiation)
+        # Observability: dispatches counts compiled-program launches;
+        # fused_tensors counts ops carried by them (tests assert N small
+        # allreduces ride 1 dispatch).
+        self.stats = {"dispatches": 0, "fused_tensors": 0}
 
-    def _jit_for(self, op: str, shape, dtype, root: int = 0):
+    # -- negotiation over the TCP control plane ---------------------------
+
+    def _negotiate(self, op: _PlaneOp) -> None:
+        """Enqueue the metadata allreduce for `op` through the engine."""
+        from horovod_tpu import common
+        from horovod_tpu.common import dtypes as _dt
+
+        if self._size == 1:
+            op.seq = self._local_seq
+            self._local_seq += 1
+            op.tick = -1  # always closed
+            op.dim0s = np.asarray(
+                [op.payload.shape[0] if op.payload.ndim else 0], np.int64)
+            return
+        dim0 = op.payload.shape[0] if op.payload.ndim else 0
+        shape = (op.payload.shape[1:] if op.kind == "ag"
+                 else op.payload.shape)
+        op.my_hash = _meta_hash(op.kind, op.handle._dtype, shape, op.root)
+        vec = np.zeros(2 * self._size, np.int64)
+        vec[self._rank] = op.my_hash
+        vec[self._size + self._rank] = dim0
+        out = np.zeros_like(vec)
+        dims = (ctypes.c_longlong * 1)(2 * self._size)
+        raw = common._lib.hvd_tpu_enqueue(
+            common.OP_ALLREDUCE, ("__xp." + op.name).encode(),
+            vec.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            dims, 1, _dt.numpy_to_code(vec.dtype), -1, 0)
+        if raw < 0:
+            raise common.HorovodInternalError("engine is shut down")
+        op.neg_raw = raw
+        op.neg_in = vec
+        op.neg_out = out
+
+    def _poll_negotiations(self) -> None:
+        """Collect completion stamps for negotiated ops (non-blocking)."""
+        from horovod_tpu import common
+
+        lib = common._lib
+        for op in self._pending:
+            if op.seq is not None or self._size == 1:
+                continue
+            if lib.hvd_tpu_poll(op.neg_raw) != 1:
+                continue
+            code = lib.hvd_tpu_status(op.neg_raw)
+            if code != common.ST_OK:
+                msg = lib.hvd_tpu_error(op.neg_raw).decode()
+                op.handle._fail(common._status_error(code, msg, op.name))
+                op.seq = -1  # consumed; never dispatched
+            else:
+                op.seq = int(lib.hvd_tpu_completion_seq(op.neg_raw))
+                op.tick = int(lib.hvd_tpu_completion_tick(op.neg_raw))
+                hashes = op.neg_out[:self._size]
+                op.dim0s = op.neg_out[self._size:].copy()
+                if not (hashes == op.my_hash).all():
+                    bad = [r for r in range(self._size)
+                           if hashes[r] != op.my_hash]
+                    op.handle._fail(ValueError(
+                        f"collective '{op.name}' failed: mismatched "
+                        f"op/shape/dtype/root across ranks (ranks {bad} "
+                        f"disagree with rank {self._rank}); every rank must "
+                        f"submit the same collective with the same dtype "
+                        f"and shape."))
+                    op.seq = -1
+            lib.hvd_tpu_release(op.neg_raw)
+            op.neg_raw = -1
+            op.neg_in = op.neg_out = None
+
+    # -- dispatch ---------------------------------------------------------
+
+    def flush(self) -> None:
+        """Dispatch every op whose negotiation tick has closed, in the
+        engine's completion order.  Ticks close simultaneously (in program
+        order) on every rank, so the dispatched sequence — including fusion
+        bucket boundaries, which never straddle a tick — is prefix-consistent
+        across ranks no matter when each rank happens to flush."""
+        from horovod_tpu import common
+
+        with self._mu:
+            # Snapshot the closed-tick horizon BEFORE polling: completions
+            # of tick t are stored before ticks_done advances past t
+            # (engine.cc RunLoopOnce), so every op in a tick this snapshot
+            # closes is observable by the poll below — reading the counter
+            # after polling could admit a later-seq op from a tick whose
+            # earlier-seq op was polled too early, breaking the cross-rank
+            # prefix property.
+            if self._size == 1:
+                ticks_done = 0  # local ticks are -1: always closed
+            else:
+                ticks_done = int(common._lib.hvd_tpu_ticks_done())
+            self._poll_negotiations()
+            ready = [op for op in self._pending
+                     if op.seq is not None and op.seq >= 0
+                     and op.tick < ticks_done]
+            failed = [op for op in self._pending if op.seq == -1]
+            dispatched = set()
+            ready.sort(key=lambda o: o.seq)
+            bucket: List[_PlaneOp] = []
+            bucket_key = None
+            bucket_bytes = 0
+            for op in ready:
+                nbytes = op.payload.nbytes
+                if op.kind == "ag":
+                    key = ("ag", id(op))  # never fused
+                else:
+                    key = (op.kind, op.tick, op.payload.dtype.str, op.root)
+                if (key != bucket_key
+                        or bucket_bytes + nbytes > self._fusion_threshold):
+                    if bucket:
+                        self._dispatch(bucket)
+                    bucket = []
+                    bucket_key = key
+                    bucket_bytes = 0
+                bucket.append(op)
+                bucket_bytes += nbytes
+                dispatched.add(id(op))
+            if bucket:
+                self._dispatch(bucket)
+            consumed = dispatched | {id(op) for op in failed}
+            self._pending = [op for op in self._pending
+                             if id(op) not in consumed]
+
+    def _wait_dispatch(self, handle: XlaHandle) -> None:
+        """Block until `handle`'s op is dispatched (or failed).  Bounded by
+        the engine cycle time; the reference's synchronize is the same poll
+        loop (/root/reference/horovod/torch/mpi_ops.cc:393-399)."""
+        while True:
+            self.flush()
+            if handle._error is not None or handle._batch is not None:
+                return
+            time.sleep(0.001)
+
+    def _jit_for(self, kind: str, length_or_shape, dtype, root: int = 0):
         import jax
 
-        key = (op, shape, np.dtype(dtype).str, root)
+        key = (kind, length_or_shape, np.dtype(dtype).str, root)
         fn = self._fns.get(key)
         if fn is None:
-            if op == "allreduce":
+            if kind == "ar":
                 fn = jax.jit(lambda a: a.sum(axis=0),
                              out_shardings=self._out_sharding)
-            else:  # broadcast: every process receives root's block
+            elif kind == "bc":
                 fn = jax.jit(lambda a: a[root],
+                             out_shardings=self._out_sharding)
+            else:  # "ag": resharding identity compiles to an all-gather
+                fn = jax.jit(lambda a: a.reshape((-1,) + a.shape[2:]),
                              out_shardings=self._out_sharding)
             self._fns[key] = fn
         return fn
 
-    def _global_array(self, array: np.ndarray):
+    def _global_array(self, local: np.ndarray):
         import jax
 
-        local = array[np.newaxis]  # (1, ...) — this process's block
         return jax.make_array_from_process_local_data(
-            self._in_sharding, local, (self._size,) + array.shape)
+            self._in_sharding, local[np.newaxis],
+            (self._size,) + local.shape)
 
-    def flush(self) -> None:
-        """Dispatch every pending op, sorted by collective name (the
-        cross-rank matching key).  Dispatches go out back-to-back, so XLA
-        pipelines the transfers."""
+    def _dispatch(self, bucket: List[_PlaneOp]) -> None:
+        kind = bucket[0].kind
+        if kind == "ag":
+            op = bucket[0]
+            pad = _bucket_len(int(op.dim0s.max()), minimum=1)
+            rest = op.payload.shape[1:]
+            block = np.zeros((pad,) + rest, op.payload.dtype)
+            block[:op.payload.shape[0]] = op.payload
+            fn = self._jit_for("ag", (pad,) + rest, op.payload.dtype)
+            batch = _Batch(fn(self._global_array(block)))
+            h = op.handle
+            h._ag_pad = pad
+            h._ag_dim0s = op.dim0s
+            h._shape = (int(op.dim0s.sum()),) + rest
+            h._set_result(batch, 0, 0)
+        else:
+            dtype = bucket[0].payload.dtype
+            lens = [op.payload.size for op in bucket]
+            total = int(sum(lens))
+            length = _bucket_len(total)
+            flat = np.zeros(length, dtype)
+            off = 0
+            offs = []
+            for op, n in zip(bucket, lens):
+                flat[off:off + n] = op.payload.reshape(-1)
+                offs.append(off)
+                off += n
+            fn = self._jit_for(kind, length, dtype, bucket[0].root)
+            batch = _Batch(fn(self._global_array(flat)))
+            for op, o, n in zip(bucket, offs, lens):
+                op.handle._set_result(batch, o, n)
+        self.stats["dispatches"] += 1
+        self.stats["fused_tensors"] += len(bucket)
+
+    # -- public enqueue API ----------------------------------------------
+
+    def _enqueue(self, kind: str, payload: np.ndarray, root: int,
+                 handle: XlaHandle, name: str) -> XlaHandle:
+        op = _PlaneOp(name, kind, payload, root, handle)
         with self._mu:
-            pending, self._pending = self._pending, []
-            pending.sort(key=lambda item: item[0])
-            for name, op, payload, root, handle in pending:
-                arr = self._global_array(payload)
-                fn = self._jit_for(op, payload.shape, payload.dtype, root)
-                handle._result = fn(arr)
+            self._negotiate(op)
+            self._pending.append(op)
+        return handle
 
     def allreduce_async(self, array: np.ndarray, average: bool,
                         out: Optional[np.ndarray], name: str) -> XlaHandle:
         dtype = array.dtype
-        # bf16/f16 sum in f32, like the engine's staging (engine.cc); bf16
-        # from ml_dtypes reports kind "V".
+        # bf16/f16 sum in f32, like the engine's staging (engine.cc
+        # HalfBufToFloat); bf16 from ml_dtypes reports kind "V".
         compute = array.astype(np.float32) if dtype.itemsize == 2 \
             and dtype.kind in ("f", "V") else array
-        handle = XlaHandle(self, name, out, average, self._size, dtype)
-        with self._mu:
-            self._pending.append((name, "allreduce", compute, 0, handle))
-        return handle
+        handle = XlaHandle(self, "ar", name, out, average, self._size,
+                           dtype, array.shape)
+        return self._enqueue("ar", compute, 0, handle, name)
 
     def broadcast_async(self, array: np.ndarray, root_rank: int,
                         out: Optional[np.ndarray], name: str) -> XlaHandle:
-        handle = XlaHandle(self, name, out, False, self._size, array.dtype)
-        with self._mu:
-            self._pending.append(
-                (name, "broadcast", array, root_rank, handle))
-        return handle
+        handle = XlaHandle(self, "bc", name, out, False, self._size,
+                           array.dtype, array.shape)
+        return self._enqueue("bc", array, root_rank, handle, name)
+
+    def allgather_async(self, array: np.ndarray, name: str) -> XlaHandle:
+        # Final shape is known only after negotiation (ragged dim 0); the
+        # handle's shape is patched at wait() from the negotiated dim0s.
+        handle = XlaHandle(self, "ag", name, None, False, self._size,
+                           array.dtype, array.shape)
+        return self._enqueue("ag", array, 0, handle, name)
 
 
 def _xla_coordinator(ps) -> Optional[str]:
@@ -147,10 +430,11 @@ def _xla_coordinator(ps) -> Optional[str]:
     if ep:
         return ep
     if ps.coord_endpoint:
-        # Derive a port clear of both defaults: engine coordinator 58930
-        # and data 58931 (basics.py pod-metadata resolution).
+        # Default offset must clear the engine data ports, which occupy
+        # port_base+1 .. port_base+local_size (runner/hosts.py); 500 matches
+        # the launcher's own xla_coord allocation (hosts.py plan()).
         host, port = ps.coord_endpoint.rsplit(":", 1)
-        offset = int(os.environ.get("HVD_TPU_XLA_COORD_PORT_OFFSET", "3"))
+        offset = int(os.environ.get("HVD_TPU_XLA_COORD_PORT_OFFSET", "500"))
         return f"{host}:{int(port) + offset}"
     return None
 
@@ -167,6 +451,8 @@ def initialize(ps) -> Optional[XlaDataPlane]:
             import jax
             from jax.sharding import (Mesh, NamedSharding,
                                       PartitionSpec as P)
+
+            from horovod_tpu.common.config import Config
 
             if ps.size > 1:
                 coord = _xla_coordinator(ps)
@@ -191,7 +477,8 @@ def initialize(ps) -> Optional[XlaDataPlane]:
                 mesh,
                 NamedSharding(mesh, P("hvd_proc")),
                 NamedSharding(mesh, P()),
-                ps.rank, ps.size)
+                ps.rank, ps.size,
+                Config.from_env().fusion_threshold)
             _plane = plane
             return plane
         except Exception as exc:  # fall back to the TCP engine

@@ -1,6 +1,7 @@
-"""XLA data plane: eager allreduce/broadcast as compiled collectives over
-jax.distributed (gloo on the CPU test fabric), with engine fallback for
-unsupported dtypes and allgather."""
+"""XLA data plane: eager allreduce/allgather/broadcast as compiled
+collectives over jax.distributed (gloo on the CPU test fabric), with
+TCP-engine negotiation for dispatch-order agreement and engine fallback
+for unsupported dtypes."""
 
 import numpy as np
 
@@ -67,9 +68,121 @@ def test_xla_plane_half_and_fallback():
                         average=False, name="xd")
     assert out.dtype == np.float64
     assert np.allclose(out, 1.5 * sum(range(1, n + 1)))
-    # allgather always rides the engine (ragged dim 0)
-    g = hvd.allgather(np.full((r + 1, 2), float(r), np.float32), name="xg")
+
+
+@distributed_test(np_=2, timeout=300.0)
+def test_xla_plane_allgather():
+    """Eager allgather rides the plane as a compiled all-gather, including
+    ragged dim-0 geometry negotiated over the control plane (parity with
+    the reference's MPI_Allgatherv, operations.cc:778-838)."""
+    import horovod_tpu.common as common
+
+    hvd = _init_with_plane()
+    r, n = hvd.rank(), hvd.size()
+    plane = common._xla_plane
+    before = plane.stats["dispatches"]
+    # Uniform dim 0.
+    g = hvd.allgather(np.full((3, 2), float(r), np.float32), name="agu")
+    assert g.shape == (3 * n, 2)
+    for i in range(n):
+        assert np.allclose(g[3 * i:3 * (i + 1)], float(i))
+    # Ragged dim 0: rank r contributes r+1 rows.
+    g = hvd.allgather(np.full((r + 1, 2), float(r), np.float32), name="agr")
     assert g.shape == (sum(range(1, n + 1)), 2)
+    off = 0
+    for i in range(n):
+        assert np.allclose(g[off:off + i + 1], float(i))
+        off += i + 1
+    # 1-D and int dtypes.
+    g = hvd.allgather(np.arange(4, dtype=np.int32) + 10 * r, name="agi")
+    assert np.array_equal(
+        g, np.concatenate([np.arange(4, dtype=np.int32) + 10 * i
+                           for i in range(n)]))
+    assert plane.stats["dispatches"] == before + 3, plane.stats
+
+
+@distributed_test(np_=2, timeout=300.0)
+def test_xla_plane_fusion_single_dispatch():
+    """N small same-dtype allreduces enqueued back-to-back execute as one
+    (or at most a couple of) compiled dispatches — the tensor-fusion story
+    of the reference (docs/tensor-fusion.md) on the XLA plane."""
+    import horovod_tpu.common as common
+
+    hvd = _init_with_plane()
+    r, n = hvd.rank(), hvd.size()
+    plane = common._xla_plane
+    before = plane.stats["dispatches"]
+    handles = [
+        common.allreduce_async(np.full(17, float(r + 1 + k), np.float32),
+                               average=False, name=f"fus.{k}")
+        for k in range(16)
+    ]
+    outs = [h.wait() for h in handles]
+    for k, out in enumerate(outs):
+        assert np.allclose(out, sum(i + 1 + k for i in range(n))), (k, out)
+    dispatches = plane.stats["dispatches"] - before
+    assert dispatches < 16, f"no fusion: {dispatches} dispatches for 16 ops"
+    assert plane.stats["fused_tensors"] >= 16
+
+
+@distributed_test(np_=2, timeout=300.0)
+def test_xla_plane_shape_mismatch_typed_error():
+    """Cross-rank shape mismatch on the plane surfaces as the same typed
+    ValueError the engine raises, not an opaque XLA error or a hang."""
+    import pytest
+
+    import horovod_tpu.common as common
+
+    hvd = _init_with_plane()
+    r = hvd.rank()
+    # Different shapes per rank.
+    h = common.allreduce_async(np.zeros(3 + r, np.float32), average=False,
+                               name="bad_shape")
+    with pytest.raises(ValueError, match="[Mm]ismatch"):
+        h.wait()
+    # Different dtypes per rank (both plane-eligible).
+    arr = np.zeros(4, np.float32 if r == 0 else np.int32)
+    h = common.allreduce_async(arr, average=False, name="bad_dtype")
+    with pytest.raises(ValueError, match="[Mm]ismatch"):
+        h.wait()
+    # The plane (and engine) stay usable after a failed op.
+    out = hvd.allreduce(np.full(5, float(r + 1), np.float32),
+                        average=False, name="after_bad")
+    assert np.allclose(out, sum(range(1, hvd.size() + 1)))
+
+
+@distributed_test(np_=2, timeout=300.0)
+def test_xla_plane_poll_while_enqueue():
+    """Interleaved poll-while-enqueue with rank-dependent enqueue order:
+    the negotiated dispatch order keeps ranks in agreement even when one
+    rank polls a handle before the other rank has enqueued anything (the
+    round-1 name-ordered flush deadlocked here)."""
+    import time
+
+    import horovod_tpu.common as common
+
+    hvd = _init_with_plane()
+    r, n = hvd.rank(), hvd.size()
+    a = np.full(9, 1.0 + r, np.float32)
+    b = np.full(5, 10.0 + r, np.float32)
+    if r == 0:
+        ha = common.allreduce_async(a, average=False, name="ilv.a")
+        # Poll (which flushes) before B exists anywhere; sleep so rank 1
+        # has very likely enqueued B (but not A) meanwhile.
+        for _ in range(3):
+            ha.done()
+            time.sleep(0.05)
+        hb = common.allreduce_async(b, average=False, name="ilv.b")
+    else:
+        hb = common.allreduce_async(b, average=False, name="ilv.b")
+        for _ in range(3):
+            hb.done()
+            time.sleep(0.05)
+        ha = common.allreduce_async(a, average=False, name="ilv.a")
+    out_a = ha.wait()
+    out_b = hb.wait()
+    assert np.allclose(out_a, sum(1.0 + i for i in range(n)))
+    assert np.allclose(out_b, sum(10.0 + i for i in range(n)))
 
 
 @distributed_test(np_=2, timeout=300.0)
