@@ -78,20 +78,25 @@ def _bucket_len(n: int, minimum: int = 256) -> int:
 
 class _Batch:
     """One dispatched XLA program; its host copy is materialized once and
-    shared by every handle whose segment lives in it."""
+    shared by every handle whose segment lives in it.  Fused batches are
+    shared by many handles, which may be waited from different threads —
+    the lock keeps the lazy materialization single-shot."""
 
     def __init__(self, arr):
         self._arr = arr
         self._host = None
+        self._mu = threading.Lock()
 
     def ready(self) -> bool:
-        return self._host is not None or self._arr.is_ready()
+        with self._mu:
+            return self._host is not None or self._arr.is_ready()
 
     def host(self) -> np.ndarray:
-        if self._host is None:
-            self._host = np.asarray(self._arr)
-            self._arr = None
-        return self._host
+        with self._mu:
+            if self._host is None:
+                self._host = np.asarray(self._arr)
+                self._arr = None
+            return self._host
 
 
 class _PlaneOp:

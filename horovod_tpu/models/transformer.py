@@ -22,16 +22,20 @@ from horovod_tpu.ops import (blockwise_attention, flash_attention,
                              ring_attention)
 
 
-def rope(x, positions, base: float = 10000.0):
+def rope(x, positions, base: float = 10000.0, seq_dim: int = -2):
     """Rotary position embedding over the last dim (pairs interleaved as
     [even half | odd half]).  ``positions``: (seq,) global token positions —
-    global, so sequence-sharded shards stay consistent."""
+    global, so sequence-sharded shards stay consistent.  ``seq_dim`` names
+    the sequence axis of ``x`` (-2 for (b, h, s, d), 1 for (b, s, h, d))."""
     d = x.shape[-1]
     half = d // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
-    cos = jnp.cos(angles)[None, None]  # (1, 1, seq, half)
-    sin = jnp.sin(angles)[None, None]
+    shape = [1] * x.ndim
+    shape[seq_dim] = x.shape[seq_dim]
+    shape[-1] = half
+    cos = jnp.cos(angles).reshape(shape)
+    sin = jnp.sin(angles).reshape(shape)
     x1, x2 = x[..., :half], x[..., half:]
     rotated = jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
@@ -48,26 +52,39 @@ class Attention(nn.Module):
     def __call__(self, x):
         b, s, d = x.shape
         head_dim = d // self.n_heads
-        dense = lambda name: nn.Dense(  # noqa: E731
-            d, use_bias=False, dtype=self.dtype, name=name)
-        q, k, v = (dense(n)(x) for n in ("q", "k", "v"))
-        # (b, heads, seq, head_dim)
-        split = lambda t: t.reshape(  # noqa: E731
-            b, s, self.n_heads, head_dim).transpose(0, 2, 1, 3)
-        q, k, v = split(q), split(k), split(v)
+        # One fused (d -> 3d) projection: a single MXU-friendly matmul
+        # instead of three skinny ones (same math and the same per-matrix
+        # fan-in init as separate q/k/v Dense layers).
+        qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype,
+                       name="qkv")(x)
 
         if self.seq_axis is not None:
+            # Ring attention wants (b, heads, seq, head_dim).
+            split = lambda t: t.reshape(  # noqa: E731
+                b, s, self.n_heads, head_dim).transpose(0, 2, 1, 3)
+            q, k, v = (split(t) for t in jnp.split(qkv, 3, axis=-1))
             offset = lax.axis_index(self.seq_axis) * s
             positions = offset + jnp.arange(s)
             q, k = rope(q, positions), rope(k, positions)
             out = ring_attention(q, k, v, axis_name=self.seq_axis,
                                  causal=True)
+            out = out.transpose(0, 2, 1, 3).reshape(b, s, d)
         else:
+            # Single shard: hand the projection's natural (b, s, h, hd)
+            # layout to flash_attention(layout="bshd") and get it back.
+            split = lambda t: t.reshape(  # noqa: E731
+                b, s, self.n_heads, head_dim)
+            q, k, v = (split(t) for t in jnp.split(qkv, 3, axis=-1))
             positions = jnp.arange(s)
-            q, k = rope(q, positions), rope(k, positions)
-            out = flash_attention(q, k, v, causal=True) if self.use_flash \
-                else blockwise_attention(q, k, v, causal=True)
-        out = out.transpose(0, 2, 1, 3).reshape(b, s, d)
+            q = rope(q, positions, seq_dim=1)
+            k = rope(k, positions, seq_dim=1)
+            if self.use_flash:
+                out = flash_attention(q, k, v, causal=True, layout="bshd")
+            else:
+                to_bhsd = lambda t: t.transpose(0, 2, 1, 3)  # noqa: E731
+                out = to_bhsd(blockwise_attention(
+                    to_bhsd(q), to_bhsd(k), to_bhsd(v), causal=True))
+            out = out.reshape(b, s, d)
         return nn.Dense(d, use_bias=False, dtype=self.dtype, name="o")(out)
 
 
@@ -105,7 +122,13 @@ class TransformerLM(nn.Module):
     use_flash: bool = True
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, targets=None):
+        if targets is not None and self.seq_axis is not None:
+            raise ValueError(
+                "targets= (fused head+loss) is unsupported under sequence "
+                "parallelism: it has no axis_name-aware normalization; "
+                "compute logits and use next_token_loss(..., axis_name=...) "
+                "instead.")
         d_ff = self.d_ff or 4 * self.d_model
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.dtype, name="embed")(tokens)
@@ -113,9 +136,61 @@ class TransformerLM(nn.Module):
             x = Block(self.n_heads, d_ff, self.dtype, self.seq_axis,
                       self.use_flash, name=f"layer_{i}")(x)
         x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
-        # Logits in float32 for a numerically stable softmax/loss.
-        return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
-                        name="lm_head")(x.astype(jnp.float32))
+        # Logits accumulate in float32 for a numerically stable softmax,
+        # but the matmul runs in bfloat16 on the MXU: an f32xf32 matmul
+        # costs multiple MXU passes, and the lm_head is ~1/3 of the model's
+        # FLOPs at vocab 32k.
+        w = self.param(
+            "lm_head_kernel",
+            nn.initializers.variance_scaling(1.0, "fan_in",
+                                             "truncated_normal"),
+            (self.d_model, self.vocab_size), jnp.float32)
+        if targets is not None:
+            # Fused head+loss: see fused_next_token_loss.
+            return fused_next_token_loss(x, w, targets, dtype=self.dtype)
+        return jnp.einsum("bsd,dv->bsv", x.astype(self.dtype),
+                          w.astype(self.dtype),
+                          preferred_element_type=jnp.float32)
+
+
+def fused_next_token_loss(hidden, w, targets, dtype=jnp.bfloat16,
+                          n_chunks: int = 8):
+    """Mean cross-entropy computed head-chunk by head-chunk.
+
+    The full-logits path materializes a ``(batch, seq, vocab)`` float32
+    tensor (1 GiB at batch 8 / seq 1024 / vocab 32k) that HBM round-trips
+    several times (softmax, correct-class gather, d-logits).  Here the
+    token dimension is split into chunks inside a rematerialized
+    ``lax.scan``: each chunk's logits live only transiently, the forward
+    keeps a scalar, and the backward recomputes one chunk's logits at a
+    time — O(tokens/n_chunks * vocab) peak memory, same math.  (The model
+    invokes this when ``targets`` is passed to ``__call__``.)
+
+    This trades one extra head matmul (the remat recompute) for the logits
+    round-trips: measured on v5e at vocab 32k / batch 8 it is ~8% *slower*
+    than the full-logits path, so use it when the logits tensor does not
+    fit comfortably (long sequences, big vocab, large batch), not as a
+    throughput knob.
+    """
+    B, S, D = hidden.shape
+    tokens = B * S
+    if tokens % n_chunks:
+        n_chunks = 1
+    xc = hidden.reshape(n_chunks, tokens // n_chunks, D)
+    tc = targets.reshape(n_chunks, tokens // n_chunks)
+    wb = w.astype(dtype)
+
+    def chunk(total, xt):
+        x, t = xt
+        logits = jnp.einsum("md,dv->mv", x.astype(dtype), wb,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        correct = jnp.take_along_axis(logits, t[:, None], axis=-1)[:, 0]
+        return total + (lse - correct).sum(), None
+
+    total, _ = lax.scan(jax.checkpoint(chunk),
+                        jnp.zeros((), jnp.float32), (xc, tc))
+    return total / tokens
 
 
 def next_token_loss(logits, targets, mask=None, axis_name=None):

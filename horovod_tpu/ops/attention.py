@@ -242,6 +242,17 @@ except ImportError:  # pragma: no cover
     _HAS_PALLAS = False
 
 
+def _rd(ref):
+    """Read a block ref squeezing unit dims: (1, n, d) and (1, n, 1, d)
+    (the bshd layout's head slot) both load as (n, d)."""
+    x = ref[...]
+    return x.reshape([s for s in x.shape if s != 1])
+
+
+def _st(ref, val):
+    ref[...] = val.reshape(ref.shape).astype(ref.dtype)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
                   acc_scratch, *, sm_scale, causal, block_q, block_k,
                   num_k_blocks):
@@ -261,9 +272,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
 
     @pl.when(run)
     def _():
-        q = q_ref[0]  # (block_q, d)
-        k = k_ref[0]  # (block_k, d)
-        v = v_ref[0]
+        q = _rd(q_ref)  # (block_q, d)
+        k = _rd(k_ref)  # (block_k, d)
+        v = _rd(v_ref)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -292,11 +303,208 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
     def _():
         l = l_scratch[:, 0]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scratch[...] / safe_l[:, None]).astype(o_ref.dtype)
+        _st(o_ref, acc_scratch[...] / safe_l[:, None])
         # 8 identical sublanes: a (1, block_q) block would violate the TPU
         # (8, 128) output tiling.
-        lse_ref[0] = jnp.broadcast_to(
-            _lse_of(m_scratch[:, 0], l)[None, :], (8, block_q))
+        lse_ref[...] = jnp.broadcast_to(
+            _lse_of(m_scratch[:, 0], l)[None, :], (8, block_q)).reshape(
+            lse_ref.shape)
+
+
+def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                           dk_ref, dv_ref, dk_scratch, dv_scratch, *,
+                           sm_scale, causal, block_q, block_k, num_q_blocks):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)  # innermost: accumulates over query blocks
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scratch[...] = jnp.zeros_like(dk_scratch)
+        dv_scratch[...] = jnp.zeros_like(dv_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True if not causal else q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _():
+        q = _rd(q_ref)          # (block_q, d)
+        do = _rd(do_ref)        # (block_q, d)
+        lse = _rd(lse_ref)[0]   # (block_q,)
+        delta = _rd(delta_ref)[0]
+        k = _rd(k_ref)          # (block_k, d)
+        v = _rd(v_ref)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # lse sentinel zeroes masked rows
+        pb = p.astype(v.dtype)
+        dv_scratch[...] += jax.lax.dot_general(
+            pb, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * sm_scale).astype(q.dtype)
+        dk_scratch[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _():
+        _st(dk_ref, dk_scratch[...])
+        _st(dv_ref, dv_scratch[...])
+
+
+def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                         dq_ref, dq_scratch, *, sm_scale, causal, block_q,
+                         block_k, num_k_blocks):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)  # innermost: accumulates over key blocks
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scratch[...] = jnp.zeros_like(dq_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True if not causal else q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _():
+        q = _rd(q_ref)
+        do = _rd(do_ref)
+        lse = _rd(lse_ref)[0]
+        delta = _rd(delta_ref)[0]
+        k = _rd(k_ref)
+        v = _rd(v_ref)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * sm_scale).astype(q.dtype)
+        dq_scratch[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _():
+        _st(dq_ref, dq_scratch[...])
+
+
+def _row_spec(block, d):
+    """BlockSpec factory for (batch*heads, seq, d) tensors: ``row`` picks
+    which grid dim walks the sequence.
+
+    (A strided (1, block, 1, d) spec reading (b, s, h, d) directly would
+    skip the host-side transposes, but Mosaic requires the second-minor
+    block dim to be a multiple of 8 or the full array dim — a 1-wide head
+    slot is not lowerable, so the bshd layout transposes at the wrapper
+    instead; see flash_attention.)"""
+    def spec(row):
+        return pl.BlockSpec((1, block, d),
+                            lambda b, i, j, _r=row: (b, _r(i, j), 0))
+
+    return spec
+
+
+def _pick_block(seq_len: int) -> int:
+    """Largest kernel-grid block that divides the sequence: keeps common
+    non-512-multiple lengths (640, 768, 1152, ...) on the Pallas kernel
+    instead of silently demoting them to the blockwise fallback."""
+    for b in (512, 384, 256, 128):
+        if seq_len % b == 0:
+            return b
+    return min(512, seq_len)  # ragged: the fallback path handles it
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
+                    block_k, interpret):
+    """Pallas flash backward (Dao et al. alg. 2 as two kernels: dk/dv with
+    queries innermost, dq with keys innermost); probabilities are
+    recomputed from (q, k, lse) so residual memory stays O(seq)."""
+    batch, heads, q_len, d = q.shape
+    k_len = k.shape[2]
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, k_len)
+    if (q_len % block_q or k_len % block_k
+            or block_q % 128 or block_k % 128):
+        return _attention_bwd_impl(q, k, v, out, lse, g, causal, sm_scale,
+                                   max(block_k, 128), 0, 0)
+    bh = batch * heads
+    qr = q.reshape(bh, q_len, d)
+    kr = k.reshape(bh, k_len, d)
+    vr = v.reshape(bh, k_len, d)
+    dor = g.reshape(bh, q_len, d)
+    # delta_i = sum_d dOut_id * Out_id; 8 broadcast sublanes keep the
+    # (8, 128) tiling legal, same trick as the forward's lse output.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, q_len)
+    delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, q_len))
+    lse8 = jnp.broadcast_to(lse.reshape(bh, q_len)[:, None, :],
+                            (bh, 8, q_len))
+    num_q = q_len // block_q
+    num_k = k_len // block_k
+    qspec, kspec = _row_spec(block_q, d), _row_spec(block_k, d)
+    kv_shape = jax.ShapeDtypeStruct((bh, k_len, d), k.dtype)
+    q_shape = jax.ShapeDtypeStruct((bh, q_len, d), q.dtype)
+
+    inner = lambda i, j: j  # noqa: E731
+    outer = lambda i, j: i  # noqa: E731
+    row_specs = [
+        qspec(inner), qspec(inner),
+        pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, j)),
+        pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, j)),
+        kspec(outer), kspec(outer),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          num_q_blocks=num_q),
+        grid=(bh, num_k, num_q),
+        in_specs=row_specs,
+        out_specs=(kspec(outer), kspec(outer)),
+        out_shape=(kv_shape, kv_shape),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, dor, lse8, delta8, kr, vr)
+
+    col_specs = [
+        qspec(outer), qspec(outer),
+        pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        kspec(inner), kspec(inner),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          num_k_blocks=num_k),
+        grid=(bh, num_q, num_k),
+        in_specs=col_specs,
+        out_specs=qspec(outer),
+        out_shape=q_shape,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, dor, lse8, delta8, kr, vr)
+    return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
 
 
 def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
@@ -317,8 +525,12 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     qr = q.reshape(bh, q_len, d)
     kr = k.reshape(bh, k_len, d)
     vr = v.reshape(bh, k_len, d)
+    o_shape = jax.ShapeDtypeStruct((bh, q_len, d), q.dtype)
     num_q = q_len // block_q
     num_k = k_len // block_k
+    qspec, kspec = _row_spec(block_q, d), _row_spec(block_k, d)
+    qrow = lambda i, j: i  # noqa: E731
+    krow = lambda i, j: j  # noqa: E731
 
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
@@ -326,17 +538,13 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, num_q, num_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-        ],
+        in_specs=[qspec(qrow), kspec(krow), kspec(krow)],
         out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            qspec(qrow),
             pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),
+            o_shape,
             jax.ShapeDtypeStruct((bh, 8, q_len), jnp.float32),
         ),
         scratch_shapes=[
@@ -364,8 +572,8 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
-    return _attention_bwd_impl(q, k, v, out, lse, g, causal, sm_scale,
-                               max(block_k, 128), 0, 0)
+    return _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
+                           block_k, interpret)
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -373,18 +581,37 @@ _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, causal: bool = False,
                     sm_scale: Optional[float] = None,
-                    block_q: Optional[int] = None, block_k: int = 128,
-                    interpret: Optional[bool] = None):
-    """Fused multi-head attention, ``(batch, heads, seq, head_dim)``.
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None,
+                    layout: str = "bhsd"):
+    """Fused multi-head attention.
+
+    ``layout="bhsd"`` takes ``(batch, heads, seq, head_dim)``;
+    ``layout="bshd"`` accepts ``(batch, seq, heads, head_dim)`` — the
+    shape QKV projections naturally produce — and returns the same layout.
+    (Internally bshd transposes to bhsd: Mosaic's block tiling cannot
+    address a 1-wide head slot, so a transpose-free strided read is not
+    lowerable; the option exists so callers never have to think about
+    head-major conventions.)
 
     On TPU this is a Pallas kernel (MXU-tiled blocks, VMEM online-softmax
     state); elsewhere (and for ragged block tails) it falls back to the
     mathematically identical :func:`blockwise_attention`.  Differentiable
     with the flash backward (logsumexp residual + per-block recompute,
-    O(seq) memory).  Default ``block_q`` adapts to the sequence length
-    (larger query blocks amortize grid overhead on long sequences;
-    measured crossover ~4k on v5e).
+    O(seq) memory, dk/dv and dq as two Pallas kernels).  Default blocks
+    are 512x512 (clipped to the sequence): measured on v5e, 512-blocks
+    halve the forward time vs 128-blocks at seq 1024 (grid overhead
+    amortizes and the MXU sees larger operands) and stay well inside VMEM
+    (~1.5 MB of scratch at head_dim 64).
     """
+    if layout not in ("bhsd", "bshd"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "bshd":
+        t = lambda a: a.transpose(0, 2, 1, 3)  # noqa: E731
+        return t(flash_attention(t(q), t(k), t(v), causal=causal,
+                                 sm_scale=sm_scale, block_q=block_q,
+                                 block_k=block_k, interpret=interpret))
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if not _HAS_PALLAS:
@@ -392,6 +619,8 @@ def flash_attention(q, k, v, causal: bool = False,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_q is None:
-        block_q = 512 if q.shape[-2] >= 4096 else 128
+        block_q = _pick_block(q.shape[-2])
+    if block_k is None:
+        block_k = _pick_block(k.shape[-2])
     return _flash_attention(q, k, v, causal, sm_scale, block_q, block_k,
                             interpret)

@@ -49,7 +49,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     `isinstance(opt, OriginalClass)` true, as the reference does at
     /root/reference/horovod/torch/__init__.py:92-124)."""
 
-    def __init__(self, params, named_parameters=None):
+    def __init__(self, params, named_parameters=None,
+                 backward_passes_per_step=1):
         super(self.__class__, self).__init__(params)
         if named_parameters is not None:
             named = list(named_parameters)
@@ -58,6 +59,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._param_names = {id(p): name for name, p in named}
         self._handles = {}
         self._hook_registrations = []
+        if backward_passes_per_step < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        self._backward_passes_per_step = backward_passes_per_step
+        self._passes = collections.Counter()  # id(p) -> hook fires
         self._register_hooks()
 
     def _grad_name(self, p) -> str:
@@ -84,14 +89,30 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def _make_hook(self):
         def hook(p):
             if p in self._handles:
-                return
-            self._handles[p] = allreduce_async_(
-                p.grad.data, average=True, name=self._grad_name(p))
+                # The previous allreduce still reads p.grad's memory; a
+                # second backward would race autograd's accumulation into
+                # the same buffer and silently corrupt gradients.  Fail
+                # loudly instead (the reference's later
+                # backward_passes_per_step semantics, made explicit).
+                raise RuntimeError(
+                    f"gradient for '{self._grad_name(p)}' was produced "
+                    "again while its allreduce is still in flight. For "
+                    "gradient accumulation over N micro-batches, construct "
+                    "DistributedOptimizer(..., backward_passes_per_step=N); "
+                    "otherwise call step()/synchronize() between backward "
+                    "passes.")
+            self._passes[id(p)] += 1
+            if self._passes[id(p)] >= self._backward_passes_per_step:
+                # Local accumulation is complete: p.grad now holds the sum
+                # over the N micro-batch backwards; average it across ranks.
+                self._handles[p] = allreduce_async_(
+                    p.grad.data, average=True, name=self._grad_name(p))
         return hook
 
     def synchronize(self) -> None:
         """Wait for every outstanding gradient allreduce; enqueue any grads
-        whose hook never fired (e.g. grads produced outside autograd)."""
+        not yet in flight (hooks that never fired, or mid-accumulation
+        grads when step() is called before the Nth backward)."""
         for group in self.param_groups:
             for p in group["params"]:
                 if p.grad is not None and p not in self._handles:
@@ -100,6 +121,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         for p, handle in list(self._handles.items()):
             handle.synchronize()
         self._handles.clear()
+        self._passes.clear()
 
     def step(self, closure=None):
         self.synchronize()
@@ -107,12 +129,22 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
 
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
-                         named_parameters: Optional[Iterator[Tuple[str, torch.nn.Parameter]]] = None):
+                         named_parameters: Optional[Iterator[Tuple[str, torch.nn.Parameter]]] = None,
+                         backward_passes_per_step: int = 1):
     """Wrap a torch optimizer: gradients are allreduce-averaged across
-    workers as backprop produces them; `step()` waits for them first."""
+    workers as backprop produces them; `step()` waits for them first.
+
+    ``backward_passes_per_step=N`` enables gradient accumulation: the
+    allreduce for each parameter is delayed until its Nth backward since
+    the last ``step()``, so ``p.grad`` first accumulates the local sum of N
+    micro-batches and one averaged allreduce carries it (reference
+    counterpart: the hook semantics of
+    /root/reference/horovod/torch/__init__.py:64-89, extended so
+    micro-batching is race-free)."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
-    return cls(optimizer.param_groups, named_parameters)
+    return cls(optimizer.param_groups, named_parameters,
+               backward_passes_per_step)
 
 
 def broadcast_parameters(params, root_rank: int = 0) -> None:

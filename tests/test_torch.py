@@ -163,6 +163,75 @@ def test_torch_distributed_optimizer_matches_full_batch():
 
 
 @distributed_test()
+def test_torch_backward_passes_per_step_matches_fused_batch():
+    """Two micro-batch backwards + one step() under
+    backward_passes_per_step=2 produce exactly the gradient (and weights)
+    of one fused-batch backward — the race-free gradient-accumulation
+    contract."""
+    import torch
+
+    hvd = _init()
+    r, n = hvd.rank(), hvd.size()
+    torch.manual_seed(7)  # same init on every rank
+    model = torch.nn.Linear(4, 1)
+
+    # Per-rank data, two micro-batches of 2 each.
+    all_x = torch.tensor(np.random.RandomState(0).randn(n * 4, 4),
+                         dtype=torch.float32)
+    all_y = torch.tensor(np.random.RandomState(1).randn(n * 4, 1),
+                         dtype=torch.float32)
+    x, y = all_x[4 * r:4 * r + 4], all_y[4 * r:4 * r + 4]
+
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters(),
+        backward_passes_per_step=2)
+    opt.zero_grad()
+    # Sum-of-micro-batch losses == fused loss when each micro loss sums.
+    torch.nn.functional.mse_loss(model(x[:2]), y[:2],
+                                 reduction="sum").backward()
+    torch.nn.functional.mse_loss(model(x[2:]), y[2:],
+                                 reduction="sum").backward()
+    opt.step()
+
+    torch.manual_seed(7)
+    ref = torch.nn.Linear(4, 1)
+    ref_opt = torch.optim.SGD(ref.parameters(), lr=0.1)
+    # Fused batch over ALL ranks' data: mean over ranks of per-rank sums.
+    ref_loss = sum(
+        torch.nn.functional.mse_loss(ref(all_x[4 * s:4 * s + 4]),
+                                     all_y[4 * s:4 * s + 4],
+                                     reduction="sum")
+        for s in range(n)) / n
+    ref_opt.zero_grad()
+    ref_loss.backward()
+    ref_opt.step()
+    assert torch.allclose(model.weight.detach(), ref.weight.detach(),
+                          atol=1e-5), (r, model.weight, ref.weight)
+    assert torch.allclose(model.bias.detach(), ref.bias.detach(), atol=1e-5)
+
+
+@distributed_test(np_=1)
+def test_torch_reentrant_backward_without_accumulation_raises():
+    """A second backward while a gradient allreduce is outstanding is a
+    silent-corruption hazard; it must raise, pointing at
+    backward_passes_per_step (round-1 behavior silently skipped the
+    re-enqueue and raced the in-flight reduce)."""
+    import pytest
+    import torch
+
+    hvd = _init()
+    model = torch.nn.Linear(3, 1)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    x = torch.ones(2, 3)
+    model(x).sum().backward()
+    with pytest.raises(RuntimeError, match="backward_passes_per_step"):
+        model(x).sum().backward()
+
+
+@distributed_test()
 def test_torch_broadcast_parameters_and_optimizer_state():
     import torch
 

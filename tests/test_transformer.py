@@ -39,6 +39,42 @@ def test_forward_shape_and_finite():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+def test_fused_loss_matches_full_logits():
+    """model.apply(..., targets=) — the chunked fused head+loss — matches
+    next_token_loss on full logits in value and gradient, including when
+    the token count does not divide the chunk count (silent n_chunks=1
+    degrade)."""
+    model = _model()
+    for seq in (32, 31):  # 2*31 tokens are not divisible by 8 chunks
+        tokens = _tokens(seq=seq + 1)
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        params = model.init(jax.random.PRNGKey(0), inp)["params"]
+
+        def full(p):
+            return next_token_loss(model.apply({"params": p}, inp), tgt)
+
+        def fused(p):
+            return model.apply({"params": p}, inp, targets=tgt)
+
+        np.testing.assert_allclose(fused(params), full(params), rtol=1e-6)
+        g_full = jax.grad(full)(params)
+        g_fused = jax.grad(fused)(params)
+        for a, b in zip(jax.tree_util.tree_leaves(g_fused),
+                        jax.tree_util.tree_leaves(g_full)):
+            np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5)
+
+
+def test_fused_loss_rejects_sequence_parallelism():
+    import pytest
+
+    model = _model(seq_axis="sp")
+    tokens = _tokens()
+    with pytest.raises(ValueError, match="sequence parallelism"):
+        # init traces __call__, which must raise before touching the mesh
+        model.init(jax.random.PRNGKey(0), tokens[:, :-1],
+                   targets=tokens[:, 1:])
+
+
 def test_causality():
     """Changing a future token must not change earlier logits."""
     model = _model()
