@@ -1,14 +1,15 @@
 """Distributed tf.estimator MNIST training with horovod_tpu.
 
-Counterpart of /root/reference/examples/tensorflow_mnist_estimator.py: a
-`tf.estimator.Estimator` whose `model_fn` wraps the optimizer in
+Counterpart of /root/reference/examples/tensorflow_mnist_estimator.py: an
+Estimator whose `model_fn` wraps the optimizer in
 `hvd.DistributedOptimizer`, with `BroadcastGlobalVariablesHook` replicating
 rank 0's variables after session creation and a model_dir only on rank 0.
 
 Run:  python -m horovod_tpu.runner -np 2 -- \
           python examples/tensorflow_mnist_estimator.py
-Requires tf.estimator (present through TF 2.15; on newer TF use
-examples/tensorflow_mnist.py instead).
+On TF builds without tf.estimator (>= 2.16) the same workflow runs on
+horovod_tpu's estimator shim (horovod_tpu.tensorflow.estimator) — same
+model_fn / EstimatorSpec / hooks / numpy_input_fn surface.
 """
 
 import argparse
@@ -18,10 +19,13 @@ import tensorflow as tf
 
 import horovod_tpu.tensorflow as hvd
 
-if not hasattr(tf, "estimator"):
-    raise SystemExit(
-        "tf.estimator was removed from this TensorFlow build (>= 2.16); "
-        "use examples/tensorflow_mnist.py (the TF2-native loop) instead.")
+if hasattr(tf, "estimator"):
+    est = tf.estimator
+    numpy_input_fn = tf.compat.v1.estimator.inputs.numpy_input_fn
+else:
+    from horovod_tpu.tensorflow import estimator as est
+
+    numpy_input_fn = est.inputs.numpy_input_fn
 
 parser = argparse.ArgumentParser(description="TF Estimator MNIST Example")
 parser.add_argument("--batch-size", type=int, default=100)
@@ -32,47 +36,67 @@ parser.add_argument("--model-dir", default="./mnist_convnet_model")
 args = parser.parse_args()
 
 
+def _conv2d(x, filters, name):
+    """5x5 SAME conv + relu over raw v1 variables (tf.compat.v1.layers is
+    unavailable under Keras 3)."""
+    v1 = tf.compat.v1
+    with v1.variable_scope(name):
+        cin = int(x.shape[-1])
+        w = v1.get_variable(
+            "kernel", [5, 5, cin, filters],
+            initializer=v1.glorot_uniform_initializer())
+        b = v1.get_variable("bias", [filters],
+                            initializer=v1.zeros_initializer())
+        return tf.nn.relu(tf.nn.conv2d(x, w, 1, "SAME") + b)
+
+
+def _dense(x, units, name, activation=None):
+    v1 = tf.compat.v1
+    with v1.variable_scope(name):
+        w = v1.get_variable("kernel", [int(x.shape[-1]), units],
+                            initializer=v1.glorot_uniform_initializer())
+        b = v1.get_variable("bias", [units],
+                            initializer=v1.zeros_initializer())
+        y = tf.matmul(x, w) + b
+        return activation(y) if activation else y
+
+
 def cnn_model_fn(features, labels, mode):
     """Conv-pool x2 -> dense -> logits, the reference's architecture."""
     input_layer = tf.reshape(features["x"], [-1, 28, 28, 1])
-    conv1 = tf.compat.v1.layers.conv2d(input_layer, 32, [5, 5],
-                                       padding="same",
-                                       activation=tf.nn.relu)
-    pool1 = tf.compat.v1.layers.max_pooling2d(conv1, [2, 2], 2)
-    conv2 = tf.compat.v1.layers.conv2d(pool1, 64, [5, 5], padding="same",
-                                       activation=tf.nn.relu)
-    pool2 = tf.compat.v1.layers.max_pooling2d(conv2, [2, 2], 2)
+    conv1 = _conv2d(input_layer, 32, "conv1")
+    pool1 = tf.nn.max_pool2d(conv1, 2, 2, "VALID")
+    conv2 = _conv2d(pool1, 64, "conv2")
+    pool2 = tf.nn.max_pool2d(conv2, 2, 2, "VALID")
     pool2_flat = tf.reshape(pool2, [-1, 7 * 7 * 64])
-    dense = tf.compat.v1.layers.dense(pool2_flat, 1024,
-                                      activation=tf.nn.relu)
-    dropout = tf.compat.v1.layers.dropout(
-        dense, rate=0.4, training=mode == tf.estimator.ModeKeys.TRAIN)
-    logits = tf.compat.v1.layers.dense(dropout, 10)
+    dense = _dense(pool2_flat, 1024, "dense", activation=tf.nn.relu)
+    dropout = tf.nn.dropout(dense, rate=0.4) \
+        if mode == est.ModeKeys.TRAIN else dense
+    logits = _dense(dropout, 10, "logits")
 
     predictions = {
         "classes": tf.argmax(input=logits, axis=1),
         "probabilities": tf.nn.softmax(logits, name="softmax_tensor"),
     }
-    if mode == tf.estimator.ModeKeys.PREDICT:
-        return tf.estimator.EstimatorSpec(mode=mode, predictions=predictions)
+    if mode == est.ModeKeys.PREDICT:
+        return est.EstimatorSpec(mode=mode, predictions=predictions)
 
     loss = tf.compat.v1.losses.sparse_softmax_cross_entropy(
         labels=labels, logits=logits)
 
-    if mode == tf.estimator.ModeKeys.TRAIN:
+    if mode == est.ModeKeys.TRAIN:
         # Scale LR by size; average gradients across workers.
         optimizer = tf.compat.v1.train.MomentumOptimizer(
             learning_rate=args.lr * hvd.size(), momentum=0.9)
         optimizer = hvd.DistributedOptimizer(optimizer)
         train_op = optimizer.minimize(
             loss=loss, global_step=tf.compat.v1.train.get_global_step())
-        return tf.estimator.EstimatorSpec(mode=mode, loss=loss,
-                                          train_op=train_op)
+        return est.EstimatorSpec(mode=mode, loss=loss, train_op=train_op)
 
     eval_metric_ops = {"accuracy": tf.compat.v1.metrics.accuracy(
         labels=labels, predictions=predictions["classes"])}
-    return tf.estimator.EstimatorSpec(mode=mode, loss=loss,
-                                      eval_metric_ops=eval_metric_ops)
+    return est.EstimatorSpec(mode=mode, loss=loss,
+                             eval_metric_ops=eval_metric_ops)
 
 
 def synthetic_mnist(n, seed):
@@ -98,10 +122,10 @@ def main(_):
 
     # Only rank 0 writes checkpoints; others pass a None model_dir.
     model_dir = args.model_dir if hvd.rank() == 0 else None
-    mnist_classifier = tf.estimator.Estimator(
+    mnist_classifier = est.Estimator(
         model_fn=cnn_model_fn, model_dir=model_dir)
 
-    train_input_fn = tf.compat.v1.estimator.inputs.numpy_input_fn(
+    train_input_fn = numpy_input_fn(
         x={"x": train_data}, y=train_labels,
         batch_size=args.batch_size, num_epochs=None, shuffle=True)
     # Broadcast initial variables from rank 0 after session creation;
@@ -111,7 +135,7 @@ def main(_):
                            steps=args.steps // hvd.size(),
                            hooks=[bcast_hook])
 
-    eval_input_fn = tf.compat.v1.estimator.inputs.numpy_input_fn(
+    eval_input_fn = numpy_input_fn(
         x={"x": eval_data}, y=eval_labels, num_epochs=1, shuffle=False)
     eval_results = mnist_classifier.evaluate(input_fn=eval_input_fn)
     if hvd.rank() == 0:

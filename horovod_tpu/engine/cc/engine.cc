@@ -248,6 +248,7 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   // topology is built.
   shut_down_.store(false);
   loop_exited_.store(false);
+  data_plane_failed_.store(false);
   completions_.store(0);
   ticks_done_.store(0);
   coord_.reset(new Coordinator());
@@ -803,6 +804,14 @@ void Engine::PerformOperation(const Response& resp) {
     for (auto& e : entries) CompleteEntry(e, ST_PRECONDITION, resp.error_message);
     return;
   }
+  if (data_plane_failed_.load()) {
+    for (auto& e : entries)
+      CompleteEntry(e, ST_ABORTED,
+                    "the data plane failed during an earlier collective "
+                    "(a rank died or a transport broke); this job cannot "
+                    "make progress and should be restarted.");
+    return;
+  }
   switch (resp.type) {
     case RESP_ALLREDUCE:
       ExecuteAllreduce(resp, entries);
@@ -894,10 +903,12 @@ void Engine::ExecuteAllreduce(const Response& resp,
   }
   for (auto& e : entries) {
     timeline_.End(e.name, NumElements(e.dims) * static_cast<int64_t>(esize));
-    if (ok)
+    if (ok) {
       CompleteEntry(e, ST_OK, "");
-    else
+    } else {
+      data_plane_failed_.store(true);
       CompleteEntry(e, ST_UNKNOWN, "ring allreduce failed: " + err);
+    }
   }
 }
 
@@ -939,10 +950,12 @@ void Engine::ExecuteAllgather(const Response& resp, TableEntry& e) {
   if (ok && e.out != nullptr)
     memcpy(e.out, buf, static_cast<size_t>(total_bytes));
   timeline_.End(e.name, total_bytes);
-  if (ok)
+  if (ok) {
     CompleteEntry(e, ST_OK, "");
-  else
+  } else {
+    data_plane_failed_.store(true);
     CompleteEntry(e, ST_UNKNOWN, "ring allgather failed: " + err);
+  }
 }
 
 void Engine::ExecuteBroadcast(const Response& resp, TableEntry& e) {
@@ -956,10 +969,12 @@ void Engine::ExecuteBroadcast(const Response& resp, TableEntry& e) {
   bool ok = RingBroadcast(buf, nbytes, e.root_rank, &err);
   timeline_.ActivityEnd(e.name);
   timeline_.End(e.name, nbytes);
-  if (ok)
+  if (ok) {
     CompleteEntry(e, ST_OK, "");
-  else
+  } else {
+    data_plane_failed_.store(true);
     CompleteEntry(e, ST_UNKNOWN, "ring broadcast failed: " + err);
+  }
 }
 
 void Engine::CompleteEntry(const TableEntry& e, int32_t code,
@@ -994,43 +1009,92 @@ bool Engine::RingAllreduce(void* buf, int64_t count, uint8_t dtype,
                          right_fd_, err);
 }
 
+namespace {
+
+// Segment bookkeeping for one direction of the bidirectional ring.
+// `index` is the rank's position in the (possibly relabeled) ring.
+struct HalfRing {
+  char* data;
+  int64_t count = 0;
+  size_t esize;
+  int N, index;
+
+  int64_t base() const { return count / N; }
+  int64_t rem() const { return count % N; }
+  int64_t seg_start(int i) const {
+    return i * base() + std::min<int64_t>(i, rem());
+  }
+  int64_t seg_count(int i) const { return base() + (i < rem() ? 1 : 0); }
+  int send_seg(int step, bool gather) const {
+    int r = gather ? index + 1 : index;
+    return ((r - step) % N + N) % N;
+  }
+  int recv_seg(int step, bool gather) const {
+    int r = gather ? index : index - 1;
+    return ((r - step) % N + N) % N;
+  }
+  char* send_ptr(int step, bool gather) const {
+    return data + seg_start(send_seg(step, gather)) * esize;
+  }
+  size_t send_len(int step, bool gather) const {
+    return static_cast<size_t>(seg_count(send_seg(step, gather))) * esize;
+  }
+  char* recv_ptr(int step, bool gather) const {
+    return data + seg_start(recv_seg(step, gather)) * esize;
+  }
+  size_t recv_len(int step, bool gather) const {
+    return static_cast<size_t>(seg_count(recv_seg(step, gather))) * esize;
+  }
+};
+
+}  // namespace
+
 bool Engine::RingAllreduceOn(void* buf, int64_t count, uint8_t dtype, int N,
                              int index, int left_fd, int right_fd,
                              std::string* err) {
+  // Bidirectional ring: the buffer splits into two halves that travel in
+  // opposite directions simultaneously — half A rightward (send on
+  // right_fd, receive on left_fd) and half B leftward on the mirrored ring
+  // (relabeling rank r as (N - r) % N turns the physical left neighbour
+  // into the logical "right" one, so the same segment schedule applies).
+  // Each link is full-duplex TCP, so this doubles usable bandwidth over
+  // the unidirectional ring (the role NCCL's multi-channel rings play for
+  // the reference, operations.cc:1050).
   if (N == 1 || count == 0) return true;
   size_t esize = DataTypeSize(dtype);
   char* data = static_cast<char*>(buf);
-  int64_t base = count / N, rem = count % N;
-  auto seg_start = [&](int i) -> int64_t {
-    return i * base + std::min<int64_t>(i, rem);
-  };
-  auto seg_count = [&](int i) -> int64_t { return base + (i < rem ? 1 : 0); };
-  int64_t max_seg = base + (rem ? 1 : 0);
-  std::vector<char> tmp(static_cast<size_t>(max_seg) * esize);
-  int r = index;
-  // Phase 1: reduce-scatter.  After N-1 steps rank r owns the fully reduced
-  // segment (r+1) mod N.
+  int64_t cB = count / 2, cA = count - cB;
+  HalfRing A{data, cA, esize, N, index};
+  HalfRing B{data + cA * esize, cB, esize, N, (N - index) % N};
+  int64_t max_seg = cA / N + (cA % N ? 1 : 0);
+  int64_t max_seg_b = cB / N + (cB % N ? 1 : 0);
+  std::vector<char> tmpA(static_cast<size_t>(max_seg) * esize);
+  std::vector<char> tmpB(static_cast<size_t>(max_seg_b) * esize);
+
+  // Phase 1: reduce-scatter both halves.  After N-1 steps this rank owns
+  // fully reduced segment (index+1) of A and (mirror+1) of B.
   for (int step = 0; step < N - 1; ++step) {
-    int ss = ((r - step) % N + N) % N;
-    int rs = ((r - step - 1) % N + N) % N;
-    if (!Exchange(right_fd, data + seg_start(ss) * esize,
-                  static_cast<size_t>(seg_count(ss)) * esize, left_fd,
-                  tmp.data(), static_cast<size_t>(seg_count(rs)) * esize)) {
+    if (!ExchangeBi(right_fd, A.send_ptr(step, false),
+                    A.send_len(step, false), tmpB.data(),
+                    B.recv_len(step, false), left_fd,
+                    B.send_ptr(step, false), B.send_len(step, false),
+                    tmpA.data(), A.recv_len(step, false))) {
       *err = "neighbour exchange failed (reduce-scatter step " +
              std::to_string(step) + ")";
       return false;
     }
-    AccumulateSum(data + seg_start(rs) * esize, tmp.data(), seg_count(rs),
-                  dtype);
+    AccumulateSum(A.recv_ptr(step, false), tmpA.data(),
+                  A.seg_count(A.recv_seg(step, false)), dtype);
+    AccumulateSum(B.recv_ptr(step, false), tmpB.data(),
+                  B.seg_count(B.recv_seg(step, false)), dtype);
   }
-  // Phase 2: allgather of reduced segments.
+  // Phase 2: allgather of reduced segments, both directions.
   for (int step = 0; step < N - 1; ++step) {
-    int ss = ((r + 1 - step) % N + N) % N;
-    int rs = ((r - step) % N + N) % N;
-    if (!Exchange(right_fd, data + seg_start(ss) * esize,
-                  static_cast<size_t>(seg_count(ss)) * esize, left_fd,
-                  data + seg_start(rs) * esize,
-                  static_cast<size_t>(seg_count(rs)) * esize)) {
+    if (!ExchangeBi(right_fd, A.send_ptr(step, true),
+                    A.send_len(step, true), B.recv_ptr(step, true),
+                    B.recv_len(step, true), left_fd,
+                    B.send_ptr(step, true), B.send_len(step, true),
+                    A.recv_ptr(step, true), A.recv_len(step, true))) {
       *err = "neighbour exchange failed (allgather step " +
              std::to_string(step) + ")";
       return false;

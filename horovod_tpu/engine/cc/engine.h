@@ -164,6 +164,11 @@ class Engine {
   EngineOptions opts_;
   std::atomic<bool> initialized_{false};
   std::atomic<bool> shut_down_{false};
+  // Latched on the first data-plane transport failure (ring or
+  // hierarchical): a broken fabric must fail *every* subsequent
+  // collective uniformly, not leave a half-functional job where
+  // allreduce errors but broadcast/allgather still succeed.
+  std::atomic<bool> data_plane_failed_{false};
   std::atomic<bool> loop_exited_{false};
   std::thread background_;
 

@@ -42,6 +42,15 @@ bool RecvFrame(int fd, std::vector<uint8_t>* payload);
 bool Exchange(int send_fd, const void* sbuf, size_t slen,
               int recv_fd, void* rbuf, size_t rlen);
 
+// Bidirectional neighbour exchange: stream A is sent rightward on
+// right_fd while stream A' arrives on left_fd (recv_l); stream B is sent
+// leftward on left_fd while B' arrives on right_fd (recv_r).  All four
+// legs run in one poll loop, saturating both directions of both links.
+bool ExchangeBi(int right_fd, const void* send_r, size_t send_r_len,
+                void* recv_r, size_t recv_r_len, int left_fd,
+                const void* send_l, size_t send_l_len, void* recv_l,
+                size_t recv_l_len);
+
 void CloseFd(int fd);
 
 }  // namespace hvdtpu

@@ -160,10 +160,22 @@ def broadcast_global_variables(root_rank: int = 0):
 
 def broadcast_variables(variables, root_rank: int = 0):
     ops = []
+    prev = []
     for i, var in enumerate(variables):
-        value = broadcast(tf.convert_to_tensor(var), root_rank,
-                          name=f"broadcast_var.{i}.{var.name.replace(':', '_')}")
-        ops.append(var.assign(value))
+        # Chain the broadcasts: in graph mode each one is a blocking
+        # py_function, and a tf.group of independent ops executes in a
+        # process-dependent order (executor readiness / hash order) — two
+        # ranks whose single inter-op thread picks different first ops
+        # would deadlock the engine's negotiation.  Control dependencies
+        # force the same (program) order on every rank; in eager mode the
+        # context is a no-op and execution is already sequential.
+        with tf.control_dependencies(prev):
+            value = broadcast(
+                tf.convert_to_tensor(var), root_rank,
+                name=f"broadcast_var.{i}.{var.name.replace(':', '_')}")
+            assign = var.assign(value)
+        ops.append(assign)
+        prev = [assign]
     if ops and isinstance(ops[0], tf.Operation):
         return tf.group(*ops)
     return ops
@@ -206,15 +218,24 @@ class _DistributedOptimizer(tf.compat.v1.train.Optimizer):
         if _common.size() == 1:
             return gradients
         averaged = []
+        prev = []
         for i, (grad, var) in enumerate(gradients):
             if grad is None:
                 averaged.append((None, var))
                 continue
-            averaged.append((allreduce(
-                grad, average=True,
-                name=f"DistributedOptimizer.grad.{i}",
-                device_dense=self._device_dense,
-                device_sparse=self._device_sparse), var))
+            # Chain the allreduces (control deps): graph-mode collectives
+            # are blocking py_functions and a session executes independent
+            # ones in process-dependent order — ranks whose inter-op
+            # threads pick different first gradients deadlock the
+            # negotiation.  Program order is the same on every rank.
+            with tf.control_dependencies(prev):
+                avg = allreduce(
+                    grad, average=True,
+                    name=f"DistributedOptimizer.grad.{i}",
+                    device_dense=self._device_dense,
+                    device_sparse=self._device_sparse)
+            averaged.append((avg, var))
+            prev = [avg.values if isinstance(avg, tf.IndexedSlices) else avg]
         return averaged
 
     def apply_gradients(self, *args, **kwargs):

@@ -1,0 +1,185 @@
+"""Minimal ``tf.estimator``-compatible shim for TF builds without it.
+
+TensorFlow >= 2.16 removed ``tf.estimator``, but the reference's
+acceptance surface includes an estimator-path example
+(/root/reference/examples/tensorflow_mnist_estimator.py).  This module
+implements just enough of the estimator contract over ``tf.compat.v1``
+graphs/sessions for that workflow to run unchanged:
+
+* ``ModeKeys`` / ``EstimatorSpec`` — the ``model_fn`` protocol,
+* ``Estimator(model_fn, model_dir).train(input_fn, steps, hooks)`` /
+  ``.evaluate(input_fn)`` — a graph-mode train loop honoring
+  ``SessionRunHook.begin``/``after_create_session`` (the surface
+  :class:`horovod_tpu.tensorflow.BroadcastGlobalVariablesHook` uses) and
+  rank-0-only checkpointing via ``model_dir=None`` elsewhere,
+* ``inputs.numpy_input_fn`` — the classic in-memory input pipeline.
+
+This is a training-workflow shim, not a full estimator reimplementation:
+``train``/``evaluate``/``predict`` cover the reference example's usage;
+exporters, distribution strategies, and ``RunConfig`` are out of scope.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+import tensorflow as tf
+
+v1 = tf.compat.v1
+
+
+class ModeKeys:
+    """Same string values as ``tf.estimator.ModeKeys``."""
+
+    TRAIN = "train"
+    EVAL = "eval"
+    PREDICT = "infer"
+
+
+class EstimatorSpec:
+    def __init__(self, mode, predictions=None, loss=None, train_op=None,
+                 eval_metric_ops=None):
+        self.mode = mode
+        self.predictions = predictions
+        self.loss = loss
+        self.train_op = train_op
+        self.eval_metric_ops = eval_metric_ops or {}
+
+
+class _Inputs:
+    @staticmethod
+    def numpy_input_fn(x: Dict[str, "object"], y=None, batch_size: int = 128,
+                       num_epochs: Optional[int] = 1, shuffle: bool = True):
+        """In-memory input pipeline: dict of arrays (+ labels) -> batched
+        ``tf.data`` iterator tensors, like the removed
+        ``tf.compat.v1.estimator.inputs.numpy_input_fn``."""
+
+        def input_fn():
+            data = (dict(x), y) if y is not None else dict(x)
+            ds = tf.data.Dataset.from_tensor_slices(data)
+            if shuffle:
+                ds = ds.shuffle(10000, seed=0)
+            if num_epochs is None:
+                ds = ds.repeat()
+            elif num_epochs > 1:
+                ds = ds.repeat(num_epochs)
+            ds = ds.batch(batch_size)
+            it = v1.data.make_one_shot_iterator(ds)
+            return it.get_next()
+
+        return input_fn
+
+
+inputs = _Inputs
+
+
+def _run_hooks_begin(hooks):
+    for h in hooks:
+        if hasattr(h, "begin"):
+            h.begin()
+
+
+def _run_hooks_after_create(hooks, sess):
+    for h in hooks:
+        if hasattr(h, "after_create_session"):
+            h.after_create_session(sess, None)
+
+
+class Estimator:
+    """Graph-mode train/evaluate/predict driver around a ``model_fn``.
+
+    ``model_dir=None`` disables checkpointing — the distributed-training
+    convention where only rank 0 persists state (SURVEY.md §5.4)."""
+
+    def __init__(self, model_fn: Callable, model_dir: Optional[str] = None):
+        self._model_fn = model_fn
+        self._model_dir = model_dir
+
+    def _ckpt_prefix(self):
+        return os.path.join(self._model_dir, "model.ckpt")
+
+    def _maybe_restore(self, sess, saver):
+        if self._model_dir is None or saver is None:
+            return
+        latest = v1.train.latest_checkpoint(self._model_dir)
+        if latest:
+            saver.restore(sess, latest)
+
+    def train(self, input_fn, steps: int, hooks=()):
+        hooks = list(hooks or ())
+        with tf.Graph().as_default():
+            global_step = v1.train.get_or_create_global_step()
+            features, labels = input_fn()
+            spec = self._model_fn(features, labels, ModeKeys.TRAIN)
+            if spec.train_op is None:
+                raise ValueError("model_fn returned no train_op for TRAIN")
+            _run_hooks_begin(hooks)
+            saver = v1.train.Saver() if self._model_dir else None
+            with v1.Session() as sess:
+                sess.run(v1.global_variables_initializer())
+                sess.run(v1.local_variables_initializer())
+                self._maybe_restore(sess, saver)
+                _run_hooks_after_create(hooks, sess)
+                loss = None
+                for _ in range(int(steps)):
+                    _, loss = sess.run([spec.train_op, spec.loss])
+                if saver is not None:
+                    os.makedirs(self._model_dir, exist_ok=True)
+                    saver.save(sess, self._ckpt_prefix(),
+                               global_step=global_step)
+                return loss
+
+    def evaluate(self, input_fn, hooks=()):
+        hooks = list(hooks or ())
+        with tf.Graph().as_default():
+            v1.train.get_or_create_global_step()
+            features, labels = input_fn()
+            spec = self._model_fn(features, labels, ModeKeys.EVAL)
+            _run_hooks_begin(hooks)
+            value_ops = {k: m[0] for k, m in spec.eval_metric_ops.items()}
+            update_ops = [m[1] for m in spec.eval_metric_ops.values()]
+            saver = v1.train.Saver() if self._model_dir else None
+            with v1.Session() as sess:
+                sess.run(v1.global_variables_initializer())
+                sess.run(v1.local_variables_initializer())
+                self._maybe_restore(sess, saver)
+                _run_hooks_after_create(hooks, sess)
+                total_loss, batches = 0.0, 0
+                try:
+                    while True:
+                        out = sess.run({"loss": spec.loss,
+                                        "updates": update_ops})
+                        total_loss += float(out["loss"])
+                        batches += 1
+                except tf.errors.OutOfRangeError:
+                    pass
+                results = sess.run(value_ops)
+                results["loss"] = total_loss / max(batches, 1)
+                results["global_step"] = int(
+                    sess.run(v1.train.get_global_step()))
+                return results
+
+    def predict(self, input_fn, hooks=()):
+        hooks = list(hooks or ())
+        with tf.Graph().as_default():
+            v1.train.get_or_create_global_step()
+            batch = input_fn()
+            features = batch[0] if isinstance(batch, tuple) else batch
+            spec = self._model_fn(features, None, ModeKeys.PREDICT)
+            _run_hooks_begin(hooks)
+            saver = v1.train.Saver() if self._model_dir else None
+            with v1.Session() as sess:
+                sess.run(v1.global_variables_initializer())
+                sess.run(v1.local_variables_initializer())
+                self._maybe_restore(sess, saver)
+                _run_hooks_after_create(hooks, sess)
+                try:
+                    while True:
+                        out = sess.run(spec.predictions)
+                        # unbatch dict-of-arrays into per-example dicts
+                        n = len(next(iter(out.values())))
+                        for i in range(n):
+                            yield {k: val[i] for k, val in out.items()}
+                except tf.errors.OutOfRangeError:
+                    return

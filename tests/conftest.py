@@ -38,6 +38,27 @@ except ImportError:  # engine/launcher tests run without jax installed
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "quick: in-process tests (no rank subprocesses); `-m quick` is the "
+        "fast PR-iteration tier (<3 min), `-m 'not quick'` the distributed "
+        "tier.")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-tier: tests decorated with @distributed_test spawn fresh rank
+    processes, and the example system tests spawn multi-rank training
+    subprocesses (with framework deps the quick CI job doesn't install);
+    everything else runs in-process and forms the quick tier."""
+    for item in items:
+        if item.fspath.basename == "test_examples.py":
+            continue
+        fn = getattr(item, "function", None)
+        if fn is not None and not hasattr(fn, "__wrapped_rank_fn__"):
+            item.add_marker(pytest.mark.quick)
+
+
 @pytest.fixture
 def single_process_hvd():
     """hvd.init() at size 1 (no env), shut down afterwards."""

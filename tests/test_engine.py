@@ -343,3 +343,91 @@ def test_timeline_written(tmp_path):
     assert "RING_ALLREDUCE" in names or "MEMCPY_IN_FUSION_BUFFER" in names
     pids = {e.get("pid") for e in events}
     assert len(pids) >= 4  # one per tensor name
+
+
+# ---------------------------------------------------------------------------
+# Fault injection: rank death must surface as HorovodInternalError on the
+# survivors, never a hang.  The reference's weakest area (SURVEY.md 5.3) --
+# its coordinated-shutdown path (operations.cc:1446-1461) was never tested.
+# ---------------------------------------------------------------------------
+
+
+@distributed_test(np_=3, timeout=120.0)
+def test_rank_death_before_collective_aborts_survivors():
+    """A rank that exits without joining a collective tears the job down:
+    the coordinator notices the dead control socket (engine.cc worker-death
+    path) and survivors' pending collectives complete with
+    HorovodInternalError well inside the stall window."""
+    import os
+
+    from horovod_tpu.common import HorovodInternalError
+
+    hvd = _init()
+    r = hvd.rank()
+    if r == 1:
+        os._exit(0)  # simulated crash: no shutdown handshake, sockets drop
+    h = hvd.allreduce_async(np.full(64, float(r), np.float32),
+                            average=False, name="orphaned")
+    with pytest.raises(HorovodInternalError):
+        h.wait()
+
+
+@distributed_test(np_=3, timeout=120.0)
+def test_rank_death_mid_allreduce_aborts_survivors():
+    """A rank that dies while the ring is moving a large payload breaks the
+    neighbour exchange mid-stream; survivors get HorovodInternalError (from
+    the failed exchange or the coordinated shutdown, whichever trips
+    first), and every LATER collective fails uniformly too instead of
+    leaving a half-functional job."""
+    import os
+    import time
+
+    from horovod_tpu.common import HorovodInternalError
+
+    hvd = _init()
+    r = hvd.rank()
+    # 64 MB keeps the ring busy for hundreds of ms on loopback, so the
+    # killed rank typically dies mid-exchange.
+    payload = np.full(16 << 20, float(r), np.float32)
+    h = hvd.allreduce_async(payload, average=False, name="doomed")
+    if r == 1:
+        time.sleep(0.3)  # negotiation (~5ms cycle) done; transfer underway
+        os._exit(0)
+    # On a fast host the 64 MB ring can outrun the 0.3 s fuse and this
+    # first wait legitimately succeeds; the contract under test is that a
+    # survivor ERRORS (on this op or the next) and never hangs.
+    with pytest.raises(HorovodInternalError):
+        h.wait()
+        hvd.allreduce(np.zeros(4, np.float32), name="death_sweep")
+    # Uniform failure: every subsequent collective must also raise, not
+    # hang and not succeed (the job is dead, not degraded).
+    with pytest.raises(HorovodInternalError):
+        hvd.broadcast(np.zeros(4, np.float32), 0, name="after_death")
+
+
+@distributed_test(np_=4, timeout=120.0)
+def test_leader_death_mid_hierarchical_aborts_all():
+    """Killing a node leader mid-hierarchical-allreduce: the peer leader's
+    cross-ring exchange fails, its members get the abort status byte, and
+    the dead leader's member fails its local recv -- every survivor raises
+    HorovodInternalError (exercises engine.cc's cross-ring abort and
+    status-byte paths), and later collectives fail uniformly."""
+    import os
+    import time
+
+    from horovod_tpu.common import HorovodInternalError
+
+    _hier_env(local_size=2)
+    hvd = _init()
+    r = hvd.rank()
+    payload = np.full(16 << 20, float(r), np.float32)
+    h = hvd.allreduce_async(payload, average=False, name="hier_doomed")
+    if r == 2:  # leader of node 1
+        time.sleep(0.3)
+        os._exit(0)
+    # As above: if the collective outran the fuse, the next one must fail.
+    with pytest.raises(HorovodInternalError):
+        h.wait()
+        hvd.allreduce(np.zeros(4, np.float32), name="hier_sweep")
+    with pytest.raises(HorovodInternalError):
+        hvd.allgather(np.zeros((1, 2), np.float32), name="hier_after")

@@ -41,6 +41,17 @@ def test_tensorflow_mnist_example():
     assert "Loss:" in out
 
 
+def test_tensorflow_mnist_estimator_example(tmp_path):
+    """The estimator-path example (reference acceptance surface) runs on
+    the shim when tf.estimator is absent: model_fn + EstimatorSpec +
+    BroadcastGlobalVariablesHook + rank-0-only model_dir."""
+    out = _run_example("tensorflow_mnist_estimator.py",
+                       ["--steps", "12", "--train-samples", "256",
+                        "--batch-size", "32",
+                        "--model-dir", str(tmp_path / "est_ckpt")])
+    assert "accuracy" in out
+
+
 def test_jax_mnist_example():
     """Single process, virtual 8-device mesh."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
