@@ -118,3 +118,27 @@ def test_bench_smoke():
     rec = json.loads(line)
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
     assert rec["value"] > 0
+
+
+def test_space_to_depth_stem_is_exact():
+    """SpaceToDepthStem is the 7x7/stride-2 SAME conv *exactly* (same
+    parameter, reshaped weights), on both even (s2d) and odd (plain-conv
+    fallback) input sizes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from horovod_tpu.models.resnet import SpaceToDepthStem
+
+    stem = SpaceToDepthStem(features=8, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 16, 3),
+                    jnp.float32)
+    params = stem.init(jax.random.PRNGKey(0), x)
+    w = params["params"]["kernel"]
+    for shape in ((2, 16, 16, 3), (1, 15, 15, 3)):
+        xi = jnp.asarray(np.random.RandomState(1).randn(*shape), jnp.float32)
+        want = lax.conv_general_dilated(
+            xi, w, (2, 2), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        got = stem.apply(params, xi)
+        np.testing.assert_allclose(got, want, atol=2e-6, err_msg=str(shape))
