@@ -1,0 +1,124 @@
+"""Raw inter-chip RDMA collectives as Pallas kernels.
+
+``ring_permute`` rotates each device's shard to its ring neighbour with a
+single ``pltpu.make_async_remote_copy`` — the hand-rolled equivalent of
+``lax.ppermute`` with the shift-by-one permutation, issued as one direct
+HBM-to-HBM DMA over ICI instead of going through XLA's collective-permute
+machinery.  It is the communication primitive for an RDMA-backed ring
+attention (``ring_attention(..., rotate_impl="rdma")``): on hardware where
+XLA's collective-permute scheduling is the bottleneck, the explicit DMA
+gives the kernel author the overlap control (start early, wait late).
+
+Differentiable: the VJP of a right rotation is a left rotation of the
+cotangent, mirroring ``ppermute``'s transpose.
+
+Requirements: must run inside ``shard_map`` over ``axis_name`` on a TPU
+mesh (or in interpret mode on any mesh, which is how the unit tests
+exercise it without multi-chip hardware).  On real TPUs the kernel takes a
+neighbour barrier first (remote DMA writes into the peer's buffer, so both
+sides must have entered the kernel); barrier semaphores need a
+``collective_id``, reserved here as 13.
+
+No reference counterpart (SURVEY §5.7: the reference has no sequence
+parallelism at all); this exceeds it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+try:
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAS_PALLAS = False
+
+_COLLECTIVE_IDS = (13, 14)  # phase-alternating barrier namespaces
+
+
+def _permute_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis_name,
+                    shift, barrier):
+    my = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    dst = lax.rem(my + shift, n)  # shift pre-normalized to [0, n)
+    if barrier:
+        # Ready handshake: I may DMA into `dst` only once `dst` has
+        # entered this kernel (its output buffer is live).  Every device
+        # signals its *source* ("you may write to me") and waits for the
+        # matching signal from its *destination*.  A stale signal from a
+        # later invocation cannot satisfy this wait: invocations alternate
+        # barrier namespaces (collective_id), and for `dst` to reach the
+        # invocation-after-next it would need its own destination — and,
+        # chasing the chain the whole way around the ring — *this* device
+        # to have advanced too, a contradiction.
+        src = lax.rem(my - shift + n, n)
+        sem = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(sem, inc=1, device_id=src,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(sem, 1)
+    copy = pltpu.make_async_remote_copy(
+        src_ref=x_ref, dst_ref=o_ref, send_sem=send_sem, recv_sem=recv_sem,
+        device_id=dst, device_id_type=pltpu.DeviceIdType.LOGICAL)
+    copy.start()
+    copy.wait()
+
+
+def _ring_permute_raw(x, axis_name, shift, interpret, phase):
+    shift = shift % lax.axis_size(axis_name)  # static: axis sizes are known
+    kernel = functools.partial(_permute_kernel, axis_name=axis_name,
+                               shift=shift, barrier=not interpret)
+    # Propagate the varying-mesh-axes annotation so shard_map's vma check
+    # accepts the pallas output (the result varies exactly as the input).
+    vma = getattr(jax.typeof(x), "vma", None)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype, vma=vma),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        compiler_params=pltpu.CompilerParams(
+            collective_id=_COLLECTIVE_IDS[phase % 2],
+            has_side_effects=True),
+        interpret=interpret,
+    )(x)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _ring_permute(x, axis_name, shift, interpret, phase):
+    return _ring_permute_raw(x, axis_name, shift, interpret, phase)
+
+
+def _ring_permute_fwd(x, axis_name, shift, interpret, phase):
+    return _ring_permute_raw(x, axis_name, shift, interpret, phase), None
+
+
+def _ring_permute_bwd(axis_name, shift, interpret, phase, _res, g):
+    # The transpose of "send my shard +shift" is "send the cotangent
+    # -shift" — identical to ppermute's transpose rule.
+    return (_ring_permute_raw(g, axis_name, -shift, interpret, phase),)
+
+
+_ring_permute.defvjp(_ring_permute_fwd, _ring_permute_bwd)
+
+
+def ring_permute(x, axis_name: str, shift: int = 1,
+                 interpret: bool = None, phase: int = 0):
+    """Rotate ``x``'s shards ``shift`` positions up the mesh ring.
+
+    Equivalent to ``lax.ppermute(x, axis_name, [(i, (i+shift) % n)])``,
+    executed as one Pallas async remote copy per device.  Differentiable.
+    Must be called inside ``shard_map`` over ``axis_name``.  Callers
+    issuing a *sequence* of rotations should alternate ``phase`` (0/1)
+    between consecutive calls so the ready-handshake barriers of adjacent
+    invocations use distinct semaphore namespaces.
+    """
+    if not _HAS_PALLAS:
+        raise RuntimeError("ring_permute requires Pallas (TPU jaxlib)")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _ring_permute(x, axis_name, shift, interpret, phase)

@@ -31,7 +31,8 @@ from horovod_tpu.ops.attention import (
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   sm_scale: Optional[float] = None):
+                   sm_scale: Optional[float] = None,
+                   rotate_impl: str = "ppermute"):
     """Attention over a sequence sharded along ``axis_name``.
 
     Args:
@@ -40,6 +41,12 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
       axis_name: the mapped mesh axis carrying the sequence shards.
       causal: apply a causal mask over *global* positions.
       sm_scale: softmax scale; default ``head_dim ** -0.5``.
+      rotate_impl: how K/V shards travel the ring — ``"ppermute"`` (XLA
+        collective permute, default: the compiler schedules it as an async
+        start/done pair overlapped with compute) or ``"rdma"``
+        (:func:`horovod_tpu.ops.rdma.ring_permute`: one raw Pallas remote
+        DMA per rotation, for hardware where explicit transfer control
+        beats XLA's scheduling; differentiable either way).
 
     Returns:
       The local output shard, same shape/dtype as ``q``.
@@ -55,6 +62,20 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     l0 = jnp.zeros(q.shape[:-1], jnp.float32)
     acc0 = jnp.zeros(q.shape[:-2] + (seq_local, q.shape[-1]), jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if rotate_impl == "ppermute":
+        def rotate(t, phase):
+            del phase
+            return lax.ppermute(t, axis_name, perm)
+    elif rotate_impl == "rdma":
+        from horovod_tpu.ops.rdma import ring_permute
+
+        def rotate(t, phase):
+            # Alternate barrier namespaces between consecutive rotations
+            # (see ring_permute).
+            return ring_permute(t, axis_name, phase=phase)
+    else:
+        raise ValueError(f"unknown rotate_impl {rotate_impl!r}")
 
     # Unrolled ring loop (n is the static mesh-axis size): each step's
     # ppermute can then be scheduled by XLA as an async collective-permute
@@ -79,6 +100,6 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         # originated on device (my_idx - t) mod n.
         m, l, acc = attend(q, k_cur, v_cur, m, l, acc, (my_idx - t) % n)
         if t < n - 1:  # rotate K/V to the right neighbour
-            k_cur = lax.ppermute(k_cur, axis_name, perm)
-            v_cur = lax.ppermute(v_cur, axis_name, perm)
+            k_cur = rotate(k_cur, 0)
+            v_cur = rotate(v_cur, 1)
     return _finalize(m, l, acc, q.dtype)
