@@ -9,6 +9,7 @@ cached next to the sources; rebuilt when any source is newer than the binary.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import subprocess
 import tempfile
@@ -17,14 +18,48 @@ _CC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cc")
 _SOURCES = ["net.cc", "wire.cc", "timeline.cc", "engine.cc", "c_api.cc"]
 _LIB_NAME = "libhvdtpu.so"
 
+# -O3 + native SIMD for the AccumulateSum / half-conversion hot loops.
+# -march=native is safe *only* because the build stamp below keys the
+# cached .so on the host's CPU feature set: a package directory shared
+# over NFS or baked into an image rebuilds on a host whose ISA differs
+# instead of SIGILL-ing on unsupported instructions.
+_FLAGS = ["-std=c++17", "-O3", "-march=native", "-g", "-fPIC", "-shared",
+          "-pthread", "-Wall", "-Wextra", "-Wno-unused-parameter"]
+
 
 def lib_path() -> str:
     return os.path.join(_CC_DIR, _LIB_NAME)
 
 
+def _stamp_path() -> str:
+    return os.path.join(_CC_DIR, ".buildstamp")
+
+
+def _build_stamp() -> str:
+    """Fingerprint of everything that must invalidate the cached binary
+    besides source mtimes: the compile flags and the host CPU's ISA."""
+    cpu = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    cpu = line
+                    break
+    except OSError:
+        pass
+    payload = " ".join(_FLAGS) + "|" + cpu
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
 def needs_build() -> bool:
     lib = lib_path()
     if not os.path.exists(lib):
+        return True
+    try:
+        with open(_stamp_path()) as f:
+            if f.read().strip() != _build_stamp():
+                return True
+    except OSError:
         return True
     lib_mtime = os.path.getmtime(lib)
     for fname in os.listdir(_CC_DIR):
@@ -45,15 +80,15 @@ def build(verbose: bool = False) -> str:
     # processes racing to build don't load a half-written .so.
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CC_DIR)
     os.close(fd)
-    cmd = [cxx, "-std=c++17", "-O2", "-g", "-fPIC", "-shared", "-pthread",
-           "-Wall", "-Wextra", "-Wno-unused-parameter",
-           "-o", tmp] + srcs
+    cmd = [cxx] + _FLAGS + ["-o", tmp] + srcs
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"failed to build {_LIB_NAME}:\n{proc.stderr}")
         os.replace(tmp, lib)
+        with open(_stamp_path(), "w") as f:
+            f.write(_build_stamp())
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)

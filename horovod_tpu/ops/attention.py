@@ -423,14 +423,15 @@ def _row_spec(block, d):
     return spec
 
 
-def _pick_block(seq_len: int) -> int:
-    """Largest kernel-grid block that divides the sequence: keeps common
-    non-512-multiple lengths (640, 768, 1152, ...) on the Pallas kernel
-    instead of silently demoting them to the blockwise fallback."""
-    for b in (512, 384, 256, 128):
-        if seq_len % b == 0:
+def _pick_block(seq_len: int, maximum: int = 512) -> int:
+    """Largest kernel-grid block <= maximum that divides the sequence:
+    keeps common non-512-multiple lengths (640, 768, 1152, ...) on the
+    Pallas kernel instead of silently demoting them to the blockwise
+    fallback."""
+    for b in (1024, 768, 512, 384, 256, 128):
+        if b <= maximum and seq_len % b == 0:
             return b
-    return min(512, seq_len)  # ragged: the fallback path handles it
+    return min(maximum, seq_len)  # ragged: the fallback path handles it
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
@@ -599,11 +600,13 @@ def flash_attention(q, k, v, causal: bool = False,
     state); elsewhere (and for ragged block tails) it falls back to the
     mathematically identical :func:`blockwise_attention`.  Differentiable
     with the flash backward (logsumexp residual + per-block recompute,
-    O(seq) memory, dk/dv and dq as two Pallas kernels).  Default blocks
-    are 512x512 (clipped to the sequence): measured on v5e, 512-blocks
-    halve the forward time vs 128-blocks at seq 1024 (grid overhead
-    amortizes and the MXU sees larger operands) and stay well inside VMEM
-    (~1.5 MB of scratch at head_dim 64).
+    O(seq) memory, dk/dv and dq as two Pallas kernels).  Default blocks:
+    block_q up to 512, block_k up to 1024, each the largest candidate
+    dividing the sequence.  Measured on v5e at seq 1024, 512-blocks halve
+    the forward time vs 128-blocks (grid overhead amortizes and the MXU
+    sees larger operands) and whole-k 1024 key blocks gain another ~5%
+    end-to-end (no online-softmax rescale, no backward key loop); scratch
+    peaks around ~4 MB of VMEM at head_dim 64.
     """
     if layout not in ("bhsd", "bshd"):
         raise ValueError(f"unknown layout {layout!r}")
@@ -621,6 +624,9 @@ def flash_attention(q, k, v, causal: bool = False,
     if block_q is None:
         block_q = _pick_block(q.shape[-2])
     if block_k is None:
-        block_k = _pick_block(k.shape[-2])
+        # Key blocks up to 1024 measure ~5% faster end-to-end than 512 at
+        # seq 1024 on v5e (whole-k blocks skip the online-softmax rescale
+        # and the backward's key-loop); scratch stays ~4 MB of VMEM.
+        block_k = _pick_block(k.shape[-2], maximum=1024)
     return _flash_attention(q, k, v, causal, sm_scale, block_q, block_k,
                             interpret)
