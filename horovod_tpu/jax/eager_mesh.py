@@ -195,6 +195,11 @@ class XlaDataPlane:
         self._rank = rank
         self._size = size
         self._fusion_threshold = int(fusion_threshold)
+        from horovod_tpu.common.config import Config
+
+        # Snapshot once: _wait_dispatch is per-handle hot path; <=0
+        # disables the stall warning (the conventional "off" value).
+        self._stall_sec = Config.from_env().stall_warning_sec
         self._fns = {}
         self._mu = threading.RLock()  # guards _fns, _pending, _local_seq
         self._pending: List[_PlaneOp] = []
@@ -329,11 +334,31 @@ class XlaDataPlane:
     def _wait_dispatch(self, handle: XlaHandle) -> None:
         """Block until `handle`'s op is dispatched (or failed).  Bounded by
         the engine cycle time; the reference's synchronize is the same poll
-        loop (/root/reference/horovod/torch/mpi_ops.cc:393-399)."""
+        loop (/root/reference/horovod/torch/mpi_ops.cc:393-399).  Like the
+        engine's coordinator sweep (engine.cc CheckForStalledTensors), a
+        wait that exceeds ``stall_warning_sec`` logs which negotiations are
+        still outstanding — a peer that never submits the matching
+        collective would otherwise spin here silently forever."""
+        stall_sec = self._stall_sec
+        start = last_warn = time.monotonic()
         while True:
             self.flush()
             if handle._error is not None or handle._batch is not None:
                 return
+            now = time.monotonic()
+            if stall_sec > 0 and now - last_warn >= stall_sec:
+                last_warn = now
+                with self._mu:
+                    waiting = [op.name for op in self._pending
+                               if op.seq is None]
+                import sys
+
+                print(
+                    f"WARNING: XLA-plane wait for '{handle._name}' has "
+                    f"stalled for {now - start:.0f}s; negotiations still "
+                    f"pending: {waiting or '[none — tick not closed]'}. "
+                    f"One or more ranks may not have submitted this "
+                    f"collective.", file=sys.stderr, flush=True)
             time.sleep(0.001)
 
     def _jit_for(self, kind: str, length_or_shape, dtype, root: int = 0):

@@ -150,6 +150,58 @@ class TransformerLM(nn.Module):
                           preferred_element_type=jnp.float32)
 
 
+def migrate_params(params, n_heads: int):
+    """Convert a legacy TransformerLM param tree to the fused layout.
+
+    The fused projections renamed/reshaped parameters relative to earlier
+    revisions of this model (``qkv_kernel``/``o_kernel``/``lm_head_kernel``
+    replaced per-matrix ``q``/``k``/``v``/``o``/``lm_head`` Dense kernels,
+    and an interim revision's single ``qkv`` Dense).  This converter makes
+    old checkpoints loadable — the analogue of how ``SpaceToDepthStem``
+    kept the (7,7,C,F) conv param so ResNet checkpoints stayed loadable.
+
+    Accepts either a bare param dict or a ``{"params": ...}`` wrapper; the
+    layout is detected per-module, so already-migrated trees pass through
+    unchanged.  ``n_heads`` must match the model's head count (the fused
+    kernels are stored head-major).
+    """
+    if "params" in params and isinstance(params["params"], dict):
+        return {**params, "params": migrate_params(params["params"],
+                                                   n_heads)}
+
+    def fuse_attention(attn):
+        if "qkv" in attn:  # interim fused (d, 3d) Dense
+            w = attn["qkv"]["kernel"]
+            d = w.shape[0]
+            qkv = w.reshape(d, 3, n_heads, d // n_heads)
+        elif all(k in attn for k in ("q", "k", "v")):  # per-matrix Dense
+            ws = [attn[k]["kernel"] for k in ("q", "k", "v")]
+            d = ws[0].shape[0]
+            qkv = jnp.stack(ws, axis=1).reshape(d, 3, n_heads,
+                                                d // n_heads)
+        else:
+            return attn  # already fused
+        # Old o Dense consumed the (h, hd)-flattened attention output, so
+        # its input dim unflattens head-major.
+        wo = attn["o"]["kernel"]
+        o = wo.reshape(n_heads, wo.shape[0] // n_heads, wo.shape[1])
+        rest = {key: val for key, val in attn.items()
+                if key not in ("q", "k", "v", "qkv", "o")}
+        return {**rest, "qkv_kernel": qkv, "o_kernel": o}
+
+    out = {}
+    for key, val in params.items():
+        if key == "lm_head" and isinstance(val, dict) and "kernel" in val:
+            out["lm_head_kernel"] = val["kernel"]
+        elif isinstance(val, dict) and ("qkv" in val or "q" in val):
+            out[key] = fuse_attention(val)
+        elif isinstance(val, dict):
+            out[key] = migrate_params(val, n_heads)
+        else:
+            out[key] = val
+    return out
+
+
 def fused_next_token_loss(hidden, w, targets, dtype=jnp.bfloat16,
                           n_chunks: int = 8):
     """Mean cross-entropy computed head-chunk by head-chunk.

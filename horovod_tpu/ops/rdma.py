@@ -99,8 +99,18 @@ def _ring_permute_fwd(x, axis_name, shift, interpret, phase):
 
 def _ring_permute_bwd(axis_name, shift, interpret, phase, _res, g):
     # The transpose of "send my shard +shift" is "send the cotangent
-    # -shift" — identical to ppermute's transpose rule.
-    return (_ring_permute_raw(g, axis_name, -shift, interpret, phase),)
+    # -shift" — identical to ppermute's transpose rule.  The barrier
+    # namespace is FLIPPED relative to the forward call: autodiff replays
+    # the transposed rotations in reverse order, so the last forward
+    # rotation (phase p) is immediately followed by its own backward
+    # rotation — with the flip that backward uses p^1, and since forward
+    # phases alternate ..., p^1, p, the backward sequence p^1, p, ...
+    # keeps the whole composed fwd+bwd chain strictly alternating.
+    # Without the flip, two adjacent invocations would share a semaphore
+    # namespace and a lagging device's ready-wait could be satisfied by a
+    # neighbour's *next*-invocation signal, licensing a DMA into a buffer
+    # that is not yet live.
+    return (_ring_permute_raw(g, axis_name, -shift, interpret, phase ^ 1),)
 
 
 _ring_permute.defvjp(_ring_permute_fwd, _ring_permute_bwd)

@@ -72,7 +72,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
         def rotate(t, phase):
             # Alternate barrier namespaces between consecutive rotations
-            # (see ring_permute).
+            # (see ring_permute).  Invariant: the phases of *every*
+            # adjacent pair of ring_permute invocations — including the
+            # autodiff-composed sequence, where the backward rotations
+            # run in reverse order right after the last forward one —
+            # must differ.  Here k uses 0 and v uses 1 within a step, so
+            # the forward stream is 0,1,0,1,…; ring_permute's VJP flips
+            # the phase, making the seam (last fwd = 1, first bwd = 0)
+            # and the whole backward stream alternate too.
             return ring_permute(t, axis_name, phase=phase)
     else:
         raise ValueError(f"unknown rotate_impl {rotate_impl!r}")

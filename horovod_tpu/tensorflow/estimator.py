@@ -95,16 +95,30 @@ class Estimator:
     def __init__(self, model_fn: Callable, model_dir: Optional[str] = None):
         self._model_fn = model_fn
         self._model_dir = model_dir
+        # Trained variable values kept in memory so evaluate()/predict()
+        # warm-start even with model_dir=None (the every-rank-but-0
+        # convention) — real tf.estimator warm-starts from its own
+        # temp-dir checkpoint in that case; we keep the analogue in RAM
+        # instead of inventing temp files on non-checkpointing ranks.
+        self._warm_start: Optional[Dict[str, "object"]] = None
 
     def _ckpt_prefix(self):
         return os.path.join(self._model_dir, "model.ckpt")
 
     def _maybe_restore(self, sess, saver):
-        if self._model_dir is None or saver is None:
-            return
-        latest = v1.train.latest_checkpoint(self._model_dir)
-        if latest:
-            saver.restore(sess, latest)
+        if self._model_dir is not None and saver is not None:
+            latest = v1.train.latest_checkpoint(self._model_dir)
+            if latest:
+                saver.restore(sess, latest)
+                return
+        if self._warm_start is not None:
+            # Assign cached trained values into same-named variables of
+            # the freshly built graph (shape-checked; unmatched names —
+            # e.g. new metric locals — keep their initializer values).
+            for var in v1.global_variables():
+                value = self._warm_start.get(var.op.name)
+                if value is not None and tuple(var.shape) == value.shape:
+                    var.load(value, sess)
 
     def train(self, input_fn, steps: int, hooks=()):
         hooks = list(hooks or ())
@@ -124,7 +138,15 @@ class Estimator:
                 loss = None
                 for _ in range(int(steps)):
                     _, loss = sess.run([spec.train_op, spec.loss])
-                if saver is not None:
+                if saver is None:
+                    # Non-checkpointing rank: keep trained values in RAM
+                    # so evaluate()/predict() warm-start (checkpointing
+                    # ranks restore from the checkpoint instead).
+                    variables = v1.global_variables()
+                    self._warm_start = dict(
+                        zip((var.op.name for var in variables),
+                            sess.run(variables)))
+                else:
                     os.makedirs(self._model_dir, exist_ok=True)
                     saver.save(sess, self._ckpt_prefix(),
                                global_step=global_step)

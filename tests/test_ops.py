@@ -211,6 +211,44 @@ def test_ring_attention_rdma_rotate_matches(causal):
         np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
 
 
+def test_rdma_phase_alternates_through_backward(monkeypatch):
+    """The barrier-namespace (phase) sequence of ring_permute invocations
+    must strictly alternate across the WHOLE autodiff-composed program:
+    the backward rotations run immediately after the last forward one, so
+    the VJP flips the phase (rdma.py _ring_permute_bwd).  On real hardware
+    two adjacent same-namespace invocations would let a lagging device's
+    ready-wait be satisfied by a neighbour's next-invocation signal."""
+    import horovod_tpu.ops.rdma as rdma
+
+    phases = []
+    real_raw = rdma._ring_permute_raw
+
+    def recording_raw(x, axis_name, shift, interpret, phase):
+        phases.append(phase % 2)
+        return real_raw(x, axis_name, shift, interpret, phase)
+
+    monkeypatch.setattr(rdma, "_ring_permute_raw", recording_raw)
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices[:4]), ("sp",))
+    q, k, v = _qkv(batch=1, heads=1, seq=4 * 16, d=8)
+    spec = P(None, None, "sp", None)
+    fn = functools.partial(ring_attention, axis_name="sp", causal=False,
+                           rotate_impl="rdma")
+
+    def ring_loss(q, k, v):
+        out = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_vma=False)(q, k, v)
+        return (out ** 2).sum()
+
+    jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    # Tracing order is program order for these sequenced collectives; the
+    # recorded stream covers forward and backward rotations.
+    assert len(phases) >= 4, phases
+    for a, b in zip(phases, phases[1:]):
+        assert a != b, f"adjacent invocations share a namespace: {phases}"
+
+
 def test_blockwise_offsets_compose():
     """Shifted-window blockwise calls (the ring building block) agree with
     one global causal call."""

@@ -159,3 +159,43 @@ def test_tf_v1_distributed_optimizer():
     # SGD step with lr=0.5 lands every rank at +mean(r+1).
     want = sum(i + 1 for i in range(n)) / n
     assert np.allclose(w1, want, atol=1e-5), (r, w1, want)
+
+
+def test_estimator_warm_start_without_model_dir():
+    """Estimator.evaluate()/predict() see the TRAINED weights even with
+    model_dir=None (the non-checkpointing-rank convention): train() caches
+    final variable values in memory and evaluate/predict warm-start from
+    them, matching real tf.estimator's temp-dir warm-start contract
+    (ADVICE r2)."""
+    import tensorflow as tf
+    from horovod_tpu.tensorflow import estimator
+
+    v1 = tf.compat.v1
+    v1.disable_eager_execution()
+
+    def model_fn(features, labels, mode):
+        w = v1.get_variable("w", initializer=np.zeros((1,), np.float32))
+        pred = features["x"] * w
+        if mode == estimator.ModeKeys.PREDICT:
+            return estimator.EstimatorSpec(mode, predictions={"p": pred})
+        loss = tf.reduce_mean((pred - labels) ** 2)
+        train_op = tf.group(
+            v1.assign_add(w, [1.0]),
+            v1.assign_add(v1.train.get_global_step(), 1))
+        return estimator.EstimatorSpec(
+            mode, loss=loss, train_op=train_op,
+            eval_metric_ops={"w_value": (tf.reduce_sum(w), tf.no_op())})
+
+    x = {"x": np.ones((4,), np.float32)}
+    y = np.zeros((4,), np.float32)
+    est = estimator.Estimator(model_fn, model_dir=None)
+    est.train(estimator.inputs.numpy_input_fn(x, y, batch_size=2,
+                                              num_epochs=None,
+                                              shuffle=False), steps=3)
+    # Fresh graph in evaluate(): without the warm start, w would read 0.
+    results = est.evaluate(estimator.inputs.numpy_input_fn(
+        x, y, batch_size=2, shuffle=False))
+    assert np.isclose(results["w_value"], 3.0), results
+    preds = list(est.predict(estimator.inputs.numpy_input_fn(
+        x, batch_size=4, shuffle=False)))
+    assert len(preds) == 4 and np.isclose(preds[0]["p"], 3.0), preds

@@ -152,3 +152,58 @@ def test_dp_sp_train_step():
         losses.append(float(loss))
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses  # tiny model memorizes the batch
+
+
+def test_migrate_params_legacy_checkpoints():
+    """migrate_params converts both legacy layouts (per-matrix q/k/v/o
+    Dense kernels; interim fused qkv Dense) into the head-major fused
+    layout, producing a tree the current model accepts and that computes
+    the same attention math (ADVICE r2: checkpoint migration path)."""
+    from horovod_tpu.models.transformer import migrate_params
+
+    model = _model()
+    tokens = _tokens()
+    params = model.init(jax.random.PRNGKey(2), tokens)["params"]
+    want = model.apply({"params": params}, tokens)
+
+    def to_legacy(tree, fused_qkv):
+        out = {}
+        for key, val in tree.items():
+            if isinstance(val, dict) and "qkv_kernel" in val:
+                w = val["qkv_kernel"]  # (d, 3, h, hd)
+                d = w.shape[0]
+                o = val["o_kernel"].reshape(d, -1)
+                if fused_qkv:
+                    out[key] = {"qkv": {"kernel": w.reshape(d, 3 * d)},
+                                "o": {"kernel": o}}
+                else:
+                    per = w.reshape(d, 3, d)
+                    out[key] = {
+                        "q": {"kernel": per[:, 0]},
+                        "k": {"kernel": per[:, 1]},
+                        "v": {"kernel": per[:, 2]},
+                        "o": {"kernel": o}}
+            elif isinstance(val, dict):
+                out[key] = to_legacy(val, fused_qkv)
+            else:
+                out[key] = val
+        out2 = {}
+        for key, val in out.items():
+            if key == "lm_head_kernel":
+                out2["lm_head"] = {"kernel": val}
+            else:
+                out2[key] = val
+        return out2
+
+    for fused_qkv in (False, True):
+        legacy = to_legacy(params, fused_qkv)
+        migrated = migrate_params(legacy, n_heads=4)
+        # Exact same tree (structure and values) as the native init.
+        assert jax.tree_util.tree_structure(migrated) == \
+            jax.tree_util.tree_structure(params)
+        got = model.apply({"params": migrated}, tokens)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+    # Already-migrated trees pass through unchanged.
+    again = migrate_params({"params": params}, n_heads=4)["params"]
+    assert jax.tree_util.tree_structure(again) == \
+        jax.tree_util.tree_structure(params)

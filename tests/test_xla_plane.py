@@ -207,3 +207,41 @@ def test_xla_plane_torch_optimizer():
     w = model.weight.detach().numpy().copy()
     agree = hvd_np.allreduce(w, average=True, name="check")
     assert np.allclose(w, agree, atol=1e-6)
+
+
+def test_xla_plane_wait_stall_warning(monkeypatch, capsys):
+    """_wait_dispatch surfaces a stall warning (ADVICE r2): if a peer never
+    submits the matching collective, the poll loop logs the op name and the
+    still-pending negotiations after stall_warning_sec instead of spinning
+    silently forever."""
+    import threading
+    import time as _time
+
+    from horovod_tpu.jax.eager_mesh import XlaDataPlane, XlaHandle, _PlaneOp, _Batch
+
+    monkeypatch.setenv("HVD_TPU_STALL_WARNING_SEC", "0.05")
+    plane = XlaDataPlane(mesh=None, spec_sharded=None, spec_replicated=None,
+                         rank=0, size=2, fusion_threshold=1 << 20)
+    handle = XlaHandle(plane, "ar", "stalled_grad", None, True, 2,
+                       np.float32, (2,))
+    op = _PlaneOp("stalled_grad", "ar", np.zeros(2, np.float32), 0, handle)
+    plane._pending.append(op)  # never negotiated: seq stays None
+    monkeypatch.setattr(plane, "flush", lambda: None)
+
+    class _Ready:
+        def ready(self):
+            return True
+
+        def host(self):
+            return np.zeros(2, np.float32)
+
+    def unblock():
+        _time.sleep(0.4)
+        handle._batch = _Ready()
+
+    t = threading.Thread(target=unblock)
+    t.start()
+    plane._wait_dispatch(handle)
+    t.join()
+    err = capsys.readouterr().err
+    assert "stalled" in err and "stalled_grad" in err, err
