@@ -225,6 +225,11 @@ class Handle:
         self._out = out
         self._name = name
         self._finished = False
+        # Engine (tick, seq) completion stamp, set by wait(): ops fused in
+        # one negotiation cycle share a tick — observability for tests and
+        # the timeline (the reference's cycle accounting).
+        self.completion_tick: Optional[int] = None
+        self.completion_seq: Optional[int] = None
 
     def done(self) -> bool:
         if self._finished:
@@ -239,6 +244,10 @@ class Handle:
             if code != ST_OK:
                 msg = _lib.hvd_tpu_error(self._raw).decode()
                 raise _status_error(code, msg, self._name)
+            self.completion_tick = int(
+                _lib.hvd_tpu_completion_tick(self._raw))
+            self.completion_seq = int(
+                _lib.hvd_tpu_completion_seq(self._raw))
             if self._op == OP_ALLGATHER:
                 nbytes = _lib.hvd_tpu_result_nbytes(self._raw)
                 dim0 = _lib.hvd_tpu_result_dim0(self._raw)

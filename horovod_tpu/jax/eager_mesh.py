@@ -139,15 +139,23 @@ class XlaHandle:
         self._ag_dim0s = None
         self._error: Optional[Exception] = None
         self._finished = False
+        # Negotiation (tick, seq) stamp, mirrored from the engine metadata
+        # op at dispatch time (duck-type parity with common.Handle).
+        self.completion_tick: Optional[int] = None
+        self.completion_seq: Optional[int] = None
 
     # plane-side plumbing -------------------------------------------------
     def _fail(self, err: Exception) -> None:
         self._error = err
 
-    def _set_result(self, batch: _Batch, off: int, n: int) -> None:
+    def _set_result(self, batch: _Batch, off: int, n: int,
+                    tick: Optional[int] = None,
+                    seq: Optional[int] = None) -> None:
         self._batch = batch
         self._off = off
         self._n = n
+        self.completion_tick = tick
+        self.completion_seq = seq
 
     # public handle API ---------------------------------------------------
     def done(self) -> bool:
@@ -400,7 +408,7 @@ class XlaDataPlane:
             h._ag_pad = pad
             h._ag_dim0s = op.dim0s
             h._shape = (int(op.dim0s.sum()),) + rest
-            h._set_result(batch, 0, 0)
+            h._set_result(batch, 0, 0, op.tick, op.seq)
         else:
             dtype = bucket[0].payload.dtype
             lens = [op.payload.size for op in bucket]
@@ -416,7 +424,7 @@ class XlaDataPlane:
             fn = self._jit_for(kind, length, dtype, bucket[0].root)
             batch = _Batch(fn(self._global_array(flat)))
             for op, o, n in zip(bucket, offs, lens):
-                op.handle._set_result(batch, o, n)
+                op.handle._set_result(batch, o, n, op.tick, op.seq)
         self.stats["dispatches"] += 1
         self.stats["fused_tensors"] += len(bucket)
 

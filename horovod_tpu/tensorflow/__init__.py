@@ -18,6 +18,7 @@ sparse IndexedSlices support, `broadcast_global_variables`,
 
 from __future__ import annotations
 
+import collections
 import threading
 from typing import Optional
 
@@ -69,6 +70,179 @@ def _through_engine(kind: str, tensor: tf.Tensor, name: str, **kw):
     else:
         out.set_shape([None] + list(tensor.shape[1:]))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Async collectives -- the ComputeAsync analogue.
+#
+# The reference's TF kernels are ComputeAsync: enqueue, return, done() fires
+# in the engine callback (/root/reference/horovod/tensorflow/mpi_ops.cc:
+# 275-330), which is what lets N gradients negotiate in one engine cycle and
+# FUSE (operations.cc:1607-1642 — fusion only works when ops co-arrive).
+# Here each collective splits into an *enqueue* py_function (non-blocking:
+# submits to the engine/plane, parks the handle in a registry) and a *wait*
+# py_function.  synchronize() gives every wait of a group a control
+# dependency on ALL the group's enqueues, so no rank blocks before it has
+# submitted everything — arbitrary executor order is then deadlock-free (the
+# engine coordinator tolerates any per-rank arrival order) and co-arriving
+# ops fuse into one negotiation cycle / one plane dispatch.
+# ---------------------------------------------------------------------------
+
+# name -> FIFO of enqueued common handles.  A deque, not a slot: the same
+# graph ops can run again (next session.run) before earlier waits drained,
+# and duplicate-named groups built twice in one graph then pair first
+# enqueue with first wait — the engine's duplicate-in-flight-name check
+# turns genuinely concurrent reuse into a typed error instead of silent
+# cross-pairing.
+_async_handles: dict = {}
+_async_lock = threading.Lock()
+# Handles of the most recent _group_average_gradients group; after the ops
+# have executed, their completion_tick spread shows how well the group
+# fused (tests assert ≤2 distinct ticks for N small gradients).
+_last_group_handles: list = []
+_group_counter = [0]
+
+
+def _next_group_id() -> int:
+    """Build-time counter making collective-name prefixes unique per group
+    (two optimizers / repeated tape calls in one graph must not share
+    in-flight names).  Deterministic across ranks under the standing
+    assumption that every rank executes the same user program."""
+    with _name_lock:
+        _group_counter[0] += 1
+        return _group_counter[0]
+
+
+def _common_enqueue(kind: str, arr: np.ndarray, name: str, root_rank: int,
+                    average: bool):
+    if kind == "allreduce":
+        return _common.allreduce_async(arr, average=average, name=name)
+    if kind == "allgather":
+        return _common.allgather_async(arr, name=name)
+    return _common.broadcast_async(arr, root_rank, name=name)
+
+
+class TFAsyncHandle:
+    """An outstanding TF collective: produce the result via
+    :func:`synchronize`.  After synchronization, ``completion_tick`` holds
+    the engine negotiation tick the op completed in (fused ops share one) —
+    the observability tests and the timeline key off."""
+
+    def __init__(self, kind: str, name: str, eager_handle=None, token=None,
+                 dtype=None, shape=None):
+        self._kind = kind
+        self._name = name
+        self._eager = eager_handle
+        self._token = token  # graph mode: the enqueue op's output
+        self._dtype = dtype
+        self._shape = shape
+        self._waited = False
+        self.completion_tick: Optional[int] = None
+
+    def done(self) -> bool:
+        """Non-blocking poll (eager handles only — a graph-mode handle has
+        no engine state until its enqueue op runs in a session)."""
+        if self._eager is None:
+            raise ValueError(
+                "done() is only available for eagerly-enqueued handles")
+        return self._eager.done()
+
+    def _wait_tensor(self) -> tf.Tensor:
+        if self._waited:
+            raise ValueError(
+                f"handle for '{self._name}' already synchronized")
+        self._waited = True
+        if self._eager is not None:
+            arr = self._eager.wait()
+            self.completion_tick = self._eager.completion_tick
+            return tf.constant(arr)
+
+        def wait_fn():
+            with _async_lock:
+                queue = _async_handles[self._name]
+                handle = queue.popleft()
+                if not queue:
+                    del _async_handles[self._name]
+            arr = handle.wait()
+            self.completion_tick = handle.completion_tick
+            return arr
+
+        out = tf.py_function(wait_fn, [], self._dtype,
+                             name=(self._name + ".wait").replace(".", "_"))
+        if self._kind == "allgather":
+            out.set_shape([None] + list(self._shape[1:]))
+        else:
+            out.set_shape(self._shape)
+        return out
+
+
+def _enqueue_async(kind: str, tensor: tf.Tensor, name: str,
+                   root_rank: int = 0, average: bool = True) -> TFAsyncHandle:
+    tensor = tf.convert_to_tensor(tensor)
+    if hasattr(tensor, "numpy"):  # eager: enqueue NOW, wait later
+        eager_handle = _common_enqueue(kind, tensor.numpy(), name,
+                                       root_rank, average)
+        return TFAsyncHandle(kind, name, eager_handle=eager_handle,
+                             dtype=tensor.dtype, shape=tensor.shape)
+
+    def enqueue_fn(x):
+        handle = _common_enqueue(kind, x.numpy(), name, root_rank, average)
+        with _async_lock:
+            _async_handles.setdefault(name, collections.deque()).append(
+                handle)
+        return np.int64(1)
+
+    token = tf.py_function(enqueue_fn, [tensor], tf.int64,
+                           name=(name + ".enq").replace(".", "_"))
+    return TFAsyncHandle(kind, name, token=token, dtype=tensor.dtype,
+                         shape=tensor.shape)
+
+
+def allreduce_async(tensor: tf.Tensor, average: bool = True,
+                    name: Optional[str] = None) -> TFAsyncHandle:
+    """Enqueue a (sum or average) allreduce without blocking."""
+    return _enqueue_async("allreduce", tensor,
+                          name or _auto_name("allreduce"), average=average)
+
+
+def allgather_async(tensor: tf.Tensor,
+                    name: Optional[str] = None) -> TFAsyncHandle:
+    """Enqueue a dim-0 allgather without blocking."""
+    return _enqueue_async("allgather", tensor,
+                          name or _auto_name("allgather"))
+
+
+def broadcast_async(tensor: tf.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> TFAsyncHandle:
+    """Enqueue a broadcast from ``root_rank`` without blocking."""
+    return _enqueue_async("broadcast", tensor,
+                          name or _auto_name("broadcast"),
+                          root_rank=root_rank)
+
+
+def synchronize(handles):
+    """Materialize async collective results.
+
+    Accepts one handle or a sequence; returns the result tensor(s).  When
+    given the whole group at once (the internal users always do), every
+    graph-mode wait op is given a control dependency on *all* of the
+    group's enqueue ops — the property that makes independent-op executor
+    scheduling deadlock-free and lets the group fuse."""
+    single = isinstance(handles, TFAsyncHandle)
+    group = [handles] if single else list(handles)
+    tokens = [h._token for h in group if h._token is not None]
+    outs = []
+    with tf.control_dependencies(tokens or None):
+        for h in group:
+            outs.append(h._wait_tensor())
+    if tokens and len(outs) > 1:
+        # Tie every output to every wait: fetching any subset still runs
+        # ALL the group's waits, so no enqueued handle is orphaned in the
+        # registry by graph pruning (every enqueue ran — the waits must
+        # drain them) and no rank leaves collectives half-consumed.
+        with tf.control_dependencies(outs):
+            outs = [tf.identity(t) for t in outs]
+    return outs[0] if single else outs
 
 
 def _allreduce(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
@@ -159,23 +333,19 @@ def broadcast_global_variables(root_rank: int = 0):
 
 
 def broadcast_variables(variables, root_rank: int = 0):
-    ops = []
-    prev = []
-    for i, var in enumerate(variables):
-        # Chain the broadcasts: in graph mode each one is a blocking
-        # py_function, and a tf.group of independent ops executes in a
-        # process-dependent order (executor readiness / hash order) — two
-        # ranks whose single inter-op thread picks different first ops
-        # would deadlock the engine's negotiation.  Control dependencies
-        # force the same (program) order on every rank; in eager mode the
-        # context is a no-op and execution is already sequential.
-        with tf.control_dependencies(prev):
-            value = broadcast(
-                tf.convert_to_tensor(var), root_rank,
-                name=f"broadcast_var.{i}.{var.name.replace(':', '_')}")
-            assign = var.assign(value)
-        ops.append(assign)
-        prev = [assign]
+    # Enqueue-all-then-wait: every broadcast is submitted before any wait
+    # blocks (synchronize control-deps each wait on all enqueues), so the
+    # whole set negotiates in one engine cycle and fuses — the reference's
+    # ComputeAsync behavior — instead of paying one cycle per variable.
+    variables = list(variables)
+    prefix = f"broadcast_var.g{_next_group_id()}"
+    handles = [
+        broadcast_async(
+            tf.convert_to_tensor(var), root_rank,
+            name=f"{prefix}.{i}.{var.name.replace(':', '_')}")
+        for i, var in enumerate(variables)]
+    values = synchronize(handles)
+    ops = [var.assign(value) for var, value in zip(variables, values)]
     if ops and isinstance(ops[0], tf.Operation):
         return tf.group(*ops)
     return ops
@@ -199,6 +369,92 @@ class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
             session.run(self.bcast_op)
 
 
+def _with_allreduce_grad(x, y, name: str):
+    """Attach the allreduce gradient (allreduce' = allreduce, the
+    reference's registration, mpi_ops.py:81-92) to a result ``y`` computed
+    from ``x`` by the async group machinery, so differentiating through a
+    group-averaged gradient (e.g. a gradient penalty) still allreduces the
+    cotangent instead of silently disconnecting."""
+
+    @tf.custom_gradient
+    def op(x):
+        def grad(dy):
+            summed = _allreduce(dy, name=f"{name}.bwd")
+            return tf.math.divide(summed, float(_common.size()))
+        return y, grad
+
+    return op(x)
+
+
+def _with_allgather_grad(x, y, name: str):
+    """Attach the allgather gradient (reduce-then-slice-by-rank-offsets,
+    the reference's mpi_ops.py:114-135) to an async-group result."""
+
+    @tf.custom_gradient
+    def op(x):
+        dim0 = tf.shape(x)[0]
+
+        def grad(dy):
+            summed = _allreduce(dy, name=f"{name}.bwd")
+            sizes = _through_engine(
+                "allgather", tf.reshape(tf.cast(dim0, tf.int64), [1]),
+                f"{name}.bwd.sizes")
+            offset = tf.reduce_sum(sizes[:_common.rank()])
+            return tf.slice(summed, [tf.cast(offset, tf.int32)] +
+                            [0] * (len(x.shape) - 1),
+                            tf.shape(x))
+        return y, grad
+
+    return op(x)
+
+
+def _group_average_gradients(gradients, name_prefix: str):
+    """Allreduce-average a list of ``(grad, var)`` (or bare grads) as ONE
+    enqueue-all-then-wait group: every gradient negotiates in the same
+    engine cycle(s) and fuses, and the collectives overlap instead of
+    serializing one cycle each.  ``tf.IndexedSlices`` ride as allgathers of
+    values+indices, like the reference's sparse path.  Results stay
+    differentiable (allreduce'/allgather' re-attached via custom_gradient).
+    """
+    global _last_group_handles
+    with_vars = gradients and isinstance(gradients[0], tuple)
+    pairs = gradients if with_vars else [(g, None) for g in gradients]
+    n = float(_common.size())
+    prefix = f"{name_prefix}.g{_next_group_id()}"
+    handles = []  # flat group, in deterministic program order
+    plan = []  # mirrors pairs: (mode, payload)
+    for i, (grad, var) in enumerate(pairs):
+        if grad is None:
+            plan.append(("none", None))
+        elif isinstance(grad, tf.IndexedSlices):
+            hv = allgather_async(grad.values, name=f"{prefix}.{i}.values")
+            hi = allgather_async(grad.indices, name=f"{prefix}.{i}.indices")
+            handles += [hv, hi]
+            plan.append(("sparse", (hv, hi, grad.dense_shape)))
+        else:
+            h = allreduce_async(grad, average=True, name=f"{prefix}.{i}")
+            handles.append(h)
+            plan.append(("dense", h))
+    results = dict(zip(map(id, handles), synchronize(handles)))
+    _last_group_handles = handles  # observability: completion_tick spread
+    out = []
+    for (grad, var), (mode, payload) in zip(pairs, plan):
+        if mode == "none":
+            red = None
+        elif mode == "sparse":
+            hv, hi, dense_shape = payload
+            values = _with_allgather_grad(grad.values, results[id(hv)],
+                                          hv._name)
+            red = tf.IndexedSlices(tf.math.divide(values, n),
+                                   results[id(hi)],
+                                   dense_shape=dense_shape)
+        else:
+            red = _with_allreduce_grad(grad, results[id(payload)],
+                                       payload._name)
+        out.append((red, var) if with_vars else red)
+    return out
+
+
 class _DistributedOptimizer(tf.compat.v1.train.Optimizer):
     """Wraps a `tf.compat.v1.train.Optimizer`; `compute_gradients` returns
     allreduce-averaged gradients
@@ -210,6 +466,9 @@ class _DistributedOptimizer(tf.compat.v1.train.Optimizer):
             name = f"Distributed{type(optimizer).__name__}"
         super().__init__(name=name, use_locking=use_locking)
         self._optimizer = optimizer
+        # Accepted for reference-API compatibility only: every engine
+        # collective is host-staged on TPU (there is no GPU-vs-CPU
+        # placement choice), so these have no effect.
         self._device_dense = device_dense
         self._device_sparse = device_sparse
 
@@ -217,26 +476,8 @@ class _DistributedOptimizer(tf.compat.v1.train.Optimizer):
         gradients = self._optimizer.compute_gradients(*args, **kwargs)
         if _common.size() == 1:
             return gradients
-        averaged = []
-        prev = []
-        for i, (grad, var) in enumerate(gradients):
-            if grad is None:
-                averaged.append((None, var))
-                continue
-            # Chain the allreduces (control deps): graph-mode collectives
-            # are blocking py_functions and a session executes independent
-            # ones in process-dependent order — ranks whose inter-op
-            # threads pick different first gradients deadlock the
-            # negotiation.  Program order is the same on every rank.
-            with tf.control_dependencies(prev):
-                avg = allreduce(
-                    grad, average=True,
-                    name=f"DistributedOptimizer.grad.{i}",
-                    device_dense=self._device_dense,
-                    device_sparse=self._device_sparse)
-            averaged.append((avg, var))
-            prev = [avg.values if isinstance(avg, tf.IndexedSlices) else avg]
-        return averaged
+        return _group_average_gradients(
+            gradients, "DistributedOptimizer.grad")
 
     def apply_gradients(self, *args, **kwargs):
         return self._optimizer.apply_gradients(*args, **kwargs)
@@ -269,6 +510,6 @@ class DistributedGradientTape(tf.GradientTape):
         grads = super().gradient(target, sources, output_gradients)
         if _common.size() == 1:
             return grads
-        return [None if g is None else
-                allreduce(g, average=True, name=f"DistributedTape.grad.{i}")
-                for i, g in enumerate(grads)]
+        # One enqueue-all-then-wait group: the collectives fuse and
+        # overlap instead of blocking one engine cycle per gradient.
+        return _group_average_gradients(grads, "DistributedTape.grad")
