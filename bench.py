@@ -8,7 +8,12 @@ reference's published number is 1656.82 images/sec on 16 Pascal GPUs =
 103.55 images/sec/GPU; `vs_baseline` is our per-chip throughput over that.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
+   "extra_metrics": {...}}
+The default (resnet101) invocation folds the transformer LM and
+long-context (seq 8192) tokens/sec into "extra_metrics" on the same line
+so the driver records them too; BENCH_EXTRA=0 disables,
+BENCH_EXTRA_CONFIGS="seq:batch,..." overrides the sweep.
 
 Env knobs: BENCH_MODEL (resnet101|resnet50|resnet18|vgg16|inception_v3|
 mnist|transformer|allreduce|scaling), BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
@@ -29,7 +34,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 REFERENCE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.md:22-38
 
 
-def bench_transformer() -> None:
+def bench_transformer(seq: int = None, batch: int = None,
+                      report: bool = True) -> float:
     """LM training throughput (tokens/sec/chip), flash attention + bf16."""
     import jax
     import jax.numpy as jnp
@@ -40,8 +46,10 @@ def bench_transformer() -> None:
 
     # Batch 16 is the measured single-chip sweet spot on v5e (batch 8
     # under-fills the MXU; batch 32 pressures HBM with the f32 logits).
-    batch = int(os.environ.get("BENCH_BATCH", "16"))
-    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    if batch is None:
+        batch = int(os.environ.get("BENCH_BATCH", "16"))
+    if seq is None:
+        seq = int(os.environ.get("BENCH_SEQ", "1024"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
@@ -79,12 +87,14 @@ def bench_transformer() -> None:
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss), final_loss
     value = batch * seq * steps / dt
-    print(json.dumps({
-        "metric": "transformer_train_tokens_per_sec_per_chip",
-        "value": round(value, 2),
-        "unit": "tokens/sec/chip",
-        "vs_baseline": None,  # the reference has no LM benchmark
-    }))
+    if report:
+        print(json.dumps({
+            "metric": "transformer_train_tokens_per_sec_per_chip",
+            "value": round(value, 2),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": None,  # the reference has no LM benchmark
+        }))
+    return value
 
 
 def bench_scaling() -> None:
@@ -324,12 +334,37 @@ def main() -> None:
     # (1656.82 img/s on 16 GPUs); other models have no comparable number.
     vs = (round(value / REFERENCE_IMG_PER_SEC_PER_DEVICE, 3)
           if model_name == "resnet101" else None)
-    print(json.dumps({
+    record = {
         "metric": f"{model_name}_train_images_per_sec_per_chip",
         "value": round(value, 2),
         "unit": "images/sec/chip",
         "vs_baseline": vs,
-    }))
+    }
+    if model_name == "resnet101" and os.environ.get("BENCH_EXTRA", "1") != "0":
+        # Fold the LM and long-context headline numbers into the same JSON
+        # line so the driver's default invocation records them too
+        # (VERDICT r2 #8: these were builder-attested only).  Failures of
+        # the extras must not cost the headline metric.
+        extras = {}
+        # seq:batch pairs; 8192:2 keeps tokens/step equal to 1024:16 (the
+        # long-context protocol of docs/benchmarks.md).
+        cfgs = os.environ.get("BENCH_EXTRA_CONFIGS", "1024:16,8192:2")
+        for cfg in cfgs.split(","):
+            try:  # a malformed config must not cost the headline metric
+                s, b = (int(v) for v in cfg.split(":"))
+            except ValueError:
+                extras[f"bad_config:{cfg.strip()}"] = "error: want seq:batch"
+                continue
+            key = ("transformer_train_tokens_per_sec_per_chip"
+                   if s == 1024 else
+                   f"transformer_seq{s}_tokens_per_sec_per_chip")
+            try:
+                extras[key] = round(
+                    bench_transformer(seq=s, batch=b, report=False), 2)
+            except Exception as exc:  # record, don't fail the headline
+                extras[key] = f"error: {exc}"
+        record["extra_metrics"] = extras
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -94,6 +94,14 @@ def _load_lib():
         lib.hvd_tpu_copy_result.argtypes = [
             ctypes.c_longlong, ctypes.c_void_p, ctypes.c_longlong]
         lib.hvd_tpu_release.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_timeline_enabled.restype = ctypes.c_int
+        lib.hvd_tpu_timeline_op_start.argtypes = [ctypes.c_char_p,
+                                                  ctypes.c_char_p]
+        lib.hvd_tpu_timeline_activity_start.argtypes = [ctypes.c_char_p,
+                                                        ctypes.c_char_p]
+        lib.hvd_tpu_timeline_activity_end.argtypes = [ctypes.c_char_p]
+        lib.hvd_tpu_timeline_op_end.argtypes = [ctypes.c_char_p,
+                                                ctypes.c_longlong]
         _lib = lib
         return lib
 

@@ -8,12 +8,16 @@ from MPI_COMM_WORLD).  Here they resolve, in priority order, from:
   2. ``HVD_TPU_RANK`` / ``HVD_TPU_SIZE`` / ``HVD_TPU_LOCAL_RANK`` /
      ``HVD_TPU_LOCAL_SIZE`` — set by the ``hvdrun`` launcher
      (the mpirun replacement, see ``horovod_tpu/runner``).
-  3. TPU pod-slice metadata environment (``TPU_WORKER_ID`` +
-     ``TPU_WORKER_HOSTNAMES``, or Cloud TPU ``CLOUD_TPU_TASK_ID``, or
-     MegaScale ``MEGASCALE_SLICE_ID``-style vars), one process per host.
-  4. An already-initialised JAX distributed runtime
+  3. libtpu multi-process pinning env (``CLOUD_TPU_TASK_ID`` +
+     ``TPU_PROCESS_ADDRESSES``) — one process per chip, local geometry
+     from grouping the address list by host.
+  4. TPU pod-slice metadata environment (``TPU_WORKER_ID`` +
+     ``TPU_WORKER_HOSTNAMES``, or Cloud TPU ``CLOUD_TPU_TASK_ID``);
+     one process per host by default, N per host when the process manager
+     also exports ``HVD_TPU_LOCAL_RANK``/``HVD_TPU_LOCAL_SIZE``.
+  5. An already-initialised JAX distributed runtime
      (``jax.process_index()`` / ``jax.process_count()``).
-  5. Single-process defaults (rank 0 of 1).
+  6. Single-process defaults (rank 0 of 1).
 
 No MPI anywhere.  The launcher also provides the control/data-plane endpoints
 (``HVD_TPU_COORD``, ``HVD_TPU_DATA``) consumed by the C++ engine.
@@ -83,22 +87,60 @@ def _from_launcher_env() -> Optional[ProcessSet]:
     return ProcessSet(rank, size, local_rank, local_size, coord, endpoints)
 
 
+def _from_tpu_pinned_metadata() -> Optional[ProcessSet]:
+    """Resolve from the libtpu multi-process pinning env (one process per
+    chip: ``CLOUD_TPU_TASK_ID`` + ``TPU_PROCESS_ADDRESSES``, as set by the
+    ``hvdrun --tpu-pin`` planner or a GKE-style process manager).  Local
+    geometry comes from grouping the address list by host."""
+    task_id = _env_int("CLOUD_TPU_TASK_ID")
+    addresses = os.environ.get("TPU_PROCESS_ADDRESSES")
+    if task_id is None or not addresses:
+        return None
+    addrs = [a.strip() for a in addresses.split(",") if a.strip()]
+    size = len(addrs)
+    if size <= 1:
+        return ProcessSet(0, 1, 0, 1)
+    hosts = [a.rsplit(":", 1)[0] for a in addrs]
+    peers = [i for i, h in enumerate(hosts) if h == hosts[task_id]]
+    coord_port = _env_int("HVD_TPU_COORD_PORT", 58930)
+    data_port = _env_int("HVD_TPU_DATA_PORT", 58931)
+    coord = f"{hosts[0]}:{coord_port}"
+    # Per-rank data ports offset by local rank so co-hosted ranks don't
+    # collide (the hvdrun planner uses the same layout, runner/hosts.py).
+    local_ranks = {}
+    seen: dict = {}
+    for i, h in enumerate(hosts):
+        local_ranks[i] = seen.get(h, 0)
+        seen[h] = local_ranks[i] + 1
+    endpoints = [f"{h}:{data_port + local_ranks[i]}"
+                 for i, h in enumerate(hosts)]
+    return ProcessSet(task_id, size, peers.index(task_id), len(peers),
+                      coord, endpoints)
+
+
 def _from_tpu_metadata() -> Optional[ProcessSet]:
-    """Resolve from Cloud TPU pod-slice metadata env (one process per host)."""
+    """Resolve from Cloud TPU pod-slice metadata env.  Default: one process
+    per host (the classic Cloud TPU layout).  With N processes per host
+    (chip pinning), the process manager additionally exports
+    ``HVD_TPU_LOCAL_RANK``/``HVD_TPU_LOCAL_SIZE`` and the global identity
+    is host-major: rank = worker_id * local_size + local_rank."""
     worker_id = _env_int("TPU_WORKER_ID", _env_int("CLOUD_TPU_TASK_ID"))
     hostnames = os.environ.get("TPU_WORKER_HOSTNAMES")
     if worker_id is None or not hostnames:
         return None
     hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
-    size = len(hosts)
+    local_rank = _env_int("HVD_TPU_LOCAL_RANK", 0)
+    local_size = _env_int("HVD_TPU_LOCAL_SIZE", 1)
+    size = len(hosts) * local_size
     if size <= 1:
         return ProcessSet(0, 1, 0, 1)
     coord_port = _env_int("HVD_TPU_COORD_PORT", 58930)
     data_port = _env_int("HVD_TPU_DATA_PORT", 58931)
     coord = f"{hosts[0]}:{coord_port}"
-    endpoints = [f"{h}:{data_port}" for h in hosts]
-    # One process per TPU host: local_rank is always 0.
-    return ProcessSet(worker_id, size, 0, 1, coord, endpoints)
+    endpoints = [f"{h}:{data_port + lr}"
+                 for h in hosts for lr in range(local_size)]
+    return ProcessSet(worker_id * local_size + local_rank, size,
+                      local_rank, local_size, coord, endpoints)
 
 
 def _from_jax_distributed() -> Optional[ProcessSet]:
@@ -126,8 +168,9 @@ def resolve_process_set(ranks: Optional[Sequence[int]] = None) -> ProcessSet:
     it must contain this process's launcher rank, and rank/size are re-mapped
     to the subset.
     """
-    ps = (_from_launcher_env() or _from_tpu_metadata()
-          or _from_jax_distributed() or ProcessSet(0, 1, 0, 1))
+    ps = (_from_launcher_env() or _from_tpu_pinned_metadata()
+          or _from_tpu_metadata() or _from_jax_distributed()
+          or ProcessSet(0, 1, 0, 1))
     if ranks is not None:
         ranks = list(ranks)
         if sorted(set(ranks)) != sorted(ranks):

@@ -139,4 +139,28 @@ int hvd_tpu_copy_result(long long handle, void* dst, long long nbytes) {
 
 void hvd_tpu_release(long long handle) { GlobalEngine()->Release(handle); }
 
+// Timeline hooks for the XLA data plane (jax/eager_mesh.py): plane-side
+// execution phases land in the same Chrome-tracing file as the engine's
+// events.  All are no-ops when HOROVOD_TIMELINE is unset.
+int hvd_tpu_timeline_enabled() {
+  return GlobalEngine()->timeline().Enabled() ? 1 : 0;
+}
+
+void hvd_tpu_timeline_op_start(const char* name, const char* op) {
+  GlobalEngine()->timeline().Start(name ? name : "", op ? op : "");
+}
+
+void hvd_tpu_timeline_activity_start(const char* name, const char* activity) {
+  GlobalEngine()->timeline().ActivityStart(name ? name : "",
+                                           activity ? activity : "");
+}
+
+void hvd_tpu_timeline_activity_end(const char* name) {
+  GlobalEngine()->timeline().ActivityEnd(name ? name : "");
+}
+
+void hvd_tpu_timeline_op_end(const char* name, long long bytes) {
+  GlobalEngine()->timeline().End(name ? name : "", bytes);
+}
+
 }  // extern "C"

@@ -219,9 +219,26 @@ struct Engine::Coordinator {
     std::vector<Request> requests;  // one per rank that announced, any order
     std::chrono::steady_clock::time_point first_seen;
     uint64_t order = 0;
+    // Set when a cross-transport mismatch is detected (one camp announced
+    // the bare name over the engine, another the "__xp."-prefixed
+    // metadata op for the SAME logical tensor over the XLA plane): the
+    // name negotiates straight to a typed error response instead of
+    // stalling forever at count < size.
+    std::string forced_error;
   };
   std::unordered_map<std::string, PendingTensor> message_table;
   std::vector<std::string> ready;  // names with all ranks announced, in order
+  // Base names that hit a cross-transport mismatch: stragglers of either
+  // camp announcing within the poison window after the error response was
+  // broadcast re-trigger the same typed error instead of re-pending
+  // forever.  Entries EXPIRE (kPoisonWindowSec) so a later, consistent
+  // resubmission of the same tensor name works again — the recovery
+  // contract docs/tpu.md promises.  Bounded: cleared past 1024 entries.
+  static constexpr double kPoisonWindowSec = 5.0;
+  std::unordered_map<std::string,
+                     std::pair<std::string,
+                               std::chrono::steady_clock::time_point>>
+      poisoned;
   uint64_t next_order = 0;
   bool shutdown_requested = false;
 };
@@ -627,6 +644,22 @@ bool Engine::RunLoopOnce() {
   return true;
 }
 
+// The XLA plane negotiates each collective via a "__xp.<name>" metadata
+// allreduce through this engine (jax/eager_mesh.py).  Transport choice is
+// dtype-deterministic, so a rank whose dtype is plane-ineligible (f64,
+// bool) announces the bare "<name>" while plane ranks announce
+// "__xp.<name>" — two pending entries that can never each reach full
+// count.  SiblingName maps one to the other so the coordinator can turn
+// that split into a typed error (the reference's cross-rank validation
+// contract, operations.cc:301-503, extended across transports).
+static const char kPlanePrefix[] = "__xp.";
+
+static std::string SiblingName(const std::string& name) {
+  const size_t n = sizeof(kPlanePrefix) - 1;
+  if (name.compare(0, n, kPlanePrefix) == 0) return name.substr(n);
+  return kPlanePrefix + name;
+}
+
 void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
   for (const auto& req : rl.requests) {
     auto& pt = coord_->message_table[req.name];
@@ -634,10 +667,54 @@ void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
       pt.first_seen = std::chrono::steady_clock::now();
       pt.order = coord_->next_order++;
       timeline_.NegotiateStart(req.name, req.op);
+      std::string base = req.name.compare(0, sizeof(kPlanePrefix) - 1,
+                                          kPlanePrefix) == 0
+                             ? SiblingName(req.name)
+                             : req.name;
+      auto poisoned = coord_->poisoned.find(base);
+      if (poisoned != coord_->poisoned.end()) {
+        auto age = std::chrono::steady_clock::now() - poisoned->second.second;
+        if (age > std::chrono::duration<double>(
+                      Coordinator::kPoisonWindowSec)) {
+          coord_->poisoned.erase(poisoned);  // expired: name usable again
+        } else {
+          pt.forced_error = poisoned->second.first;
+          coord_->ready.push_back(req.name);
+        }
+      }
+      auto sib = coord_->message_table.find(SiblingName(req.name));
+      // Only a sibling still PENDING (count < size) indicates a split: a
+      // full-count sibling is a validly negotiated collective already in
+      // `ready` (erroring it would convert a good op into a spurious
+      // failure and double-push its name, double-building the response).
+      if (sib != coord_->message_table.end() &&
+          !sib->second.requests.empty() &&
+          static_cast<int>(sib->second.requests.size()) < opts_.size &&
+          sib->second.forced_error.empty() && pt.forced_error.empty()) {
+        std::string msg =
+            "cross-transport mismatch for tensor '" + base +
+            "': some ranks submitted it over the XLA data plane while "
+            "others fell back to the TCP engine (rank " +
+            std::to_string(req.rank) + " vs rank " +
+            std::to_string(sib->second.requests[0].rank) +
+            ").  The transport is chosen by dtype, so this means the "
+            "ranks disagree on the tensor's dtype (e.g. float32 on one "
+            "rank, float64/bool on another); every rank must submit the "
+            "same collective with the same dtype.";
+        pt.forced_error = msg;
+        sib->second.forced_error = msg;
+        if (coord_->poisoned.size() > 1024) coord_->poisoned.clear();
+        coord_->poisoned[base] = {msg, std::chrono::steady_clock::now()};
+        coord_->ready.push_back(req.name);
+        coord_->ready.push_back(sib->first);
+      }
     }
     timeline_.NegotiateRankReady(req.name, from_rank);
     pt.requests.push_back(req);
-    if (static_cast<int>(pt.requests.size()) == opts_.size) {
+    // forced_error entries were already pushed to ready at detection; a
+    // second push here would double-build (and double-erase) the entry.
+    if (static_cast<int>(pt.requests.size()) == opts_.size &&
+        pt.forced_error.empty()) {
       timeline_.NegotiateEnd(req.name);
       coord_->ready.push_back(req.name);
     }
@@ -652,6 +729,12 @@ Response Engine::BuildResponse(const std::string& name) {
   auto it = coord_->message_table.find(name);
   Response resp;
   resp.names.push_back(name);
+  if (!it->second.forced_error.empty()) {
+    resp.type = RESP_ERROR;
+    resp.error_message = it->second.forced_error;
+    coord_->message_table.erase(it);
+    return resp;
+  }
   auto& reqs = it->second.requests;
   const Request& first = reqs[0];
   std::string error;

@@ -122,6 +122,14 @@ class Engine {
   bool CopyResult(int64_t handle, void* dst, int64_t nbytes);
   void Release(int64_t handle);
 
+  // The engine-owned Chrome-tracing timeline.  Exposed so the XLA data
+  // plane (Python, jax/eager_mesh.py) can emit its BUCKET_BUILD /
+  // XLA_DISPATCH / DEVICE_WAIT activities into the SAME trace file as the
+  // engine's NEGOTIATE/op events (the reference wraps every execution
+  // phase, operations.cc:680-692).  Timeline methods are internally
+  // mutex-guarded and no-ops when the timeline is disabled.
+  Timeline& timeline() { return timeline_; }
+
  private:
   struct Coordinator;  // rank-0 only state
 

@@ -139,6 +139,7 @@ class XlaHandle:
         self._ag_dim0s = None
         self._error: Optional[Exception] = None
         self._finished = False
+        self._tl_started = False  # timeline op row opened at dispatch
         # Negotiation (tick, seq) stamp, mirrored from the engine metadata
         # op at dispatch time (duck-type parity with common.Handle).
         self.completion_tick: Optional[int] = None
@@ -173,7 +174,24 @@ class XlaHandle:
         self._plane._wait_dispatch(self)
         if self._error is not None:
             raise self._error
+        tl_lib = None
+        if self._tl_started:
+            from horovod_tpu import common
+
+            tl_lib = common._lib
+            tl_lib.hvd_tpu_timeline_activity_start(self._name.encode(),
+                                                   b"DEVICE_WAIT")
         host = self._batch.host()
+        if tl_lib is not None:
+            # This op's own extent, not the shared fused buffer's size
+            # (which would over-report by the fusion factor).
+            if self._kind == "ag":
+                my_bytes = int(np.prod(self._shape)) * host.itemsize
+            else:
+                my_bytes = self._n * host.itemsize
+            tl_lib.hvd_tpu_timeline_activity_end(self._name.encode())
+            tl_lib.hvd_tpu_timeline_op_end(self._name.encode(),
+                                           int(my_bytes))
         if self._kind == "ag":
             pad = self._ag_pad
             blocks = [host[r * pad:r * pad + int(d)]
@@ -394,7 +412,30 @@ class XlaDataPlane:
             self._in_sharding, local[np.newaxis],
             (self._size,) + local.shape)
 
+    _TL_OP_NAMES = {"ar": "XLA_ALLREDUCE", "bc": "XLA_BROADCAST",
+                    "ag": "XLA_ALLGATHER"}
+
     def _dispatch(self, bucket: List[_PlaneOp]) -> None:
+        # Timeline: plane execution phases land in the same Chrome-tracing
+        # file as the engine's NEGOTIATE events (the `__xp.*` rows), per
+        # REAL tensor name: BUCKET_BUILD -> XLA_DISPATCH here, DEVICE_WAIT
+        # + op end in XlaHandle.wait().  Mirrors the reference's
+        # ACTIVITY_START_ALL around every execution phase
+        # (operations.cc:680-692).
+        from horovod_tpu import common
+
+        lib = common._lib
+        tl = bool(lib and lib.hvd_tpu_timeline_enabled())
+        if tl:
+            op_name = self._TL_OP_NAMES[bucket[0].kind].encode()
+            for op in bucket:
+                lib.hvd_tpu_timeline_op_start(op.name.encode(), op_name)
+                lib.hvd_tpu_timeline_activity_start(op.name.encode(),
+                                                    b"BUCKET_BUILD")
+                op.handle._tl_started = True
+        self._dispatch_inner(bucket, lib if tl else None)
+
+    def _dispatch_inner(self, bucket: List[_PlaneOp], tl_lib) -> None:
         kind = bucket[0].kind
         if kind == "ag":
             op = bucket[0]
@@ -403,7 +444,9 @@ class XlaDataPlane:
             block = np.zeros((pad,) + rest, op.payload.dtype)
             block[:op.payload.shape[0]] = op.payload
             fn = self._jit_for("ag", (pad,) + rest, op.payload.dtype)
-            batch = _Batch(fn(self._global_array(block)))
+            self._tl_phase(tl_lib, bucket, b"XLA_DISPATCH")
+            batch = _Batch(self._traced_dispatch(fn, block, "ag", 1))
+            self._tl_phase(tl_lib, bucket, None)
             h = op.handle
             h._ag_pad = pad
             h._ag_dim0s = op.dim0s
@@ -422,11 +465,36 @@ class XlaDataPlane:
                 offs.append(off)
                 off += n
             fn = self._jit_for(kind, length, dtype, bucket[0].root)
-            batch = _Batch(fn(self._global_array(flat)))
+            self._tl_phase(tl_lib, bucket, b"XLA_DISPATCH")
+            batch = _Batch(self._traced_dispatch(fn, flat, kind,
+                                                 len(bucket)))
+            self._tl_phase(tl_lib, bucket, None)
             for op, o, n in zip(bucket, offs, lens):
                 op.handle._set_result(batch, o, n, op.tick, op.seq)
         self.stats["dispatches"] += 1
         self.stats["fused_tensors"] += len(bucket)
+
+    def _tl_phase(self, tl_lib, bucket: List[_PlaneOp],
+                  start: Optional[bytes]) -> None:
+        """End the current timeline activity for every op in the bucket
+        and (optionally) start the next one."""
+        if tl_lib is None:
+            return
+        for op in bucket:
+            tl_lib.hvd_tpu_timeline_activity_end(op.name.encode())
+            if start is not None:
+                tl_lib.hvd_tpu_timeline_activity_start(op.name.encode(),
+                                                       start)
+
+    def _traced_dispatch(self, fn, local: np.ndarray, kind: str, n_ops: int):
+        """Launch the compiled collective, annotated for jax.profiler so
+        plane dispatches are attributable inside an XProf/jax trace too
+        (SURVEY §5.1's 'hooks into jax.profiler')."""
+        import jax
+
+        with jax.profiler.TraceAnnotation(
+                f"hvd_plane_dispatch:{kind}:x{n_ops}"):
+            return fn(self._global_array(local))
 
     # -- public enqueue API ----------------------------------------------
 

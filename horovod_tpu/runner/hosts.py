@@ -77,8 +77,16 @@ def parse_hosts(spec: str) -> List:
 
 
 def plan(np_: int, hosts_spec: str,
-         port_base: int = DEFAULT_PORT_BASE) -> List[RankPlacement]:
-    """Assign `np_` ranks across the host spec in contiguous blocks."""
+         port_base: int = DEFAULT_PORT_BASE,
+         tpu_pin: bool = False,
+         tpu_topology: Optional[str] = None) -> List[RankPlacement]:
+    """Assign `np_` ranks across the host spec in contiguous blocks.
+
+    With ``tpu_pin``, each rank's env additionally confines its libtpu
+    client to one local chip by ``local_rank`` (runner/tpu_pin.py) — the
+    TPU analogue of the reference recipe's
+    ``visible_device_list = str(hvd.local_rank())`` step.
+    """
     hosts = parse_hosts(hosts_spec)
     capacity = sum(n for _, n in hosts)
     if np_ > capacity:
@@ -100,6 +108,22 @@ def plan(np_: int, hosts_spec: str,
     # plane's jax.distributed coordinator gets a port well clear of them.
     xla_coord = f"{placements[0][0]}:{port_base + 500}"
     data = [f"{host}:{port_base + 1 + lr}" for host, lr in placements]
+    pin_envs: List[Dict[str, str]] = [{} for _ in placements]
+    if tpu_pin:
+        from horovod_tpu.runner.tpu_pin import pin_addresses, pin_env
+
+        sizes = set(per_host.values())
+        if len(sizes) != 1:
+            raise ValueError(
+                "--tpu-pin requires the same number of ranks on every "
+                f"host (got {per_host}); chip grids are per-host uniform")
+        chips_per_host = sizes.pop()
+        host_order = list(per_host)
+        addresses = pin_addresses(placements, port_base)
+        pin_envs = [
+            pin_env(rank, lr, chips_per_host, host_order.index(host),
+                    len(host_order), addresses, tpu_topology)
+            for rank, (host, lr) in enumerate(placements)]
     out = []
     for rank, (host, lr) in enumerate(placements):
         env = {
@@ -111,6 +135,7 @@ def plan(np_: int, hosts_spec: str,
             "HVD_TPU_DATA": ",".join(data),
             "HVD_TPU_XLA_COORD": xla_coord,
         }
+        env.update(pin_envs[rank])
         out.append(RankPlacement(rank, host, lr, per_host[host], env))
     return out
 

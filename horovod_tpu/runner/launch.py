@@ -44,15 +44,27 @@ def run_command(cmd: Sequence[str], np: int,
                 env: Optional[Dict[str, str]] = None,
                 timeout: float = 300.0,
                 capture: bool = False,
-                host: str = "127.0.0.1") -> List[RankResult]:
+                host: str = "127.0.0.1",
+                tpu_pin: bool = False,
+                tpu_topology: Optional[str] = None) -> List[RankResult]:
     """Launch `cmd` as `np` local ranks; wait for all; kill all on any
-    failure.  Returns per-rank results (stdout/stderr only if capture)."""
+    failure.  Returns per-rank results (stdout/stderr only if capture).
+    ``tpu_pin`` confines each rank's libtpu client to the chip matching
+    its local_rank (runner/tpu_pin.py)."""
     coord, data = allocate_endpoints(np, host)
     xla_coord = f"{host}:{pick_free_port(host)}"
+    pin_envs = [{} for _ in range(np)]
+    if tpu_pin:
+        from horovod_tpu.runner.tpu_pin import pin_env
+
+        addresses = [f"{host}:{pick_free_port(host)}" for _ in range(np)]
+        pin_envs = [pin_env(r, r, np, 0, 1, addresses, tpu_topology)
+                    for r in range(np)]
     procs = []
     for r in range(np):
         rank_env = make_rank_env(r, np, coord, data, env,
                                  xla_coord=xla_coord)
+        rank_env.update(pin_envs[r])
         procs.append(subprocess.Popen(
             list(cmd),
             env=rank_env,
@@ -67,7 +79,9 @@ def run_hosts(cmd: Sequence[str], np: int, hosts_spec: str,
               env: Optional[Dict[str, str]] = None,
               timeout: float = 3e7,
               capture: bool = False,
-              ssh_args: Sequence[str] = ()) -> List[RankResult]:
+              ssh_args: Sequence[str] = (),
+              tpu_pin: bool = False,
+              tpu_topology: Optional[str] = None) -> List[RankResult]:
     """Launch `cmd` across a host spec ("host1:2,host2:2"): local ranks
     spawn directly, remote ranks over ssh (the `mpirun -H` replacement,
     /root/reference/docs/running.md).  Keys of `env` that differ from this
@@ -75,7 +89,8 @@ def run_hosts(cmd: Sequence[str], np: int, hosts_spec: str,
     the ssh command), so overrides like PYTHONPATH reach every rank."""
     from horovod_tpu.runner.hosts import DEFAULT_PORT_BASE, plan, ssh_command
 
-    placements = plan(np, hosts_spec, port_base or DEFAULT_PORT_BASE)
+    placements = plan(np, hosts_spec, port_base or DEFAULT_PORT_BASE,
+                      tpu_pin=tpu_pin, tpu_topology=tpu_topology)
     base_env = dict(env if env is not None else os.environ)
     overrides = {k: v for k, v in base_env.items()
                  if os.environ.get(k) != v}
@@ -174,6 +189,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "(single-host mode)")
     parser.add_argument("--timeout", type=float, default=0.0,
                         help="kill the job after this many seconds (0 = none)")
+    parser.add_argument("--tpu-pin", action="store_true",
+                        default=None,
+                        help="pin one TPU chip per rank by local_rank "
+                             "(TPU_VISIBLE_CHIPS / TPU_PROCESS_BOUNDS; the "
+                             "reference recipe's visible_device_list step). "
+                             "Also enabled by HVD_TPU_PIN=1.")
+    parser.add_argument("--tpu-topology", default=None,
+                        help="per-host chip grid 'x,y[,z]' when it differs "
+                             "from the built-in table (1/2/4/8 chips)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command, e.g. python train.py")
     args = parser.parse_args(argv)
@@ -182,14 +206,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     cmd = args.command
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
+    from horovod_tpu.runner.tpu_pin import pinning_requested
+
+    tpu_pin = pinning_requested(args.tpu_pin)
     try:
         if args.hosts:
             results = run_hosts(cmd, args.num_proc, args.hosts,
                                 port_base=args.port_base,
-                                timeout=args.timeout or 3e7)
+                                timeout=args.timeout or 3e7,
+                                tpu_pin=tpu_pin,
+                                tpu_topology=args.tpu_topology)
         else:
             results = run_command(cmd, args.num_proc, host=args.host,
-                                  timeout=args.timeout or 3e7)
+                                  timeout=args.timeout or 3e7,
+                                  tpu_pin=tpu_pin,
+                                  tpu_topology=args.tpu_topology)
     except subprocess.TimeoutExpired:
         print("hvdrun: job timed out", file=sys.stderr)
         return 124

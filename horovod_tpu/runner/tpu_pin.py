@@ -1,0 +1,114 @@
+"""Per-local-rank TPU chip pinning for the launcher.
+
+Step 2 of the reference's five-line recipe is "pin one accelerator per
+process by local_rank()" (/root/reference/examples/tensorflow_mnist.py:69-71
+``config.gpu_options.visible_device_list = str(hvd.local_rank())``;
+/root/reference/examples/pytorch_mnist.py:60
+``torch.cuda.set_device(hvd.local_rank())``).  On TPU the pinning cannot
+live in the user script: chip visibility is fixed at libtpu client
+initialization by environment variables.  The launcher therefore computes
+the pinning env per rank (``hvdrun --tpu-pin``, or ``HVD_TPU_PIN=1``), and
+examples need no edits — the TPU-native analogue of the recipe's step 2.
+
+The libtpu multi-process contract (the one JAX's own multi-process-per-host
+setups use):
+
+* ``TPU_VISIBLE_CHIPS``      — the local chip id(s) this process may open.
+* ``TPU_CHIPS_PER_PROCESS_BOUNDS`` — per-process chip sub-grid (x,y,z).
+* ``TPU_PROCESS_BOUNDS``     — the process grid over the whole slice.
+* ``TPU_PROCESS_ADDRESSES``  — every process's coordination endpoint, in
+  task-id order.
+* ``TPU_PROCESS_PORT`` / ``CLOUD_TPU_TASK_ID`` — this process's endpoint
+  and its index into the address list.
+
+Physical chip grids per host are not linear: a 4-chip v5e host is a 2x2
+grid, an 8-chip v5e host 4x2.  ``host_chip_grid`` encodes the common
+layouts and ``--tpu-topology x,y[,z]`` overrides them for exotic slices.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Physical chip grid of one host, by chips-per-host count (v5e/v4 hosts).
+DEFAULT_HOST_GRIDS: Dict[int, Tuple[int, int, int]] = {
+    1: (1, 1, 1),
+    2: (2, 1, 1),
+    4: (2, 2, 1),
+    8: (4, 2, 1),
+}
+
+# Coordination ports sit clear of the engine data ports (port_base+1 ..
+# port_base+local_size, runner/hosts.py) and the XLA-plane coordinator
+# (port_base+500).
+TPU_PORT_OFFSET = 600
+
+
+def parse_topology(spec: str) -> Tuple[int, int, int]:
+    """``"4,2"`` or ``"4x2x1"`` -> (4, 2, 1)."""
+    parts = [p for p in spec.replace("x", ",").split(",") if p]
+    dims = [int(p) for p in parts]
+    if not 1 <= len(dims) <= 3 or any(d < 1 for d in dims):
+        raise ValueError(f"bad TPU topology spec: {spec!r}")
+    while len(dims) < 3:
+        dims.append(1)
+    return tuple(dims)  # type: ignore[return-value]
+
+
+def host_chip_grid(chips_per_host: int,
+                   topology: Optional[str] = None) -> Tuple[int, int, int]:
+    if topology:
+        grid = parse_topology(topology)
+        if grid[0] * grid[1] * grid[2] != chips_per_host:
+            raise ValueError(
+                f"topology {topology!r} has {grid[0] * grid[1] * grid[2]} "
+                f"chips, but {chips_per_host} ranks are placed per host")
+        return grid
+    grid = DEFAULT_HOST_GRIDS.get(chips_per_host)
+    if grid is None:
+        raise ValueError(
+            f"no default chip grid for {chips_per_host} chips per host; "
+            "pass --tpu-topology x,y[,z]")
+    return grid
+
+
+def pin_env(rank: int, local_rank: int, chips_per_host: int,
+            host_index: int, n_hosts: int,
+            addresses: Sequence[str],
+            topology: Optional[str] = None) -> Dict[str, str]:
+    """Environment confining launcher rank ``rank`` to one local chip.
+
+    ``addresses``: every rank's ``host:port`` coordination endpoint, in
+    rank order (rank order must equal task-id order — hvdrun places ranks
+    in contiguous blocks per host, which libtpu's host-major process
+    numbering matches).  Multi-chip-per-process layouts can keep using
+    plain jax.distributed without pinning; this covers the
+    one-process-per-chip model of the reference examples.
+    """
+    gx, gy, gz = host_chip_grid(chips_per_host, topology)
+    # Process grid: hosts stack along y (host-major), chips within a host
+    # along (x, y) of the host grid.  One chip per process.
+    process_bounds = f"{gx},{gy * n_hosts},{gz}"
+    return {
+        "TPU_VISIBLE_CHIPS": str(local_rank),
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": "1,1,1",
+        "TPU_PROCESS_BOUNDS": process_bounds,
+        "TPU_PROCESS_ADDRESSES": ",".join(addresses),
+        "TPU_PROCESS_PORT": addresses[rank].rsplit(":", 1)[1],
+        "CLOUD_TPU_TASK_ID": str(rank),
+    }
+
+
+def pin_addresses(placements: Sequence[Tuple[str, int]],
+                  port_base: int) -> List[str]:
+    """``host:port`` per rank for TPU_PROCESS_ADDRESSES: the host's
+    address with a per-local-rank port above the engine's port range."""
+    return [f"{host}:{port_base + TPU_PORT_OFFSET + lr}"
+            for host, lr in placements]
+
+
+def pinning_requested(flag: Optional[bool] = None) -> bool:
+    if flag is not None:
+        return flag
+    return os.environ.get("HVD_TPU_PIN", "0") not in ("", "0", "false")

@@ -369,6 +369,35 @@ class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
             session.run(self.bcast_op)
 
 
+# Backward-pass collectives from custom gradients are built independently
+# by TF's backprop, with no group to enqueue together — in graph mode,
+# unordered blocking collectives deadlock across ranks (different
+# executors pick different first ops).  _chained_bwd serializes them by
+# BUILD order via control deps, which is deterministic and identical on
+# every rank (the same forward graph yields the same backward build
+# order).  Slower than a group, but the double-backward path is rare;
+# eager mode needs no chain (python program order is already global).
+_bwd_chain = {"graph": None, "op": None}
+
+
+def _chained_bwd(build_fn, ref_tensor):
+    if hasattr(ref_tensor, "numpy"):  # eager backward: program order
+        return build_fn()
+    graph = getattr(ref_tensor, "graph", None)
+    if graph is None:
+        return build_fn()
+    with _name_lock:
+        prev = ([_bwd_chain["op"]]
+                if _bwd_chain["graph"] is graph
+                and _bwd_chain["op"] is not None else [])
+    with tf.control_dependencies(prev):
+        out = build_fn()
+    with _name_lock:
+        _bwd_chain["graph"] = graph
+        _bwd_chain["op"] = out
+    return out
+
+
 def _with_allreduce_grad(x, y, name: str):
     """Attach the allreduce gradient (allreduce' = allreduce, the
     reference's registration, mpi_ops.py:81-92) to a result ``y`` computed
@@ -379,7 +408,8 @@ def _with_allreduce_grad(x, y, name: str):
     @tf.custom_gradient
     def op(x):
         def grad(dy):
-            summed = _allreduce(dy, name=f"{name}.bwd")
+            summed = _chained_bwd(
+                lambda: _allreduce(dy, name=f"{name}.bwd"), dy)
             return tf.math.divide(summed, float(_common.size()))
         return y, grad
 
@@ -395,10 +425,12 @@ def _with_allgather_grad(x, y, name: str):
         dim0 = tf.shape(x)[0]
 
         def grad(dy):
-            summed = _allreduce(dy, name=f"{name}.bwd")
-            sizes = _through_engine(
-                "allgather", tf.reshape(tf.cast(dim0, tf.int64), [1]),
-                f"{name}.bwd.sizes")
+            summed = _chained_bwd(
+                lambda: _allreduce(dy, name=f"{name}.bwd"), dy)
+            sizes = _chained_bwd(
+                lambda: _through_engine(
+                    "allgather", tf.reshape(tf.cast(dim0, tf.int64), [1]),
+                    f"{name}.bwd.sizes"), dy)
             offset = tf.reduce_sum(sizes[:_common.rank()])
             return tf.slice(summed, [tf.cast(offset, tf.int32)] +
                             [0] * (len(x.shape) - 1),

@@ -150,3 +150,72 @@ def test_run_hosts_local_live():
         [(r.rank, r.returncode, r.stderr[-500:]) for r in results]
     lines = sorted(r.stdout.strip() for r in results)
     assert lines == ["RANK_OK 0 0 3", "RANK_OK 1 1 3", "RANK_OK 2 2 3"]
+
+
+def test_plan_tpu_pinning_env():
+    """--tpu-pin: every rank's env confines libtpu to the chip matching
+    its local_rank (TPU_VISIBLE_CHIPS), with a process grid spanning the
+    slice and task-id-ordered process addresses (VERDICT r2 #4 — the TPU
+    analogue of visible_device_list = local_rank)."""
+    from horovod_tpu.runner.hosts import plan
+
+    placements = plan(8, "hostA:4,hostB:4", port_base=60000, tpu_pin=True)
+    for p in placements:
+        env = p.env
+        assert env["TPU_VISIBLE_CHIPS"] == str(p.local_rank)
+        assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+        assert env["TPU_PROCESS_BOUNDS"] == "2,4,1"  # 2x2 host grid x 2 hosts
+        assert env["CLOUD_TPU_TASK_ID"] == str(p.rank)
+        addrs = env["TPU_PROCESS_ADDRESSES"].split(",")
+        assert len(addrs) == 8
+        # Port clear of engine data ports (60001..60004) and xla (60500).
+        host, port = addrs[p.rank].rsplit(":", 1)
+        assert host == p.host and int(port) == 60600 + p.local_rank
+    # Uneven rank placement cannot be pinned (chip grids are uniform).
+    import pytest
+
+    with pytest.raises(ValueError, match="same number of ranks"):
+        plan(6, "hostA:4,hostB:4", port_base=60000, tpu_pin=True)
+    # Topology override for exotic hosts.
+    placements = plan(2, "hostA:1,hostB:1", port_base=60000, tpu_pin=True,
+                      tpu_topology="1,1")
+    assert placements[0].env["TPU_PROCESS_BOUNDS"] == "1,2,1"
+
+
+def test_tpu_metadata_multi_rank_per_host(monkeypatch):
+    """The pod-slice metadata path supports N ranks per TPU host: global
+    rank is host-major (worker_id * local_size + local_rank) and data
+    ports offset by local rank (VERDICT r2 #4)."""
+    from horovod_tpu.common.basics import resolve_process_set
+
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "CLOUD_TPU_TASK_ID",
+                "TPU_PROCESS_ADDRESSES"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "tpu-0,tpu-1")
+    monkeypatch.setenv("HVD_TPU_LOCAL_RANK", "2")
+    monkeypatch.setenv("HVD_TPU_LOCAL_SIZE", "4")
+    ps = resolve_process_set()
+    assert (ps.rank, ps.size, ps.local_rank, ps.local_size) == (6, 8, 2, 4)
+    assert ps.coord_endpoint == "tpu-0:58930"
+    assert ps.data_endpoints[6] == "tpu-1:58933"
+    assert len(ps.data_endpoints) == 8
+
+
+def test_tpu_pinned_metadata_path(monkeypatch):
+    """CLOUD_TPU_TASK_ID + TPU_PROCESS_ADDRESSES (the env hvdrun --tpu-pin
+    exports) resolve rank/size/local geometry without any HVD_TPU_* vars —
+    a pinned process manager needs nothing else."""
+    from horovod_tpu.common.basics import resolve_process_set
+
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_LOCAL_RANK",
+                "HVD_TPU_LOCAL_SIZE", "TPU_WORKER_ID",
+                "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("CLOUD_TPU_TASK_ID", "5")
+    monkeypatch.setenv(
+        "TPU_PROCESS_ADDRESSES",
+        "a:60600,a:60601,a:60602,a:60603,b:60600,b:60601,b:60602,b:60603")
+    ps = resolve_process_set()
+    assert (ps.rank, ps.size, ps.local_rank, ps.local_size) == (5, 8, 1, 4)
+    assert ps.data_endpoints[5] == "b:58932"

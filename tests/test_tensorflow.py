@@ -305,3 +305,31 @@ def test_tf_tape_gradient_is_differentiable():
     assert np.allclose(g.numpy(), want_g), (g.numpy(), want_g)
     want_gg = 288.0 * m2 * (r + 1) ** 2
     assert np.allclose(gg.numpy(), want_gg), (gg.numpy(), want_gg)
+
+
+@distributed_test(np_=2, timeout=300)
+def test_tf_tape_double_backward_in_graph_mode():
+    """Gradient penalty under @tf.function with multiple variables: the
+    backward-pass allreduces are build-order chained (control deps), so
+    graph executors cannot deadlock on independent blocking collectives at
+    np>1 (code-review r3 finding on the async-group rewrite)."""
+    import tensorflow as tf
+
+    hvd = _init()
+    r = hvd.rank()
+    x = tf.constant(np.full((2, 3), float(r + 1), np.float32))
+    w1 = tf.Variable(np.ones((3, 2), np.float32))
+    w2 = tf.Variable(np.ones((2, 1), np.float32))
+
+    @tf.function
+    def penalty_step():
+        with tf.GradientTape() as outer:
+            with hvd.DistributedGradientTape(persistent=True) as inner:
+                loss = tf.reduce_sum(tf.matmul(tf.matmul(x, w1), w2) ** 2)
+            g1, g2 = inner.gradient(loss, [w1, w2])
+            penalty = tf.reduce_sum(g1 ** 2) + tf.reduce_sum(g2 ** 2)
+        return outer.gradient(penalty, [w1, w2])
+
+    gg1, gg2 = penalty_step()
+    assert gg1 is not None and gg2 is not None
+    assert np.isfinite(gg1.numpy()).all() and np.isfinite(gg2.numpy()).all()

@@ -245,3 +245,66 @@ def test_xla_plane_wait_stall_warning(monkeypatch, capsys):
     t.join()
     err = capsys.readouterr().err
     assert "stalled" in err and "stalled_grad" in err, err
+
+
+@distributed_test(np_=2, timeout=300.0)
+def test_xla_plane_cross_transport_mismatch_typed_error():
+    """VERDICT r2 #6: when ranks disagree on dtype such that one rides the
+    XLA plane (f32) and the other falls back to the TCP engine (f64), the
+    coordinator pairs the bare and '__xp.'-prefixed pending names and
+    both ranks get a typed ValueError instead of the documented stall."""
+    import pytest
+
+    import horovod_tpu.common as common
+
+    hvd = _init_with_plane()
+    r = hvd.rank()
+    # f32 -> plane on rank 0; f64 -> engine fallback on rank 1.
+    arr = np.zeros(4, np.float32 if r == 0 else np.float64)
+    h = common.allreduce_async(arr, average=False, name="split_transport")
+    with pytest.raises(ValueError, match="cross-transport mismatch"):
+        h.wait()
+    # Both transports stay usable afterwards.
+    out = hvd.allreduce(np.full(3, float(r + 1), np.float32),
+                        average=False, name="after_split")
+    assert np.allclose(out, sum(range(1, hvd.size() + 1)))
+    out = hvd.allreduce(np.full(3, float(r + 1), np.float64),
+                        average=False, name="after_split_f64")
+    assert np.allclose(out, sum(range(1, hvd.size() + 1)))
+
+
+@distributed_test(np_=2, timeout=300.0)
+def test_xla_plane_timeline_activities():
+    """VERDICT r2 #5: the plane's execution phases (BUCKET_BUILD,
+    XLA_DISPATCH, DEVICE_WAIT) land in the SAME Chrome-tracing file as the
+    engine's NEGOTIATE events, per real tensor name — the reference wraps
+    every execution phase the same way (operations.cc:680-692)."""
+    import json
+    import os
+
+    tag = os.environ["HVD_TPU_COORD"].replace(":", "_").replace(".", "_")
+    path = f"/tmp/hvd_tl_plane_{tag}.json"
+    os.environ["HOROVOD_TIMELINE"] = path
+    hvd = _init_with_plane()
+    r = hvd.rank()
+    for i in range(3):
+        out = hvd.allreduce(np.full(4, float(r + 1), np.float32),
+                            average=False, name=f"tlp.{i}")
+        assert np.allclose(out, 3.0)
+    hvd.allgather(np.ones((r + 1, 2), np.float32), name="tlp.g")
+    hvd.shutdown()
+    if r != 0:
+        return
+    events = json.loads(path.rstrip() and
+                        open(path).read().rstrip().rstrip(",") + "]")
+    names = {e.get("name") for e in events}
+    assert "XLA_ALLREDUCE" in names, names
+    assert "XLA_ALLGATHER" in names, names
+    for phase in ("BUCKET_BUILD", "XLA_DISPATCH", "DEVICE_WAIT"):
+        assert phase in names, names
+    assert "NEGOTIATE" in names  # engine rows (__xp.*) share the file
+    # Plane rows are per REAL tensor name.
+    pid_names = {e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and "args" in e}
+    assert "tlp.0" in pid_names and "__xp.tlp.0" in pid_names, pid_names
+    os.unlink(path)
