@@ -183,6 +183,21 @@ def bench_scaling() -> None:
         assert efficiency >= float(floor), (
             f"scaling efficiency {efficiency:.4f} fell below the floor "
             f"{float(floor):.4f}")
+    # Core-normalized floor, portable across virtual-mesh hosts: with C
+    # cores shared by N virtual devices the compute-bound ceiling is C/N,
+    # so efficiency * N / min(C, N) isolates sharding+collective overhead
+    # from host core count (docs/benchmarks.md, scaling harness).
+    norm_floor = os.environ.get("BENCH_SCALING_FLOOR_NORM")
+    if norm_floor is not None:
+        try:  # respects taskset/cgroup pinning, unlike os.cpu_count()
+            cores = len(os.sched_getaffinity(0))
+        except AttributeError:  # pragma: no cover - non-Linux
+            cores = os.cpu_count() or 1
+        normalized = efficiency * n_dev / min(cores, n_dev)
+        assert normalized >= float(norm_floor), (
+            f"core-normalized scaling efficiency {normalized:.4f} "
+            f"(raw {efficiency:.4f} x {n_dev}/{min(cores, n_dev)}) fell "
+            f"below the floor {float(norm_floor):.4f}")
     if jax.process_index() == 0:  # one JSON line per job, not per host
         print(json.dumps({
             "metric": f"resnet50_dp_scaling_efficiency_{n_base}_to_{n_dev}",
@@ -225,6 +240,11 @@ if hvd.rank() == 0:
     assert out.returncode == 0, out.stderr[-2000:]
     bw = next(float(line.split()[1]) for line in out.stdout.splitlines()
               if line.startswith("BW_GBPS"))
+    floor = os.environ.get("BENCH_ALLREDUCE_FLOOR_GBPS")
+    if floor is not None:
+        assert bw >= float(floor), (
+            f"engine ring-allreduce bandwidth {bw:.3f} GB/s at np={np_} "
+            f"fell below the floor {float(floor):.3f} GB/s")
     print(json.dumps({
         "metric": f"engine_ring_allreduce_bandwidth_np{np_}",
         "value": round(bw, 3),
@@ -260,6 +280,7 @@ def main() -> None:
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     side = int(os.environ.get("BENCH_IMAGE", "224"))
 
+    kwargs = {}
     if model_name == "mnist":
         model = models.MnistCNN()
         side, classes = 28, 10
@@ -268,7 +289,12 @@ def main() -> None:
         cls = {"resnet50": models.ResNet50, "resnet101": models.ResNet101,
                "resnet18": models.ResNet18, "vgg16": models.VGG16,
                "inception_v3": models.InceptionV3}[model_name]
-        model = cls(num_classes=1000, dtype=jnp.bfloat16)
+        if model_name.startswith("resnet"):
+            # Step-level fused BN running-stats EMA (models/norm.py): same
+            # math as per-layer flax BN, ~1.4 ms/step less tiny-op
+            # overhead; the train step applies models.ema_batch_stats.
+            kwargs["fused_ema"] = True
+        model = cls(num_classes=1000, dtype=jnp.bfloat16, **kwargs)
         if model_name == "inception_v3" and "BENCH_IMAGE" not in os.environ:
             side = 299
         classes = 1000
@@ -302,12 +328,16 @@ def main() -> None:
             logits, labels).mean()
         return loss, new_stats
 
+    fused_ema = bool(kwargs.get("fused_ema"))
+
     # Donating params/stats/opt_state lets XLA update in place instead of
     # allocating fresh HBM buffers every step (~1.5% on resnet101).
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, batch_stats, opt_state, images, labels):
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        if fused_ema and has_bn:
+            new_stats = models.ema_batch_stats(batch_stats, new_stats, 0.9)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, loss

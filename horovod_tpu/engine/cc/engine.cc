@@ -225,6 +225,13 @@ struct Engine::Coordinator {
     // name negotiates straight to a typed error response instead of
     // stalling forever at count < size.
     std::string forced_error;
+    // Entries for a recently-poisoned base name get a decision deadline
+    // instead of an immediate error: if all ranks announce (a corrected,
+    // consistent resubmission) the name negotiates normally and the
+    // poison clears; if the count still stalls at the deadline, the
+    // announcers are stragglers of the mismatched round and get the
+    // poison's typed error.  0 = no deadline.
+    int64_t poison_deadline_tick = 0;
   };
   std::unordered_map<std::string, PendingTensor> message_table;
   std::vector<std::string> ready;  // names with all ranks announced, in order
@@ -235,6 +242,7 @@ struct Engine::Coordinator {
   // resubmission of the same tensor name works again — the recovery
   // contract docs/tpu.md promises.  Bounded: cleared past 1024 entries.
   static constexpr double kPoisonWindowSec = 5.0;
+  static constexpr int64_t kPoisonDeadlineTicks = 40;  // ~200ms @ 5ms cycle
   std::unordered_map<std::string,
                      std::pair<std::string,
                                std::chrono::steady_clock::time_point>>
@@ -660,6 +668,12 @@ static std::string SiblingName(const std::string& name) {
   return kPlanePrefix + name;
 }
 
+static std::string BaseName(const std::string& name) {
+  const size_t n = sizeof(kPlanePrefix) - 1;
+  if (name.compare(0, n, kPlanePrefix) == 0) return name.substr(n);
+  return name;
+}
+
 void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
   for (const auto& req : rl.requests) {
     auto& pt = coord_->message_table[req.name];
@@ -667,10 +681,7 @@ void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
       pt.first_seen = std::chrono::steady_clock::now();
       pt.order = coord_->next_order++;
       timeline_.NegotiateStart(req.name, req.op);
-      std::string base = req.name.compare(0, sizeof(kPlanePrefix) - 1,
-                                          kPlanePrefix) == 0
-                             ? SiblingName(req.name)
-                             : req.name;
+      std::string base = BaseName(req.name);
       auto poisoned = coord_->poisoned.find(base);
       if (poisoned != coord_->poisoned.end()) {
         auto age = std::chrono::steady_clock::now() - poisoned->second.second;
@@ -678,8 +689,11 @@ void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
                       Coordinator::kPoisonWindowSec)) {
           coord_->poisoned.erase(poisoned);  // expired: name usable again
         } else {
-          pt.forced_error = poisoned->second.first;
-          coord_->ready.push_back(req.name);
+          // Defer: full count before the deadline = consistent retry
+          // (negotiates normally); stalled at the deadline = straggler of
+          // the mismatched round (typed error, swept in CoordinatorTick).
+          pt.poison_deadline_tick =
+              ticks_done_.load() + Coordinator::kPoisonDeadlineTicks;
         }
       }
       auto sib = coord_->message_table.find(SiblingName(req.name));
@@ -715,6 +729,12 @@ void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
     // second push here would double-build (and double-erase) the entry.
     if (static_cast<int>(pt.requests.size()) == opts_.size &&
         pt.forced_error.empty()) {
+      if (pt.poison_deadline_tick != 0) {
+        // Every rank re-announced consistently: the mismatch is resolved;
+        // the name negotiates normally and the poison clears.
+        coord_->poisoned.erase(BaseName(req.name));
+        pt.poison_deadline_tick = 0;
+      }
       timeline_.NegotiateEnd(req.name);
       coord_->ready.push_back(req.name);
     }
@@ -732,6 +752,9 @@ Response Engine::BuildResponse(const std::string& name) {
   if (!it->second.forced_error.empty()) {
     resp.type = RESP_ERROR;
     resp.error_message = it->second.forced_error;
+    // Close the NEGOTIATE row opened at first announce (the normal path
+    // closes it at full count, which forced errors never reach).
+    timeline_.NegotiateEnd(name);
     coord_->message_table.erase(it);
     return resp;
   }
@@ -803,6 +826,23 @@ Response Engine::BuildResponse(const std::string& name) {
 ResponseList Engine::CoordinatorTick() {
   ResponseList out;
   out.shutdown = coord_->shutdown_requested;
+  // Poison-deadline sweep: entries for a recently-mismatched base name
+  // that are STILL short of full count at their deadline are stragglers
+  // of the mismatched round — give them the typed error.
+  const int64_t now_tick = ticks_done_.load();
+  for (auto& kv : coord_->message_table) {
+    auto& pt = kv.second;
+    if (pt.poison_deadline_tick != 0 && now_tick >= pt.poison_deadline_tick &&
+        pt.forced_error.empty() && !pt.requests.empty()) {
+      auto poisoned = coord_->poisoned.find(BaseName(kv.first));
+      pt.forced_error =
+          poisoned != coord_->poisoned.end()
+              ? poisoned->second.first
+              : "cross-transport mismatch for tensor '" + BaseName(kv.first) +
+                    "' (straggler of an earlier mismatched round).";
+      coord_->ready.push_back(kv.first);
+    }
+  }
   if (coord_->ready.empty()) return out;
   std::vector<std::string> ready;
   ready.swap(coord_->ready);

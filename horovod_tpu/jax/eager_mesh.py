@@ -173,6 +173,12 @@ class XlaHandle:
         self._finished = True
         self._plane._wait_dispatch(self)
         if self._error is not None:
+            if self._tl_started:
+                from horovod_tpu import common
+
+                # Close the op row opened at dispatch so the trace does
+                # not show the tensor as running forever.
+                common._lib.hvd_tpu_timeline_op_end(self._name.encode(), 0)
             raise self._error
         tl_lib = None
         if self._tl_started:
@@ -214,10 +220,15 @@ class XlaHandle:
 
 class XlaDataPlane:
     def __init__(self, mesh, spec_sharded, spec_replicated, rank, size,
-                 fusion_threshold):
+                 fusion_threshold, spec_proc_only=None, local_chips=1):
         self._mesh = mesh
+        # ar/bc inputs shard the flat payload across this process's local
+        # chips too ("hvd_local"), engaging every chip's ICI bandwidth;
+        # allgather keeps the ragged payload replicated per process.
         self._in_sharding = spec_sharded
+        self._in_sharding_proc = spec_proc_only or spec_sharded
         self._out_sharding = spec_replicated
+        self._local_chips = int(local_chips)
         self._rank = rank
         self._size = size
         self._fusion_threshold = int(fusion_threshold)
@@ -405,11 +416,12 @@ class XlaDataPlane:
             self._fns[key] = fn
         return fn
 
-    def _global_array(self, local: np.ndarray):
+    def _global_array(self, local: np.ndarray, replicated: bool = False):
         import jax
 
+        sharding = self._in_sharding_proc if replicated else self._in_sharding
         return jax.make_array_from_process_local_data(
-            self._in_sharding, local[np.newaxis],
+            sharding, local[np.newaxis],
             (self._size,) + local.shape)
 
     _TL_OP_NAMES = {"ar": "XLA_ALLREDUCE", "bc": "XLA_BROADCAST",
@@ -457,6 +469,10 @@ class XlaDataPlane:
             lens = [op.payload.size for op in bucket]
             total = int(sum(lens))
             length = _bucket_len(total)
+            # The flat buffer also shards across this process's local
+            # chips; keep it divisible so every chip holds an equal slice.
+            chips = self._local_chips
+            length = -(-length // chips) * chips
             flat = np.zeros(length, dtype)
             off = 0
             offs = []
@@ -494,7 +510,7 @@ class XlaDataPlane:
 
         with jax.profiler.TraceAnnotation(
                 f"hvd_plane_dispatch:{kind}:x{n_ops}"):
-            return fn(self._global_array(local))
+            return fn(self._global_array(local, replicated=(kind == "ag")))
 
     # -- public enqueue API ----------------------------------------------
 
@@ -569,22 +585,35 @@ def initialize(ps) -> Optional[XlaDataPlane]:
                     coordinator_address=coord,
                     num_processes=ps.size, process_id=ps.rank)
             devices = jax.devices()
-            # One device per process, ordered by rank.
+            # A (process, local-chip) 2-D mesh: each process may own
+            # several local devices (the reference ran several GPUs from
+            # one process, test_tensorflow.py:189); with one device per
+            # process this reduces to the 1-D per-process mesh.
             by_proc = {}
             for d in devices:
-                by_proc.setdefault(d.process_index, d)
+                by_proc.setdefault(d.process_index, []).append(d)
             if len(by_proc) != ps.size:
                 raise RuntimeError(
                     f"{len(by_proc)} processes visible to JAX, expected "
                     f"{ps.size}")
-            mesh_devices = [by_proc[i] for i in sorted(by_proc)]
-            mesh = Mesh(np.array(mesh_devices), ("hvd_proc",))
+            counts = {len(v) for v in by_proc.values()}
+            if len(counts) != 1:
+                raise RuntimeError(
+                    f"uneven device counts per process: "
+                    f"{ {k: len(v) for k, v in by_proc.items()} }")
+            chips = counts.pop()
+            mesh_devices = np.array(
+                [sorted(by_proc[i], key=lambda d: d.id)
+                 for i in sorted(by_proc)])
+            mesh = Mesh(mesh_devices, ("hvd_proc", "hvd_local"))
             plane = XlaDataPlane(
                 mesh,
-                NamedSharding(mesh, P("hvd_proc")),
+                NamedSharding(mesh, P("hvd_proc", "hvd_local")),
                 NamedSharding(mesh, P()),
                 ps.rank, ps.size,
-                Config.from_env().fusion_threshold)
+                Config.from_env().fusion_threshold,
+                spec_proc_only=NamedSharding(mesh, P("hvd_proc")),
+                local_chips=chips)
             _plane = plane
             return plane
         except Exception as exc:  # fall back to the TCP engine

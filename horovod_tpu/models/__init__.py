@@ -8,6 +8,10 @@ driver's graft entry all share one TPU-tuned implementation.
 """
 
 from horovod_tpu.models.mnist import MnistCNN  # noqa: F401
+from horovod_tpu.models.norm import (  # noqa: F401
+    BatchStatsNorm,
+    ema_batch_stats,
+)
 from horovod_tpu.models.resnet import (  # noqa: F401
     ResNet,
     ResNet18,

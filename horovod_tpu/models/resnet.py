@@ -133,13 +133,23 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     axis_name: Optional[str] = None
     small_inputs: bool = False  # CIFAR-style stem: 3x3/1, no maxpool
+    # Step-level fused running-stats EMA (models/norm.py): the ~104 BN
+    # layers' EMAs collapse into one op — the train step must then apply
+    # models.ema_batch_stats to the mutable update.  Same math, ~1.4 ms
+    # less per-op overhead per v5e step (docs/benchmarks.md).
+    fused_ema: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        from horovod_tpu.models import norm as norm_mod
+
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
                                  padding="SAME")
+        # norm_mod.BatchNorm = BatchStatsNorm aliased so flax auto-names
+        # (BatchNorm_0 ...) keep the two paths' trees path-identical.
+        norm_cls = norm_mod.BatchNorm if self.fused_ema else nn.BatchNorm
         norm = functools.partial(
-            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            norm_cls, use_running_average=not train, momentum=0.9,
             epsilon=1e-5, dtype=self.dtype, axis_name=self.axis_name)
 
         x = x.astype(self.dtype)

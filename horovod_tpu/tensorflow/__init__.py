@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import weakref
 from typing import Optional
 
 import numpy as np
@@ -377,7 +378,11 @@ class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
 # every rank (the same forward graph yields the same backward build
 # order).  Slower than a group, but the double-backward path is rare;
 # eager mode needs no chain (python program order is already global).
-_bwd_chain = {"graph": None, "op": None}
+# Per-graph last-built backward collective (weakly keyed: graphs are not
+# pinned alive).  A single shared slot would lose the chain whenever two
+# graphs' builds interleave (nested FuncGraphs, tf.cond gradients) and
+# silently re-expose the deadlock.
+_bwd_chain = weakref.WeakKeyDictionary()
 
 
 def _chained_bwd(build_fn, ref_tensor):
@@ -387,14 +392,17 @@ def _chained_bwd(build_fn, ref_tensor):
     if graph is None:
         return build_fn()
     with _name_lock:
-        prev = ([_bwd_chain["op"]]
-                if _bwd_chain["graph"] is graph
-                and _bwd_chain["op"] is not None else [])
-    with tf.control_dependencies(prev):
+        prev_ref = _bwd_chain.get(graph)
+        prev = prev_ref() if prev_ref is not None else None
+    with tf.control_dependencies([prev] if prev is not None else []):
         out = build_fn()
     with _name_lock:
-        _bwd_chain["graph"] = graph
-        _bwd_chain["op"] = out
+        try:
+            # The value is a weakref too: a strong op value would reference
+            # its graph (the key) and pin both alive forever.
+            _bwd_chain[graph] = weakref.ref(out)
+        except TypeError:  # non-weakref-able object: skip chaining
+            pass
     return out
 
 

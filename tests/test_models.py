@@ -142,3 +142,51 @@ def test_space_to_depth_stem_is_exact():
             xi, w, (2, 2), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
         got = stem.apply(params, xi)
         np.testing.assert_allclose(got, want, atol=2e-6, err_msg=str(shape))
+
+
+def test_fused_ema_batchnorm_matches_flax_bn():
+    """ResNet(fused_ema=True) + ema_batch_stats reproduces the stock flax
+    BatchNorm path exactly (same logits, same running stats) over several
+    training steps — the EMA is hoisted out of the 104 BN layers into one
+    fused op, not changed (models/norm.py)."""
+    import optax
+
+    from horovod_tpu.models import ResNet18, ema_batch_stats
+
+    def run(fused):
+        model = ResNet18(num_classes=10, dtype=jnp.float32,
+                         small_inputs=True, fused_ema=fused)
+        images = jnp.asarray(
+            np.random.RandomState(0).rand(4, 32, 32, 3), jnp.float32)
+        labels = jnp.asarray([0, 1, 2, 3], jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), images, train=False)
+        params, stats = variables["params"], variables["batch_stats"]
+        tx = optax.sgd(0.1)
+        opt_state = tx.init(params)
+
+        def loss_fn(p, stats):
+            logits, upd = model.apply(
+                {"params": p, "batch_stats": stats}, images, train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            return loss, upd["batch_stats"]
+
+        for _ in range(3):
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, stats)
+            stats = (ema_batch_stats(stats, new_stats, 0.9) if fused
+                     else new_stats)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        eval_logits = model.apply({"params": params, "batch_stats": stats},
+                                  images, train=False)
+        return loss, stats, eval_logits
+
+    loss_a, stats_a, eval_a = run(False)
+    loss_b, stats_b, eval_b = run(True)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        stats_a, stats_b)
+    np.testing.assert_allclose(eval_a, eval_b, rtol=1e-4, atol=1e-5)
