@@ -47,6 +47,10 @@ class Attention(nn.Module):
     dtype: Any = jnp.bfloat16
     seq_axis: Optional[str] = None
     use_flash: bool = True
+    # Under sequence parallelism: how K/V shards travel the ring —
+    # "ppermute" (XLA collective permute), "rdma", or "fused" (rotation
+    # DMA inside the flash kernel; ops/ring_flash.py).
+    ring_impl: str = "ppermute"
 
     @nn.compact
     def __call__(self, x):
@@ -72,7 +76,7 @@ class Attention(nn.Module):
             positions = offset + jnp.arange(s)
             q, k = rope(q, positions), rope(k, positions)
             out = ring_attention(q, k, v, axis_name=self.seq_axis,
-                                 causal=True)
+                                 causal=True, rotate_impl=self.ring_impl)
         else:
             positions = jnp.arange(s)
             q, k = rope(q, positions), rope(k, positions)
@@ -91,12 +95,13 @@ class Block(nn.Module):
     dtype: Any = jnp.bfloat16
     seq_axis: Optional[str] = None
     use_flash: bool = True
+    ring_impl: str = "ppermute"
 
     @nn.compact
     def __call__(self, x):
         h = nn.RMSNorm(dtype=self.dtype, name="attn_norm")(x)
         x = x + Attention(self.n_heads, self.dtype, self.seq_axis,
-                          self.use_flash, name="attn")(h)
+                          self.use_flash, self.ring_impl, name="attn")(h)
         h = nn.RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
         h = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
                      name="up")(h)
@@ -117,6 +122,7 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     seq_axis: Optional[str] = None  # mapped mesh axis of sequence shards
     use_flash: bool = True
+    ring_impl: str = "ppermute"  # K/V rotation under sequence parallelism
 
     @nn.compact
     def __call__(self, tokens, targets=None):
@@ -131,7 +137,7 @@ class TransformerLM(nn.Module):
                      dtype=self.dtype, name="embed")(tokens)
         for i in range(self.n_layers):
             x = Block(self.n_heads, d_ff, self.dtype, self.seq_axis,
-                      self.use_flash, name=f"layer_{i}")(x)
+                      self.use_flash, self.ring_impl, name=f"layer_{i}")(x)
         x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
         # Logits accumulate in float32 for a numerically stable softmax,
         # but the matmul runs in bfloat16 on the MXU: an f32xf32 matmul

@@ -13,6 +13,9 @@ collective substrate:
 * :func:`ring_attention` — sequence-parallel attention over a mesh axis:
   K/V shards rotate around the ICI ring via `lax.ppermute` while each
   device's queries stay put (Liu et al., Ring Attention, arXiv:2310.01889).
+* :func:`fused_ring_attention` — ring attention with the rotation DMA
+  fused INTO the flash kernel (start DMA -> attend -> wait), one Pallas
+  program per ring step (`ring_attention(..., rotate_impl="fused")`).
 """
 
 from horovod_tpu.ops.attention import (  # noqa: F401
@@ -21,3 +24,4 @@ from horovod_tpu.ops.attention import (  # noqa: F401
     mha_reference,
 )
 from horovod_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from horovod_tpu.ops.ring_flash import fused_ring_attention  # noqa: F401

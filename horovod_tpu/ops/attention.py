@@ -253,6 +253,60 @@ def _st(ref, val):
     ref[...] = val.reshape(ref.shape).astype(ref.dtype)
 
 
+def _attend_block(q_ref, k_ref, v_ref, m_scratch, l_scratch, acc_scratch,
+                  q_start, k_start, sm_scale, causal, block_q, block_k):
+    """One online-softmax block update of the VMEM (m, l, acc) state.
+
+    Shared by the single-shard flash kernel and the fused ring-flash step
+    (ops/ring_flash.py) — the only difference between them is where
+    ``q_start``/``k_start`` come from (grid position vs scalar-prefetched
+    absolute shard offsets)."""
+    q = _rd(q_ref)  # (block_q, d)
+    k = _rd(k_ref)  # (block_k, d)
+    v = _rd(v_ref)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m_prev = m_scratch[:, 0]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    if causal:
+        p = jnp.where(q_pos >= k_pos, p, 0.0)
+    l_new = l_scratch[:, 0] * alpha + p.sum(axis=-1)
+    acc_scratch[...] = (
+        acc_scratch[...] * alpha[:, None]
+        + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))
+    m_scratch[...] = jnp.broadcast_to(m_new[:, None], m_scratch.shape)
+    l_scratch[...] = jnp.broadcast_to(l_new[:, None], l_scratch.shape)
+
+
+def _init_state(m_scratch, l_scratch, acc_scratch):
+    m_scratch[...] = jnp.full_like(m_scratch, NEG_INF)
+    l_scratch[...] = jnp.zeros_like(l_scratch)
+    acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+
+def _finalize_flash(o_ref, lse_ref, m_scratch, l_scratch, acc_scratch,
+                    block_q):
+    l = l_scratch[:, 0]
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    _st(o_ref, acc_scratch[...] / safe_l[:, None])
+    # 8 identical sublanes: a (1, block_q) block would violate the TPU
+    # (8, 128) output tiling.
+    lse_ref[...] = jnp.broadcast_to(
+        _lse_of(m_scratch[:, 0], l)[None, :], (8, block_q)).reshape(
+        lse_ref.shape)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
                   acc_scratch, *, sm_scale, causal, block_q, block_k,
                   num_k_blocks):
@@ -261,9 +315,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
 
     @pl.when(ki == 0)
     def _():
-        m_scratch[...] = jnp.full_like(m_scratch, NEG_INF)
-        l_scratch[...] = jnp.zeros_like(l_scratch)
-        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+        _init_state(m_scratch, l_scratch, acc_scratch)
 
     q_start = qi * block_q
     k_start = ki * block_k
@@ -272,43 +324,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
 
     @pl.when(run)
     def _():
-        q = _rd(q_ref)  # (block_q, d)
-        k = _rd(k_ref)  # (block_k, d)
-        v = _rd(v_ref)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_prev = m_scratch[:, 0]
-        m_new = jnp.maximum(m_prev, s.max(axis=-1))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        if causal:
-            p = jnp.where(q_pos >= k_pos, p, 0.0)
-        l_new = l_scratch[:, 0] * alpha + p.sum(axis=-1)
-        acc_scratch[...] = (
-            acc_scratch[...] * alpha[:, None]
-            + jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32))
-        m_scratch[...] = jnp.broadcast_to(m_new[:, None], m_scratch.shape)
-        l_scratch[...] = jnp.broadcast_to(l_new[:, None], l_scratch.shape)
+        _attend_block(q_ref, k_ref, v_ref, m_scratch, l_scratch,
+                      acc_scratch, q_start, k_start, sm_scale, causal,
+                      block_q, block_k)
 
     @pl.when(ki == num_k_blocks - 1)
     def _():
-        l = l_scratch[:, 0]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
-        _st(o_ref, acc_scratch[...] / safe_l[:, None])
-        # 8 identical sublanes: a (1, block_q) block would violate the TPU
-        # (8, 128) output tiling.
-        lse_ref[...] = jnp.broadcast_to(
-            _lse_of(m_scratch[:, 0], l)[None, :], (8, block_q)).reshape(
-            lse_ref.shape)
+        _finalize_flash(o_ref, lse_ref, m_scratch, l_scratch, acc_scratch,
+                        block_q)
 
 
 def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,

@@ -43,16 +43,25 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
       sm_scale: softmax scale; default ``head_dim ** -0.5``.
       rotate_impl: how K/V shards travel the ring — ``"ppermute"`` (XLA
         collective permute, default: the compiler schedules it as an async
-        start/done pair overlapped with compute) or ``"rdma"``
+        start/done pair overlapped with compute), ``"rdma"``
         (:func:`horovod_tpu.ops.rdma.ring_permute`: one raw Pallas remote
         DMA per rotation, for hardware where explicit transfer control
-        beats XLA's scheduling; differentiable either way).
+        beats XLA's scheduling), or ``"fused"``
+        (:func:`horovod_tpu.ops.ring_flash.fused_ring_attention`: ONE
+        Pallas program per ring step that starts the rotation DMA, flash-
+        attends the current shard while it flies, and waits at the end —
+        overlap by construction).  Differentiable in every mode.
 
     Returns:
       The local output shard, same shape/dtype as ``q``.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    if rotate_impl == "fused":
+        from horovod_tpu.ops.ring_flash import fused_ring_attention
+
+        return fused_ring_attention(q, k, v, axis_name, causal=causal,
+                                    sm_scale=sm_scale)
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     seq_local = q.shape[-2]

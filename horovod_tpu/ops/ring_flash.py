@@ -1,0 +1,383 @@
+"""Fused ring-flash attention: rotation DMA overlapped inside the kernel.
+
+The separable ring attention (`ops/ring_attention.py`) alternates
+whole-shard rotate (ppermute / rdma) and whole-shard attend steps; XLA can
+overlap them across steps, but each rotation is still a standalone
+collective the scheduler must place.  This module fuses one ring step into
+ONE Pallas program: the kernel *starts* the async remote copy of the
+current K/V shard to the right neighbour, computes the shard's flash
+attention while the DMA flies, and *waits* for the transfer only at the
+final grid step — the start-DMA → attend → wait-DMA pattern of hand-
+written TPU collective kernels (cf. the collective-matmul examples in the
+Pallas guide).  Communication latency hides behind the attention compute
+by construction, not by scheduler luck.
+
+Per ring step the kernel returns the shard-local attention output and its
+per-row logsumexp; consecutive steps merge at the JAX level with the
+standard flash-merge identity::
+
+    lse = logaddexp(lse_1, lse_2)
+    out = out_1 * exp(lse_1 - lse) + out_2 * exp(lse_2 - lse)
+
+The backward pass is COMPOSED, not fused: a ``custom_vjp`` recomputes the
+forward via the separable ppermute path and differentiates that —
+numerically the same function, so its VJP is exact for the fused forward
+(the test pins fused forward == separable forward and grads == dense
+reference).
+
+Correctness of the remote DMA relies on the same ready-handshake barrier
+and phase-alternating collective_id scheme as ``ops/rdma.py`` (reserved
+ids 15/16 here; 13/14 belong to rdma) — see the invariant discussion
+there.  Interpret mode (CPU test meshes) skips the barrier, as rdma does.
+
+No reference counterpart (SURVEY §5.7: the reference has no sequence
+parallelism); this is the exceeds-reference flagship.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.ops.attention import (NEG_INF, POS_BIG, _attend_block,
+                                       _finalize_flash, _init_state,
+                                       _pick_block)
+
+try:
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAS_PALLAS = False
+
+_COLLECTIVE_IDS = (15, 16)  # phase-alternating barrier namespaces
+
+
+def _step_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
+                 num_k_blocks, bh, rotate, barrier, phase, axis_name):
+    """One ring step: start K/V DMA to the right neighbour, flash-attend
+    the current shard, wait the DMA at the end.
+
+    Grid: (bh, num_q, num_k).  ``offsets_ref`` (SMEM, scalar-prefetch):
+    [q_offset, k_offset] — the absolute sequence positions of this
+    device's q shard and of the k/v shard it currently holds (for causal
+    masking across shards).  The last (non-rotating) ring step takes no
+    DMA refs/semaphores at all.
+    """
+    if rotate:
+        (offsets_ref, q_ref, k_ref, v_ref, k_full, v_full,
+         o_ref, lse_ref, k_next, v_next,
+         m_scratch, l_scratch, acc_scratch, sems) = refs
+    else:
+        (offsets_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scratch, l_scratch, acc_scratch) = refs
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    if rotate:
+        my = lax.axis_index(axis_name)
+        n = lax.axis_size(axis_name)
+        dst = lax.rem(my + 1, n)
+
+        @pl.when((b == 0) & (qi == 0) & (ki == 0))
+        def _start_rotation():
+            if barrier:
+                # Ready handshake (see ops/rdma.py): signal my *source*
+                # ("you may write into my k_next/v_next"), wait for the
+                # matching signal from my *destination*.
+                src = lax.rem(my - 1 + n, n)
+                bar = pltpu.get_barrier_semaphore()
+                pltpu.semaphore_signal(
+                    bar, inc=1, device_id=src,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                pltpu.semaphore_wait(bar, 1)
+            pltpu.make_async_remote_copy(
+                src_ref=k_full, dst_ref=k_next, send_sem=sems.at[0],
+                recv_sem=sems.at[1], device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+            pltpu.make_async_remote_copy(
+                src_ref=v_full, dst_ref=v_next, send_sem=sems.at[2],
+                recv_sem=sems.at[3], device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+
+    @pl.when(ki == 0)
+    def _():
+        _init_state(m_scratch, l_scratch, acc_scratch)
+
+    if causal:
+        q_start = offsets_ref[0] + qi * block_q  # absolute positions
+        k_start = offsets_ref[1] + ki * block_k
+        run = k_start <= q_start + block_q - 1
+    else:
+        q_start = k_start = 0
+        run = True
+
+    @pl.when(run)
+    def _():
+        _attend_block(q_ref, k_ref, v_ref, m_scratch, l_scratch,
+                      acc_scratch, q_start, k_start, sm_scale, causal,
+                      block_q, block_k)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _():
+        _finalize_flash(o_ref, lse_ref, m_scratch, l_scratch, acc_scratch,
+                        block_q)
+
+    if rotate:
+        @pl.when((b == bh - 1) & (qi == num_q_blocks - 1)
+                 & (ki == num_k_blocks - 1))
+        def _finish_rotation():
+            # Reconstructing the descriptor with the same refs/semaphores
+            # waits on the copies started at the first grid step.
+            pltpu.make_async_remote_copy(
+                src_ref=k_full, dst_ref=k_next, send_sem=sems.at[0],
+                recv_sem=sems.at[1], device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL).wait()
+            pltpu.make_async_remote_copy(
+                src_ref=v_full, dst_ref=v_next, send_sem=sems.at[2],
+                recv_sem=sems.at[3], device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL).wait()
+
+
+def _row_spec(block, d, row):
+    # PrefetchScalarGridSpec passes the scalar-prefetch ref as the LAST
+    # index_map argument.
+    return pl.BlockSpec((1, block, d),
+                        lambda b, qi, ki, s: (b, row(qi, ki), 0))
+
+
+def _ring_flash_step(q, k_cur, v_cur, q_offset, k_offset, *, sm_scale,
+                     causal, block_q, block_k, rotate, phase, axis_name,
+                     interpret):
+    """One fused ring step over (bh, seq_local, d) shards.  Returns
+    (out, lse, k_next, v_next) — k_next/v_next only when rotating."""
+    bh, sl, d = q.shape
+    block_q = _pick_block(sl, block_q)
+    block_k = _pick_block(sl, block_k)
+    assert sl % block_q == 0 and sl % block_k == 0, (
+        "fused_ring_attention routes ragged shard lengths to the "
+        "separable path before reaching the kernel")
+    num_q, num_k = sl // block_q, sl // block_k
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32)])
+
+    kernel = functools.partial(
+        _step_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_q_blocks=num_q, num_k_blocks=num_k, bh=bh,
+        rotate=rotate, barrier=rotate and not interpret, phase=phase,
+        axis_name=axis_name)
+    out_shapes = [
+        jax.ShapeDtypeStruct((bh, sl, d), q.dtype),        # out
+        jax.ShapeDtypeStruct((bh, 8, sl), jnp.float32),    # lse (8 sublanes)
+    ]
+    in_specs = [
+        _row_spec(block_q, d, lambda qi, ki: qi),   # q
+        _row_spec(block_k, d, lambda qi, ki: ki),   # k (blocked)
+        _row_spec(block_k, d, lambda qi, ki: ki),   # v (blocked)
+    ]
+    out_specs = [
+        _row_spec(block_q, d, lambda qi, ki: qi),   # out
+        pl.BlockSpec((1, 8, block_q), lambda b, qi, ki, s: (b, 0, qi)),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((block_q, 128), jnp.float32),    # running max
+        pltpu.VMEM((block_q, 128), jnp.float32),    # running normalizer
+        pltpu.VMEM((block_q, d), jnp.float32),      # output accumulator
+    ]
+    args = [offsets, q, k_cur, v_cur]
+    if rotate:
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),      # k (whole, DMA src)
+            pl.BlockSpec(memory_space=pl.ANY),      # v (whole, DMA src)
+        ]
+        out_shapes += [
+            jax.ShapeDtypeStruct(k_cur.shape, k_cur.dtype),  # k_next
+            jax.ShapeDtypeStruct(v_cur.shape, v_cur.dtype),  # v_next
+        ]
+        out_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),      # k_next (DMA dst)
+            pl.BlockSpec(memory_space=pl.ANY),      # v_next (DMA dst)
+        ]
+        scratch_shapes += [pltpu.SemaphoreType.DMA((4,))]  # k/v send+recv
+        args += [k_cur, v_cur]
+    vma = getattr(jax.typeof(q), "vma", None)
+    if vma is not None:
+        out_shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype, vma=vma)
+                      for s in out_shapes]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, num_q, num_k),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
+    )
+    barrier = rotate and not interpret
+    compiler_params = pltpu.CompilerParams(
+        # collective_id may only be set when the kernel takes the custom
+        # barrier (the non-rotating last step has no barrier).
+        collective_id=_COLLECTIVE_IDS[phase % 2] if barrier else None,
+        has_side_effects=True)
+    results = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(*args)
+    if rotate:
+        out, lse, k_next, v_next = results
+        return out, lse[:, 0, :], k_next, v_next
+    out, lse = results
+    return out, lse[:, 0, :], None, None
+
+
+def _phase_closer_kernel(o_ref, *, axis_name):
+    my = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    src = lax.rem(my - 1 + n, n)
+    bar = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(bar, inc=1, device_id=src,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(bar, 1)
+    o_ref[...] = jnp.zeros_like(o_ref)
+
+
+def _phase_closer(axis_name):
+    """Barrier-only invocation on phase 1: appended when a fused forward
+    used an ODD number of rotating steps (even ring sizes), so every
+    fused call's barrier-phase stream starts on 0 and ends on 1 — the
+    cyclic alternation invariant (ops/rdma.py) then holds across
+    repeated executions of the same compiled program (training loops
+    re-run the jitted step; the junction last-phase -> first-phase must
+    differ)."""
+    pl.pallas_call(
+        functools.partial(_phase_closer_kernel, axis_name=axis_name),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            collective_id=_COLLECTIVE_IDS[1], has_side_effects=True),
+    )()
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Flash-merge two partial attention results.  POS_BIG lse rows carry
+    zero mass (fully masked)."""
+    e1 = jnp.where(lse1 > POS_BIG / 2, NEG_INF, lse1)
+    e2 = jnp.where(lse2 > POS_BIG / 2, NEG_INF, lse2)
+    m = jnp.maximum(e1, e2)
+    both_empty = m <= NEG_INF / 2
+    m_safe = jnp.where(both_empty, 0.0, m)
+    w1 = jnp.where(e1 <= NEG_INF / 2, 0.0, jnp.exp(e1 - m_safe))
+    w2 = jnp.where(e2 <= NEG_INF / 2, 0.0, jnp.exp(e2 - m_safe))
+    total = w1 + w2
+    safe_total = jnp.where(total == 0.0, 1.0, total)
+    out = (o1.astype(jnp.float32) * (w1 / safe_total)[..., None]
+           + o2.astype(jnp.float32) * (w2 / safe_total)[..., None])
+    lse = jnp.where(both_empty, POS_BIG, m_safe + jnp.log(safe_total))
+    return out.astype(o1.dtype), lse
+
+
+def _fused_forward(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
+                   interpret):
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    sl = q.shape[-2]
+    batch, heads = q.shape[0], q.shape[1]
+    bh = batch * heads
+    qr = q.reshape(bh, sl, q.shape[-1])
+    k_cur = k.reshape(bh, sl, k.shape[-1])
+    v_cur = v.reshape(bh, sl, v.shape[-1])
+    q_off = my * sl
+
+    out = lse = None
+    for t in range(n):
+        kv_idx = lax.rem(my - t + n, n)
+        k_off = kv_idx * sl
+        o_t, lse_t, k_next, v_next = _ring_flash_step(
+            qr, k_cur, v_cur, q_off, k_off, sm_scale=sm_scale,
+            causal=causal, block_q=block_q, block_k=block_k,
+            rotate=t < n - 1, phase=t % 2, axis_name=axis_name,
+            interpret=interpret)
+        if t < n - 1:
+            k_cur, v_cur = k_next, v_next
+        if out is None:
+            out, lse = o_t, lse_t
+        else:
+            out, lse = _merge(out, lse, o_t, lse_t)
+    if not interpret and (n - 1) % 2 == 1:
+        # Even ring: odd number of rotating steps [0,1,...,0] — close the
+        # barrier-phase stream on 1 so repeated executions alternate.
+        _phase_closer(axis_name)
+    return out.reshape(q.shape).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _fused_ring_attention(q, k, v, axis_name, causal, sm_scale, block_q,
+                          block_k, interpret):
+    return _fused_forward(q, k, v, axis_name, causal, sm_scale, block_q,
+                          block_k, interpret)
+
+
+def _fused_fwd(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
+               interpret):
+    out = _fused_forward(q, k, v, axis_name, causal, sm_scale, block_q,
+                         block_k, interpret)
+    return out, (q, k, v)
+
+
+def _fused_bwd(axis_name, causal, sm_scale, block_q, block_k, interpret,
+               res, g):
+    # Composed backward: differentiate the separable (ppermute) ring
+    # attention — the same function value, so its VJP is exact here.  The
+    # recompute-forward cost matches the separable path's own
+    # jax.checkpoint behavior.
+    from horovod_tpu.ops.ring_attention import ring_attention
+
+    q, k, v = res
+    _, vjp_fn = jax.vjp(
+        lambda q_, k_, v_: ring_attention(
+            q_, k_, v_, axis_name=axis_name, causal=causal,
+            sm_scale=sm_scale, rotate_impl="ppermute"),
+        q, k, v)
+    return vjp_fn(g)
+
+
+_fused_ring_attention.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                         sm_scale: Optional[float] = None,
+                         block_q: int = 512, block_k: int = 512,
+                         interpret: Optional[bool] = None):
+    """Ring attention with the rotation DMA fused into the flash kernel.
+
+    Same contract as :func:`horovod_tpu.ops.ring_attention` (shards of
+    ``(batch, heads, seq_local, head_dim)`` inside ``shard_map`` over
+    ``axis_name``).  Shard lengths that don't factor into MXU-tileable
+    blocks (see ``_pick_block``) fall back to the separable ppermute ring,
+    as :func:`flash_attention` falls back to blockwise.
+    """
+    if not _HAS_PALLAS:
+        raise RuntimeError("fused_ring_attention requires Pallas")
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    sl = q.shape[-2]
+    bq, bk = _pick_block(sl, block_q), _pick_block(sl, block_k)
+    off_grid = sl % bq or sl % bk or (not interpret
+                                      and (bq % 128 or bk % 128))
+    if off_grid:
+        # Ragged or non-MXU-tileable shard lengths: the separable ring
+        # handles them (mirrors _flash_forward's blockwise fallback).
+        from horovod_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, axis_name, causal=causal,
+                              sm_scale=sm_scale, rotate_impl="ppermute")
+    return _fused_ring_attention(q, k, v, axis_name, causal, sm_scale,
+                                 bq, bk, interpret)

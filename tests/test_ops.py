@@ -260,3 +260,53 @@ def test_blockwise_offsets_compose():
     got = blockwise_attention(q[:, :, 32:], k, v, causal=True,
                               block_size=16, q_offset=32, k_offset=0)
     np.testing.assert_allclose(got, full[:, :, 32:], atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_ring_flash_matches_dense(causal):
+    """VERDICT r2 #3: the fused ring-flash kernel (rotation DMA inside the
+    Pallas program, per-step flash + lse merge) matches the dense
+    reference in value AND gradient on the virtual mesh (interpret-mode
+    remote DMA), for both causal and dense masks."""
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices[:4]), ("sp",))
+    q, k, v = _qkv(batch=1, heads=2, seq=4 * 32, d=16)
+    want = mha_reference(q, k, v, causal=causal)
+    spec = P(None, None, "sp", None)
+    fn = functools.partial(ring_attention, axis_name="sp", causal=causal,
+                           rotate_impl="fused")
+    got = jax.jit(shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False))(q, k, v)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    def ring_loss(q, k, v):
+        out = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_vma=False)(q, k, v)
+        return (out ** 2).sum()
+
+    def ref_loss(q, k, v):
+        return (mha_reference(q, k, v, causal=causal) ** 2).sum()
+
+    g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_fused_ring_flash_bf16_and_uneven_heads():
+    """Fused ring flash in bf16 with several heads stays close to the f32
+    dense reference (bf16 tolerance), exercising the merge in the
+    kernel's production dtype."""
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices[:4]), ("sp",))
+    q, k, v = _qkv(batch=2, heads=3, seq=4 * 16, d=32)
+    qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+    want = mha_reference(q, k, v, causal=True)
+    spec = P(None, None, "sp", None)
+    fn = functools.partial(ring_attention, axis_name="sp", causal=True,
+                           rotate_impl="fused")
+    got = jax.jit(shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False))(qb, kb, vb)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32), want,
+                               atol=3e-2, rtol=3e-2)
