@@ -40,6 +40,12 @@ parser.add_argument("--vocab", type=int, default=1024)
 parser.add_argument("--d-model", type=int, default=256)
 parser.add_argument("--n-layers", type=int, default=4)
 parser.add_argument("--n-heads", type=int, default=8)
+parser.add_argument("--ring-impl", default="ppermute",
+                    choices=["ppermute", "rdma", "fused"],
+                    help="K/V rotation: XLA collective permute, raw "
+                         "Pallas remote DMA, or the fused ring-flash "
+                         "kernel (DMA overlapped inside the attention "
+                         "program)")
 parser.add_argument("--steps", type=int, default=30)
 parser.add_argument("--lr", type=float, default=3e-4)
 args = parser.parse_args()
@@ -56,7 +62,7 @@ def main():
 
     model = TransformerLM(vocab_size=args.vocab, d_model=args.d_model,
                           n_layers=args.n_layers, n_heads=args.n_heads,
-                          seq_axis="sp")
+                          seq_axis="sp", ring_impl=args.ring_impl)
 
     # A tiny synthetic corpus with learnable structure (token t+1 depends
     # on token t), deterministic across hosts.
@@ -84,8 +90,13 @@ def main():
 
     tx = optax.adamw(args.lr)
     spec = P("dp", "sp")
+    # Interpret-mode Pallas collectives (rdma/fused rotation on CPU test
+    # meshes) need check_vma=False; compiled TPU kernels don't.
+    check_vma = (args.ring_impl == "ppermute"
+                 or jax.default_backend() == "tpu")
     step = build_train_step(loss_fn, tx, mesh, axis_name=("dp", "sp"),
-                            batch_spec=(spec, spec, spec))
+                            batch_spec=(spec, spec, spec),
+                            check_vma=check_vma)
     params = replicate(mesh, params)
     opt_state = replicate(mesh, tx.init(params))
     batch = tuple(jax.device_put(np.asarray(x), NamedSharding(mesh, spec))

@@ -29,7 +29,8 @@ def build_train_step(loss_fn: Callable, optimizer, mesh: Mesh,
                      axis_name: Optional[str] = None,
                      has_aux: bool = False,
                      batch_spec=None,
-                     donate: bool = True):
+                     donate: bool = True,
+                     check_vma: bool = True):
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss[, aux])``.
 
     ``loss_fn(params, batch)`` computes the *local shard's* mean loss (and
@@ -66,8 +67,13 @@ def build_train_step(loss_fn: Callable, optimizer, mesh: Mesh,
         return params, opt_state, loss
 
     n_out = 4 if has_aux else 3
+    # check_vma=False is needed for interpret-mode Pallas collectives on
+    # CPU test meshes (rdma / fused ring rotation): the interpreter does
+    # not propagate the varying-manual-axes annotation through its
+    # internals.  Compiled TPU kernels don't need it.
     mapped = shard_map(
         shard_step, mesh=mesh,
         in_specs=(P(), P(), batch_spec),
-        out_specs=(P(),) * n_out)
+        out_specs=(P(),) * n_out,
+        check_vma=check_vma)
     return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())

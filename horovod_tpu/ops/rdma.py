@@ -41,11 +41,39 @@ except ImportError:  # pragma: no cover
 _COLLECTIVE_IDS = (13, 14)  # phase-alternating barrier namespaces
 
 
+def _device_id(ring_idx, ring_axis, mesh_axes):
+    """(device_id, device_id_type) addressing ``ring_idx`` along the ring
+    axis.  Single-axis meshes use scalar LOGICAL ids (what interpret mode
+    supports); multi-axis meshes use MESH coordinates over every axis —
+    a LOGICAL id computed from the ring axis alone would address the
+    wrong device on a dp x sp mesh."""
+    if len(mesh_axes) == 1:
+        return ring_idx, pltpu.DeviceIdType.LOGICAL
+    coords = tuple(ring_idx if ax == ring_axis else lax.axis_index(ax)
+                   for ax in mesh_axes)
+    return coords, pltpu.DeviceIdType.MESH
+
+
+def _ambient_mesh_axes(axis_name):
+    """Axis names of the surrounding shard_map mesh (falls back to the
+    ring axis alone outside any mesh context)."""
+    try:
+        import jax as _jax
+
+        mesh = _jax.sharding.get_abstract_mesh()
+        names = tuple(getattr(mesh, "axis_names", ()) or ())
+        if axis_name in names:
+            return names
+    except Exception:  # pragma: no cover - very old jax
+        pass
+    return (axis_name,)
+
+
 def _permute_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis_name,
-                    shift, barrier):
+                    shift, barrier, mesh_axes):
     my = lax.axis_index(axis_name)
     n = lax.axis_size(axis_name)
-    dst = lax.rem(my + shift, n)  # shift pre-normalized to [0, n)
+    dst, id_type = _device_id(lax.rem(my + shift, n), axis_name, mesh_axes)
     if barrier:
         # Ready handshake: I may DMA into `dst` only once `dst` has
         # entered this kernel (its output buffer is live).  Every device
@@ -56,14 +84,15 @@ def _permute_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis_name,
         # invocation-after-next it would need its own destination — and,
         # chasing the chain the whole way around the ring — *this* device
         # to have advanced too, a contradiction.
-        src = lax.rem(my - shift + n, n)
+        src, _ = _device_id(lax.rem(my - shift + n, n), axis_name,
+                            mesh_axes)
         sem = pltpu.get_barrier_semaphore()
         pltpu.semaphore_signal(sem, inc=1, device_id=src,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+                               device_id_type=id_type)
         pltpu.semaphore_wait(sem, 1)
     copy = pltpu.make_async_remote_copy(
         src_ref=x_ref, dst_ref=o_ref, send_sem=send_sem, recv_sem=recv_sem,
-        device_id=dst, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        device_id=dst, device_id_type=id_type)
     copy.start()
     copy.wait()
 
@@ -71,7 +100,8 @@ def _permute_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis_name,
 def _ring_permute_raw(x, axis_name, shift, interpret, phase):
     shift = shift % lax.axis_size(axis_name)  # static: axis sizes are known
     kernel = functools.partial(_permute_kernel, axis_name=axis_name,
-                               shift=shift, barrier=not interpret)
+                               shift=shift, barrier=not interpret,
+                               mesh_axes=_ambient_mesh_axes(axis_name))
     # Propagate the varying-mesh-axes annotation so shard_map's vma check
     # accepts the pallas output (the result varies exactly as the input).
     vma = getattr(jax.typeof(x), "vma", None)

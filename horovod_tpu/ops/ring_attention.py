@@ -77,7 +77,16 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
             del phase
             return lax.ppermute(t, axis_name, perm)
     elif rotate_impl == "rdma":
-        from horovod_tpu.ops.rdma import ring_permute
+        from horovod_tpu.ops.rdma import _ambient_mesh_axes, ring_permute
+
+        if (jax.default_backend() != "tpu"
+                and len(_ambient_mesh_axes(axis_name)) > 1):
+            # Interpret-mode remote DMA only supports single-axis meshes
+            # (upstream dma_start_p limitation); fall back to ppermute on
+            # CPU dp x sp meshes, as the fused backend does.
+            return ring_attention(q, k, v, axis_name, causal=causal,
+                                  sm_scale=sm_scale,
+                                  rotate_impl="ppermute")
 
         def rotate(t, phase):
             # Alternate barrier namespaces between consecutive rotations

@@ -57,9 +57,13 @@ except ImportError:  # pragma: no cover
 
 _COLLECTIVE_IDS = (15, 16)  # phase-alternating barrier namespaces
 
+if _HAS_PALLAS:
+    from horovod_tpu.ops.rdma import _ambient_mesh_axes, _device_id
+
 
 def _step_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
-                 num_k_blocks, bh, rotate, barrier, phase, axis_name):
+                 num_k_blocks, bh, rotate, barrier, phase, axis_name,
+                 mesh_axes):
     """One ring step: start K/V DMA to the right neighbour, flash-attend
     the current shard, wait the DMA at the end.
 
@@ -83,7 +87,8 @@ def _step_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
     if rotate:
         my = lax.axis_index(axis_name)
         n = lax.axis_size(axis_name)
-        dst = lax.rem(my + 1, n)
+        dst, id_type = _device_id(lax.rem(my + 1, n), axis_name, mesh_axes)
+        src, _ = _device_id(lax.rem(my - 1 + n, n), axis_name, mesh_axes)
 
         @pl.when((b == 0) & (qi == 0) & (ki == 0))
         def _start_rotation():
@@ -91,20 +96,18 @@ def _step_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
                 # Ready handshake (see ops/rdma.py): signal my *source*
                 # ("you may write into my k_next/v_next"), wait for the
                 # matching signal from my *destination*.
-                src = lax.rem(my - 1 + n, n)
                 bar = pltpu.get_barrier_semaphore()
                 pltpu.semaphore_signal(
-                    bar, inc=1, device_id=src,
-                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                    bar, inc=1, device_id=src, device_id_type=id_type)
                 pltpu.semaphore_wait(bar, 1)
             pltpu.make_async_remote_copy(
                 src_ref=k_full, dst_ref=k_next, send_sem=sems.at[0],
                 recv_sem=sems.at[1], device_id=dst,
-                device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+                device_id_type=id_type).start()
             pltpu.make_async_remote_copy(
                 src_ref=v_full, dst_ref=v_next, send_sem=sems.at[2],
                 recv_sem=sems.at[3], device_id=dst,
-                device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+                device_id_type=id_type).start()
 
     @pl.when(ki == 0)
     def _():
@@ -138,11 +141,11 @@ def _step_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
             pltpu.make_async_remote_copy(
                 src_ref=k_full, dst_ref=k_next, send_sem=sems.at[0],
                 recv_sem=sems.at[1], device_id=dst,
-                device_id_type=pltpu.DeviceIdType.LOGICAL).wait()
+                device_id_type=id_type).wait()
             pltpu.make_async_remote_copy(
                 src_ref=v_full, dst_ref=v_next, send_sem=sems.at[2],
                 recv_sem=sems.at[3], device_id=dst,
-                device_id_type=pltpu.DeviceIdType.LOGICAL).wait()
+                device_id_type=id_type).wait()
 
 
 def _row_spec(block, d, row):
@@ -171,7 +174,7 @@ def _ring_flash_step(q, k_cur, v_cur, q_offset, k_offset, *, sm_scale,
         _step_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
         block_k=block_k, num_q_blocks=num_q, num_k_blocks=num_k, bh=bh,
         rotate=rotate, barrier=rotate and not interpret, phase=phase,
-        axis_name=axis_name)
+        axis_name=axis_name, mesh_axes=_ambient_mesh_axes(axis_name))
     out_shapes = [
         jax.ShapeDtypeStruct((bh, sl, d), q.dtype),        # out
         jax.ShapeDtypeStruct((bh, 8, sl), jnp.float32),    # lse (8 sublanes)
@@ -237,13 +240,13 @@ def _ring_flash_step(q, k_cur, v_cur, q_offset, k_offset, *, sm_scale,
     return out, lse[:, 0, :], None, None
 
 
-def _phase_closer_kernel(o_ref, *, axis_name):
+def _phase_closer_kernel(o_ref, *, axis_name, mesh_axes):
     my = lax.axis_index(axis_name)
     n = lax.axis_size(axis_name)
-    src = lax.rem(my - 1 + n, n)
+    src, id_type = _device_id(lax.rem(my - 1 + n, n), axis_name, mesh_axes)
     bar = pltpu.get_barrier_semaphore()
     pltpu.semaphore_signal(bar, inc=1, device_id=src,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+                           device_id_type=id_type)
     pltpu.semaphore_wait(bar, 1)
     o_ref[...] = jnp.zeros_like(o_ref)
 
@@ -257,7 +260,8 @@ def _phase_closer(axis_name):
     re-run the jitted step; the junction last-phase -> first-phase must
     differ)."""
     pl.pallas_call(
-        functools.partial(_phase_closer_kernel, axis_name=axis_name),
+        functools.partial(_phase_closer_kernel, axis_name=axis_name,
+                          mesh_axes=_ambient_mesh_axes(axis_name)),
         out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             collective_id=_COLLECTIVE_IDS[1], has_side_effects=True),
@@ -372,7 +376,13 @@ def fused_ring_attention(q, k, v, axis_name: str, causal: bool = False,
     bq, bk = _pick_block(sl, block_q), _pick_block(sl, block_k)
     off_grid = sl % bq or sl % bk or (not interpret
                                       and (bq % 128 or bk % 128))
-    if off_grid:
+    # Interpret-mode (CPU test mesh) remote DMA only supports single-axis
+    # meshes (upstream dma_start_p limitation); a dp x sp mesh on CPU
+    # falls back to the separable ring.  Real TPUs use MESH device ids
+    # and are unaffected.
+    multi_axis_interpret = (interpret
+                            and len(_ambient_mesh_axes(axis_name)) > 1)
+    if off_grid or multi_axis_interpret:
         # Ragged or non-MXU-tileable shard lengths: the separable ring
         # handles them (mirrors _flash_forward's blockwise fallback).
         from horovod_tpu.ops.ring_attention import ring_attention

@@ -333,3 +333,38 @@ def test_tf_tape_double_backward_in_graph_mode():
     gg1, gg2 = penalty_step()
     assert gg1 is not None and gg2 is not None
     assert np.isfinite(gg1.numpy()).all() and np.isfinite(gg2.numpy()).all()
+
+
+@distributed_test(np_=2, timeout=300)
+def test_tf_v1_optimizer_sparse_gradients():
+    """tf.IndexedSlices gradients (embedding lookups) ride the async
+    group as allgathers of values+indices — the reference's sparse path
+    (tensorflow/__init__.py:68-79) — through the v1 optimizer end to end."""
+    import tensorflow as tf
+
+    hvd = _init()
+    r, n = hvd.rank(), hvd.size()
+    tf.compat.v1.disable_eager_execution()
+    with tf.compat.v1.Session() as sess:
+        emb = tf.compat.v1.get_variable(
+            "emb", initializer=np.zeros((6, 3), np.float32))
+        # Each rank touches different rows; gradients arrive as
+        # IndexedSlices.
+        ids = tf.constant([r, r + 1], tf.int64)
+        looked = tf.nn.embedding_lookup(emb, ids)
+        loss = tf.reduce_sum(looked * float(r + 1))
+        opt = hvd.DistributedOptimizer(
+            tf.compat.v1.train.GradientDescentOptimizer(1.0))
+        grads_vars = opt.compute_gradients(loss, [emb])
+        assert isinstance(grads_vars[0][0], tf.IndexedSlices)
+        train = opt.apply_gradients(grads_vars)
+        sess.run(tf.compat.v1.global_variables_initializer())
+        sess.run(train)
+        emb1 = sess.run(emb)
+    # Row touched by rank rr gets -(rr+1)/n per rank that touched it
+    # (gathered values are averaged by size; apply subtracts lr*grad).
+    want = np.zeros((6, 3), np.float32)
+    for rr in range(n):
+        for row in (rr, rr + 1):
+            want[row] -= (rr + 1) / n
+    assert np.allclose(emb1, want, atol=1e-5), (r, emb1, want)
