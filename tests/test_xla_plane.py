@@ -330,3 +330,27 @@ def test_xla_plane_multi_chip_single_process():
     out = hvd.allgather(x.reshape(5, 4), name="mc.ag")
     np.testing.assert_array_equal(out, x.reshape(5, 4))
     assert plane.stats["dispatches"] >= 3
+
+
+@distributed_test(np_=3, timeout=300.0)
+def test_xla_plane_with_rank_subset_falls_back():
+    """hvd.init(comm=subset) with HVD_TPU_XLA_DATA_PLANE=1: the plane's
+    jax.distributed world is launcher-wide while the engine job is the
+    subset, so plane init must not wedge the job — either it comes up
+    consistently or every subset rank falls back to the TCP engine
+    together (the __xla_plane_agreement__ handshake)."""
+    import os
+
+    os.environ["HVD_TPU_XLA_DATA_PLANE"] = "1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    launcher_rank = int(os.environ["HVD_TPU_RANK"])
+    if launcher_rank == 1:
+        return  # not in the subset
+    import horovod_tpu as hvd
+
+    hvd.init(comm=[0, 2])
+    assert hvd.size() == 2
+    out = hvd.allreduce(np.full(4, float(launcher_rank), np.float32),
+                        average=False, name="subset_plane")
+    assert np.allclose(out, 2.0), out  # 0 + 2
+    hvd.shutdown()
