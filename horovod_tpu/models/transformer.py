@@ -23,10 +23,39 @@ from horovod_tpu.ops import (blockwise_attention, flash_attention,
 
 
 def rope(x, positions, base: float = 10000.0, seq_dim: int = -2):
-    """Rotary position embedding over the last dim (pairs interleaved as
-    [even half | odd half]).  ``positions``: (seq,) global token positions —
-    global, so sequence-sharded shards stay consistent.  ``seq_dim`` names
-    the sequence axis of ``x`` (-2 for (b, h, s, d), 1 for (b, s, h, d))."""
+    """Rotary position embedding, ADJACENT-pair formulation: component
+    pairs ``(x[2i], x[2i+1])`` rotate by the i-th frequency.  The pairs
+    are reached by a free reshape view instead of the classic
+    [even half | odd half] split's two big slices + concatenate — XLA
+    then fuses the whole rotation into neighbouring ops (measured +6%
+    LM step time; docs/benchmarks.md round-3 log).  The two pairings are
+    the same function up to a fixed permutation of the q/k projections'
+    output axis — :func:`migrate_rope_pairing` converts checkpoints
+    trained under the old pairing exactly.
+
+    ``positions``: (seq,) global token positions — global, so
+    sequence-sharded shards stay consistent.  ``seq_dim`` names the
+    sequence axis of ``x`` (-2 for (b, h, s, d), 1 for (b, s, h, d))."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+    shape = [1] * x.ndim
+    shape[seq_dim] = x.shape[seq_dim]
+    shape[-1] = half
+    cos = jnp.cos(angles).reshape(shape)[..., None]
+    sin = jnp.sin(angles).reshape(shape)[..., None]
+    xp = x.reshape(x.shape[:-1] + (half, 2))
+    a, b = xp[..., :1], xp[..., 1:]
+    rotated = jnp.concatenate([a * cos - b * sin, a * sin + b * cos],
+                              axis=-1)
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+def _rope_half_pairing(x, positions, base: float = 10000.0,
+                       seq_dim: int = -2):
+    """The pre-round-3 [even half | odd half] pairing — kept as the
+    reference the rope-pairing migration test checks against."""
     d = x.shape[-1]
     half = d // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
@@ -170,6 +199,11 @@ def migrate_params(params, n_heads: int):
     layout is detected per-module, so already-migrated trees pass through
     unchanged.  ``n_heads`` must match the model's head count (the fused
     kernels are stored head-major).
+
+    Round-1/2 checkpoints were also trained under the old rope pairing:
+    after this structural conversion, apply
+    :func:`migrate_rope_pairing` once to reproduce their function under
+    the round-3 adjacent-pair rope exactly.
     """
     if "params" in params and isinstance(params["params"], dict):
         return {**params, "params": migrate_params(params["params"],
@@ -205,6 +239,57 @@ def migrate_params(params, n_heads: int):
             out[key] = migrate_params(val, n_heads)
         else:
             out[key] = val
+    return out
+
+
+def migrate_rope_pairing(params, n_heads: int):
+    """Convert a checkpoint trained under the pre-round-3 rope pairing
+    ([even half | odd half]) to the adjacent-pair formulation, EXACTLY:
+    the pairings differ by a fixed permutation P of the q/k projections'
+    head_dim axis (``new_rope(P x) = P old_rope(x)`` and attention scores
+    are invariant under a shared q/k permutation), so permuting
+    ``qkv_kernel``'s q and k slots reproduces the old model's function to
+    the bit.  v and the output projection are untouched (no rope).
+    Accepts a bare param dict or a ``{"params": ...}`` wrapper.  Apply
+    ONCE per checkpoint (it is its own inverse only for head_dim == 2).
+    """
+    if "params" in params and isinstance(params["params"], dict):
+        return {**params,
+                "params": migrate_rope_pairing(params["params"], n_heads)}
+
+    converted = [0]
+
+    def permute(tree):
+        out = {}
+        for key, val in tree.items():
+            if isinstance(val, dict) and "qkv_kernel" in val:
+                w = val["qkv_kernel"]  # (d, 3, heads, head_dim)
+                if w.shape[-2] != n_heads:
+                    raise ValueError(
+                        f"qkv_kernel has {w.shape[-2]} heads, caller said "
+                        f"n_heads={n_heads}")
+                head_dim = w.shape[-1]
+                half = head_dim // 2
+                # new output 2i <- old i ; 2i+1 <- old i+half.
+                idx = jnp.stack([jnp.arange(half),
+                                 jnp.arange(half) + half],
+                                axis=1).reshape(-1)
+                qk = w[:, :2, :, :][..., idx]
+                out[key] = {**val,
+                            "qkv_kernel": jnp.concatenate(
+                                [qk, w[:, 2:, :, :]], axis=1)}
+                converted[0] += 1
+            elif isinstance(val, dict):
+                out[key] = permute(val)
+            else:
+                out[key] = val
+        return out
+
+    out = permute(params)
+    if not converted[0]:
+        raise ValueError(
+            "no qkv_kernel found: this tree is still in a legacy layout "
+            "— run migrate_params(...) first, then migrate_rope_pairing")
     return out
 
 

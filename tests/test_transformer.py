@@ -230,3 +230,33 @@ def test_sequence_parallel_fused_ring_matches():
     got = jax.jit(shard_map(fwd, mesh=mesh, in_specs=spec,
                             out_specs=spec, check_vma=False))(tokens)
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_migrate_rope_pairing_exact():
+    """migrate_rope_pairing reproduces the old [even|odd]-half rope
+    model's logits EXACTLY (up to float tolerance) under the round-3
+    adjacent-pair rope: the pairings differ by a fixed q/k head_dim
+    permutation that attention scores are invariant to."""
+    import horovod_tpu.models.transformer as T
+    from horovod_tpu.models.transformer import (_rope_half_pairing,
+                                                migrate_rope_pairing)
+
+    model = _model()
+    tokens = _tokens()
+    params = model.init(jax.random.PRNGKey(7), tokens)["params"]
+
+    # Reference: what the old model (same params, half-pairing rope)
+    # computed.
+    new_rope = T.rope
+    T.rope = _rope_half_pairing
+    try:
+        want = model.apply({"params": params}, tokens)
+    finally:
+        T.rope = new_rope
+
+    migrated = migrate_rope_pairing(params, n_heads=4)
+    got = model.apply({"params": migrated}, tokens)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+    # Param trees stay structurally identical.
+    assert jax.tree_util.tree_structure(migrated) == \
+        jax.tree_util.tree_structure(params)
