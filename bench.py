@@ -390,12 +390,11 @@ def main() -> None:
                    if s == 1024 else
                    f"transformer_seq{s}_tokens_per_sec_per_chip")
             try:
-                # steps=10: keeps the extras' runtime bounded (compile
-                # dominates anyway) so the whole default invocation stays
-                # within driver time budgets.
+                # Full default step count: steps cost ~1s while compile
+                # dominates the extras' runtime, and short windows
+                # under-report by several percent.
                 extras[key] = round(
-                    bench_transformer(seq=s, batch=b, steps=10,
-                                      report=False), 2)
+                    bench_transformer(seq=s, batch=b, report=False), 2)
             except Exception as exc:  # record, don't fail the headline
                 extras[key] = f"error: {exc}"
         record["extra_metrics"] = extras
