@@ -308,13 +308,33 @@ def main() -> None:
     variables = model.init(rng, images, train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
+
+    # Tiny-leaf packing (models/packing.py): the ~420 1-D tensors of a
+    # BN model's train state (scale/bias/mean/var + momentum mirrors)
+    # each pay a ~40 us memory-space-assignment copy per step — 11% of
+    # the r3 ResNet-101 step.  Carrying them as one flat vector removes
+    # all but two of those buffers; numerics pinned float32-tight by
+    # tests/test_models.py::test_packed_train_step_bit_identical.
+    packed = os.environ.get("BENCH_PACKED", "1") != "0"
+    if packed:
+        from horovod_tpu.models.packing import TreePacker
+        p_packer = TreePacker(params)
+        params = p_packer.pack(params)
+        if has_bn := bool(batch_stats):
+            s_packer = TreePacker(batch_stats)
+            batch_stats = s_packer.pack(batch_stats)
+    else:
+        has_bn = bool(batch_stats)
     tx = optax.sgd(0.1, momentum=0.9)
     opt_state = tx.init(params)
 
-    has_bn = bool(batch_stats)
     dropout_rng = jax.random.PRNGKey(2)
 
     def loss_fn(params, batch_stats, images, labels):
+        if packed:
+            params = p_packer.unpack(params)
+            if has_bn:
+                batch_stats = s_packer.unpack(batch_stats)
         variables = {"params": params}
         # Unused rngs are fine in flax; models mixing BN and dropout
         # (inception_v3) need both the rng and the mutable stats.
@@ -325,6 +345,8 @@ def main() -> None:
         out = model.apply(variables, images, train=True, **kwargs)
         logits, new_stats = out if has_bn else (out, batch_stats)
         new_stats = new_stats["batch_stats"] if has_bn else new_stats
+        if packed and has_bn:
+            new_stats = s_packer.pack(new_stats)  # one concatenate
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, labels).mean()
         return loss, new_stats

@@ -13,6 +13,7 @@ import atexit
 import ctypes
 import os
 import threading
+import weakref
 from typing import Optional, Sequence
 
 import numpy as np
@@ -90,6 +91,8 @@ def _load_lib():
         lib.hvd_tpu_result_nbytes.argtypes = [ctypes.c_longlong]
         lib.hvd_tpu_result_dim0.restype = ctypes.c_longlong
         lib.hvd_tpu_result_dim0.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_result_ptr.restype = ctypes.c_void_p
+        lib.hvd_tpu_result_ptr.argtypes = [ctypes.c_longlong]
         lib.hvd_tpu_copy_result.restype = ctypes.c_int
         lib.hvd_tpu_copy_result.argtypes = [
             ctypes.c_longlong, ctypes.c_void_p, ctypes.c_longlong]
@@ -233,6 +236,7 @@ class Handle:
         self._out = out
         self._name = name
         self._finished = False
+        self._finish_lock = threading.Lock()
         # Engine (tick, seq) completion stamp, set by wait(): ops fused in
         # one negotiation cycle share a tick — observability for tests and
         # the timeline (the reference's cycle accounting).
@@ -245,8 +249,15 @@ class Handle:
         return _lib.hvd_tpu_poll(self._raw) != 0
 
     def wait(self) -> np.ndarray:
-        if self._finished:
-            raise ValueError(f"handle for '{self._name}' already waited on")
+        # Atomic test-and-set: with the zero-copy allgather result a
+        # double-wait would register two finalizers releasing the same
+        # engine buffer (use-after-free), not just waste a copy.
+        with self._finish_lock:
+            if self._finished:
+                raise ValueError(
+                    f"handle for '{self._name}' already waited on")
+            self._finished = True
+        release = True
         code = _lib.hvd_tpu_wait(self._raw)
         try:
             if code != ST_OK:
@@ -257,19 +268,35 @@ class Handle:
             self.completion_seq = int(
                 _lib.hvd_tpu_completion_seq(self._raw))
             if self._op == OP_ALLGATHER:
-                nbytes = _lib.hvd_tpu_result_nbytes(self._raw)
+                nbytes = int(_lib.hvd_tpu_result_nbytes(self._raw))
                 dim0 = _lib.hvd_tpu_result_dim0(self._raw)
                 shape = (int(dim0),) + self._in.shape[1:]
-                out = np.empty(shape, dtype=self._in.dtype)
-                assert out.nbytes == nbytes, (out.nbytes, nbytes)
-                if nbytes:
-                    _lib.hvd_tpu_copy_result(
-                        self._raw, out.ctypes.data_as(ctypes.c_void_p), nbytes)
-                return out
+                if not nbytes:
+                    return np.empty(shape, dtype=self._in.dtype)
+                # Zero-copy: view the engine-owned result buffer directly
+                # (the second full copy of the gathered payload the
+                # round-3 host path paid).  The handle — and with it the
+                # buffer — is released when the array is dropped; the
+                # engine never touches a completed handle's buffer again,
+                # and the (leaked) engine keeps released-less handles
+                # valid across shutdown, so the view cannot dangle.
+                itemsize = np.dtype(self._in.dtype).itemsize
+                assert int(np.prod(shape)) * itemsize == nbytes, \
+                    (shape, self._in.dtype, nbytes)
+                ptr = _lib.hvd_tpu_result_ptr(self._raw)
+                view = (ctypes.c_char * nbytes).from_address(ptr)
+                # The finalizer hangs off the ctypes view — the bottom of
+                # every derived ndarray's base chain (numpy collapses
+                # view-of-view bases, so an intermediate array could be
+                # collected while slices of it live on).
+                weakref.finalize(view, _lib.hvd_tpu_release, self._raw)
+                release = False
+                return np.frombuffer(view,
+                                     dtype=self._in.dtype).reshape(shape)
             return self._out
         finally:
-            self._finished = True
-            _lib.hvd_tpu_release(self._raw)
+            if release:
+                _lib.hvd_tpu_release(self._raw)
 
 
 def _status_error(code: int, msg: str, name: str) -> Exception:

@@ -137,6 +137,12 @@ int hvd_tpu_copy_result(long long handle, void* dst, long long nbytes) {
   return GlobalEngine()->CopyResult(handle, dst, nbytes) ? 0 : 1;
 }
 
+// Zero-copy view of a completed allgather's engine-owned result; valid
+// until hvd_tpu_release(handle).  NULL while pending or for empty results.
+void* hvd_tpu_result_ptr(long long handle) {
+  return GlobalEngine()->ResultPtr(handle);
+}
+
 void hvd_tpu_release(long long handle) { GlobalEngine()->Release(handle); }
 
 // Timeline hooks for the XLA data plane (jax/eager_mesh.py): plane-side

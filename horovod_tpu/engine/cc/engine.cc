@@ -243,6 +243,13 @@ struct Engine::Coordinator {
   // contract docs/tpu.md promises.  Bounded: cleared past 1024 entries.
   static constexpr double kPoisonWindowSec = 5.0;
   static constexpr int64_t kPoisonDeadlineTicks = 40;  // ~200ms @ 5ms cycle
+  // A straggler first announcing AFTER the window expired still must not
+  // pend forever (its peers consumed their error responses long ago).
+  // The expired tombstone grants a stall-warning-length grace deadline:
+  // any healthy (if skewed) full-count reuse of the name negotiates
+  // normally well within it, and a still-short count at the deadline —
+  // the point where the stall sweep would start warning anyway — gets
+  // the typed error instead of an indefinite pend.
   std::unordered_map<std::string,
                      std::pair<std::string,
                                std::chrono::steady_clock::time_point>>
@@ -552,11 +559,13 @@ int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
   e.enqueued_at = std::chrono::steady_clock::now();
   {
     std::lock_guard<std::mutex> lk(mu_);
+    // Failure paths need no notify: the handle has not been returned to
+    // the caller yet, so no waiter can exist; Wait's predicate check sees
+    // the already-flipped (atomic) code.
     if (loop_exited_.load()) {
       status->error =
           "Horovod-TPU has been shut down; no further collectives can run.";
       status->code.store(ST_ABORTED);
-      handles_cv_.notify_all();
       return handle;
     }
     if (table_.count(name)) {
@@ -566,7 +575,6 @@ int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
                       "' is already in progress; names must be unique per "
                       "outstanding operation.";
       status->code.store(ST_PRECONDITION);
-      handles_cv_.notify_all();
       return handle;
     }
     table_.emplace(name, std::move(e));
@@ -687,7 +695,18 @@ void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
         auto age = std::chrono::steady_clock::now() - poisoned->second.second;
         if (age > std::chrono::duration<double>(
                       Coordinator::kPoisonWindowSec)) {
-          coord_->poisoned.erase(poisoned);  // expired: name usable again
+          // Expired: the name is usable again, but this announcer may be a
+          // very late straggler of the mismatched round whose peers
+          // already consumed their error responses — give it a
+          // stall-warning-length grace deadline instead of letting it
+          // re-pend forever.
+          coord_->poisoned.erase(poisoned);
+          pt.poison_deadline_tick =
+              ticks_done_.load() +
+              std::max<int64_t>(
+                  Coordinator::kPoisonDeadlineTicks,
+                  static_cast<int64_t>(opts_.stall_warning_sec * 1000.0 /
+                                       std::max(opts_.cycle_time_ms, 0.1)));
         } else {
           // Defer: full count before the deadline = consistent retry
           // (negotiates normally); stalled at the deadline = straggler of
@@ -1113,13 +1132,17 @@ void Engine::CompleteEntry(const TableEntry& e, int32_t code,
   // after seeing a non-pending code).  CompleteEntry only runs on the engine
   // thread, in response-execution order, and response lists are broadcast
   // from rank 0 — so the *relative* order of these stamps is identical
-  // across ranks for the same ops.
-  status->completion_seq = completions_.fetch_add(1);
-  status->completion_tick = ticks_done_.load();
-  status->error = error;
-  status->code.store(code);
-  std::lock_guard<std::mutex> lk(handles_mu_);
-  handles_cv_.notify_all();
+  // across ranks for the same ops.  Waking only THIS handle's cv keeps a
+  // group of N outstanding collectives at O(N) wakeups total instead of
+  // the O(N^2) a global notify_all per completion costs.
+  {
+    std::lock_guard<std::mutex> lk(status->mu);
+    status->completion_seq = completions_.fetch_add(1);
+    status->completion_tick = ticks_done_.load();
+    status->error = error;
+    status->code.store(code);
+  }
+  status->cv.notify_all();
 }
 
 // ---------------------------------------------------------------------------
@@ -1388,11 +1411,15 @@ int Engine::Poll(int64_t handle) {
 }
 
 int32_t Engine::Wait(int64_t handle) {
-  std::unique_lock<std::mutex> lk(handles_mu_);
-  auto it = handles_.find(handle);
-  if (it == handles_.end()) return ST_INVALID;
-  auto status = it->second;
-  handles_cv_.wait(lk, [&]() { return status->code.load() != ST_PENDING; });
+  std::shared_ptr<HandleStatus> status;
+  {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    auto it = handles_.find(handle);
+    if (it == handles_.end()) return ST_INVALID;
+    status = it->second;
+  }
+  std::unique_lock<std::mutex> lk(status->mu);
+  status->cv.wait(lk, [&]() { return status->code.load() != ST_PENDING; });
   return status->code.load();
 }
 
@@ -1443,6 +1470,15 @@ bool Engine::CopyResult(int64_t handle, void* dst, int64_t nbytes) {
   if (nbytes != static_cast<int64_t>(status->gathered.size())) return false;
   memcpy(dst, status->gathered.data(), static_cast<size_t>(nbytes));
   return true;
+}
+
+void* Engine::ResultPtr(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end() || it->second->code.load() == ST_PENDING ||
+      it->second->gathered.empty())
+    return nullptr;
+  return it->second->gathered.data();
 }
 
 void Engine::Release(int64_t handle) {

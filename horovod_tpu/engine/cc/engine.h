@@ -57,7 +57,17 @@ struct EngineOptions {
 struct HandleStatus {
   std::atomic<int32_t> code{ST_PENDING};
   std::string error;
-  // Allgather result storage (engine-owned; copied out by the caller).
+  // Per-handle completion signalling: Wait() sleeps on THIS handle's cv,
+  // and CompleteEntry wakes only this handle's waiters.  A single global
+  // cv would make every completion wake every waiter — O(waiters x
+  // completions) wakeups for the 100-collective broadcast groups the TF
+  // binding enqueues (the scale the reference's per-handle
+  // std::promise/future avoided by construction, torch handle manager).
+  std::mutex mu;
+  std::condition_variable cv;
+  // Allgather result storage (engine-owned; exposed to the caller as a
+  // zero-copy view via ResultPtr — the handle stays alive until the view
+  // is dropped).
   std::vector<char> gathered;
   int64_t out_dim0 = 0;
   // Completion order stamps, written by the engine thread before `code`
@@ -120,6 +130,9 @@ class Engine {
   int64_t ResultBytes(int64_t handle);
   int64_t ResultDim0(int64_t handle);
   bool CopyResult(int64_t handle, void* dst, int64_t nbytes);
+  // Zero-copy view of a completed allgather's engine-owned result buffer;
+  // valid until Release(handle).  nullptr while pending/absent.
+  void* ResultPtr(int64_t handle);
   void Release(int64_t handle);
 
   // The engine-owned Chrome-tracing timeline.  Exposed so the XLA data
@@ -185,7 +198,6 @@ class Engine {
   std::unordered_map<std::string, TableEntry> table_;
 
   std::mutex handles_mu_;
-  std::condition_variable handles_cv_;
   std::unordered_map<int64_t, std::shared_ptr<HandleStatus>> handles_;
   std::atomic<int64_t> next_handle_{0};
   std::atomic<int64_t> completions_{0};  // CompleteEntry stamp counter

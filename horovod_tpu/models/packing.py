@@ -1,0 +1,101 @@
+"""TreePacker: carry a pytree's many tiny leaves as ONE flat buffer.
+
+Why: a ResNet-101 train state holds ~420 tiny 1-D float32 tensors (104
+BatchNorm layers x scale/bias/mean/var, plus their optimizer-momentum
+mirrors).  Each is a separate XLA buffer, and every one pays a fixed-cost
+(~40 us on v5e) memory-space-assignment copy per executed step — measured
+11% of the whole ResNet-101 step (docs/benchmarks.md, round-3 profile).
+The reference's analogue of this problem class is its fusion buffer for
+many tiny gradient tensors (/root/reference/horovod/common/operations.cc,
+tensor-fusion); here the fix is at the train-state level: pack the tiny
+leaves into one vector OUTSIDE the step, and unpack INSIDE the jitted step
+with static `jnp.split` — whose transpose is a single `concatenate`, so
+the gradient flows back into one packed cotangent buffer too.  2 buffers
+(vector + its momentum) replace ~400.
+
+Numerics are untouched: unpacking reproduces the exact leaf values (same
+bytes, same dtypes); residual drift vs an unpacked step is only XLA
+choosing different fusions for the two graphs, bounded float32-tight by
+tests/test_models.py::test_packed_train_step_bit_identical.
+
+Usage::
+
+    packer = TreePacker(params)              # layout from an example tree
+    packed = packer.pack(params)             # {"big": (...), "small": vec}
+    tx_state = tx.init(packed)               # optax mirrors the packing
+
+    @jax.jit
+    def step(packed, ...):
+        params = packer.unpack(packed)       # split + reshapes, fuses away
+        ...
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _default_small(leaf) -> bool:
+    """Tiny-leaf predicate: 1-D float32 tensors (BN scale/bias/mean/var,
+    dense biases) are the many-tiny-buffers problem; kernels stay
+    unpacked.  Restricted to float32 so packing is value-exact — a cast
+    through the packed dtype would silently round int/uint leaves (PRNG
+    keys, step counters) and float64 leaves."""
+    return np.ndim(leaf) <= 1 and jnp.asarray(leaf).dtype == jnp.float32
+
+
+class TreePacker:
+    """Reversible (tree) <-> ({"big": tuple, "small": vector}) transform.
+
+    The layout (treedef, which leaves are small, their shapes/dtypes and
+    split offsets) is computed host-side once from an example tree; both
+    :meth:`pack` and :meth:`unpack` are then pure jnp functions usable
+    inside or outside jit.  Only leaves whose dtype already equals the
+    packed dtype are packed (enforced on top of ``small``): a cast
+    through the packed dtype would silently corrupt int/uint/float64
+    leaves, so those always stay in the ``big`` partition.
+    """
+
+    def __init__(self, example_tree, small: Callable = _default_small,
+                 dtype=jnp.float32):
+        leaves, self._treedef = jax.tree_util.tree_flatten(example_tree)
+        self._is_small = [
+            bool(small(l)) and jnp.asarray(l).dtype == jnp.dtype(dtype)
+            for l in leaves
+        ]
+        self._shapes = [np.shape(l) for l in leaves]
+        self._dtypes = [jnp.asarray(l).dtype for l in leaves]
+        self._dtype = dtype
+        sizes = [int(np.prod(s)) if f else 0
+                 for s, f in zip(self._shapes, self._is_small)]
+        self._bounds = list(np.cumsum([s for s, f in
+                                       zip(sizes, self._is_small) if f])[:-1])
+        self.packed_size = sum(sizes)
+        if not any(self._is_small):
+            raise ValueError("no leaves matched the small() predicate; "
+                             "packing would be an identity with overhead")
+
+    def pack(self, tree):
+        """tree -> {"big": tuple(big leaves), "small": 1-D vector}."""
+        leaves = self._treedef.flatten_up_to(tree)
+        small = [jnp.ravel(l) for l, f in zip(leaves, self._is_small) if f]
+        big = tuple(l for l, f in zip(leaves, self._is_small) if not f)
+        return {"big": big, "small": jnp.concatenate(small)}
+
+    def unpack(self, packed):
+        """Inverse of :meth:`pack`; inside jit the splits/reshapes fuse
+        into the consumers and the VJP is one concatenate."""
+        pieces = jnp.split(packed["small"], self._bounds)
+        big_it = iter(packed["big"])
+        small_it = iter(pieces)
+        leaves = [
+            (next(small_it).reshape(shape).astype(dt) if f
+             else next(big_it))
+            for f, shape, dt in zip(self._is_small, self._shapes,
+                                    self._dtypes)
+        ]
+        return self._treedef.unflatten(leaves)

@@ -190,3 +190,70 @@ def test_fused_ema_batchnorm_matches_flax_bn():
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
         stats_a, stats_b)
     np.testing.assert_allclose(eval_a, eval_b, rtol=1e-4, atol=1e-5)
+
+
+def test_packed_train_step_bit_identical():
+    """Carrying the tiny 1-D leaves (BN scale/bias/mean/var, biases) as one
+    packed vector (models/packing.py) matches the unpacked train step over
+    several SGD+momentum steps.  Unpacking reproduces the exact leaf
+    values; the only drift is XLA choosing different fusions (reduction
+    reassociation) for the two graphs, so the bound is float32-tight
+    (1e-6) rather than bitwise."""
+    import optax
+
+    from horovod_tpu.models import ResNet18, ema_batch_stats
+    from horovod_tpu.models.packing import TreePacker
+
+    model = ResNet18(num_classes=10, dtype=jnp.float32, small_inputs=True,
+                     fused_ema=True)
+    images = jnp.asarray(
+        np.random.RandomState(0).rand(4, 32, 32, 3), jnp.float32)
+    labels = jnp.asarray([0, 1, 2, 3], jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), images, train=False)
+    params0, stats0 = variables["params"], variables["batch_stats"]
+
+    def run(packed):
+        params, stats = params0, stats0
+        if packed:
+            p_packer = TreePacker(params)
+            s_packer = TreePacker(stats)
+            params, stats = p_packer.pack(params), s_packer.pack(stats)
+        tx = optax.sgd(0.1, momentum=0.9)
+        opt_state = tx.init(params)
+
+        def loss_fn(p, stats):
+            tree_p = p_packer.unpack(p) if packed else p
+            tree_s = s_packer.unpack(stats) if packed else stats
+            logits, upd = model.apply(
+                {"params": tree_p, "batch_stats": tree_s}, images,
+                train=True, mutable=["batch_stats"])
+            new_stats = upd["batch_stats"]
+            if packed:
+                new_stats = s_packer.pack(new_stats)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            return loss, new_stats
+
+        @jax.jit
+        def step(params, stats, opt_state):
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, stats)
+            new_stats = ema_batch_stats(stats, new_stats, 0.9)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_stats, \
+                opt_state, loss
+
+        for _ in range(3):
+            params, stats, opt_state, loss = step(params, stats, opt_state)
+        if packed:
+            params, stats = p_packer.unpack(params), s_packer.unpack(stats)
+        return loss, params, stats
+
+    loss_a, params_a, stats_a = run(False)
+    loss_b, params_b, stats_b = run(True)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
+    for tree_a, tree_b in ((params_a, params_b), (stats_a, stats_b)):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            tree_a, tree_b)

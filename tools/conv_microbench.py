@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Microbenchmark each distinct ResNet-101 conv (fwd, bwd-data, bwd-filter).
+
+Times XLA's lowering of every conv shape in the headline model at the
+benchmark batch size and reports achieved TFLOP/s vs the chip's practical
+matmul peak — the shape-by-shape evidence behind conv-optimisation
+decisions (docs/benchmarks.md round-4 log).
+
+Usage: python tools/conv_microbench.py [--batch 64] [--iters 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# (name, H, Cin, Cout, k, stride, count) — ResNet-101 v1.5 @224, after the
+# space-to-depth stem.  count = occurrences in the network.
+SHAPES = [
+    ("stem 4x4x12->64 /1@112", 112, 12, 64, 4, 1, 1),
+    ("s1 1x1 64->64", 56, 64, 64, 1, 1, 2),
+    ("s1 1x1 256->64", 56, 256, 64, 1, 1, 2),
+    ("s1 3x3 64->64", 56, 64, 64, 3, 1, 3),
+    ("s1 1x1 64->256", 56, 64, 256, 1, 1, 3),
+    ("s1 proj 1x1 64->256", 56, 64, 256, 1, 1, 1),
+    ("s2 1x1 256->128", 56, 256, 128, 1, 1, 1),
+    ("s2 3x3 128->128 /2", 56, 128, 128, 3, 2, 1),
+    ("s2 1x1 512->128", 28, 512, 128, 1, 1, 3),
+    ("s2 3x3 128->128", 28, 128, 128, 3, 1, 3),
+    ("s2 1x1 128->512", 28, 128, 512, 1, 1, 4),
+    ("s2 proj 1x1 256->512 /2", 56, 256, 512, 1, 2, 1),
+    ("s3 1x1 512->256", 28, 512, 256, 1, 1, 1),
+    ("s3 3x3 256->256 /2", 28, 256, 256, 3, 2, 1),
+    ("s3 1x1 1024->256", 14, 1024, 256, 1, 1, 22),
+    ("s3 3x3 256->256", 14, 256, 256, 3, 1, 22),
+    ("s3 1x1 256->1024", 14, 256, 1024, 1, 1, 23),
+    ("s3 proj 1x1 512->1024 /2", 28, 512, 1024, 1, 2, 1),
+    ("s4 1x1 1024->512", 14, 1024, 512, 1, 1, 1),
+    ("s4 3x3 512->512 /2", 14, 512, 512, 3, 2, 1),
+    ("s4 1x1 2048->512", 7, 2048, 512, 1, 1, 2),
+    ("s4 3x3 512->512", 7, 512, 512, 3, 1, 2),
+    ("s4 1x1 512->2048", 7, 512, 2048, 1, 1, 3),
+    ("s4 proj 1x1 1024->2048 /2", 14, 1024, 2048, 1, 2, 1),
+]
+
+DN = ("NHWC", "HWIO", "NHWC")
+
+
+def timed(fn, *args, iters):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    # One scalar fetch drains the chain (tunnel-safe, the bench.py pattern).
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jnp.sum(leaf.astype(jnp.float32)))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--peak", type=float, default=116.0,
+                    help="practical bf16 TFLOP/s of this chip")
+    args = ap.parse_args()
+    B = args.batch
+
+    total = {"fwd": 0.0, "dx": 0.0, "dw": 0.0}
+    ideal = {"fwd": 0.0, "dx": 0.0, "dw": 0.0}
+    print(f"{'shape':<28}{'dir':>5}{'ms':>9}{'TF/s':>8}{'%peak':>7}")
+    for name, H, cin, cout, k, stride, count in SHAPES:
+        Ho = H // stride
+        x = jnp.asarray(np.random.RandomState(0).randn(B, H, H, cin),
+                        jnp.bfloat16)
+        w = jnp.asarray(np.random.RandomState(1).randn(k, k, cin, cout),
+                        jnp.bfloat16)
+        pad = "SAME"
+
+        @jax.jit
+        def fwd(x, w):
+            return lax.conv_general_dilated(x, w, (stride, stride), pad,
+                                            dimension_numbers=DN)
+
+        def loss(x, w):
+            return jnp.sum(fwd(x, w).astype(jnp.float32))
+
+        dx_fn = jax.jit(jax.grad(loss, argnums=0))
+        dw_fn = jax.jit(jax.grad(loss, argnums=1))
+
+        flops = 2 * B * Ho * Ho * k * k * cin * cout
+        for tag, fn in (("fwd", fwd), ("dx", dx_fn), ("dw", dw_fn)):
+            dt = timed(fn, x, w, iters=args.iters)
+            tf = flops / dt / 1e12
+            total[tag] += dt * count * 1e3
+            ideal[tag] += flops * count / (args.peak * 1e12) * 1e3
+            print(f"{name:<28}{tag:>5}{dt * 1e3:>9.3f}{tf:>8.1f}"
+                  f"{100 * tf / args.peak:>6.1f}%")
+    print("\nnetwork totals (shape x count), ms and vs practical peak:")
+    for tag in ("fwd", "dx", "dw"):
+        print(f"  {tag}: {total[tag]:8.2f} ms   ideal {ideal[tag]:6.2f} ms "
+              f" -> {100 * ideal[tag] / max(total[tag], 1e-9):.0f}% eff")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Profile one benchmark training step and print a device-time breakdown.
+
+Captures a jax.profiler trace of a few steps of the same train step
+bench.py measures, parses the XLA ``.xplane.pb`` with TensorFlow's
+bundled xplane proto, and aggregates device busy-time by op category —
+the tool behind the "where the step actually goes" tables in
+docs/benchmarks.md.
+
+Usage:  python tools/profile_step.py [trace_dir]
+Env:    same BENCH_* knobs as bench.py (BENCH_MODEL, BENCH_BATCH, ...).
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(trace_dir: str, steps: int = 5) -> None:
+    os.environ.setdefault("BENCH_STEPS", str(steps))
+    os.environ.setdefault("BENCH_WARMUP", "3")
+    os.environ.setdefault("BENCH_EXTRA", "0")
+    import jax
+
+    import bench
+
+    # Warm up/compile outside the trace by running main once, then trace a
+    # second, short run (cached executable).
+    bench.main()
+    with jax.profiler.trace(trace_dir):
+        bench.main()
+
+
+def load_xplanes(trace_dir: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = [p for pat in ("*.xplane.pb", "*.xplane.pb.gz")
+             for p in glob.glob(os.path.join(trace_dir, "**", pat),
+                                recursive=True)]
+    if not paths:
+        raise SystemExit(f"no .xplane.pb under {trace_dir}")
+    path = max(paths, key=os.path.getmtime)
+    data = open(path, "rb").read()
+    if path.endswith(".gz"):
+        data = gzip.decompress(data)
+    space = xplane_pb2.XSpace()
+    space.ParseFromString(data)
+    return space
+
+
+CATEGORIES = [
+    ("conv", re.compile(r"convolution|conv[.\d]|cudnn", re.I)),
+    ("matmul", re.compile(r"dot|einsum|gemm", re.I)),
+    ("copy", re.compile(r"copy", re.I)),
+    ("select-and-scatter", re.compile(r"select-and-scatter", re.I)),
+    ("reduce-window", re.compile(r"reduce-window", re.I)),
+    ("allreduce/collective", re.compile(r"all-reduce|collective|psum", re.I)),
+    ("fusion/elementwise", re.compile(r"fusion|loop_|input_|wrapped", re.I)),
+    ("reduce", re.compile(r"reduce", re.I)),
+    ("transpose/reshape", re.compile(r"transpose|reshape|bitcast", re.I)),
+]
+
+
+def categorize(name: str) -> str:
+    for cat, pat in CATEGORIES:
+        if pat.search(name):
+            return cat
+    return "other"
+
+
+def main() -> None:
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/hvd_tpu_trace"
+    if not glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb",),
+                     recursive=True):
+        capture(trace_dir)
+    space = load_xplanes(trace_dir)
+
+    for plane in space.planes:
+        # Device planes only (TPU/GPU/accelerator op streams).
+        if not ("TPU" in plane.name or "GPU" in plane.name
+                or "/device:" in plane.name):
+            continue
+        sm = {k: v.name for k, v in plane.stat_metadata.items()}
+        ev_names, ev_cats, ev_flops = {}, {}, {}
+        for k, v in plane.event_metadata.items():
+            ev_names[k] = v.display_name or v.name
+            for s in v.stats:
+                stat = sm.get(s.metadata_id)
+                if stat == "hlo_category":
+                    ev_cats[k] = s.str_value
+                elif stat == "flops":
+                    ev_flops[k] = s.uint64_value
+        by_cat = collections.Counter()
+        by_name = collections.Counter()
+        n_events = collections.Counter()
+        flops_total = 0
+        total = 0
+        for line in plane.lines:
+            # Steps/XLA Modules lines re-cover the same device time the
+            # per-op line itemizes; count only the op events.
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                name = ev_names.get(ev.metadata_id, "?")
+                # The profiler's own hlo_category (convolution, loop
+                # fusion, copy, ...) beats name-regex guessing.
+                cat = ev_cats.get(ev.metadata_id) or categorize(name)
+                dur = ev.duration_ps / 1e6  # -> us
+                total += dur
+                by_cat[cat] += dur
+                by_name[name] += dur
+                n_events[name] += 1
+                flops_total += ev_flops.get(ev.metadata_id, 0)
+        if not total:
+            continue
+        print(f"\n=== {plane.name}  (total device busy "
+              f"{total / 1e3:.2f} ms over trace, "
+              f"{flops_total / max(total, 1) / 1e6:.1f} sustained "
+              f"TFLOP/s) ===")
+        print(f"{'category':<24}{'ms':>10}{'%':>7}")
+        for cat, us in by_cat.most_common():
+            print(f"{cat:<24}{us / 1e3:>10.2f}{100 * us / total:>6.1f}%")
+        print("\ntop ops:")
+        print(f"{'op':<56}{'ms':>9}{'n':>6}{'us/call':>9}")
+        for name, us in by_name.most_common(25):
+            n = n_events[name]
+            print(f"{name[:55]:<56}{us / 1e3:>9.2f}{n:>6}{us / n:>9.1f}")
+
+
+if __name__ == "__main__":
+    main()
