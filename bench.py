@@ -54,11 +54,16 @@ def bench_transformer(seq: int = None, batch: int = None,
         steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
+    # bf16 logits STORAGE (f32 accumulation and f32 loss internals): the
+    # logits tensor dominates the step's HBM traffic; see TransformerLM.
+    logits_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_LOGITS_DTYPE", "bfloat16")]
     model = TransformerLM(
         vocab_size=vocab,
         d_model=int(os.environ.get("BENCH_D_MODEL", "512")),
         n_layers=int(os.environ.get("BENCH_LAYERS", "8")),
-        n_heads=int(os.environ.get("BENCH_HEADS", "8")))
+        n_heads=int(os.environ.get("BENCH_HEADS", "8")),
+        logits_dtype=logits_dtype)
 
     tokens = jnp.asarray(
         np.random.RandomState(0).randint(0, vocab, (batch, seq + 1)))

@@ -134,24 +134,34 @@ def init(comm: Optional[Sequence[int]] = None) -> None:
             "engine initialization failed: "
             + lib.hvd_tpu_init_error().decode())
     _process_set = ps
-    if cfg.xla_data_plane:
+    # XLA data plane selection.  Like the reference's NCCL path — which
+    # auto-selected whenever NCCL was compiled in, no runtime flag
+    # (/root/reference/horovod/common/operations.cc:861-914) — the plane
+    # is AUTO-enabled when jax reports TPU devices; HVD_TPU_XLA_DATA_PLANE
+    # (or HOROVOD_XLA_DATA_PLANE) forces it on (=1) or off (=0).
+    auto = cfg.xla_data_plane is None
+    enable = _tpu_visible() if auto else cfg.xla_data_plane
+    if enable or auto:
         global _xla_plane
         plane = None
-        try:
-            from horovod_tpu.jax import eager_mesh
+        if enable:
+            try:
+                from horovod_tpu.jax import eager_mesh
 
-            plane = eager_mesh.initialize(ps)
-        except ImportError as exc:
-            import warnings
+                plane = eager_mesh.initialize(ps)
+            except ImportError as exc:
+                import warnings
 
-            warnings.warn(
-                f"HVD_TPU_XLA_DATA_PLANE=1 but jax is unavailable ({exc}); "
-                "eager collectives will use the TCP engine.")
+                warnings.warn(
+                    f"XLA data plane requested but jax is unavailable "
+                    f"({exc}); eager collectives will use the TCP engine.")
         if ps.size > 1:
             # Job-wide agreement over the TCP engine (_xla_plane is still
             # None, so this allreduce cannot ride the plane): a rank whose
-            # plane init failed must not diverge from ranks whose
-            # succeeded, or the job deadlocks across two transports.
+            # plane init failed — or, in auto mode, that saw no TPU —
+            # must not diverge from ranks that enabled the plane, or the
+            # job deadlocks across two transports.  Auto mode therefore
+            # always votes, even with a local "no".
             total = allreduce(np.asarray(1 if plane else 0, np.int32),
                               average=False, name="__xla_plane_agreement__")
             if int(total) != ps.size:
@@ -165,6 +175,18 @@ def init(comm: Optional[Sequence[int]] = None) -> None:
                 plane = None
         _xla_plane = plane
     atexit.register(shutdown)
+
+
+def _tpu_visible() -> bool:
+    """True when jax is importable and reports at least one TPU device —
+    the auto-enable predicate for the XLA data plane.  Conservative: any
+    failure (no jax, no backend, no devices) means 'no'."""
+    try:
+        import jax
+
+        return any(d.platform == "tpu" for d in jax.devices())
+    except Exception:
+        return False
 
 
 def shutdown() -> None:

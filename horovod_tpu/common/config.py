@@ -40,12 +40,15 @@ class Config:
     # rank layout).  The engine analogue of the reference's
     # HOROVOD_HIERARCHICAL_ALLREDUCE (operations.cc:1003-1048).
     hierarchical_allreduce: bool = False
-    # Execute eager allreduce/broadcast as compiled XLA collectives over the
+    # Execute eager collectives as compiled XLA collectives over the
     # accelerator fabric (jax.distributed across the job) instead of the TCP
     # ring — the TPU mapping of the reference's NCCL data plane
-    # (operations.cc:861-1100).  Allgather and unsupported dtypes stay on
-    # the TCP engine.
-    xla_data_plane: bool = False
+    # (operations.cc:861-1100).  Tri-state, like the reference's NCCL path
+    # which needed no runtime flag once compiled in (operations.cc:861-914):
+    # None (env unset) = AUTO — enable when jax reports TPU devices;
+    # True = forced on; False ("0"/"false"/"off") = explicit opt-out.
+    # Unsupported dtypes stay on the TCP engine either way.
+    xla_data_plane: Optional[bool] = None
 
     @staticmethod
     def from_env() -> "Config":
@@ -61,5 +64,7 @@ class Config:
             hierarchical_allreduce=_flag(
                 _get("HVD_TPU_HIERARCHICAL_ALLREDUCE",
                      "HOROVOD_HIERARCHICAL_ALLREDUCE")),
-            xla_data_plane=_flag(os.environ.get("HVD_TPU_XLA_DATA_PLANE")),
+            xla_data_plane=(None if (plane := _get(
+                "HVD_TPU_XLA_DATA_PLANE", "HOROVOD_XLA_DATA_PLANE")) is None
+                else _flag(plane)),
         )

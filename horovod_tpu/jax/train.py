@@ -18,9 +18,21 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:  # jax >= 0.6 exposes shard_map at top level
-    shard_map = jax.shard_map
+    _shard_map = jax.shard_map
 except AttributeError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map  # type: ignore
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore  # noqa: E501
+
+
+def shard_map(fn, mesh, in_specs, out_specs, check_vma=True):
+    """shard_map across jax versions: the replication-check kwarg was
+    renamed check_rep -> check_vma; translate for the min-supported jax
+    (CI min-versions leg)."""
+    import inspect
+
+    kw = ("check_vma" if "check_vma"
+          in inspect.signature(_shard_map).parameters else "check_rep")
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{kw: check_vma})
 
 from horovod_tpu.jax import DistributedOptimizer
 

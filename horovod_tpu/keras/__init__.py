@@ -129,6 +129,14 @@ def broadcast_global_variables(root_rank: int = 0, model=None) -> None:
     variables = list(model.weights)
     opt = getattr(model, "optimizer", None)
     if opt is not None:
+        # Build slot variables BEFORE collecting: on resume-from-
+        # checkpoint the root's loaded optimizer (hvd.load_model) already
+        # has momentum slots while fresh ranks would lazily build them on
+        # the first batch — a divergent variable set deadlocks the
+        # broadcast group (caught by tests/test_examples.py's keras
+        # resume leg).
+        if not getattr(opt, "built", True):
+            opt.build(model.trainable_variables)
         variables += list(opt.variables)
     # Enqueue all broadcasts, then wait: the set fuses into few engine
     # cycles instead of paying one negotiation cycle per variable.

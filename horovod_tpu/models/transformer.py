@@ -152,6 +152,16 @@ class TransformerLM(nn.Module):
     seq_axis: Optional[str] = None  # mapped mesh axis of sequence shards
     use_flash: bool = True
     ring_impl: str = "ppermute"  # K/V rotation under sequence parallelism
+    # Storage dtype of the returned logits.  The MXU accumulation is
+    # always float32; bfloat16 STORAGE halves the dominant HBM stream of
+    # the LM step (the (batch, seq, vocab) logits tensor and its
+    # cotangent round-trip HBM several times between the head matmul,
+    # the softmax-CE, and the two backward matmuls — and the backward
+    # matmuls consume bf16 operands anyway).  next_token_loss upcasts to
+    # f32 internally, so the only precision loss is one bf16 rounding of
+    # each logit (~0.4% relative); measured +9% tokens/s on v5e
+    # (docs/benchmarks.md round-4 log).
+    logits_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, tokens, targets=None):
@@ -182,7 +192,57 @@ class TransformerLM(nn.Module):
             return fused_next_token_loss(x, w, targets, dtype=self.dtype)
         return jnp.einsum("bsd,dv->bsv", x.astype(self.dtype),
                           w.astype(self.dtype),
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=jnp.float32).astype(
+                              self.logits_dtype)
+
+
+# Param-layout version stamped into checkpoint wrappers by the migrators
+# (and checked by check_layout): 2 = fused qkv/o/lm_head kernels with the
+# legacy [even half | odd half] rope pairing, 3 = round-3 adjacent-pair
+# rope.  An unversioned tree that skips migrate_rope_pairing still loads
+# and runs — computing a silently different function — so loaders should
+# gate on check_layout rather than on users reading docstrings.
+LAYOUT_VERSION = 3
+
+
+def stamp_layout(variables, version: int = LAYOUT_VERSION):
+    """Return ``variables`` (a ``{"params": ...}``-style checkpoint
+    wrapper) with a ``layout`` collection recording the param-layout
+    version.  flax ``Module.apply`` ignores unused collections, so the
+    stamp rides along transparently; serializers persist it."""
+    if "params" not in variables:
+        raise ValueError("stamp_layout expects a {'params': ...} wrapper "
+                         "(the stamp must not live inside the param tree, "
+                         "where optimizers would treat it as a weight)")
+    return {**variables, "layout": {"version": version}}
+
+
+def check_layout(variables, strict: bool = False):
+    """Gate a loaded checkpoint wrapper on its layout stamp.
+
+    Unversioned trees (no ``layout`` collection) predate round 3 and run
+    under the adjacent-pair rope as a silently different function —
+    warn (or raise with ``strict=True``) and point at the migrators.
+    Returns ``variables`` unchanged so this can wrap a load expression.
+    """
+    version = variables.get("layout", {}).get("version")
+    version = None if version is None else int(version)
+    if version == LAYOUT_VERSION:
+        return variables
+    msg = (
+        "TransformerLM checkpoint has no current layout stamp "
+        f"(found version {version}, current {LAYOUT_VERSION}): trees "
+        "saved before round 3 use the legacy rope pairing and will "
+        "compute a DIFFERENT function if applied unmigrated.  Run "
+        "models.transformer.migrate_params(...) (structure) and "
+        "migrate_rope_pairing(...) (rope) once; both stamp the result."
+    )
+    if strict:
+        raise ValueError(msg)
+    import warnings
+
+    warnings.warn(msg)
+    return variables
 
 
 def migrate_params(params, n_heads: int):
@@ -206,8 +266,11 @@ def migrate_params(params, n_heads: int):
     the round-3 adjacent-pair rope exactly.
     """
     if "params" in params and isinstance(params["params"], dict):
-        return {**params, "params": migrate_params(params["params"],
-                                                   n_heads)}
+        # Structure migrated but rope still legacy: version 2 (the rope
+        # migrator upgrades the stamp to LAYOUT_VERSION).
+        return stamp_layout(
+            {**params, "params": migrate_params(params["params"], n_heads)},
+            version=2)
 
     def fuse_attention(attn):
         if "qkv" in attn:  # interim fused (d, 3d) Dense
@@ -254,8 +317,9 @@ def migrate_rope_pairing(params, n_heads: int):
     ONCE per checkpoint (it is its own inverse only for head_dim == 2).
     """
     if "params" in params and isinstance(params["params"], dict):
-        return {**params,
-                "params": migrate_rope_pairing(params["params"], n_heads)}
+        return stamp_layout(
+            {**params,
+             "params": migrate_rope_pairing(params["params"], n_heads)})
 
     converted = [0]
 
@@ -347,7 +411,10 @@ def next_token_loss(logits, targets, mask=None, axis_name=None):
     """
     import optax
 
-    loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    # f32 internals regardless of logits storage dtype (bf16-stored
+    # logits ride a convert that XLA fuses into the reductions).
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets)
     if mask is None:
         return loss.mean()
     mask = mask.astype(loss.dtype)

@@ -254,37 +254,59 @@ def _st(ref, val):
 
 
 def _attend_block(q_ref, k_ref, v_ref, m_scratch, l_scratch, acc_scratch,
-                  q_start, k_start, sm_scale, causal, block_q, block_k):
+                  q_start, k_start, causal, block_q, block_k,
+                  single_k=False):
     """One online-softmax block update of the VMEM (m, l, acc) state.
 
     Shared by the single-shard flash kernel and the fused ring-flash step
     (ops/ring_flash.py) — the only difference between them is where
     ``q_start``/``k_start`` come from (grid position vs scalar-prefetched
-    absolute shard offsets)."""
-    q = _rd(q_ref)  # (block_q, d)
+    absolute shard offsets).
+
+    VPU economy (the kernel is elementwise-bound at head_dim 64 — the MXU
+    finishes each block's two dots in ~1/3 of the time the softmax passes
+    take): ``q`` arrives PRE-SCALED by sm_scale (one (seq, d) pass at the
+    wrapper instead of a (seq, seq) pass here); fully-masked rows are
+    neutralized by clamping the softmax reference ``m_safe`` per ROW
+    (block_q elements) instead of a second (block_q, block_k) ``where``
+    on p — masked elements already underflow via exp(NEG_INF - m_safe);
+    and ``single_k=True`` (one key block, the tuned whole-k layout) skips
+    the online-rescale multiplies entirely."""
+    q = _rd(q_ref)  # (block_q, d), pre-scaled by sm_scale
     k = _rd(k_ref)  # (block_k, d)
     v = _rd(v_ref)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * sm_scale
+        preferred_element_type=jnp.float32)
     if causal:
         q_pos = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_pos = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    m_prev = m_scratch[:, 0]
-    m_new = jnp.maximum(m_prev, s.max(axis=-1))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new[:, None])
-    if causal:
-        p = jnp.where(q_pos >= k_pos, p, 0.0)
-    l_new = l_scratch[:, 0] * alpha + p.sum(axis=-1)
-    acc_scratch[...] = (
-        acc_scratch[...] * alpha[:, None]
-        + jax.lax.dot_general(
+    if single_k:
+        m_new = s.max(axis=-1)
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])
+        l_new = p.sum(axis=-1)
+        acc_scratch[...] = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32))
+            preferred_element_type=jnp.float32)
+    else:
+        m_prev = m_scratch[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        # m_safe keeps fully-masked rows at zero mass: exp(NEG_INF - 0)
+        # underflows to 0 for every element AND for alpha (m_prev is
+        # NEG_INF too), so no (block_q, block_k) re-mask of p is needed.
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        alpha = jnp.exp(m_prev - m_safe)
+        p = jnp.exp(s - m_safe[:, None])
+        l_new = l_scratch[:, 0] * alpha + p.sum(axis=-1)
+        acc_scratch[...] = (
+            acc_scratch[...] * alpha[:, None]
+            + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
     m_scratch[...] = jnp.broadcast_to(m_new[:, None], m_scratch.shape)
     l_scratch[...] = jnp.broadcast_to(l_new[:, None], l_scratch.shape)
 
@@ -308,14 +330,15 @@ def _finalize_flash(o_ref, lse_ref, m_scratch, l_scratch, acc_scratch,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
-                  acc_scratch, *, sm_scale, causal, block_q, block_k,
-                  num_k_blocks):
+                  acc_scratch, *, causal, block_q, block_k, num_k_blocks):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    single_k = num_k_blocks == 1
 
-    @pl.when(ki == 0)
-    def _():
-        _init_state(m_scratch, l_scratch, acc_scratch)
+    if not single_k:
+        @pl.when(ki == 0)
+        def _():
+            _init_state(m_scratch, l_scratch, acc_scratch)
 
     q_start = qi * block_q
     k_start = ki * block_k
@@ -325,8 +348,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
     @pl.when(run)
     def _():
         _attend_block(q_ref, k_ref, v_ref, m_scratch, l_scratch,
-                      acc_scratch, q_start, k_start, sm_scale, causal,
-                      block_q, block_k)
+                      acc_scratch, q_start, k_start, causal,
+                      block_q, block_k, single_k=single_k)
 
     @pl.when(ki == num_k_blocks - 1)
     def _():
@@ -336,7 +359,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
 
 def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                            dk_ref, dv_ref, dk_scratch, dv_scratch, *,
-                           sm_scale, causal, block_q, block_k, num_q_blocks):
+                           causal, block_q, block_k, num_q_blocks):
     ki = pl.program_id(1)
     qi = pl.program_id(2)  # innermost: accumulates over query blocks
 
@@ -351,15 +374,18 @@ def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
     @pl.when(run)
     def _():
-        q = _rd(q_ref)          # (block_q, d)
+        q = _rd(q_ref)          # (block_q, d), pre-scaled by sm_scale
         do = _rd(do_ref)        # (block_q, d)
         lse = _rd(lse_ref)[0]   # (block_q,)
         delta = _rd(delta_ref)[0]
         k = _rd(k_ref)          # (block_k, d)
         v = _rd(v_ref)
+        # q pre-scaled: s matches the forward's pre-activation, ds needs
+        # no *sm_scale pass, and dk = ds^T q' is exact as-is (the scale
+        # belongs to q's branch; the wrapper rescales dq once).
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
+            preferred_element_type=jnp.float32)
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -374,7 +400,7 @@ def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * sm_scale).astype(q.dtype)
+        ds = (p * (dp - delta[:, None])).astype(q.dtype)
         dk_scratch[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -386,7 +412,7 @@ def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
 
 def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                         dq_ref, dq_scratch, *, sm_scale, causal, block_q,
+                         dq_ref, dq_scratch, *, causal, block_q,
                          block_k, num_k_blocks):
     qi = pl.program_id(1)
     ki = pl.program_id(2)  # innermost: accumulates over key blocks
@@ -409,7 +435,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         v = _rd(v_ref)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
+            preferred_element_type=jnp.float32)
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -420,7 +446,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * sm_scale).astype(q.dtype)
+        ds = (p * (dp - delta[:, None])).astype(q.dtype)
         dq_scratch[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -428,6 +454,226 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     @pl.when(ki == num_k_blocks - 1)
     def _():
         _st(dq_ref, dq_scratch[...])
+
+
+def _combined_bwd_kernel(*refs, causal, block_q, block_k, num_q_blocks,
+                         num_k_blocks, bh, rotate, barrier, axis_name,
+                         mesh_axes):
+    """Flash backward with dk/dv AND dq from ONE probability recompute.
+
+    Grid: (bh, ki, qi) — queries innermost so dk/dv accumulate in scratch
+    and flush per key block; dq accumulates in a whole-sequence VMEM
+    scratch and flushes once per bh row.  The split dkdv/dq kernel pair
+    pays the s/p/dp/ds recompute twice; sharing it here nearly halves the
+    backward's kernel time (measured on v5e, docs/benchmarks.md r4).
+
+    With ``rotate=True`` this is the fused ring-flash backward step
+    (ops/ring_flash.py): the K/V rotation DMA to the right neighbour
+    starts at the first grid step, flies under the gradient compute, and
+    is waited at the last.  ``offsets_ref`` carries the absolute
+    [q_offset, k_offset] for causal masking across shards (zeros for the
+    single-shard case).  ``q`` arrives pre-scaled by sm_scale; dq is
+    emitted in q' units (callers rescale once).
+    """
+    if rotate:
+        (offsets_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+         k_full, v_full, dk_ref, dv_ref, dq_ref, k_next, v_next,
+         dk_scratch, dv_scratch, dq_scratch, sems) = refs
+    else:
+        (offsets_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+         dk_ref, dv_ref, dq_ref,
+         dk_scratch, dv_scratch, dq_scratch) = refs
+    b = pl.program_id(0)
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    if rotate:
+        from horovod_tpu.ops.rdma import _device_id
+
+        my = jax.lax.axis_index(axis_name)
+        n = jax.lax.axis_size(axis_name)
+        dst, id_type = _device_id(jax.lax.rem(my + 1, n), axis_name,
+                                  mesh_axes)
+        src, _ = _device_id(jax.lax.rem(my - 1 + n, n), axis_name,
+                            mesh_axes)
+
+        @pl.when((b == 0) & (ki == 0) & (qi == 0))
+        def _start_rotation():
+            if barrier:
+                bar = pltpu.get_barrier_semaphore()
+                pltpu.semaphore_signal(
+                    bar, inc=1, device_id=src, device_id_type=id_type)
+                pltpu.semaphore_wait(bar, 1)
+            pltpu.make_async_remote_copy(
+                src_ref=k_full, dst_ref=k_next, send_sem=sems.at[0],
+                recv_sem=sems.at[1], device_id=dst,
+                device_id_type=id_type).start()
+            pltpu.make_async_remote_copy(
+                src_ref=v_full, dst_ref=v_next, send_sem=sems.at[2],
+                recv_sem=sems.at[3], device_id=dst,
+                device_id_type=id_type).start()
+
+    @pl.when((ki == 0) & (qi == 0))
+    def _zero_dq():
+        dq_scratch[...] = jnp.zeros_like(dq_scratch)
+
+    @pl.when(qi == 0)
+    def _zero_dkdv():
+        dk_scratch[...] = jnp.zeros_like(dk_scratch)
+        dv_scratch[...] = jnp.zeros_like(dv_scratch)
+
+    if causal:
+        q_start = offsets_ref[0] + qi * block_q  # absolute positions
+        k_start = offsets_ref[1] + ki * block_k
+        run = q_start + block_q - 1 >= k_start
+    else:
+        q_start = k_start = 0
+        run = True
+
+    @pl.when(run)
+    def _():
+        q = _rd(q_ref)          # (block_q, d), pre-scaled by sm_scale
+        do = _rd(do_ref)        # (block_q, d)
+        lse = _rd(lse_ref)[0]   # (block_q,)
+        delta = _rd(delta_ref)[0]
+        k = _rd(k_ref)          # (block_k, d)
+        v = _rd(v_ref)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # POS_BIG lse zeroes masked rows
+        dv_scratch[...] += jax.lax.dot_general(
+            p.astype(v.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None])).astype(q.dtype)
+        dk_scratch[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        row = pl.ds(qi * block_q, block_q)
+        dq_scratch[row, :] = dq_scratch[row, :] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _flush_dkdv():
+        dk_ref[...] = dk_scratch[...].reshape(dk_ref.shape)
+        dv_ref[...] = dv_scratch[...].reshape(dv_ref.shape)
+
+    @pl.when((ki == num_k_blocks - 1) & (qi == num_q_blocks - 1))
+    def _flush_dq():
+        dq_ref[...] = dq_scratch[...].reshape(dq_ref.shape)
+
+    if rotate:
+        @pl.when((b == bh - 1) & (ki == num_k_blocks - 1)
+                 & (qi == num_q_blocks - 1))
+        def _finish_rotation():
+            pltpu.make_async_remote_copy(
+                src_ref=k_full, dst_ref=k_next, send_sem=sems.at[0],
+                recv_sem=sems.at[1], device_id=dst,
+                device_id_type=id_type).wait()
+            pltpu.make_async_remote_copy(
+                src_ref=v_full, dst_ref=v_next, send_sem=sems.at[2],
+                recv_sem=sems.at[3], device_id=dst,
+                device_id_type=id_type).wait()
+
+
+def _combined_bwd_call(q, do, lse8, delta8, k_cur, v_cur, q_offset,
+                       k_offset, *, causal, block_q, block_k, rotate,
+                       collective_id, axis_name, mesh_axes, interpret):
+    """pallas_call wrapper for `_combined_bwd_kernel` over (bh, sl, d)
+    operands (q pre-scaled).  Returns (dk, dv, dq[, k_next, v_next]) with
+    the gradients in float32."""
+    bh, sl, d = q.shape
+    num_q, num_k = sl // block_q, sl // block_k
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32)])
+
+    kernel = functools.partial(
+        _combined_bwd_kernel, causal=causal, block_q=block_q,
+        block_k=block_k, num_q_blocks=num_q, num_k_blocks=num_k, bh=bh,
+        rotate=rotate, barrier=rotate and not interpret,
+        axis_name=axis_name, mesh_axes=mesh_axes)
+
+    def qspec(row):
+        return pl.BlockSpec((1, block_q, d),
+                            lambda b, ki, qi, s, _r=row: (b, _r(qi, ki), 0))
+
+    def kspec(row):
+        return pl.BlockSpec((1, block_k, d),
+                            lambda b, ki, qi, s, _r=row: (b, _r(qi, ki), 0))
+
+    inner_q = lambda qi, ki: qi  # noqa: E731
+    outer_k = lambda qi, ki: ki  # noqa: E731
+    in_specs = [
+        qspec(inner_q),                                    # q
+        qspec(inner_q),                                    # do
+        pl.BlockSpec((1, 8, block_q), lambda b, ki, qi, s: (b, 0, qi)),
+        pl.BlockSpec((1, 8, block_q), lambda b, ki, qi, s: (b, 0, qi)),
+        kspec(outer_k),                                    # k (blocked)
+        kspec(outer_k),                                    # v (blocked)
+    ]
+    out_shapes = [
+        jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),    # dk
+        jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),    # dv
+        jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),    # dq
+    ]
+    out_specs = [
+        kspec(outer_k),                                    # dk
+        kspec(outer_k),                                    # dv
+        pl.BlockSpec((1, sl, d), lambda b, ki, qi, s: (b, 0, 0)),  # dq
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((block_k, d), jnp.float32),             # dk accumulator
+        pltpu.VMEM((block_k, d), jnp.float32),             # dv accumulator
+        pltpu.VMEM((sl, d), jnp.float32),                  # whole-seq dq
+    ]
+    args = [offsets, q, do, lse8, delta8, k_cur, v_cur]
+    if rotate:
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),             # k (DMA src)
+            pl.BlockSpec(memory_space=pl.ANY),             # v (DMA src)
+        ]
+        out_shapes += [
+            jax.ShapeDtypeStruct(k_cur.shape, k_cur.dtype),  # k_next
+            jax.ShapeDtypeStruct(v_cur.shape, v_cur.dtype),  # v_next
+        ]
+        out_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),             # k_next
+            pl.BlockSpec(memory_space=pl.ANY),             # v_next
+        ]
+        scratch_shapes += [pltpu.SemaphoreType.DMA((4,))]
+        args += [k_cur, v_cur]
+    vma = getattr(jax.typeof(q), "vma", None)
+    if vma is not None:
+        out_shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype, vma=vma)
+                      for s in out_shapes]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, num_k, num_q),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
+    )
+    compiler_params = pltpu.CompilerParams(
+        collective_id=(collective_id if rotate and not interpret
+                       else None),
+        has_side_effects=rotate)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(*args)
 
 
 def _row_spec(block, d):
@@ -459,19 +705,26 @@ def _pick_block(seq_len: int, maximum: int = 512) -> int:
 
 def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
                     block_k, interpret):
-    """Pallas flash backward (Dao et al. alg. 2 as two kernels: dk/dv with
-    queries innermost, dq with keys innermost); probabilities are
-    recomputed from (q, k, lse) so residual memory stays O(seq)."""
+    """Pallas flash backward: ONE combined kernel computes dk/dv and dq
+    from a single probability recompute per block (`_combined_bwd_kernel`
+    — the split dkdv/dq kernel pair paid the s/p/dp/ds recompute twice);
+    residual memory stays O(seq) (Dao et al. alg. 2)."""
     batch, heads, q_len, d = q.shape
     k_len = k.shape[2]
     block_q = min(block_q, q_len)
     block_k = min(block_k, k_len)
+    # The combined kernel keeps the whole per-(batch, head) dq row in
+    # VMEM; beyond ~8 MB (seq 16k at head_dim 128) route to the scan impl.
     if (q_len % block_q or k_len % block_k
-            or block_q % 128 or block_k % 128):
+            or block_q % 128 or block_k % 128 or q_len != k_len
+            or q_len * d * 4 > 8 * 1024 * 1024):
         return _attention_bwd_impl(q, k, v, out, lse, g, causal, sm_scale,
                                    max(block_k, 128), 0, 0)
     bh = batch * heads
-    qr = q.reshape(bh, q_len, d)
+    # Pre-scaled q (see _flash_forward): the kernel drops its two
+    # (seq, seq) sm_scale passes; dq comes back in q' units and is
+    # rescaled once below.
+    qr = (q * sm_scale).astype(q.dtype).reshape(bh, q_len, d)
     kr = k.reshape(bh, k_len, d)
     vr = v.reshape(bh, k_len, d)
     dor = g.reshape(bh, q_len, d)
@@ -482,53 +735,13 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
     delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, q_len))
     lse8 = jnp.broadcast_to(lse.reshape(bh, q_len)[:, None, :],
                             (bh, 8, q_len))
-    num_q = q_len // block_q
-    num_k = k_len // block_k
-    qspec, kspec = _row_spec(block_q, d), _row_spec(block_k, d)
-    kv_shape = jax.ShapeDtypeStruct((bh, k_len, d), k.dtype)
-    q_shape = jax.ShapeDtypeStruct((bh, q_len, d), q.dtype)
-
-    inner = lambda i, j: j  # noqa: E731
-    outer = lambda i, j: i  # noqa: E731
-    row_specs = [
-        qspec(inner), qspec(inner),
-        pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, j)),
-        pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, j)),
-        kspec(outer), kspec(outer),
-    ]
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkdv_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=block_q, block_k=block_k,
-                          num_q_blocks=num_q),
-        grid=(bh, num_k, num_q),
-        in_specs=row_specs,
-        out_specs=(kspec(outer), kspec(outer)),
-        out_shape=(kv_shape, kv_shape),
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-        ],
-        interpret=interpret,
-    )(qr, dor, lse8, delta8, kr, vr)
-
-    col_specs = [
-        qspec(outer), qspec(outer),
-        pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
-        pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
-        kspec(inner), kspec(inner),
-    ]
-    dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=block_q, block_k=block_k,
-                          num_k_blocks=num_k),
-        grid=(bh, num_q, num_k),
-        in_specs=col_specs,
-        out_specs=qspec(outer),
-        out_shape=q_shape,
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        interpret=interpret,
-    )(qr, dor, lse8, delta8, kr, vr)
-    return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
+    dk, dv, dq = _combined_bwd_call(
+        qr, dor, lse8, delta8, kr, vr, 0, 0, causal=causal,
+        block_q=block_q, block_k=block_k, rotate=False, collective_id=None,
+        axis_name=None, mesh_axes=(), interpret=interpret)
+    return ((dq * sm_scale).astype(q.dtype).reshape(q.shape),
+            dk.astype(k.dtype).reshape(k.shape),
+            dv.astype(v.dtype).reshape(v.shape))
 
 
 def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
@@ -546,7 +759,10 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         return _blockwise_fwd_impl(q, k, v, causal, sm_scale,
                                    max(block_k, 128), 0, 0)
     bh = batch * heads
-    qr = q.reshape(bh, q_len, d)
+    # Pre-scale q: one (seq, d) multiply here replaces a (seq, seq) pass
+    # inside the kernel (for head_dim a power of 4 the scale is a power
+    # of two, so this is exact even in bf16).
+    qr = (q * sm_scale).astype(q.dtype).reshape(bh, q_len, d)
     kr = k.reshape(bh, k_len, d)
     vr = v.reshape(bh, k_len, d)
     o_shape = jax.ShapeDtypeStruct((bh, q_len, d), q.dtype)
@@ -557,7 +773,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     krow = lambda i, j: j  # noqa: E731
 
     kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        _flash_kernel, causal=causal, block_q=block_q,
         block_k=block_k, num_k_blocks=num_k)
     out, lse = pl.pallas_call(
         kernel,
@@ -645,11 +861,15 @@ def flash_attention(q, k, v, causal: bool = False,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_q is None:
-        block_q = _pick_block(q.shape[-2])
+        # 1024-row query blocks: the kernels are grid-overhead-bound at
+        # these shapes (~3-5 us of fixed cost per grid step against ~1.4
+        # us of MXU work), so halving the grid beats smaller tiles —
+        # measured r4 at seq 1024: fwd 965 -> 687 us/call, fwd+bwd -5%
+        # vs 512-row blocks.  VMEM peaks ~2 MB at head_dim 64.
+        block_q = _pick_block(q.shape[-2], maximum=1024)
     if block_k is None:
-        # Key blocks up to 1024 measure ~5% faster end-to-end than 512 at
-        # seq 1024 on v5e (whole-k blocks skip the online-softmax rescale
-        # and the backward's key-loop); scratch stays ~4 MB of VMEM.
+        # Whole-k key blocks skip the online-softmax rescale entirely
+        # (the kernel's single_k fast path) and the backward's key loop.
         block_k = _pick_block(k.shape[-2], maximum=1024)
     return _flash_attention(q, k, v, causal, sm_scale, block_q, block_k,
                             interpret)

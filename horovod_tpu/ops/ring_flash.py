@@ -19,11 +19,20 @@ standard flash-merge identity::
     lse = logaddexp(lse_1, lse_2)
     out = out_1 * exp(lse_1 - lse) + out_2 * exp(lse_2 - lse)
 
-The backward pass is COMPOSED, not fused: a ``custom_vjp`` recomputes the
-forward via the separable ppermute path and differentiates that —
-numerically the same function, so its VJP is exact for the fused forward
-(the test pins fused forward == separable forward and grads == dense
-reference).
+The backward is fused the same way (round 4): each ring step is ONE
+Pallas program that starts the K/V rotation DMA, recomputes the step's
+probability block once from the saved (out, lse) residuals — feeding BOTH
+the dk/dv and the dq gradient blocks, where the split single-shard
+backward pays that recompute twice — and waits for the DMA at the final
+grid step.  The dk/dv partial accumulators travel between step kernels as
+float32 ``lax.ppermute`` rotations (following their K/V shard around the
+ring, one extra rotation delivering each shard's total to its owner):
+a trailing in-kernel DMA could not overlap anything — the accumulator is
+only complete at kernel end — while the XLA-level rotation of step t can
+hide under the step-t+1 kernel.  Unlike the round-3 composed backward,
+nothing re-runs the forward: out/lse are residuals, exactly the flash
+backward recompute strategy (Dao et al., arXiv:2205.14135) extended
+across the ring.
 
 Correctness of the remote DMA relies on the same ready-handshake barrier
 and phase-alternating collective_id scheme as ``ops/rdma.py`` (reserved
@@ -45,7 +54,7 @@ from jax import lax
 
 from horovod_tpu.ops.attention import (NEG_INF, POS_BIG, _attend_block,
                                        _finalize_flash, _init_state,
-                                       _pick_block)
+                                       _pick_block, _rd)
 
 try:
     import jax.experimental.pallas as pl
@@ -61,7 +70,7 @@ if _HAS_PALLAS:
     from horovod_tpu.ops.rdma import _ambient_mesh_axes, _device_id
 
 
-def _step_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
+def _step_kernel(*refs, causal, block_q, block_k, num_q_blocks,
                  num_k_blocks, bh, rotate, barrier, phase, axis_name,
                  mesh_axes):
     """One ring step: start K/V DMA to the right neighbour, flash-attend
@@ -123,9 +132,11 @@ def _step_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
 
     @pl.when(run)
     def _():
+        # single_k skips the online rescale; the unconditional init above
+        # still covers whole-shard-masked ring steps (run stays False).
         _attend_block(q_ref, k_ref, v_ref, m_scratch, l_scratch,
-                      acc_scratch, q_start, k_start, sm_scale, causal,
-                      block_q, block_k)
+                      acc_scratch, q_start, k_start, causal,
+                      block_q, block_k, single_k=num_k_blocks == 1)
 
     @pl.when(ki == num_k_blocks - 1)
     def _():
@@ -155,11 +166,229 @@ def _row_spec(block, d, row):
                         lambda b, qi, ki, s: (b, row(qi, ki), 0))
 
 
-def _ring_flash_step(q, k_cur, v_cur, q_offset, k_offset, *, sm_scale,
+def _bwd_step_kernel(*refs, causal, block_q, block_k,
+                     num_q_blocks, num_k_blocks, seq_local, bh, rotate,
+                     barrier, axis_name, mesh_axes):
+    """One fused backward ring step: start the K/V rotation DMA, compute
+    this shard's dk/dv AND dq gradient blocks from ONE probability
+    recompute, wait the DMA at the end.
+
+    Grid: (bh, ki, qi) — queries innermost so dk/dv accumulate in scratch
+    and flush per key block (the `_flash_bwd_dkdv_kernel` order); dq
+    accumulates in a whole-shard VMEM scratch and flushes once per bh
+    row.  ``offsets_ref`` carries the absolute [q_offset, k_offset] for
+    causal masking across shards, as in the forward step kernel.
+    """
+    if rotate:
+        (offsets_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+         k_full, v_full, dk_ref, dv_ref, dq_ref, k_next, v_next,
+         dk_scratch, dv_scratch, dq_scratch, sems) = refs
+    else:
+        (offsets_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+         dk_ref, dv_ref, dq_ref,
+         dk_scratch, dv_scratch, dq_scratch) = refs
+    b = pl.program_id(0)
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    if rotate:
+        my = lax.axis_index(axis_name)
+        n = lax.axis_size(axis_name)
+        dst, id_type = _device_id(lax.rem(my + 1, n), axis_name, mesh_axes)
+        src, _ = _device_id(lax.rem(my - 1 + n, n), axis_name, mesh_axes)
+
+        @pl.when((b == 0) & (ki == 0) & (qi == 0))
+        def _start_rotation():
+            if barrier:
+                bar = pltpu.get_barrier_semaphore()
+                pltpu.semaphore_signal(
+                    bar, inc=1, device_id=src, device_id_type=id_type)
+                pltpu.semaphore_wait(bar, 1)
+            pltpu.make_async_remote_copy(
+                src_ref=k_full, dst_ref=k_next, send_sem=sems.at[0],
+                recv_sem=sems.at[1], device_id=dst,
+                device_id_type=id_type).start()
+            pltpu.make_async_remote_copy(
+                src_ref=v_full, dst_ref=v_next, send_sem=sems.at[2],
+                recv_sem=sems.at[3], device_id=dst,
+                device_id_type=id_type).start()
+
+    @pl.when((ki == 0) & (qi == 0))
+    def _zero_dq():
+        dq_scratch[...] = jnp.zeros_like(dq_scratch)
+
+    @pl.when(qi == 0)
+    def _zero_dkdv():
+        dk_scratch[...] = jnp.zeros_like(dk_scratch)
+        dv_scratch[...] = jnp.zeros_like(dv_scratch)
+
+    if causal:
+        q_start = offsets_ref[0] + qi * block_q  # absolute positions
+        k_start = offsets_ref[1] + ki * block_k
+        run = q_start + block_q - 1 >= k_start
+    else:
+        q_start = k_start = 0
+        run = True
+
+    @pl.when(run)
+    def _():
+        q = _rd(q_ref)          # (block_q, d), pre-scaled by sm_scale
+        do = _rd(do_ref)        # (block_q, d)
+        lse = _rd(lse_ref)[0]   # (block_q,)
+        delta = _rd(delta_ref)[0]
+        k = _rd(k_ref)          # (block_k, d)
+        v = _rd(v_ref)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # POS_BIG lse zeroes masked rows
+        dv_scratch[...] += lax.dot_general(
+            p.astype(v.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None])).astype(q.dtype)
+        dk_scratch[...] += lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        row = pl.ds(qi * block_q, block_q)
+        dq_scratch[row, :] = dq_scratch[row, :] + lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _flush_dkdv():
+        dk_ref[...] = dk_scratch[...].reshape(dk_ref.shape)
+        dv_ref[...] = dv_scratch[...].reshape(dv_ref.shape)
+
+    @pl.when((ki == num_k_blocks - 1) & (qi == num_q_blocks - 1))
+    def _flush_dq():
+        dq_ref[...] = dq_scratch[...].reshape(dq_ref.shape)
+
+    if rotate:
+        @pl.when((b == bh - 1) & (ki == num_k_blocks - 1)
+                 & (qi == num_q_blocks - 1))
+        def _finish_rotation():
+            pltpu.make_async_remote_copy(
+                src_ref=k_full, dst_ref=k_next, send_sem=sems.at[0],
+                recv_sem=sems.at[1], device_id=dst,
+                device_id_type=id_type).wait()
+            pltpu.make_async_remote_copy(
+                src_ref=v_full, dst_ref=v_next, send_sem=sems.at[2],
+                recv_sem=sems.at[3], device_id=dst,
+                device_id_type=id_type).wait()
+
+
+def _bwd_ring_step(q, do, lse8, delta8, k_cur, v_cur, q_offset, k_offset, *,
+                   causal, block_q, block_k, rotate, phase,
+                   axis_name, interpret):
+    """One fused backward ring step over (bh, seq_local, d) shards (q
+    arrives pre-scaled by sm_scale).  Returns (dk, dv, dq, k_next,
+    v_next) — dk/dv/dq float32 contributions for the CURRENTLY HELD
+    shard (dq in q' units); k_next/v_next only when rotating."""
+    bh, sl, d = q.shape
+    num_q, num_k = sl // block_q, sl // block_k
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32)])
+
+    kernel = functools.partial(
+        _bwd_step_kernel, causal=causal,
+        block_q=block_q, block_k=block_k, num_q_blocks=num_q,
+        num_k_blocks=num_k, seq_local=sl, bh=bh, rotate=rotate,
+        barrier=rotate and not interpret, axis_name=axis_name,
+        mesh_axes=_ambient_mesh_axes(axis_name))
+
+    def qspec(row):
+        return pl.BlockSpec((1, block_q, d),
+                            lambda b, ki, qi, s, _r=row: (b, _r(qi, ki), 0))
+
+    def kspec(row):
+        return pl.BlockSpec((1, block_k, d),
+                            lambda b, ki, qi, s, _r=row: (b, _r(qi, ki), 0))
+
+    inner_q = lambda qi, ki: qi  # noqa: E731
+    outer_k = lambda qi, ki: ki  # noqa: E731
+    in_specs = [
+        qspec(inner_q),                                    # q
+        qspec(inner_q),                                    # do
+        pl.BlockSpec((1, 8, block_q), lambda b, ki, qi, s: (b, 0, qi)),
+        pl.BlockSpec((1, 8, block_q), lambda b, ki, qi, s: (b, 0, qi)),
+        kspec(outer_k),                                    # k (blocked)
+        kspec(outer_k),                                    # v (blocked)
+    ]
+    out_shapes = [
+        jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),    # dk
+        jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),    # dv
+        jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),    # dq
+    ]
+    out_specs = [
+        kspec(outer_k),                                    # dk
+        kspec(outer_k),                                    # dv
+        pl.BlockSpec((1, sl, d), lambda b, ki, qi, s: (b, 0, 0)),  # dq
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((block_k, d), jnp.float32),             # dk accumulator
+        pltpu.VMEM((block_k, d), jnp.float32),             # dv accumulator
+        pltpu.VMEM((sl, d), jnp.float32),                  # whole-shard dq
+    ]
+    args = [offsets, q, do, lse8, delta8, k_cur, v_cur]
+    if rotate:
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),             # k (DMA src)
+            pl.BlockSpec(memory_space=pl.ANY),             # v (DMA src)
+        ]
+        out_shapes += [
+            jax.ShapeDtypeStruct(k_cur.shape, k_cur.dtype),  # k_next
+            jax.ShapeDtypeStruct(v_cur.shape, v_cur.dtype),  # v_next
+        ]
+        out_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),             # k_next
+            pl.BlockSpec(memory_space=pl.ANY),             # v_next
+        ]
+        scratch_shapes += [pltpu.SemaphoreType.DMA((4,))]
+        args += [k_cur, v_cur]
+    vma = getattr(jax.typeof(q), "vma", None)
+    if vma is not None:
+        out_shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype, vma=vma)
+                      for s in out_shapes]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, num_k, num_q),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
+    )
+    barrier = rotate and not interpret
+    compiler_params = pltpu.CompilerParams(
+        collective_id=_COLLECTIVE_IDS[phase % 2] if barrier else None,
+        has_side_effects=True)
+    results = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(*args)
+    if rotate:
+        dk, dv, dq, k_next, v_next = results
+        return dk, dv, dq, k_next, v_next
+    dk, dv, dq = results
+    return dk, dv, dq, None, None
+
+
+def _ring_flash_step(q, k_cur, v_cur, q_offset, k_offset, *,
                      causal, block_q, block_k, rotate, phase, axis_name,
                      interpret):
-    """One fused ring step over (bh, seq_local, d) shards.  Returns
-    (out, lse, k_next, v_next) — k_next/v_next only when rotating."""
+    """One fused ring step over (bh, seq_local, d) shards (q arrives
+    pre-scaled by sm_scale).  Returns (out, lse, k_next, v_next) —
+    k_next/v_next only when rotating."""
     bh, sl, d = q.shape
     block_q = _pick_block(sl, block_q)
     block_k = _pick_block(sl, block_k)
@@ -171,7 +400,7 @@ def _ring_flash_step(q, k_cur, v_cur, q_offset, k_offset, *, sm_scale,
                          jnp.asarray(k_offset, jnp.int32)])
 
     kernel = functools.partial(
-        _step_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        _step_kernel, causal=causal, block_q=block_q,
         block_k=block_k, num_q_blocks=num_q, num_k_blocks=num_k, bh=bh,
         rotate=rotate, barrier=rotate and not interpret, phase=phase,
         axis_name=axis_name, mesh_axes=_ambient_mesh_axes(axis_name))
@@ -270,7 +499,10 @@ def _phase_closer(axis_name):
 
 def _merge(o1, lse1, o2, lse2):
     """Flash-merge two partial attention results.  POS_BIG lse rows carry
-    zero mass (fully masked)."""
+    zero mass (fully masked).  Returns the merged output in FLOAT32 — the
+    running accumulator must stay f32 across the whole ring (an n-device
+    ring would otherwise accumulate n-1 bf16 roundings, drifting from the
+    separable path's single final cast); callers cast once at the end."""
     e1 = jnp.where(lse1 > POS_BIG / 2, NEG_INF, lse1)
     e2 = jnp.where(lse2 > POS_BIG / 2, NEG_INF, lse2)
     m = jnp.maximum(e1, e2)
@@ -283,7 +515,7 @@ def _merge(o1, lse1, o2, lse2):
     out = (o1.astype(jnp.float32) * (w1 / safe_total)[..., None]
            + o2.astype(jnp.float32) * (w2 / safe_total)[..., None])
     lse = jnp.where(both_empty, POS_BIG, m_safe + jnp.log(safe_total))
-    return out.astype(o1.dtype), lse
+    return out, lse
 
 
 def _fused_forward(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
@@ -293,7 +525,9 @@ def _fused_forward(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
     sl = q.shape[-2]
     batch, heads = q.shape[0], q.shape[1]
     bh = batch * heads
-    qr = q.reshape(bh, sl, q.shape[-1])
+    # Pre-scaled q (ops/attention.py): one (seq, d) pass replaces a
+    # (seq, seq) kernel pass per ring step.
+    qr = (q * sm_scale).astype(q.dtype).reshape(bh, sl, q.shape[-1])
     k_cur = k.reshape(bh, sl, k.shape[-1])
     v_cur = v.reshape(bh, sl, v.shape[-1])
     q_off = my * sl
@@ -303,7 +537,7 @@ def _fused_forward(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
         kv_idx = lax.rem(my - t + n, n)
         k_off = kv_idx * sl
         o_t, lse_t, k_next, v_next = _ring_flash_step(
-            qr, k_cur, v_cur, q_off, k_off, sm_scale=sm_scale,
+            qr, k_cur, v_cur, q_off, k_off,
             causal=causal, block_q=block_q, block_k=block_k,
             rotate=t < n - 1, phase=t % 2, axis_name=axis_name,
             interpret=interpret)
@@ -317,38 +551,86 @@ def _fused_forward(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
         # Even ring: odd number of rotating steps [0,1,...,0] — close the
         # barrier-phase stream on 1 so repeated executions alternate.
         _phase_closer(axis_name)
-    return out.reshape(q.shape).astype(q.dtype)
+    return (out.reshape(q.shape).astype(q.dtype),
+            lse.reshape(q.shape[:-1]))
+
+
+def _fused_backward(q, k, v, out, lse, g, axis_name, causal, sm_scale,
+                    block_q, block_k, interpret):
+    """Fused ring backward: per ring step ONE Pallas program rotates K/V
+    by in-kernel DMA while computing the shard's dk/dv and dq blocks from
+    the saved (out, lse); the float32 dk/dv partials follow their shard
+    around the ring as ppermute rotations between kernels."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    batch, heads, sl, d = q.shape
+    bh = batch * heads
+    qr = (q * sm_scale).astype(q.dtype).reshape(bh, sl, d)  # q' units
+    dor = g.reshape(bh, sl, d)
+    k_cur = k.reshape(bh, sl, d)
+    v_cur = v.reshape(bh, sl, d)
+    q_off = my * sl
+    # delta_i = sum_d dOut_id * Out_id, broadcast to 8 sublanes alongside
+    # lse (the single-shard flash backward's tiling trick).
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, sl)
+    delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, sl))
+    lse8 = jnp.broadcast_to(lse.reshape(bh, sl)[:, None, :], (bh, 8, sl))
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    dq_total = None
+    acc_k = acc_v = None
+    for t in range(n):
+        kv_idx = lax.rem(my - t + n, n)
+        k_off = kv_idx * sl
+        dk_t, dv_t, dq_t, k_next, v_next = _bwd_ring_step(
+            qr, dor, lse8, delta8, k_cur, v_cur, q_off, k_off,
+            causal=causal, block_q=block_q,
+            block_k=block_k, rotate=t < n - 1, phase=t % 2,
+            axis_name=axis_name, interpret=interpret)
+        if t < n - 1:
+            k_cur, v_cur = k_next, v_next
+        dq_total = dq_t if dq_total is None else dq_total + dq_t
+        if acc_k is None:
+            acc_k, acc_v = dk_t, dv_t
+        else:
+            # The accumulators chase their K/V shard: rotate one hop (the
+            # shard moved while the kernel ran), then add this device's
+            # contribution for the shard it now holds.  XLA schedules the
+            # ppermute of step t-1 alongside the step-t kernel.
+            acc_k = lax.ppermute(acc_k, axis_name, perm) + dk_t
+            acc_v = lax.ppermute(acc_v, axis_name, perm) + dv_t
+    if n > 1:
+        # After step n-1, shard j's totals sit one hop left of owner j.
+        acc_k = lax.ppermute(acc_k, axis_name, perm)
+        acc_v = lax.ppermute(acc_v, axis_name, perm)
+    if not interpret and (n - 1) % 2 == 1:
+        _phase_closer(axis_name)  # same stream invariant as the forward
+    # dq accumulated in q' = sm_scale*q units; rescale once.
+    return ((dq_total * sm_scale).reshape(q.shape).astype(q.dtype),
+            acc_k.reshape(k.shape).astype(k.dtype),
+            acc_v.reshape(v.shape).astype(v.dtype))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _fused_ring_attention(q, k, v, axis_name, causal, sm_scale, block_q,
                           block_k, interpret):
     return _fused_forward(q, k, v, axis_name, causal, sm_scale, block_q,
-                          block_k, interpret)
+                          block_k, interpret)[0]
 
 
 def _fused_fwd(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
                interpret):
-    out = _fused_forward(q, k, v, axis_name, causal, sm_scale, block_q,
-                         block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _fused_forward(q, k, v, axis_name, causal, sm_scale,
+                              block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _fused_bwd(axis_name, causal, sm_scale, block_q, block_k, interpret,
                res, g):
-    # Composed backward: differentiate the separable (ppermute) ring
-    # attention — the same function value, so its VJP is exact here.  The
-    # recompute-forward cost matches the separable path's own
-    # jax.checkpoint behavior.
-    from horovod_tpu.ops.ring_attention import ring_attention
-
-    q, k, v = res
-    _, vjp_fn = jax.vjp(
-        lambda q_, k_, v_: ring_attention(
-            q_, k_, v_, axis_name=axis_name, causal=causal,
-            sm_scale=sm_scale, rotate_impl="ppermute"),
-        q, k, v)
-    return vjp_fn(g)
+    q, k, v, out, lse = res
+    return _fused_backward(q, k, v, out, lse, g, axis_name, causal,
+                           sm_scale, block_q, block_k, interpret)
 
 
 _fused_ring_attention.defvjp(_fused_fwd, _fused_bwd)

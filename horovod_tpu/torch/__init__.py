@@ -49,6 +49,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     `isinstance(opt, OriginalClass)` true, as the reference does at
     /root/reference/horovod/torch/__init__.py:92-124)."""
 
+    _hvd_tpu_distributed = True  # marker for comm-free base-step dispatch
+
     def __init__(self, params, named_parameters=None,
                  backward_passes_per_step=1):
         super(self.__class__, self).__init__(params)
@@ -187,11 +189,33 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
     if not state_dict["state"]:
         # New optimizers have empty per-param state; materialize it with a
         # zero-grad step so every rank has the same structure to fill.
+        # The bootstrap must be LOCAL and PARAM-NEUTRAL: on a
+        # resume-from-checkpoint, the root rank has loaded state while the
+        # other ranks bootstrap — (a) a DistributedOptimizer.step() here
+        # would enqueue gradient allreduces the root never joins
+        # (deadlock, caught by tests/test_examples.py's resume leg), so
+        # dispatch to the wrapped optimizer's own step; (b) lr/
+        # weight_decay are zeroed for the dummy step so it cannot move
+        # the already-broadcast parameters (zero grads alone don't make
+        # a decoupled-weight-decay step a no-op).
         for group in optimizer.param_groups:
             for p in group["params"]:
                 if p.requires_grad and p.grad is None:
                     p.grad = torch.zeros_like(p)
-        optimizer.step()
+        saved = [{key: group[key] for key in ("lr", "weight_decay")
+                  if key in group} for group in optimizer.param_groups]
+        for group in optimizer.param_groups:
+            for key in ("lr", "weight_decay"):
+                if key in group:
+                    group[key] = 0.0
+        try:
+            if getattr(optimizer, "_hvd_tpu_distributed", False):
+                super(type(optimizer), optimizer).step()
+            else:
+                optimizer.step()
+        finally:
+            for group, vals in zip(optimizer.param_groups, saved):
+                group.update(vals)
         state_dict = optimizer.state_dict()
 
     scalars = {}       # key -> broadcast scalar value

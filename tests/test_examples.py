@@ -70,3 +70,51 @@ def test_word2vec_example_sparse_path():
     out = _run_example("tensorflow_word2vec.py",
                        ["--steps", "20", "--corpus-words", "2000"])
     assert "trained embeddings" in out
+
+
+def test_keras_mnist_advanced_example():
+    """BASELINE.json acceptance config 2: the advanced Keras path
+    (epoch-scaled training, LR warmup + schedule callbacks, metric
+    averaging)."""
+    out = _run_example("keras_mnist_advanced.py",
+                       ["--base-epochs", "1", "--warmup-epochs", "1",
+                        "--train-samples", "256", "--batch-size", "32"])
+    assert "Test accuracy" in out
+
+
+def test_keras_imagenet_resnet50_example_with_resume(tmp_path):
+    """BASELINE.json acceptance config 4, both legs: a fresh run that
+    checkpoints on rank 0, then a resumed run that must find the epoch-1
+    checkpoint, broadcast the resume decision, and reload via
+    hvd.load_model (re-wrapping the optimizer) — the reference's
+    keras checkpoint/resume convention."""
+    fmt = str(tmp_path / "ckpt-{epoch}.keras")
+    common = ["--synthetic-batches", "2", "--batch-size", "2",
+              "--val-batch-size", "2", "--image-size", "32",
+              "--warmup-epochs", "1", "--checkpoint-format", fmt]
+    out = _run_example("keras_imagenet_resnet50.py",
+                       ["--epochs", "1"] + common, timeout=900)
+    assert "Validation accuracy" in out
+    assert os.path.exists(fmt.format(epoch=1))
+    out = _run_example("keras_imagenet_resnet50.py",
+                       ["--epochs", "2"] + common, timeout=900)
+    assert "Validation accuracy" in out
+    assert os.path.exists(fmt.format(epoch=2))
+
+
+def test_pytorch_imagenet_resnet50_example_with_resume(tmp_path):
+    """BASELINE.json acceptance config 5, both legs: fresh run (rank-0
+    checkpoint + parameter/optimizer-state broadcast), then a resumed run
+    exercising the resume-from-epoch broadcast and rank-0 state restore."""
+    fmt = str(tmp_path / "ckpt-{epoch}.pth.tar")
+    common = ["--synthetic-batches", "2", "--batch-size", "2",
+              "--val-batch-size", "2", "--image-size", "32",
+              "--checkpoint-format", fmt]
+    out = _run_example("pytorch_imagenet_resnet50.py",
+                       ["--epochs", "1"] + common, timeout=900)
+    assert "validation" in out
+    assert os.path.exists(fmt.format(epoch=1))
+    out = _run_example("pytorch_imagenet_resnet50.py",
+                       ["--epochs", "2"] + common, timeout=900)
+    assert "validation" in out
+    assert os.path.exists(fmt.format(epoch=2))

@@ -295,3 +295,53 @@ def test_torch_noncontiguous_inplace_rejected(single_process_hvd):
     x = torch.ones(4, 4).t()
     with pytest.raises(ValueError, match="contiguous"):
         hvd.allreduce_(x, name="t.nc")
+
+
+@distributed_test(np_=2, timeout=300)
+def test_torch_broadcast_optimizer_state_resume_asymmetry():
+    """Resume-from-checkpoint shape: the ROOT rank has loaded optimizer
+    state (momentum buffers), the other ranks are fresh.  The fresh
+    ranks' empty-state bootstrap must be comm-free (a wrapped step() here
+    used to enqueue gradient allreduces the root never joins — deadlock)
+    and param-neutral (lr/weight_decay zeroed for the dummy step, or the
+    already-broadcast params drift).  Everyone must end with the root's
+    state and identical params."""
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd = _init()
+    torch.manual_seed(7)  # same init everywhere; focus on state/step
+    model = torch.nn.Linear(4, 3)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9,
+                        weight_decay=0.1),
+        named_parameters=model.named_parameters())
+    if hvd.rank() == 0:
+        # Stand-in for torch.load of an epoch-1 checkpoint: state with
+        # distinctive momentum buffers.
+        sd = opt.state_dict()
+        sd["state"] = {i: {"momentum_buffer": torch.full_like(p, 2.5)}
+                       for i, p in enumerate(
+                           sd["param_groups"][0]["params"]
+                           and [p for g in opt.param_groups
+                                for p in g["params"]])}
+        opt.load_state_dict(sd)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    params_before = [p.detach().clone() for p in model.parameters()]
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    # Params untouched by the bootstrap dummy step (weight_decay != 0).
+    for p, want in zip(model.parameters(), params_before):
+        assert torch.equal(p, want), "bootstrap moved parameters"
+    # Every rank now carries the root's buffers.
+    for g in opt.param_groups:
+        for p in g["params"]:
+            buf = opt.state[p]["momentum_buffer"]
+            assert torch.allclose(buf, torch.full_like(buf, 2.5)), buf
+    # And hyperparameters were restored after the zeroed dummy step.
+    assert opt.param_groups[0]["lr"] == 0.1
+    assert opt.param_groups[0]["weight_decay"] == 0.1
+    # The job still trains (no stranded handles from the bootstrap).
+    out = model(torch.ones(2, 4)).sum()
+    out.backward()
+    opt.step()

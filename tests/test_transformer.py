@@ -5,6 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from horovod_tpu.models import TransformerLM, next_token_loss
@@ -207,6 +208,43 @@ def test_migrate_params_legacy_checkpoints():
     again = migrate_params({"params": params}, n_heads=4)["params"]
     assert jax.tree_util.tree_structure(again) == \
         jax.tree_util.tree_structure(params)
+
+
+def test_layout_version_stamp():
+    """ADVICE r3: migrators stamp a layout version into checkpoint
+    wrappers; check_layout warns on unversioned/stale trees (which would
+    silently compute a different function under the adjacent-pair rope)
+    and raises under strict; a stamped wrapper still applies cleanly."""
+    import warnings
+
+    from horovod_tpu.models.transformer import (LAYOUT_VERSION,
+                                                check_layout,
+                                                migrate_params,
+                                                migrate_rope_pairing)
+
+    model = _model()
+    tokens = _tokens()
+    params = model.init(jax.random.PRNGKey(2), tokens)["params"]
+
+    v2 = migrate_params({"params": params}, n_heads=4)
+    assert int(v2["layout"]["version"]) == 2  # structure only: rope legacy
+    v3 = migrate_rope_pairing(v2, n_heads=4)
+    assert int(v3["layout"]["version"]) == LAYOUT_VERSION
+
+    # Current stamp: silent pass-through.
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert check_layout(v3) is v3
+    # Unversioned and stale trees: warn (default) or raise (strict).
+    for bad in ({"params": params}, v2):
+        with pytest.warns(UserWarning, match="layout stamp"):
+            check_layout(bad)
+        with pytest.raises(ValueError, match="layout stamp"):
+            check_layout(bad, strict=True)
+    # The stamp rides through apply as an ignored collection.
+    out = model.apply({"params": v3["params"], "layout": v3["layout"]},
+                      tokens)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
 
 
 def test_sequence_parallel_fused_ring_matches():
