@@ -112,14 +112,21 @@ def _load_lib():
 def init(comm: Optional[Sequence[int]] = None) -> None:
     """Initialize the engine.
 
-    ``comm`` optionally restricts the job to a subset of launcher ranks,
-    mirroring ``hvd.init(comm=[...])`` in the reference
-    (/root/reference/horovod/common/__init__.py:51-62).
+    ``comm`` optionally restricts the job to a subset of launcher ranks —
+    either a rank list or an mpi4py(-style) communicator, mirroring both
+    forms the reference accepts
+    (/root/reference/horovod/common/__init__.py:51-78; the communicator
+    is duck-typed via ``Get_size``/``allgather``, see
+    :func:`horovod_tpu.common.basics.comm_ranks` — no MPI dependency).
     """
     global _process_set
     lib = _load_lib()
     if lib.hvd_tpu_initialized():
         return
+    if comm is not None and hasattr(comm, "Get_size"):
+        from horovod_tpu.common.basics import comm_ranks
+
+        comm = comm_ranks(comm, resolve_process_set(None).rank)
     ps = resolve_process_set(comm)
     cfg = Config.from_env()
     timeline = cfg.timeline_path if ps.rank == 0 else ""

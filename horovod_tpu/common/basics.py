@@ -160,6 +160,31 @@ def _from_jax_distributed() -> Optional[ProcessSet]:
         return None
 
 
+def comm_ranks(comm, launcher_rank: int) -> list:
+    """Map an mpi4py-style communicator to the launcher-rank subset the
+    rank-list init path consumes.
+
+    The reference accepts either a rank list or an mpi4py communicator in
+    ``hvd.init(comm=...)`` (/root/reference/horovod/common/__init__.py:
+    51-78, where the C side marshals the raw ``MPI_Comm``).  There is no
+    MPI anywhere in this framework, so the shim is duck-typed instead of
+    importing mpi4py: any object with ``Get_size`` and a pickle-based
+    ``allgather`` works — each member contributes its own launcher rank
+    and the gathered list IS the subset, with no world-group rank
+    translation needed.  The list keeps the communicator's own rank
+    order (allgather returns in comm-rank order), and
+    :func:`resolve_process_set` numbers the subset by list position —
+    so ``hvd.rank() == comm.Get_rank()`` even for reordered
+    subcommunicators (root-only logic stays on the comm's root).
+    """
+    ranks = list(comm.allgather(launcher_rank))
+    if len(ranks) != comm.Get_size():
+        raise ValueError(
+            f"communicator allgather returned {len(ranks)} ranks but "
+            f"Get_size() says {comm.Get_size()}")
+    return ranks
+
+
 def resolve_process_set(ranks: Optional[Sequence[int]] = None) -> ProcessSet:
     """Resolve this process's identity.
 
@@ -173,15 +198,20 @@ def resolve_process_set(ranks: Optional[Sequence[int]] = None) -> ProcessSet:
           or ProcessSet(0, 1, 0, 1))
     if ranks is not None:
         ranks = list(ranks)
-        if sorted(set(ranks)) != sorted(ranks):
+        if len(set(ranks)) != len(ranks):
             raise ValueError(f"duplicate ranks in subset {ranks}")
         if ps.rank not in ranks:
             raise ValueError(
                 f"process rank {ps.rank} not in requested subset {ranks}")
-        new_rank = sorted(ranks).index(ps.rank)
+        # LIST ORDER defines the new numbering — matching MPI Group.Incl
+        # semantics, which is what the reference's comm forms resolve to:
+        # subset rank i is launcher rank ranks[i], so a reordered
+        # mpi4py subcommunicator keeps hvd.rank() == comm.Get_rank()
+        # (root-only logic stays on the comm's root).
+        new_rank = ranks.index(ps.rank)
         endpoints = None
         if ps.data_endpoints:
-            endpoints = [ps.data_endpoints[r] for r in sorted(ranks)]
+            endpoints = [ps.data_endpoints[r] for r in ranks]
         coord = None
         if endpoints:
             host = endpoints[0].rsplit(":", 1)[0]

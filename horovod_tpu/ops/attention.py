@@ -253,9 +253,23 @@ def _st(ref, val):
     ref[...] = val.reshape(ref.shape).astype(ref.dtype)
 
 
+def _split_scale(sm_scale: float):
+    """Split ``sm_scale`` into an exact power-of-two factor (applied to q
+    in the storage dtype — exact even in bf16) and a float32 residual in
+    [1, 2) applied to the logits inside the kernel.  For head dims that
+    are powers of 4 (64, 256, ...) the residual is exactly 1.0 and the
+    kernels skip the extra (block_q, block_k) pass entirely; other scales
+    (head_dim 128, 96, ...) keep full f32 accuracy instead of rounding q
+    to bf16 under a non-representable scale (ADVICE r4)."""
+    import math
+
+    m, e = math.frexp(sm_scale)  # sm_scale = m * 2**e, m in [0.5, 1)
+    return 2.0 ** (e - 1), m * 2.0
+
+
 def _attend_block(q_ref, k_ref, v_ref, m_scratch, l_scratch, acc_scratch,
                   q_start, k_start, causal, block_q, block_k,
-                  single_k=False):
+                  single_k=False, scale_r=1.0):
     """One online-softmax block update of the VMEM (m, l, acc) state.
 
     Shared by the single-shard flash kernel and the fused ring-flash step
@@ -265,19 +279,23 @@ def _attend_block(q_ref, k_ref, v_ref, m_scratch, l_scratch, acc_scratch,
 
     VPU economy (the kernel is elementwise-bound at head_dim 64 — the MXU
     finishes each block's two dots in ~1/3 of the time the softmax passes
-    take): ``q`` arrives PRE-SCALED by sm_scale (one (seq, d) pass at the
-    wrapper instead of a (seq, seq) pass here); fully-masked rows are
+    take): ``q`` arrives PRE-SCALED by the power-of-two part of sm_scale
+    (one (seq, d) pass at the wrapper instead of a (seq, seq) pass here;
+    ``scale_r`` is the f32 residual, exactly 1.0 for power-of-4 head
+    dims — see :func:`_split_scale`); fully-masked rows are
     neutralized by clamping the softmax reference ``m_safe`` per ROW
     (block_q elements) instead of a second (block_q, block_k) ``where``
     on p — masked elements already underflow via exp(NEG_INF - m_safe);
     and ``single_k=True`` (one key block, the tuned whole-k layout) skips
     the online-rescale multiplies entirely."""
-    q = _rd(q_ref)  # (block_q, d), pre-scaled by sm_scale
+    q = _rd(q_ref)  # (block_q, d), pre-scaled by the pow2 part of sm_scale
     k = _rd(k_ref)  # (block_k, d)
     v = _rd(v_ref)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
+    if scale_r != 1.0:
+        s *= scale_r
     if causal:
         q_pos = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
@@ -330,7 +348,8 @@ def _finalize_flash(o_ref, lse_ref, m_scratch, l_scratch, acc_scratch,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
-                  acc_scratch, *, causal, block_q, block_k, num_k_blocks):
+                  acc_scratch, *, causal, block_q, block_k, num_k_blocks,
+                  scale_r=1.0):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     single_k = num_k_blocks == 1
@@ -349,7 +368,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
     def _():
         _attend_block(q_ref, k_ref, v_ref, m_scratch, l_scratch,
                       acc_scratch, q_start, k_start, causal,
-                      block_q, block_k, single_k=single_k)
+                      block_q, block_k, single_k=single_k,
+                      scale_r=scale_r)
 
     @pl.when(ki == num_k_blocks - 1)
     def _():
@@ -357,9 +377,54 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
                         block_q)
 
 
+def _bwd_block_math(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                    causal, q_start, k_start, block_q, block_k, scale_r):
+    """Shared flash-backward block recompute (Dao et al. alg. 2 inner
+    body), used by the combined kernel, both split kernels, and the fused
+    ring backward (ops/ring_flash.py).
+
+    ``q`` arrives pre-scaled by the power-of-two part of sm_scale;
+    ``scale_r`` is the f32 residual (see :func:`_split_scale`), applied
+    once to s (matching the forward's pre-activation) and once to ds —
+    ds_r = r * dL/ds — so dk = ds_r^T q' and dq' = ds_r k are exact in
+    q' units (the wrapper rescales dq by the pow2 factor once).
+
+    Returns ``(pb, do, ds)``: the probability block cast to v's dtype
+    (for dv += pb^T do), the loaded dO block, and the scaled ds block
+    cast to q's dtype (for dk/dq dots)."""
+    q = _rd(q_ref)          # (block_q, d), pre-scaled (pow2 part)
+    do = _rd(do_ref)        # (block_q, d)
+    lse = _rd(lse_ref)[0]   # (block_q,)
+    delta = _rd(delta_ref)[0]
+    k = _rd(k_ref)          # (block_k, d)
+    v = _rd(v_ref)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if scale_r != 1.0:
+        s *= scale_r
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])  # POS_BIG lse zeroes masked rows
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None])
+    if scale_r != 1.0:
+        ds *= scale_r
+    return p.astype(v.dtype), do, ds.astype(q.dtype)
+
+
 def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                            dk_ref, dv_ref, dk_scratch, dv_scratch, *,
-                           causal, block_q, block_k, num_q_blocks):
+                           causal, block_q, block_k, num_q_blocks, scale_r):
+    """Split backward, dk/dv half: O(block) scoped memory — the long-seq
+    path where the combined kernel's whole-seq dq scratch exceeds the
+    chip's scoped-VMEM ceiling (see _bwd_plan)."""
     ki = pl.program_id(1)
     qi = pl.program_id(2)  # innermost: accumulates over query blocks
 
@@ -374,35 +439,14 @@ def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
     @pl.when(run)
     def _():
-        q = _rd(q_ref)          # (block_q, d), pre-scaled by sm_scale
-        do = _rd(do_ref)        # (block_q, d)
-        lse = _rd(lse_ref)[0]   # (block_q,)
-        delta = _rd(delta_ref)[0]
-        k = _rd(k_ref)          # (block_k, d)
-        v = _rd(v_ref)
-        # q pre-scaled: s matches the forward's pre-activation, ds needs
-        # no *sm_scale pass, and dk = ds^T q' is exact as-is (the scale
-        # belongs to q's branch; the wrapper rescales dq once).
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # lse sentinel zeroes masked rows
-        pb = p.astype(v.dtype)
+        pb, do, ds = _bwd_block_math(
+            q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, causal,
+            q_start, k_start, block_q, block_k, scale_r)
         dv_scratch[...] += jax.lax.dot_general(
             pb, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None])).astype(q.dtype)
         dk_scratch[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds, _rd(q_ref), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_q_blocks - 1)
@@ -413,7 +457,9 @@ def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
 def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                          dq_ref, dq_scratch, *, causal, block_q,
-                         block_k, num_k_blocks):
+                         block_k, num_k_blocks, scale_r):
+    """Split backward, dq half: accumulates one query block over the key
+    loop — O(block) scoped memory (long-seq path, see _bwd_plan)."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)  # innermost: accumulates over key blocks
 
@@ -427,28 +473,11 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
     @pl.when(run)
     def _():
-        q = _rd(q_ref)
-        do = _rd(do_ref)
-        lse = _rd(lse_ref)[0]
-        delta = _rd(delta_ref)[0]
-        k = _rd(k_ref)
-        v = _rd(v_ref)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None])).astype(q.dtype)
+        _pb, _do, ds = _bwd_block_math(
+            q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, causal,
+            q_start, k_start, block_q, block_k, scale_r)
         dq_scratch[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds, _rd(k_ref), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == num_k_blocks - 1)
@@ -458,7 +487,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
 def _combined_bwd_kernel(*refs, causal, block_q, block_k, num_q_blocks,
                          num_k_blocks, bh, rotate, barrier, axis_name,
-                         mesh_axes):
+                         mesh_axes, scale_r):
     """Flash backward with dk/dv AND dq from ONE probability recompute.
 
     Grid: (bh, ki, qi) — queries innermost so dk/dv accumulate in scratch
@@ -472,8 +501,8 @@ def _combined_bwd_kernel(*refs, causal, block_q, block_k, num_q_blocks,
     starts at the first grid step, flies under the gradient compute, and
     is waited at the last.  ``offsets_ref`` carries the absolute
     [q_offset, k_offset] for causal masking across shards (zeros for the
-    single-shard case).  ``q`` arrives pre-scaled by sm_scale; dq is
-    emitted in q' units (callers rescale once).
+    single-shard case).  ``q`` arrives pre-scaled by the pow2 part of
+    sm_scale; dq is emitted in q' units (callers rescale once).
     """
     if rotate:
         (offsets_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
@@ -532,35 +561,18 @@ def _combined_bwd_kernel(*refs, causal, block_q, block_k, num_q_blocks,
 
     @pl.when(run)
     def _():
-        q = _rd(q_ref)          # (block_q, d), pre-scaled by sm_scale
-        do = _rd(do_ref)        # (block_q, d)
-        lse = _rd(lse_ref)[0]   # (block_q,)
-        delta = _rd(delta_ref)[0]
-        k = _rd(k_ref)          # (block_k, d)
-        v = _rd(v_ref)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # POS_BIG lse zeroes masked rows
+        pb, do, ds = _bwd_block_math(
+            q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, causal,
+            q_start, k_start, block_q, block_k, scale_r)
         dv_scratch[...] += jax.lax.dot_general(
-            p.astype(v.dtype), do, (((0,), (0,)), ((), ())),
+            pb, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None])).astype(q.dtype)
         dk_scratch[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds, _rd(q_ref), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         row = pl.ds(qi * block_q, block_q)
         dq_scratch[row, :] = dq_scratch[row, :] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds, _rd(k_ref), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_q_blocks - 1)
@@ -588,10 +600,11 @@ def _combined_bwd_kernel(*refs, causal, block_q, block_k, num_q_blocks,
 
 def _combined_bwd_call(q, do, lse8, delta8, k_cur, v_cur, q_offset,
                        k_offset, *, causal, block_q, block_k, rotate,
-                       collective_id, axis_name, mesh_axes, interpret):
+                       collective_id, axis_name, mesh_axes, interpret,
+                       scale_r=1.0):
     """pallas_call wrapper for `_combined_bwd_kernel` over (bh, sl, d)
-    operands (q pre-scaled).  Returns (dk, dv, dq[, k_next, v_next]) with
-    the gradients in float32."""
+    operands (q pre-scaled by the pow2 part of sm_scale).  Returns
+    (dk, dv, dq[, k_next, v_next]) with the gradients in float32."""
     bh, sl, d = q.shape
     num_q, num_k = sl // block_q, sl // block_k
     offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
@@ -601,7 +614,7 @@ def _combined_bwd_call(q, do, lse8, delta8, k_cur, v_cur, q_offset,
         _combined_bwd_kernel, causal=causal, block_q=block_q,
         block_k=block_k, num_q_blocks=num_q, num_k_blocks=num_k, bh=bh,
         rotate=rotate, barrier=rotate and not interpret,
-        axis_name=axis_name, mesh_axes=mesh_axes)
+        axis_name=axis_name, mesh_axes=mesh_axes, scale_r=scale_r)
 
     def qspec(row):
         return pl.BlockSpec((1, block_q, d),
@@ -703,28 +716,116 @@ def _pick_block(seq_len: int, maximum: int = 512) -> int:
     return min(maximum, seq_len)  # ragged: the fallback path handles it
 
 
+def _bwd_plan(q_len: int, d: int, block_q: int, block_k: int):
+    """Choose the flash-backward execution mode and blocks against the
+    chip's 16 MiB scoped-VMEM ceiling.
+
+    Calibrated by compile sweep on v5e (r5; docs/benchmarks.md): the
+    combined kernel's whole-sequence dq scratch plus its double-buffered
+    dq output block cost ~12 B per sequence row per 128-lane group —
+    head_dim <= 128 pads to 128 lanes, so viability depends on
+    ``q_len * max(d, 128)``, NOT on block size alone (the r4 OOM: seq
+    8192 measured 20.84 MiB at 1024-blocks, and seq 16384 still measures
+    25.1 MiB at 256-blocks).  Measured boundaries, b2h8 grad path:
+
+    ==============================  =========================
+    q_len * max(d,128) / 128        viable combined blocks
+    ==============================  =========================
+    <= 4096                         up to (1024, 1024) (tuned)
+    <= 8192                         (512, 512) and below
+    > 8192                          none -> split kernels
+    ==============================  =========================
+
+    Returns ``(mode, block_q, block_k)`` with mode ``"combined"`` (one
+    probability recompute, whole-seq dq scratch) or ``"split"`` (dkdv +
+    dq kernel pair, O(block) scoped memory at any length)."""
+    rows128 = q_len * max(d, 128) // 128
+    if rows128 <= 4096:
+        return "combined", block_q, block_k
+    if rows128 <= 8192:
+        return ("combined", _pick_block(q_len, min(block_q, 512)),
+                _pick_block(q_len, min(block_k, 512)))
+    return ("split", _pick_block(q_len, min(block_q, 512)),
+            _pick_block(q_len, min(block_k, 512)))
+
+
+def _split_bwd_call(q, do, lse8, delta8, k, v, *, causal, block_q,
+                    block_k, interpret, scale_r):
+    """Split flash backward over (bh, sl, d) operands (q pre-scaled by
+    the pow2 part of sm_scale): two pallas_calls — dk/dv (queries inner)
+    and dq (keys inner) — each with O(block) scoped VMEM, so any
+    sequence length compiles.  Pays the s/p/dp/ds recompute twice; the
+    combined kernel is preferred whenever its whole-seq dq scratch fits
+    (see _bwd_plan).  Returns (dk, dv, dq) in float32."""
+    bh, sl, d = q.shape
+    num_q, num_k = sl // block_q, sl // block_k
+    qspec, kspec = _row_spec(block_q, d), _row_spec(block_k, d)
+
+    def lse_spec(row):
+        return pl.BlockSpec((1, 8, block_q), lambda b, i, j, _r=row:
+                            (b, 0, _r(i, j)))
+
+    inner = lambda i, j: j  # noqa: E731  (innermost grid dim)
+    outer = lambda i, j: i  # noqa: E731
+    dkdv = functools.partial(
+        _flash_bwd_dkdv_kernel, causal=causal, block_q=block_q,
+        block_k=block_k, num_q_blocks=num_q, scale_r=scale_r)
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(bh, num_k, num_q),  # queries innermost
+        in_specs=[qspec(inner), qspec(inner), lse_spec(inner),
+                  lse_spec(inner), kspec(outer), kspec(outer)],
+        out_specs=(kspec(outer), kspec(outer)),
+        out_shape=(jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, sl, d), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, do, lse8, delta8, k, v)
+    dqk = functools.partial(
+        _flash_bwd_dq_kernel, causal=causal, block_q=block_q,
+        block_k=block_k, num_k_blocks=num_k, scale_r=scale_r)
+    dq = pl.pallas_call(
+        dqk,
+        grid=(bh, num_q, num_k),  # keys innermost
+        in_specs=[qspec(outer), qspec(outer), lse_spec(outer),
+                  lse_spec(outer), kspec(inner), kspec(inner)],
+        out_specs=qspec(outer),
+        out_shape=jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, do, lse8, delta8, k, v)
+    return dk, dv, dq
+
+
 def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
                     block_k, interpret):
-    """Pallas flash backward: ONE combined kernel computes dk/dv and dq
-    from a single probability recompute per block (`_combined_bwd_kernel`
-    — the split dkdv/dq kernel pair paid the s/p/dp/ds recompute twice);
-    residual memory stays O(seq) (Dao et al. alg. 2)."""
+    """Pallas flash backward.  Two kernel strategies, chosen per shape by
+    :func:`_bwd_plan` against the scoped-VMEM ceiling: the combined
+    kernel computes dk/dv AND dq from a single probability recompute per
+    block (whole-seq dq scratch), the split dkdv/dq pair recomputes twice
+    but needs only O(block) scoped memory (long sequences).  Residual
+    memory is O(seq) either way (Dao et al. alg. 2)."""
     batch, heads, q_len, d = q.shape
     k_len = k.shape[2]
     block_q = min(block_q, q_len)
     block_k = min(block_k, k_len)
-    # The combined kernel keeps the whole per-(batch, head) dq row in
-    # VMEM; beyond ~8 MB (seq 16k at head_dim 128) route to the scan impl.
     if (q_len % block_q or k_len % block_k
-            or block_q % 128 or block_k % 128 or q_len != k_len
-            or q_len * d * 4 > 8 * 1024 * 1024):
+            or block_q % 128 or block_k % 128 or q_len != k_len):
+        return _attention_bwd_impl(q, k, v, out, lse, g, causal, sm_scale,
+                                   max(block_k, 128), 0, 0)
+    mode, block_q, block_k = _bwd_plan(q_len, d, block_q, block_k)
+    if q_len % block_q or k_len % block_k or block_q % 128 or block_k % 128:
+        # Plan stepped blocks down past what divides this length (rare
+        # non-power-of-two long seqs): the scan impl handles it.
         return _attention_bwd_impl(q, k, v, out, lse, g, causal, sm_scale,
                                    max(block_k, 128), 0, 0)
     bh = batch * heads
-    # Pre-scaled q (see _flash_forward): the kernel drops its two
-    # (seq, seq) sm_scale passes; dq comes back in q' units and is
+    # Pre-scaled q (see _flash_forward): exact pow2 factor on q, f32
+    # residual inside the kernel; dq comes back in q' units and is
     # rescaled once below.
-    qr = (q * sm_scale).astype(q.dtype).reshape(bh, q_len, d)
+    p2, scale_r = _split_scale(sm_scale)
+    qr = (q * p2).astype(q.dtype).reshape(bh, q_len, d)
     kr = k.reshape(bh, k_len, d)
     vr = v.reshape(bh, k_len, d)
     dor = g.reshape(bh, q_len, d)
@@ -735,11 +836,18 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
     delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, q_len))
     lse8 = jnp.broadcast_to(lse.reshape(bh, q_len)[:, None, :],
                             (bh, 8, q_len))
-    dk, dv, dq = _combined_bwd_call(
-        qr, dor, lse8, delta8, kr, vr, 0, 0, causal=causal,
-        block_q=block_q, block_k=block_k, rotate=False, collective_id=None,
-        axis_name=None, mesh_axes=(), interpret=interpret)
-    return ((dq * sm_scale).astype(q.dtype).reshape(q.shape),
+    if mode == "combined":
+        dk, dv, dq = _combined_bwd_call(
+            qr, dor, lse8, delta8, kr, vr, 0, 0, causal=causal,
+            block_q=block_q, block_k=block_k, rotate=False,
+            collective_id=None, axis_name=None, mesh_axes=(),
+            interpret=interpret, scale_r=scale_r)
+    else:
+        dk, dv, dq = _split_bwd_call(
+            qr, dor, lse8, delta8, kr, vr, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            scale_r=scale_r)
+    return ((dq * p2).astype(q.dtype).reshape(q.shape),
             dk.astype(k.dtype).reshape(k.shape),
             dv.astype(v.dtype).reshape(v.shape))
 
@@ -759,10 +867,12 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         return _blockwise_fwd_impl(q, k, v, causal, sm_scale,
                                    max(block_k, 128), 0, 0)
     bh = batch * heads
-    # Pre-scale q: one (seq, d) multiply here replaces a (seq, seq) pass
-    # inside the kernel (for head_dim a power of 4 the scale is a power
-    # of two, so this is exact even in bf16).
-    qr = (q * sm_scale).astype(q.dtype).reshape(bh, q_len, d)
+    # Pre-scale q by the exact power-of-two part of sm_scale: one
+    # (seq, d) multiply here replaces a (seq, seq) pass inside the
+    # kernel; the f32 residual (1.0 for power-of-4 head dims) is applied
+    # to the logits in-kernel, so non-pow2 scales lose no precision.
+    p2, scale_r = _split_scale(sm_scale)
+    qr = (q * p2).astype(q.dtype).reshape(bh, q_len, d)
     kr = k.reshape(bh, k_len, d)
     vr = v.reshape(bh, k_len, d)
     o_shape = jax.ShapeDtypeStruct((bh, q_len, d), q.dtype)
@@ -774,7 +884,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _flash_kernel, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=num_k)
+        block_k=block_k, num_k_blocks=num_k, scale_r=scale_r)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, num_q, num_k),
@@ -839,13 +949,15 @@ def flash_attention(q, k, v, causal: bool = False,
     state); elsewhere (and for ragged block tails) it falls back to the
     mathematically identical :func:`blockwise_attention`.  Differentiable
     with the flash backward (logsumexp residual + per-block recompute,
-    O(seq) memory, dk/dv and dq as two Pallas kernels).  Default blocks:
-    block_q up to 512, block_k up to 1024, each the largest candidate
-    dividing the sequence.  Measured on v5e at seq 1024, 512-blocks halve
-    the forward time vs 128-blocks (grid overhead amortizes and the MXU
-    sees larger operands) and whole-k 1024 key blocks gain another ~5%
-    end-to-end (no online-softmax rescale, no backward key loop); scratch
-    peaks around ~4 MB of VMEM at head_dim 64.
+    O(seq) memory).  Default blocks: up to 1024 each, the largest
+    candidate dividing the sequence — measured on v5e at seq 1024,
+    1024-row query blocks beat 512 by ~5% fwd+bwd (grid overhead
+    amortizes) and whole-k key blocks skip the online-softmax rescale
+    (the kernel's single_k path).  The BACKWARD re-plans blocks per
+    shape against the 16 MiB scoped-VMEM ceiling and switches to the
+    split dkdv/dq kernel pair for long sequences (see :func:`_bwd_plan`
+    — the r4 regression was exactly a tuned-block choice that did not
+    compile at seq 8192).
     """
     if layout not in ("bhsd", "bshd"):
         raise ValueError(f"unknown layout {layout!r}")

@@ -19,6 +19,15 @@ neighbour barrier first (remote DMA writes into the peer's buffer, so both
 sides must have entered the kernel); barrier semaphores need a
 ``collective_id``, reserved here as 13.
 
+HARDWARE CAVEAT: this module (and ops/ring_flash.py, which shares the
+barrier scheme) has NEVER run on a physical multi-chip slice — every
+round of this project had one chip.  The barrier/phase invariants are
+pinned by interpret-mode tests (tests/test_ops.py
+::test_rdma_phase_alternates_through_backward and
+::test_ring_flash_phase_stream_alternates), but validate on a real slice
+before production use; ``lax.ppermute`` is the default rotation for
+exactly this reason.
+
 No reference counterpart (SURVEY §5.7: the reference has no sequence
 parallelism at all); this exceeds it.
 """

@@ -53,8 +53,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from horovod_tpu.ops.attention import (NEG_INF, POS_BIG, _attend_block,
+                                       _bwd_plan, _combined_bwd_call,
                                        _finalize_flash, _init_state,
-                                       _pick_block, _rd)
+                                       _pick_block, _split_scale)
 
 try:
     import jax.experimental.pallas as pl
@@ -72,7 +73,7 @@ if _HAS_PALLAS:
 
 def _step_kernel(*refs, causal, block_q, block_k, num_q_blocks,
                  num_k_blocks, bh, rotate, barrier, phase, axis_name,
-                 mesh_axes):
+                 mesh_axes, scale_r):
     """One ring step: start K/V DMA to the right neighbour, flash-attend
     the current shard, wait the DMA at the end.
 
@@ -136,7 +137,8 @@ def _step_kernel(*refs, causal, block_q, block_k, num_q_blocks,
         # still covers whole-shard-masked ring steps (run stays False).
         _attend_block(q_ref, k_ref, v_ref, m_scratch, l_scratch,
                       acc_scratch, q_start, k_start, causal,
-                      block_q, block_k, single_k=num_k_blocks == 1)
+                      block_q, block_k, single_k=num_k_blocks == 1,
+                      scale_r=scale_r)
 
     @pl.when(ki == num_k_blocks - 1)
     def _():
@@ -166,216 +168,23 @@ def _row_spec(block, d, row):
                         lambda b, qi, ki, s: (b, row(qi, ki), 0))
 
 
-def _bwd_step_kernel(*refs, causal, block_q, block_k,
-                     num_q_blocks, num_k_blocks, seq_local, bh, rotate,
-                     barrier, axis_name, mesh_axes):
-    """One fused backward ring step: start the K/V rotation DMA, compute
-    this shard's dk/dv AND dq gradient blocks from ONE probability
-    recompute, wait the DMA at the end.
-
-    Grid: (bh, ki, qi) — queries innermost so dk/dv accumulate in scratch
-    and flush per key block (the `_flash_bwd_dkdv_kernel` order); dq
-    accumulates in a whole-shard VMEM scratch and flushes once per bh
-    row.  ``offsets_ref`` carries the absolute [q_offset, k_offset] for
-    causal masking across shards, as in the forward step kernel.
-    """
-    if rotate:
-        (offsets_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-         k_full, v_full, dk_ref, dv_ref, dq_ref, k_next, v_next,
-         dk_scratch, dv_scratch, dq_scratch, sems) = refs
-    else:
-        (offsets_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-         dk_ref, dv_ref, dq_ref,
-         dk_scratch, dv_scratch, dq_scratch) = refs
-    b = pl.program_id(0)
-    ki = pl.program_id(1)
-    qi = pl.program_id(2)
-
-    if rotate:
-        my = lax.axis_index(axis_name)
-        n = lax.axis_size(axis_name)
-        dst, id_type = _device_id(lax.rem(my + 1, n), axis_name, mesh_axes)
-        src, _ = _device_id(lax.rem(my - 1 + n, n), axis_name, mesh_axes)
-
-        @pl.when((b == 0) & (ki == 0) & (qi == 0))
-        def _start_rotation():
-            if barrier:
-                bar = pltpu.get_barrier_semaphore()
-                pltpu.semaphore_signal(
-                    bar, inc=1, device_id=src, device_id_type=id_type)
-                pltpu.semaphore_wait(bar, 1)
-            pltpu.make_async_remote_copy(
-                src_ref=k_full, dst_ref=k_next, send_sem=sems.at[0],
-                recv_sem=sems.at[1], device_id=dst,
-                device_id_type=id_type).start()
-            pltpu.make_async_remote_copy(
-                src_ref=v_full, dst_ref=v_next, send_sem=sems.at[2],
-                recv_sem=sems.at[3], device_id=dst,
-                device_id_type=id_type).start()
-
-    @pl.when((ki == 0) & (qi == 0))
-    def _zero_dq():
-        dq_scratch[...] = jnp.zeros_like(dq_scratch)
-
-    @pl.when(qi == 0)
-    def _zero_dkdv():
-        dk_scratch[...] = jnp.zeros_like(dk_scratch)
-        dv_scratch[...] = jnp.zeros_like(dv_scratch)
-
-    if causal:
-        q_start = offsets_ref[0] + qi * block_q  # absolute positions
-        k_start = offsets_ref[1] + ki * block_k
-        run = q_start + block_q - 1 >= k_start
-    else:
-        q_start = k_start = 0
-        run = True
-
-    @pl.when(run)
-    def _():
-        q = _rd(q_ref)          # (block_q, d), pre-scaled by sm_scale
-        do = _rd(do_ref)        # (block_q, d)
-        lse = _rd(lse_ref)[0]   # (block_q,)
-        delta = _rd(delta_ref)[0]
-        k = _rd(k_ref)          # (block_k, d)
-        v = _rd(v_ref)
-        s = lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = q_start + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # POS_BIG lse zeroes masked rows
-        dv_scratch[...] += lax.dot_general(
-            p.astype(v.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None])).astype(q.dtype)
-        dk_scratch[...] += lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        row = pl.ds(qi * block_q, block_q)
-        dq_scratch[row, :] = dq_scratch[row, :] + lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    @pl.when(qi == num_q_blocks - 1)
-    def _flush_dkdv():
-        dk_ref[...] = dk_scratch[...].reshape(dk_ref.shape)
-        dv_ref[...] = dv_scratch[...].reshape(dv_ref.shape)
-
-    @pl.when((ki == num_k_blocks - 1) & (qi == num_q_blocks - 1))
-    def _flush_dq():
-        dq_ref[...] = dq_scratch[...].reshape(dq_ref.shape)
-
-    if rotate:
-        @pl.when((b == bh - 1) & (ki == num_k_blocks - 1)
-                 & (qi == num_q_blocks - 1))
-        def _finish_rotation():
-            pltpu.make_async_remote_copy(
-                src_ref=k_full, dst_ref=k_next, send_sem=sems.at[0],
-                recv_sem=sems.at[1], device_id=dst,
-                device_id_type=id_type).wait()
-            pltpu.make_async_remote_copy(
-                src_ref=v_full, dst_ref=v_next, send_sem=sems.at[2],
-                recv_sem=sems.at[3], device_id=dst,
-                device_id_type=id_type).wait()
-
-
 def _bwd_ring_step(q, do, lse8, delta8, k_cur, v_cur, q_offset, k_offset, *,
                    causal, block_q, block_k, rotate, phase,
-                   axis_name, interpret):
+                   axis_name, interpret, scale_r):
     """One fused backward ring step over (bh, seq_local, d) shards (q
-    arrives pre-scaled by sm_scale).  Returns (dk, dv, dq, k_next,
-    v_next) — dk/dv/dq float32 contributions for the CURRENTLY HELD
-    shard (dq in q' units); k_next/v_next only when rotating."""
-    bh, sl, d = q.shape
-    num_q, num_k = sl // block_q, sl // block_k
-    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
-                         jnp.asarray(k_offset, jnp.int32)])
-
-    kernel = functools.partial(
-        _bwd_step_kernel, causal=causal,
-        block_q=block_q, block_k=block_k, num_q_blocks=num_q,
-        num_k_blocks=num_k, seq_local=sl, bh=bh, rotate=rotate,
-        barrier=rotate and not interpret, axis_name=axis_name,
-        mesh_axes=_ambient_mesh_axes(axis_name))
-
-    def qspec(row):
-        return pl.BlockSpec((1, block_q, d),
-                            lambda b, ki, qi, s, _r=row: (b, _r(qi, ki), 0))
-
-    def kspec(row):
-        return pl.BlockSpec((1, block_k, d),
-                            lambda b, ki, qi, s, _r=row: (b, _r(qi, ki), 0))
-
-    inner_q = lambda qi, ki: qi  # noqa: E731
-    outer_k = lambda qi, ki: ki  # noqa: E731
-    in_specs = [
-        qspec(inner_q),                                    # q
-        qspec(inner_q),                                    # do
-        pl.BlockSpec((1, 8, block_q), lambda b, ki, qi, s: (b, 0, qi)),
-        pl.BlockSpec((1, 8, block_q), lambda b, ki, qi, s: (b, 0, qi)),
-        kspec(outer_k),                                    # k (blocked)
-        kspec(outer_k),                                    # v (blocked)
-    ]
-    out_shapes = [
-        jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),    # dk
-        jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),    # dv
-        jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),    # dq
-    ]
-    out_specs = [
-        kspec(outer_k),                                    # dk
-        kspec(outer_k),                                    # dv
-        pl.BlockSpec((1, sl, d), lambda b, ki, qi, s: (b, 0, 0)),  # dq
-    ]
-    scratch_shapes = [
-        pltpu.VMEM((block_k, d), jnp.float32),             # dk accumulator
-        pltpu.VMEM((block_k, d), jnp.float32),             # dv accumulator
-        pltpu.VMEM((sl, d), jnp.float32),                  # whole-shard dq
-    ]
-    args = [offsets, q, do, lse8, delta8, k_cur, v_cur]
-    if rotate:
-        in_specs += [
-            pl.BlockSpec(memory_space=pl.ANY),             # k (DMA src)
-            pl.BlockSpec(memory_space=pl.ANY),             # v (DMA src)
-        ]
-        out_shapes += [
-            jax.ShapeDtypeStruct(k_cur.shape, k_cur.dtype),  # k_next
-            jax.ShapeDtypeStruct(v_cur.shape, v_cur.dtype),  # v_next
-        ]
-        out_specs += [
-            pl.BlockSpec(memory_space=pl.ANY),             # k_next
-            pl.BlockSpec(memory_space=pl.ANY),             # v_next
-        ]
-        scratch_shapes += [pltpu.SemaphoreType.DMA((4,))]
-        args += [k_cur, v_cur]
-    vma = getattr(jax.typeof(q), "vma", None)
-    if vma is not None:
-        out_shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype, vma=vma)
-                      for s in out_shapes]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(bh, num_k, num_q),
-        in_specs=in_specs,
-        out_specs=out_specs,
-        scratch_shapes=scratch_shapes,
-    )
+    arrives pre-scaled by the pow2 part of sm_scale).  Returns (dk, dv,
+    dq, k_next, v_next) — dk/dv/dq float32 contributions for the
+    CURRENTLY HELD shard (dq in q' units); k_next/v_next only when
+    rotating.  The kernel is attention.py's combined backward
+    (`_combined_bwd_kernel`) invoked with rotate=True: one probability
+    recompute feeds dk/dv and dq while the K/V rotation DMA flies."""
     barrier = rotate and not interpret
-    compiler_params = pltpu.CompilerParams(
+    results = _combined_bwd_call(
+        q, do, lse8, delta8, k_cur, v_cur, q_offset, k_offset,
+        causal=causal, block_q=block_q, block_k=block_k, rotate=rotate,
         collective_id=_COLLECTIVE_IDS[phase % 2] if barrier else None,
-        has_side_effects=True)
-    results = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=out_shapes,
-        compiler_params=compiler_params,
-        interpret=interpret,
-    )(*args)
+        axis_name=axis_name, mesh_axes=_ambient_mesh_axes(axis_name),
+        interpret=interpret, scale_r=scale_r)
     if rotate:
         dk, dv, dq, k_next, v_next = results
         return dk, dv, dq, k_next, v_next
@@ -385,10 +194,10 @@ def _bwd_ring_step(q, do, lse8, delta8, k_cur, v_cur, q_offset, k_offset, *,
 
 def _ring_flash_step(q, k_cur, v_cur, q_offset, k_offset, *,
                      causal, block_q, block_k, rotate, phase, axis_name,
-                     interpret):
+                     interpret, scale_r):
     """One fused ring step over (bh, seq_local, d) shards (q arrives
-    pre-scaled by sm_scale).  Returns (out, lse, k_next, v_next) —
-    k_next/v_next only when rotating."""
+    pre-scaled by the pow2 part of sm_scale).  Returns (out, lse,
+    k_next, v_next) — k_next/v_next only when rotating."""
     bh, sl, d = q.shape
     block_q = _pick_block(sl, block_q)
     block_k = _pick_block(sl, block_k)
@@ -403,7 +212,8 @@ def _ring_flash_step(q, k_cur, v_cur, q_offset, k_offset, *,
         _step_kernel, causal=causal, block_q=block_q,
         block_k=block_k, num_q_blocks=num_q, num_k_blocks=num_k, bh=bh,
         rotate=rotate, barrier=rotate and not interpret, phase=phase,
-        axis_name=axis_name, mesh_axes=_ambient_mesh_axes(axis_name))
+        axis_name=axis_name, mesh_axes=_ambient_mesh_axes(axis_name),
+        scale_r=scale_r)
     out_shapes = [
         jax.ShapeDtypeStruct((bh, sl, d), q.dtype),        # out
         jax.ShapeDtypeStruct((bh, 8, sl), jnp.float32),    # lse (8 sublanes)
@@ -497,6 +307,24 @@ def _phase_closer(axis_name):
     )()
 
 
+def _rotation_phases(n: int):
+    """Barrier-phase schedule for one fused ring pass over ``n`` devices.
+
+    Returns ``(phases, needs_closer)``: ``phases[t]`` is the barrier
+    namespace (0/1 -> collective_ids 15/16) of rotating step ``t`` (the
+    last step doesn't rotate), and ``needs_closer`` says whether a
+    trailing :func:`_phase_closer` on phase 1 is required so the pass's
+    barrier stream has even length — the cyclic-alternation invariant
+    (ops/rdma.py): consecutive barrier invocations, INCLUDING the
+    junctions forward->backward and end-of-step->next-step of a re-run
+    jitted program, must never share a namespace, or a lagging device's
+    ready-wait could be satisfied by a neighbour's next-invocation
+    signal.  Pure so tests can pin the schedule
+    (tests/test_ops.py::test_ring_flash_phase_stream_alternates)."""
+    phases = [t % 2 for t in range(n - 1)]
+    return phases, len(phases) % 2 == 1
+
+
 def _merge(o1, lse1, o2, lse2):
     """Flash-merge two partial attention results.  POS_BIG lse rows carry
     zero mass (fully masked).  Returns the merged output in FLOAT32 — the
@@ -525,13 +353,15 @@ def _fused_forward(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
     sl = q.shape[-2]
     batch, heads = q.shape[0], q.shape[1]
     bh = batch * heads
-    # Pre-scaled q (ops/attention.py): one (seq, d) pass replaces a
-    # (seq, seq) kernel pass per ring step.
-    qr = (q * sm_scale).astype(q.dtype).reshape(bh, sl, q.shape[-1])
+    # Pre-scaled q (ops/attention.py): exact pow2 factor on q, f32
+    # residual applied to the logits inside the kernel.
+    p2, scale_r = _split_scale(sm_scale)
+    qr = (q * p2).astype(q.dtype).reshape(bh, sl, q.shape[-1])
     k_cur = k.reshape(bh, sl, k.shape[-1])
     v_cur = v.reshape(bh, sl, v.shape[-1])
     q_off = my * sl
 
+    phases, needs_closer = _rotation_phases(n)
     out = lse = None
     for t in range(n):
         kv_idx = lax.rem(my - t + n, n)
@@ -539,15 +369,15 @@ def _fused_forward(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
         o_t, lse_t, k_next, v_next = _ring_flash_step(
             qr, k_cur, v_cur, q_off, k_off,
             causal=causal, block_q=block_q, block_k=block_k,
-            rotate=t < n - 1, phase=t % 2, axis_name=axis_name,
-            interpret=interpret)
+            rotate=t < n - 1, phase=phases[t] if t < n - 1 else 0,
+            axis_name=axis_name, interpret=interpret, scale_r=scale_r)
         if t < n - 1:
             k_cur, v_cur = k_next, v_next
         if out is None:
             out, lse = o_t, lse_t
         else:
             out, lse = _merge(out, lse, o_t, lse_t)
-    if not interpret and (n - 1) % 2 == 1:
+    if not interpret and needs_closer:
         # Even ring: odd number of rotating steps [0,1,...,0] — close the
         # barrier-phase stream on 1 so repeated executions alternate.
         _phase_closer(axis_name)
@@ -565,7 +395,8 @@ def _fused_backward(q, k, v, out, lse, g, axis_name, causal, sm_scale,
     my = lax.axis_index(axis_name)
     batch, heads, sl, d = q.shape
     bh = batch * heads
-    qr = (q * sm_scale).astype(q.dtype).reshape(bh, sl, d)  # q' units
+    p2, scale_r = _split_scale(sm_scale)
+    qr = (q * p2).astype(q.dtype).reshape(bh, sl, d)  # q' units
     dor = g.reshape(bh, sl, d)
     k_cur = k.reshape(bh, sl, d)
     v_cur = v.reshape(bh, sl, d)
@@ -578,6 +409,7 @@ def _fused_backward(q, k, v, out, lse, g, axis_name, causal, sm_scale,
     lse8 = jnp.broadcast_to(lse.reshape(bh, sl)[:, None, :], (bh, 8, sl))
 
     perm = [(i, (i + 1) % n) for i in range(n)]
+    phases, needs_closer = _rotation_phases(n)
     dq_total = None
     acc_k = acc_v = None
     for t in range(n):
@@ -586,8 +418,9 @@ def _fused_backward(q, k, v, out, lse, g, axis_name, causal, sm_scale,
         dk_t, dv_t, dq_t, k_next, v_next = _bwd_ring_step(
             qr, dor, lse8, delta8, k_cur, v_cur, q_off, k_off,
             causal=causal, block_q=block_q,
-            block_k=block_k, rotate=t < n - 1, phase=t % 2,
-            axis_name=axis_name, interpret=interpret)
+            block_k=block_k, rotate=t < n - 1,
+            phase=phases[t] if t < n - 1 else 0,
+            axis_name=axis_name, interpret=interpret, scale_r=scale_r)
         if t < n - 1:
             k_cur, v_cur = k_next, v_next
         dq_total = dq_t if dq_total is None else dq_total + dq_t
@@ -604,10 +437,10 @@ def _fused_backward(q, k, v, out, lse, g, axis_name, causal, sm_scale,
         # After step n-1, shard j's totals sit one hop left of owner j.
         acc_k = lax.ppermute(acc_k, axis_name, perm)
         acc_v = lax.ppermute(acc_v, axis_name, perm)
-    if not interpret and (n - 1) % 2 == 1:
+    if not interpret and needs_closer:
         _phase_closer(axis_name)  # same stream invariant as the forward
-    # dq accumulated in q' = sm_scale*q units; rescale once.
-    return ((dq_total * sm_scale).reshape(q.shape).astype(q.dtype),
+    # dq accumulated in q' = p2*q units; rescale once.
+    return ((dq_total * p2).reshape(q.shape).astype(q.dtype),
             acc_k.reshape(k.shape).astype(k.dtype),
             acc_v.reshape(v.shape).astype(v.dtype))
 
@@ -655,9 +488,18 @@ def fused_ring_attention(q, k, v, axis_name: str, causal: bool = False,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     sl = q.shape[-2]
+    d = q.shape[-1]
     bq, bk = _pick_block(sl, block_q), _pick_block(sl, block_k)
     off_grid = sl % bq or sl % bk or (not interpret
                                       and (bq % 128 or bk % 128))
+    # The fused backward step is the combined kernel — whole-shard dq
+    # scratch in VMEM.  Long local shards where that cannot compile
+    # (attention._bwd_plan, calibrated against the 16 MiB scoped-VMEM
+    # ceiling) route to the separable ppermute ring, whose backward
+    # composes per-step flash backwards, instead of failing at Mosaic
+    # compile time on the backward pass (ADVICE r4).
+    mode, bq, bk = _bwd_plan(sl, d, bq, bk)
+    off_grid = off_grid or mode != "combined" or sl % bq or sl % bk
     # Interpret-mode (CPU test mesh) remote DMA only supports single-axis
     # meshes (upstream dma_start_p limitation); a dp x sp mesh on CPU
     # falls back to the separable ring.  Real TPUs use MESH device ids

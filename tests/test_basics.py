@@ -55,6 +55,12 @@ def test_rank_subset(monkeypatch):
     ps = resolve_process_set(ranks=[1, 3])
     assert ps.rank == 1 and ps.size == 2
     assert list(ps.data_endpoints) == ["h1:2", "h3:2"]
+    # List order defines the numbering (MPI Group.Incl semantics): a
+    # reordered subset makes this launcher rank the subset ROOT.
+    ps = resolve_process_set(ranks=[3, 1])
+    assert ps.rank == 0 and ps.size == 2
+    assert list(ps.data_endpoints) == ["h3:2", "h1:2"]
+    assert ps.coord_endpoint.startswith("h3:")
     with pytest.raises(ValueError):
         resolve_process_set(ranks=[0, 2])  # our rank not in subset
 
@@ -128,3 +134,28 @@ def test_config_env(monkeypatch):
     assert cfg.fusion_threshold == 1024
     assert cfg.cycle_time_ms == 2.5
     assert cfg.timeline_path == "/tmp/tl.json"
+
+
+def test_comm_ranks_shim():
+    """comm_ranks maps an mpi4py-style communicator to the rank-subset
+    form (duck-typed allgather of launcher ranks; reference
+    /root/reference/horovod/common/__init__.py:51-78)."""
+    import pytest
+
+    from horovod_tpu.common.basics import comm_ranks
+
+    class Comm:
+        def __init__(self, members, size=None):
+            self._members, self._size = members, size or len(members)
+
+        def Get_size(self):
+            return self._size
+
+        def allgather(self, value):
+            assert value in self._members
+            return list(self._members)
+
+    assert comm_ranks(Comm([0, 2]), 2) == [0, 2]
+    assert comm_ranks(Comm([3, 1, 5]), 1) == [3, 1, 5]
+    with pytest.raises(ValueError):
+        comm_ranks(Comm([0, 2], size=3), 0)  # gather/size mismatch

@@ -309,6 +309,43 @@ def test_init_comm_subset():
     assert np.allclose(out, 2.0), out  # 0 + 2
 
 
+@distributed_test(np_=3)
+def test_init_comm_mpi4py_style():
+    """hvd.init(comm=<communicator>) accepts an mpi4py-style object (the
+    reference's second init form, /root/reference/horovod/common/
+    __init__.py:51-78): duck-typed Get_size/allgather, each member
+    contributing its launcher rank.  The stub stands in for a REORDERED
+    subcommunicator (comm rank 0 = launcher rank 2, as
+    MPI.Group.Incl([2, 0]) would build): hvd.rank() must equal the
+    comm's own rank, so root-only logic stays on the comm's root."""
+    import os
+
+    import horovod_tpu as hvd
+
+    launcher_rank = int(os.environ["HVD_TPU_RANK"])
+    if launcher_rank == 1:
+        return  # not a member of the communicator; must not join
+    comm_rank = 0 if launcher_rank == 2 else 1
+
+    class SubComm:  # mpi4py allgather returns values in comm-rank order
+        def Get_size(self):
+            return 2
+
+        def Get_rank(self):
+            return comm_rank
+
+        def allgather(self, value):
+            assert value == launcher_rank
+            return [2, 0]
+
+    hvd.init(comm=SubComm())
+    assert hvd.size() == 2
+    assert hvd.rank() == comm_rank
+    out = hvd.allreduce(np.full(4, float(launcher_rank), np.float32),
+                        average=False, name="mpi4py_subset")
+    assert np.allclose(out, 2.0), out  # 0 + 2
+
+
 def test_timeline_written(tmp_path):
     """Timeline (Chrome tracing) is written on rank 0 when enabled --
     reference aux subsystem /root/reference/horovod/common/timeline.{h,cc}."""
@@ -326,7 +363,12 @@ def test_timeline_written(tmp_path):
         "hvd.allgather(np.ones((2, 2), np.float32), name='tl.g')\n"
         "hvd.shutdown()\n"
     )
-    env = dict(os.environ, HOROVOD_TIMELINE=str(tl), JAX_PLATFORMS="cpu")
+    # Pin the TCP engine transport: on a TPU-attached host the site hook
+    # re-registers the TPU platform inside the child (overriding
+    # JAX_PLATFORMS), and the auto-enabled XLA data plane would record
+    # XLA_ALLREDUCE instead of the engine activities asserted below.
+    env = dict(os.environ, HOROVOD_TIMELINE=str(tl), JAX_PLATFORMS="cpu",
+               HVD_TPU_XLA_DATA_PLANE="0")
     for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE"):
         env.pop(var, None)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -120,6 +120,34 @@ def test_bench_smoke():
     assert rec["value"] > 0
 
 
+def test_bench_headline_survives_failing_extra():
+    """A failing extra must never erase the headline metric (the round-4
+    failure mode: a 20 KB compile error inside the single JSON line pushed
+    it past the driver's capture window).  The headline line must be on
+    stdout BEFORE the extras run, and extra errors must be clipped short."""
+    import json
+
+    env = dict(os.environ, BENCH_MODEL="resnet101", BENCH_IMAGE="32",
+               BENCH_BATCH="2", BENCH_STEPS="1", BENCH_WARMUP="1",
+               BENCH_PLATFORM="cpu", BENCH_EXTRA_INJECT_FAIL="1",
+               BENCH_EXTRA_CONFIGS="64:2")
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 2, lines
+    headline = json.loads(lines[0])
+    assert "extra_metrics" not in headline  # printed before extras ran
+    assert headline["value"] > 0
+    enriched = json.loads(lines[1])
+    err = enriched["extra_metrics"][
+        "transformer_seq64_tokens_per_sec_per_chip"]
+    assert err.startswith("error: injected failure")
+    assert len(lines[1]) < 2000  # clipped: fits any capture window
+
+
 def test_space_to_depth_stem_is_exact():
     """SpaceToDepthStem is the 7x7/stride-2 SAME conv *exactly* (same
     parameter, reshaped weights), on both even (s2d) and odd (plain-conv

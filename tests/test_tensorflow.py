@@ -216,7 +216,9 @@ def test_tf_async_group_completes_in_few_ticks():
     # 50 ms cycles: the <=2-tick assertion measures CO-ARRIVAL (fusion),
     # not latency — with the default 5 ms cycle a GIL/scheduler hiccup on
     # a loaded box can spread enqueues across >2 cycles and flake the
-    # test without any product regression (ADVICE r3).
+    # test without any product regression (ADVICE r3).  This body runs in
+    # a rank SUBPROCESS (@distributed_test), so the override dies with
+    # the process — no leak into later pytest-process tests.
     os.environ["HVD_TPU_CYCLE_TIME"] = "50"
     hvd = _init()
     r = hvd.rank()

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Scoped-VMEM calibration sweep for the flash-attention backward.
+
+Recompiles `jax.grad(flash_attention)` over (seq, head_dim, block_q,
+block_k) on the attached TPU and reports which configs fit the chip's
+scoped-VMEM ceiling — the ground truth behind
+`horovod_tpu.ops.attention._bwd_plan` (r5 calibration; the r4 regression
+was a tuned block choice that stopped compiling at seq 8192).  Compile-
+only: safe to run anywhere a TPU is visible, ~1-2 s per config.
+
+Usage: python tools/vmem_sweep.py [--full]
+  default: the documented sweep {1k, 4k, 8k, 16k} x {64, 128} with the
+  plan's chosen blocks (should print all OK);
+  --full: every block candidate per shape, to re-derive the plan table
+  after a Mosaic/compiler update.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.ops.attention import _bwd_plan, flash_attention
+
+
+def try_compile(sl, d, bq, bk):
+    q = jnp.zeros((2, 8, sl, d), jnp.bfloat16)
+
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=bq,
+                               block_k=bk).astype(jnp.float32).sum()
+
+    t0 = time.time()
+    try:
+        jax.jit(jax.grad(f, argnums=(0, 1, 2))).lower(q, q, q).compile()
+        return "OK", time.time() - t0, ""
+    except Exception as e:  # report the Mosaic scoped-vmem line if present
+        key = next((ln.strip() for ln in str(e).splitlines()
+                    if "Scoped allocation" in ln), str(e).splitlines()[0])
+        return "FAIL", time.time() - t0, key[:110]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true",
+                    help="sweep every block candidate, not just the plan's")
+    args = ap.parse_args()
+    if jax.default_backend() != "tpu":
+        print("no TPU visible; this sweep only means something on-chip")
+        return
+    cands = [(1024, 1024), (512, 1024), (1024, 512), (512, 512),
+             (256, 512), (256, 256)]
+    failures = 0
+    for d in (64, 128):
+        for sl in (1024, 4096, 8192, 16384):
+            if args.full:
+                todo = [c for c in cands if sl % c[0] == 0 and sl % c[1] == 0]
+            else:
+                mode, bq, bk = _bwd_plan(sl, d, 1024, 1024)
+                todo = [(bq, bk)]
+            for bq, bk in todo:
+                st, dt, key = try_compile(sl, d, bq, bk)
+                plan = _bwd_plan(sl, d, bq, bk)
+                print(f"d={d} sl={sl} bq={bq} bk={bk} plan={plan}: "
+                      f"{st} ({dt:.1f}s) {key}", flush=True)
+                failures += st != "OK" and not args.full
+    if failures:
+        sys.exit(f"{failures} plan-chosen config(s) failed to compile")
+    print("all plan-chosen configs compile")
+
+
+if __name__ == "__main__":
+    main()
