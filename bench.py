@@ -372,10 +372,13 @@ def main() -> None:
     # -level unroll, NOT lax.scan — the scan body loses ~2 ms/step of
     # memory-space-assignment quality, r3 tuning log): the ~2.7 ms
     # per-execute tunnel overhead amortizes K-fold while the per-step HLO
-    # stays identical.  Donating params/stats/opt_state lets XLA update
+    # stays identical.  Default 8 for the resnet101 headline (measured
+    # r5: 1717/1723 -> 1745 img/s; compile time grows ~K-fold, so other
+    # models keep 1).  Donating params/stats/opt_state lets XLA update
     # in place instead of allocating fresh HBM buffers every step (~1.5%
     # on resnet101).
-    unroll = max(1, int(os.environ.get("BENCH_UNROLL", "1")))
+    unroll = max(1, int(os.environ.get(
+        "BENCH_UNROLL", "8" if model_name == "resnet101" else "1")))
 
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, batch_stats, opt_state, images, labels):
@@ -426,9 +429,11 @@ def main() -> None:
         # record can never outgrow the driver's output tail (the r4
         # failure mode: a 20 KB Mosaic error inside the JSON).
         extras = {}
-        # seq:batch pairs; 8192:2 keeps tokens/step equal to 1024:16 (the
-        # long-context protocol of docs/benchmarks.md).
-        cfgs = os.environ.get("BENCH_EXTRA_CONFIGS", "1024:16,8192:2")
+        # seq:batch pairs, token-constant (16k tokens/step — the
+        # long-context protocol of docs/benchmarks.md); the full
+        # documented sweep so each round's driver record carries it.
+        cfgs = os.environ.get("BENCH_EXTRA_CONFIGS",
+                              "1024:16,4096:4,8192:2,16384:1")
         for cfg in cfgs.split(","):
             try:  # a malformed config must not cost the headline metric
                 s, b = (int(v) for v in cfg.split(":"))

@@ -14,7 +14,7 @@ import ctypes
 import os
 import threading
 import weakref
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence, Union
 
 import numpy as np
 
@@ -109,7 +109,7 @@ def _load_lib():
         return lib
 
 
-def init(comm: Optional[Sequence[int]] = None) -> None:
+def init(comm: Union[Sequence[int], Any, None] = None) -> None:
     """Initialize the engine.
 
     ``comm`` optionally restricts the job to a subset of launcher ranks —

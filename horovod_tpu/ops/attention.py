@@ -389,9 +389,10 @@ def _bwd_block_math(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     ds_r = r * dL/ds — so dk = ds_r^T q' and dq' = ds_r k are exact in
     q' units (the wrapper rescales dq by the pow2 factor once).
 
-    Returns ``(pb, do, ds)``: the probability block cast to v's dtype
-    (for dv += pb^T do), the loaded dO block, and the scaled ds block
-    cast to q's dtype (for dk/dq dots)."""
+    Returns ``(pb, ds, q, do, k)``: the probability block cast to v's
+    dtype (for dv += pb^T do), the scaled ds block cast to q's dtype
+    (for dk/dq dots), and the loaded q/do/k blocks — returned so callers
+    don't re-read the refs (a second ``_rd`` costs extra scoped VMEM)."""
     q = _rd(q_ref)          # (block_q, d), pre-scaled (pow2 part)
     do = _rd(do_ref)        # (block_q, d)
     lse = _rd(lse_ref)[0]   # (block_q,)
@@ -416,7 +417,7 @@ def _bwd_block_math(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     ds = p * (dp - delta[:, None])
     if scale_r != 1.0:
         ds *= scale_r
-    return p.astype(v.dtype), do, ds.astype(q.dtype)
+    return p.astype(v.dtype), ds.astype(q.dtype), q, do, k
 
 
 def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
@@ -439,14 +440,14 @@ def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
     @pl.when(run)
     def _():
-        pb, do, ds = _bwd_block_math(
+        pb, ds, q, do, _k = _bwd_block_math(
             q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, causal,
             q_start, k_start, block_q, block_k, scale_r)
         dv_scratch[...] += jax.lax.dot_general(
             pb, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_scratch[...] += jax.lax.dot_general(
-            ds, _rd(q_ref), (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_q_blocks - 1)
@@ -473,11 +474,11 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
     @pl.when(run)
     def _():
-        _pb, _do, ds = _bwd_block_math(
+        _pb, ds, _q, _do, k = _bwd_block_math(
             q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, causal,
             q_start, k_start, block_q, block_k, scale_r)
         dq_scratch[...] += jax.lax.dot_general(
-            ds, _rd(k_ref), (((1,), (0,)), ((), ())),
+            ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == num_k_blocks - 1)
@@ -561,18 +562,18 @@ def _combined_bwd_kernel(*refs, causal, block_q, block_k, num_q_blocks,
 
     @pl.when(run)
     def _():
-        pb, do, ds = _bwd_block_math(
+        pb, ds, q, do, k = _bwd_block_math(
             q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, causal,
             q_start, k_start, block_q, block_k, scale_r)
         dv_scratch[...] += jax.lax.dot_general(
             pb, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_scratch[...] += jax.lax.dot_general(
-            ds, _rd(q_ref), (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         row = pl.ds(qi * block_q, block_q)
         dq_scratch[row, :] = dq_scratch[row, :] + jax.lax.dot_general(
-            ds, _rd(k_ref), (((1,), (0,)), ((), ())),
+            ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_q_blocks - 1)
@@ -716,37 +717,56 @@ def _pick_block(seq_len: int, maximum: int = 512) -> int:
     return min(maximum, seq_len)  # ragged: the fallback path handles it
 
 
-def _bwd_plan(q_len: int, d: int, block_q: int, block_k: int):
+def _bwd_plan(q_len: int, d: int, block_q: int, block_k: int,
+              bh: int = 1):
     """Choose the flash-backward execution mode and blocks against the
     chip's 16 MiB scoped-VMEM ceiling.
 
-    Calibrated by compile sweep on v5e (r5; docs/benchmarks.md): the
-    combined kernel's whole-sequence dq scratch plus its double-buffered
-    dq output block cost ~12 B per sequence row per 128-lane group —
-    head_dim <= 128 pads to 128 lanes, so viability depends on
-    ``q_len * max(d, 128)``, NOT on block size alone (the r4 OOM: seq
-    8192 measured 20.84 MiB at 1024-blocks, and seq 16384 still measures
-    25.1 MiB at 256-blocks).  Measured boundaries, b2h8 grad path:
+    Calibrated by on-chip compile sweep, v5e r5 (tools/vmem_sweep.py;
+    docs/benchmarks.md).  Mosaic's scoped allocation for the combined
+    kernel is NOT a simple closed form — it grows with the whole-seq dq
+    scratch (head_dim <= 128 pads to 128 lanes, so sequence length
+    enters as ``q_len * max(d, 128)``), with block size, and
+    NON-MONOTONICALLY with the batch*heads grid dimension (measured:
+    seq 8192 at 1024-blocks is 23.2 MiB at bh=16 but 16.5 MiB at
+    bh=32; seq 8192 at 512-blocks fits at bh<=32 and exceeds by 0.17
+    MiB at bh=64) — so the bands below come from the measured pass/fail
+    frontier with margin, not a model:
 
-    ==============================  =========================
-    q_len * max(d,128) / 128        viable combined blocks
-    ==============================  =========================
-    <= 4096                         up to (1024, 1024) (tuned)
-    <= 8192                         (512, 512) and below
-    > 8192                          none -> split kernels
-    ==============================  =========================
+    The combined kernel is restricted to head_dim <= 128 outright: wide
+    heads fail at shapes whose 128-lane equivalents fit (measured d=256:
+    17.9 MiB at seq 1024/bh 64 with 1024-blocks, 18.8 MiB at seq
+    2048/bh 64 with (512, 1024) — where d=64 passes both at bh up to
+    1024), and the sweep has no wide-head pass region worth the risk.
+    For d <= 128 (lane-padded, so seq enters as q_len*max(d,128)/128):
 
-    Returns ``(mode, block_q, block_k)`` with mode ``"combined"`` (one
-    probability recompute, whole-seq dq scratch) or ``"split"`` (dkdv +
-    dq kernel pair, O(block) scoped memory at any length)."""
+    =====================  ==========  =============================
+    q_len*max(d,128)/128   bh          choice
+    =====================  ==========  =============================
+    <= 2048                any(<=1024)  combined, tuned blocks (1024)
+    <= 4096                any(<=512)   combined (512, 1024)
+    <= 8192                <= 32        combined (512, 512)
+    otherwise              any          split, tuned blocks (1024)
+    =====================  ==========  =============================
+
+    ``mode`` is ``"combined"`` (one probability recompute per block,
+    whole-seq dq scratch — fastest where it fits: measured ~15% over
+    split at seq 8192) or ``"split"`` (dkdv + dq kernel pair, O(block)
+    scoped memory: full 1024-blocks compile at every probed extreme —
+    seq to 64k, bh to 256, d to 256 — and beat 512-blocks by ~12% at
+    seq 16k)."""
     rows128 = q_len * max(d, 128) // 128
-    if rows128 <= 4096:
-        return "combined", block_q, block_k
-    if rows128 <= 8192:
-        return ("combined", _pick_block(q_len, min(block_q, 512)),
-                _pick_block(q_len, min(block_k, 512)))
-    return ("split", _pick_block(q_len, min(block_q, 512)),
-            _pick_block(q_len, min(block_k, 512)))
+    if d <= 128:
+        if rows128 <= 2048:
+            return "combined", block_q, block_k
+        if rows128 <= 4096:
+            return ("combined", _pick_block(q_len, min(block_q, 512)),
+                    _pick_block(q_len, min(block_k, 1024)))
+        if rows128 <= 8192 and bh <= 32:
+            return ("combined", _pick_block(q_len, min(block_q, 512)),
+                    _pick_block(q_len, min(block_k, 512)))
+    return ("split", _pick_block(q_len, block_q),
+            _pick_block(q_len, block_k))
 
 
 def _split_bwd_call(q, do, lse8, delta8, k, v, *, causal, block_q,
@@ -814,7 +834,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
             or block_q % 128 or block_k % 128 or q_len != k_len):
         return _attention_bwd_impl(q, k, v, out, lse, g, causal, sm_scale,
                                    max(block_k, 128), 0, 0)
-    mode, block_q, block_k = _bwd_plan(q_len, d, block_q, block_k)
+    mode, block_q, block_k = _bwd_plan(q_len, d, block_q, block_k,
+                                       batch * heads)
     if q_len % block_q or k_len % block_k or block_q % 128 or block_k % 128:
         # Plan stepped blocks down past what divides this length (rare
         # non-power-of-two long seqs): the scan impl handles it.

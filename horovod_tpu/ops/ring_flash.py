@@ -498,7 +498,7 @@ def fused_ring_attention(q, k, v, axis_name: str, causal: bool = False,
     # ceiling) route to the separable ppermute ring, whose backward
     # composes per-step flash backwards, instead of failing at Mosaic
     # compile time on the backward pass (ADVICE r4).
-    mode, bq, bk = _bwd_plan(sl, d, bq, bk)
+    mode, bq, bk = _bwd_plan(sl, d, bq, bk, q.shape[0] * q.shape[1])
     off_grid = off_grid or mode != "combined" or sl % bq or sl % bk
     # Interpret-mode (CPU test mesh) remote DMA only supports single-axis
     # meshes (upstream dma_start_p limitation); a dp x sp mesh on CPU

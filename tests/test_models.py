@@ -129,6 +129,7 @@ def test_bench_headline_survives_failing_extra():
 
     env = dict(os.environ, BENCH_MODEL="resnet101", BENCH_IMAGE="32",
                BENCH_BATCH="2", BENCH_STEPS="1", BENCH_WARMUP="1",
+               BENCH_UNROLL="1",  # keep the CPU compile cheap
                BENCH_PLATFORM="cpu", BENCH_EXTRA_INJECT_FAIL="1",
                BENCH_EXTRA_CONFIGS="64:2")
     out = subprocess.run(

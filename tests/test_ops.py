@@ -109,23 +109,32 @@ def test_flash_gradients_multiblock(causal):
 
 def test_bwd_plan_matches_vmem_calibration():
     """The backward block plan must reproduce the v5e scoped-VMEM compile
-    sweep (r5 calibration, docs/benchmarks.md): the combined kernel's
-    whole-seq dq scratch is viable up to seq*max(d,128)/128 == 8192 rows
-    (blocks capped at 512 past 4096 rows) and the split kernel pair takes
-    over beyond.  The r4 regression — tuned 1024-blocks that failed TPU
-    compilation at seq 8192 — is exactly the class of change this pins."""
+    sweep (r5 calibration, tools/vmem_sweep.py, docs/benchmarks.md): the
+    combined kernel's viability depends on sequence rows, head width AND
+    the batch*heads grid dim (measured non-monotonic), so the plan bands
+    are pinned exactly.  The r4 regression — tuned 1024-blocks that
+    failed TPU compilation at seq 8192 — is the class of change this
+    catches."""
     from horovod_tpu.ops.attention import _bwd_plan
 
-    assert _bwd_plan(1024, 64, 1024, 1024) == ("combined", 1024, 1024)
-    assert _bwd_plan(4096, 64, 1024, 1024) == ("combined", 1024, 1024)
-    assert _bwd_plan(4096, 128, 1024, 1024) == ("combined", 1024, 1024)
-    assert _bwd_plan(8192, 64, 1024, 1024) == ("combined", 512, 512)
-    assert _bwd_plan(8192, 128, 1024, 1024) == ("combined", 512, 512)
-    assert _bwd_plan(16384, 64, 1024, 1024)[0] == "split"
-    assert _bwd_plan(16384, 128, 1024, 1024)[0] == "split"
-    assert _bwd_plan(32768, 128, 1024, 1024)[0] == "split"
+    # bench-protocol shapes (token-constant seq:batch sweep)
+    assert _bwd_plan(1024, 64, 1024, 1024, 128) == ("combined", 1024, 1024)
+    assert _bwd_plan(2048, 64, 1024, 1024, 64) == ("combined", 1024, 1024)
+    assert _bwd_plan(4096, 64, 1024, 1024, 32) == ("combined", 512, 1024)
+    assert _bwd_plan(8192, 64, 1024, 1024, 16) == ("combined", 512, 512)
+    assert _bwd_plan(16384, 64, 1024, 1024, 8)[0] == "split"
+    # the bh frontier at seq 8192 (bh=64 measured 0.17 MiB over limit)
+    assert _bwd_plan(8192, 64, 1024, 1024, 32)[0] == "combined"
+    assert _bwd_plan(8192, 64, 1024, 1024, 64)[0] == "split"
+    # wide heads never take the combined kernel (d=256 measured failing
+    # at seq 1024/bh 64 where the d=64 lane-equivalent passes)
+    assert _bwd_plan(2048, 128, 1024, 1024, 16)[0] == "combined"
+    assert _bwd_plan(8192, 128, 1024, 1024, 16) == ("combined", 512, 512)
+    assert _bwd_plan(1024, 256, 1024, 1024, 64)[0] == "split"
+    assert _bwd_plan(4096, 256, 1024, 1024, 16)[0] == "split"
+    assert _bwd_plan(32768, 128, 1024, 1024, 8)[0] == "split"
     # plan blocks must divide the sequence even for non-pow2 lengths
-    mode, bq, bk = _bwd_plan(11520, 64, 1024, 1024)
+    mode, bq, bk = _bwd_plan(11520, 64, 1024, 1024, 8)
     assert 11520 % bq == 0 and 11520 % bk == 0
 
 
@@ -133,10 +142,13 @@ def test_bwd_plan_matches_vmem_calibration():
 @pytest.mark.parametrize("seq", [1024, 4096, 8192, 16384])
 def test_flash_bwd_seq_sweep_compiles(seq, d):
     """The documented long-context sweep {1k, 4k, 8k, 16k} x head_dim
-    {64, 128} must COMPILE for fwd+bwd — AOT on a real TPU (catches
-    scoped-VMEM OOM, the r4 failure), abstract trace elsewhere (catches
-    block/shape mismatches in the plan routing)."""
-    q = jnp.zeros((2, 8, seq, d), jnp.bfloat16)
+    {64, 128} must COMPILE for fwd+bwd at the bench-protocol batch
+    (token-constant seq:batch pairs — batch*heads feeds _bwd_plan's bh
+    frontier) — AOT on a real TPU (catches scoped-VMEM OOM, the r4
+    failure), abstract trace elsewhere (catches block/shape mismatches
+    in the plan routing)."""
+    batch = {1024: 16, 4096: 4, 8192: 2, 16384: 1}[seq]
+    q = jnp.zeros((batch, 8, seq, d), jnp.bfloat16)
 
     def loss(q, k, v):
         return flash_attention(q, k, v, causal=True,
@@ -156,7 +168,7 @@ def test_flash_split_backward_matches(monkeypatch):
     import horovod_tpu.ops.attention as attn
 
     monkeypatch.setattr(attn, "_bwd_plan",
-                        lambda q_len, d, bq, bk: ("split", 128, 128))
+                        lambda q_len, d, bq, bk, bh=1: ("split", 128, 128))
     q, k, v = _qkv(seq=384, d=64, seed=5)
 
     def loss_ref(q, k, v):

@@ -27,8 +27,8 @@ import jax.numpy as jnp
 from horovod_tpu.ops.attention import _bwd_plan, flash_attention
 
 
-def try_compile(sl, d, bq, bk):
-    q = jnp.zeros((2, 8, sl, d), jnp.bfloat16)
+def try_compile(sl, d, bq, bk, bh=16):
+    q = jnp.zeros((bh // 8, 8, sl, d), jnp.bfloat16)
 
     def f(q, k, v):
         return flash_attention(q, k, v, causal=True, block_q=bq,
@@ -39,8 +39,9 @@ def try_compile(sl, d, bq, bk):
         jax.jit(jax.grad(f, argnums=(0, 1, 2))).lower(q, q, q).compile()
         return "OK", time.time() - t0, ""
     except Exception as e:  # report the Mosaic scoped-vmem line if present
-        key = next((ln.strip() for ln in str(e).splitlines()
-                    if "Scoped allocation" in ln), str(e).splitlines()[0])
+        lines = str(e).splitlines() or [repr(e)]
+        key = next((ln.strip() for ln in lines
+                    if "Scoped allocation" in ln), lines[0])
         return "FAIL", time.time() - t0, key[:110]
 
 
@@ -54,20 +55,26 @@ def main():
         return
     cands = [(1024, 1024), (512, 1024), (1024, 512), (512, 512),
              (256, 512), (256, 256)]
+    # bench-protocol bh (token-constant seq:batch sweep) plus a high-bh
+    # probe per seq: the scoped size varies non-monotonically with the
+    # batch*heads grid dim (see attention._bwd_plan).
+    bench_bh = {1024: 128, 4096: 32, 8192: 16, 16384: 8}
     failures = 0
     for d in (64, 128):
         for sl in (1024, 4096, 8192, 16384):
-            if args.full:
-                todo = [c for c in cands if sl % c[0] == 0 and sl % c[1] == 0]
-            else:
-                mode, bq, bk = _bwd_plan(sl, d, 1024, 1024)
-                todo = [(bq, bk)]
-            for bq, bk in todo:
-                st, dt, key = try_compile(sl, d, bq, bk)
-                plan = _bwd_plan(sl, d, bq, bk)
-                print(f"d={d} sl={sl} bq={bq} bk={bk} plan={plan}: "
-                      f"{st} ({dt:.1f}s) {key}", flush=True)
-                failures += st != "OK" and not args.full
+            for bh in (bench_bh[sl], 128):
+                if args.full:
+                    todo = [c for c in cands
+                            if sl % c[0] == 0 and sl % c[1] == 0]
+                else:
+                    mode, bq, bk = _bwd_plan(sl, d, 1024, 1024, bh)
+                    todo = [(bq, bk)]
+                for bq, bk in todo:
+                    st, dt, key = try_compile(sl, d, bq, bk, bh)
+                    plan = _bwd_plan(sl, d, bq, bk, bh)
+                    print(f"d={d} sl={sl} bh={bh} bq={bq} bk={bk} "
+                          f"plan={plan}: {st} ({dt:.1f}s) {key}", flush=True)
+                    failures += st != "OK" and not args.full
     if failures:
         sys.exit(f"{failures} plan-chosen config(s) failed to compile")
     print("all plan-chosen configs compile")
