@@ -373,8 +373,10 @@ def main() -> None:
     # memory-space-assignment quality, r3 tuning log): the ~2.7 ms
     # per-execute tunnel overhead amortizes K-fold while the per-step HLO
     # stays identical.  Default 8 for the resnet101 headline (measured
-    # r5: 1717/1723 -> 1745 img/s; compile time grows ~K-fold, so other
-    # models keep 1).  Donating params/stats/opt_state lets XLA update
+    # r5 over the full 240-step window: 1717/1723 -> 1843/1839 img/s,
+    # +7%; short windows under-report the gain — see docs/benchmarks.md.
+    # Compile time grows ~K-fold, so other models keep 1).
+    # Donating params/stats/opt_state lets XLA update
     # in place instead of allocating fresh HBM buffers every step (~1.5%
     # on resnet101).
     unroll = max(1, int(os.environ.get(

@@ -757,9 +757,13 @@ def _bwd_plan(q_len: int, d: int, block_q: int, block_k: int,
     seq 16k)."""
     rows128 = q_len * max(d, 128) // 128
     if d <= 128:
-        if rows128 <= 2048:
+        # Each band is gated at its CALIBRATED bh bound (the table
+        # above); anything beyond falls through to split, which
+        # compiles everywhere — never extrapolate the combined kernel
+        # past a probed region (the r4 lesson).
+        if rows128 <= 2048 and bh <= 1024:
             return "combined", block_q, block_k
-        if rows128 <= 4096:
+        if rows128 <= 4096 and bh <= 512:
             return ("combined", _pick_block(q_len, min(block_q, 512)),
                     _pick_block(q_len, min(block_k, 1024)))
         if rows128 <= 8192 and bh <= 32:

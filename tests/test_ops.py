@@ -126,6 +126,9 @@ def test_bwd_plan_matches_vmem_calibration():
     # the bh frontier at seq 8192 (bh=64 measured 0.17 MiB over limit)
     assert _bwd_plan(8192, 64, 1024, 1024, 32)[0] == "combined"
     assert _bwd_plan(8192, 64, 1024, 1024, 64)[0] == "split"
+    # bands never extrapolate past their calibrated bh bound
+    assert _bwd_plan(1024, 64, 1024, 1024, 2048)[0] == "split"
+    assert _bwd_plan(4096, 64, 1024, 1024, 1024)[0] == "split"
     # wide heads never take the combined kernel (d=256 measured failing
     # at seq 1024/bh 64 where the d=64 lane-equivalent passes)
     assert _bwd_plan(2048, 128, 1024, 1024, 16)[0] == "combined"

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Measure the TF-binding collective overhead vs the raw engine path.
+
+The TF/Keras bindings run collectives through ``tf.py_function`` (eager
+numpy in, engine, numpy out) — the deliberate division of labor where
+COMPILED training belongs to the JAX path (docs/tpu.md).  A TF user
+should know exactly what that costs: this tool times, at ResNet-50
+gradient scale (~25M floats, fused by the engine to the 64 MiB
+threshold),
+
+1. the raw engine allreduce (numpy in/out — the floor the TF path can
+   at best reach), and
+2. a graph-mode ``tf.function`` step whose gradients go through
+   ``horovod_tpu.tensorflow.allreduce_async`` + ``synchronize`` (the
+   enqueue-all-then-wait group path DistributedOptimizer uses),
+
+on an np=2 loopback ring, and prints one JSON line with both ms/step
+figures and the implied overhead.  Run:
+
+    python tools/tf_overhead_bench.py            # np=2 loopback
+    TF_OVERHEAD_NP=3 python tools/tf_overhead_bench.py
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RANK_CODE = r"""
+import json, os, time
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+n = int(os.environ.get("TF_OVERHEAD_FLOATS", str(25 * 1024 * 1024)))
+iters = int(os.environ.get("TF_OVERHEAD_ITERS", "10"))
+
+# 1) raw engine floor: one fused numpy allreduce of the full buffer.
+x = np.ones(n, np.float32)
+hvd.allreduce(x, name="warm")
+t0 = time.perf_counter()
+for i in range(iters):
+    hvd.allreduce(x, name=f"raw.{i}")
+raw_ms = (time.perf_counter() - t0) / iters * 1e3
+
+# 2) TF graph mode: the same bytes as 16 gradient-sized tensors inside
+# a tf.function (the DistributedOptimizer shape), via the group path.
+import tensorflow as tf
+import horovod_tpu.tensorflow as hvd_tf
+
+parts = [tf.ones([n // 16], tf.float32) for _ in range(16)]
+
+@tf.function
+def step(ts):
+    hs = [hvd_tf.allreduce_async(t, name=f"tfg.{j}")
+          for j, t in enumerate(ts)]
+    return hvd_tf.synchronize(hs)
+
+step(parts)  # trace + warm
+t0 = time.perf_counter()
+for _ in range(iters):
+    out = step(parts)
+tf_ms = (time.perf_counter() - t0) / iters * 1e3
+if hvd.rank() == 0:
+    print("RESULT " + json.dumps({"raw_ms": round(raw_ms, 1),
+                                  "tf_ms": round(tf_ms, 1)}), flush=True)
+hvd.shutdown()
+"""
+
+
+def main():
+    np_ = int(os.environ.get("TF_OVERHEAD_NP", "2"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_), "--",
+         sys.executable, "-c", RANK_CODE],
+        capture_output=True, text=True, env=env, timeout=900)
+    if out.returncode != 0:
+        sys.exit(f"rank failure:\n{out.stderr[-2000:]}")
+    rec = next(json.loads(line.split(" ", 1)[1])
+               for line in out.stdout.splitlines()
+               if line.startswith("RESULT "))
+    rec.update({
+        "metric": f"tf_graph_allreduce_overhead_np{np_}",
+        "floats": int(os.environ.get("TF_OVERHEAD_FLOATS",
+                                     str(25 * 1024 * 1024))),
+        "tf_over_raw": round(rec["tf_ms"] / rec["raw_ms"], 2),
+        "unit": "ms/step",
+    })
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

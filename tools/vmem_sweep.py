@@ -62,7 +62,7 @@ def main():
     failures = 0
     for d in (64, 128):
         for sl in (1024, 4096, 8192, 16384):
-            for bh in (bench_bh[sl], 128):
+            for bh in dict.fromkeys((bench_bh[sl], 128)):
                 if args.full:
                     todo = [c for c in cands
                             if sl % c[0] == 0 and sl % c[1] == 0]
