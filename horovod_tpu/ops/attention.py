@@ -458,7 +458,7 @@ def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
 def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                          dq_ref, dq_scratch, *, causal, block_q,
-                         block_k, num_k_blocks, scale_r):
+                         block_k, num_k_blocks, scale_r, dq_scale=1.0):
     """Split backward, dq half: accumulates one query block over the key
     loop — O(block) scoped memory (long-seq path, see _bwd_plan)."""
     qi = pl.program_id(1)
@@ -483,12 +483,15 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
     @pl.when(ki == num_k_blocks - 1)
     def _():
-        _st(dq_ref, dq_scratch[...])
+        # pow2 rescale folded into the f32 flush (see the combined
+        # kernel's _flush_dq note).
+        _st(dq_ref, dq_scratch[...] * dq_scale if dq_scale != 1.0
+            else dq_scratch[...])
 
 
 def _combined_bwd_kernel(*refs, causal, block_q, block_k, num_q_blocks,
                          num_k_blocks, bh, rotate, barrier, axis_name,
-                         mesh_axes, scale_r):
+                         mesh_axes, scale_r, dq_scale=1.0):
     """Flash backward with dk/dv AND dq from ONE probability recompute.
 
     Grid: (bh, ki, qi) — queries innermost so dk/dv accumulate in scratch
@@ -578,12 +581,22 @@ def _combined_bwd_kernel(*refs, causal, block_q, block_k, num_q_blocks,
 
     @pl.when(qi == num_q_blocks - 1)
     def _flush_dkdv():
-        dk_ref[...] = dk_scratch[...].reshape(dk_ref.shape)
-        dv_ref[...] = dv_scratch[...].reshape(dv_ref.shape)
+        # _st casts: the scratch accumulates in f32, the output dtype is
+        # the caller's grad_dtype (input dtype for the single-shard path
+        # — saving an XLA-side cast+relayout pass over each gradient —
+        # f32 for the ring path, whose partials keep accumulating).
+        _st(dk_ref, dk_scratch[...])
+        _st(dv_ref, dv_scratch[...])
 
     @pl.when((ki == num_k_blocks - 1) & (qi == num_q_blocks - 1))
     def _flush_dq():
-        dq_ref[...] = dq_scratch[...].reshape(dq_ref.shape)
+        # dq accumulated in q' units; the pow2 rescale folds into the
+        # flush IN F32, before the grad_dtype cast — no extra XLA pass
+        # over dq, and no overflow for narrow-exponent dtypes (fp16).
+        # Ring callers keep dq_scale=1.0 (partials sum across steps
+        # first) and rescale once outside.
+        _st(dq_ref, dq_scratch[...] * dq_scale if dq_scale != 1.0
+            else dq_scratch[...])
 
     if rotate:
         @pl.when((b == bh - 1) & (ki == num_k_blocks - 1)
@@ -602,10 +615,12 @@ def _combined_bwd_kernel(*refs, causal, block_q, block_k, num_q_blocks,
 def _combined_bwd_call(q, do, lse8, delta8, k_cur, v_cur, q_offset,
                        k_offset, *, causal, block_q, block_k, rotate,
                        collective_id, axis_name, mesh_axes, interpret,
-                       scale_r=1.0):
+                       scale_r=1.0, grad_dtype=jnp.float32, dq_scale=1.0):
     """pallas_call wrapper for `_combined_bwd_kernel` over (bh, sl, d)
     operands (q pre-scaled by the pow2 part of sm_scale).  Returns
-    (dk, dv, dq[, k_next, v_next]) with the gradients in float32."""
+    (dk, dv, dq[, k_next, v_next]) with the gradients in ``grad_dtype``
+    (accumulation is always f32 in scratch; only the flush casts, after
+    applying ``dq_scale`` to dq in f32)."""
     bh, sl, d = q.shape
     num_q, num_k = sl // block_q, sl // block_k
     offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
@@ -615,7 +630,8 @@ def _combined_bwd_call(q, do, lse8, delta8, k_cur, v_cur, q_offset,
         _combined_bwd_kernel, causal=causal, block_q=block_q,
         block_k=block_k, num_q_blocks=num_q, num_k_blocks=num_k, bh=bh,
         rotate=rotate, barrier=rotate and not interpret,
-        axis_name=axis_name, mesh_axes=mesh_axes, scale_r=scale_r)
+        axis_name=axis_name, mesh_axes=mesh_axes, scale_r=scale_r,
+        dq_scale=dq_scale)
 
     def qspec(row):
         return pl.BlockSpec((1, block_q, d),
@@ -636,9 +652,9 @@ def _combined_bwd_call(q, do, lse8, delta8, k_cur, v_cur, q_offset,
         kspec(outer_k),                                    # v (blocked)
     ]
     out_shapes = [
-        jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),    # dk
-        jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),    # dv
-        jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),    # dq
+        jax.ShapeDtypeStruct((bh, sl, d), grad_dtype),     # dk
+        jax.ShapeDtypeStruct((bh, sl, d), grad_dtype),     # dv
+        jax.ShapeDtypeStruct((bh, sl, d), grad_dtype),     # dq
     ]
     out_specs = [
         kspec(outer_k),                                    # dk
@@ -774,13 +790,15 @@ def _bwd_plan(q_len: int, d: int, block_q: int, block_k: int,
 
 
 def _split_bwd_call(q, do, lse8, delta8, k, v, *, causal, block_q,
-                    block_k, interpret, scale_r):
+                    block_k, interpret, scale_r, grad_dtype=jnp.float32,
+                    dq_scale=1.0):
     """Split flash backward over (bh, sl, d) operands (q pre-scaled by
     the pow2 part of sm_scale): two pallas_calls — dk/dv (queries inner)
     and dq (keys inner) — each with O(block) scoped VMEM, so any
     sequence length compiles.  Pays the s/p/dp/ds recompute twice; the
     combined kernel is preferred whenever its whole-seq dq scratch fits
-    (see _bwd_plan).  Returns (dk, dv, dq) in float32."""
+    (see _bwd_plan).  Returns (dk, dv, dq) in ``grad_dtype`` (f32
+    accumulation in scratch; the flush casts)."""
     bh, sl, d = q.shape
     num_q, num_k = sl // block_q, sl // block_k
     qspec, kspec = _row_spec(block_q, d), _row_spec(block_k, d)
@@ -800,22 +818,23 @@ def _split_bwd_call(q, do, lse8, delta8, k, v, *, causal, block_q,
         in_specs=[qspec(inner), qspec(inner), lse_spec(inner),
                   lse_spec(inner), kspec(outer), kspec(outer)],
         out_specs=(kspec(outer), kspec(outer)),
-        out_shape=(jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),
-                   jax.ShapeDtypeStruct((bh, sl, d), jnp.float32)),
+        out_shape=(jax.ShapeDtypeStruct((bh, sl, d), grad_dtype),
+                   jax.ShapeDtypeStruct((bh, sl, d), grad_dtype)),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(q, do, lse8, delta8, k, v)
     dqk = functools.partial(
         _flash_bwd_dq_kernel, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=num_k, scale_r=scale_r)
+        block_k=block_k, num_k_blocks=num_k, scale_r=scale_r,
+        dq_scale=dq_scale)
     dq = pl.pallas_call(
         dqk,
         grid=(bh, num_q, num_k),  # keys innermost
         in_specs=[qspec(outer), qspec(outer), lse_spec(outer),
                   lse_spec(outer), kspec(inner), kspec(inner)],
         out_specs=qspec(outer),
-        out_shape=jax.ShapeDtypeStruct((bh, sl, d), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((bh, sl, d), grad_dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, do, lse8, delta8, k, v)
@@ -861,18 +880,29 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
     delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, q_len))
     lse8 = jnp.broadcast_to(lse.reshape(bh, q_len)[:, None, :],
                             (bh, 8, q_len))
+    # Gradients emitted directly in the input dtype, with the pow2 dq
+    # rescale folded into the kernels' f32 flush: the XLA-side
+    # cast+relayout and rescale passes over the 3 gradients measured
+    # ~100 us/layer of pure copy time in the seq-1024 LM step.  The
+    # f32-multiply-then-cast order also keeps narrow-exponent dtypes
+    # (fp16) finite where cast-then-scale could overflow in q' units.
+    # Mixed input dtypes keep the old f32 emission (dk must not round
+    # through q.dtype when k is wider).
+    same_dtype = q.dtype == k.dtype == v.dtype
+    grad_dtype = q.dtype if same_dtype else jnp.float32
     if mode == "combined":
         dk, dv, dq = _combined_bwd_call(
             qr, dor, lse8, delta8, kr, vr, 0, 0, causal=causal,
             block_q=block_q, block_k=block_k, rotate=False,
             collective_id=None, axis_name=None, mesh_axes=(),
-            interpret=interpret, scale_r=scale_r)
+            interpret=interpret, scale_r=scale_r, grad_dtype=grad_dtype,
+            dq_scale=p2)
     else:
         dk, dv, dq = _split_bwd_call(
             qr, dor, lse8, delta8, kr, vr, causal=causal,
             block_q=block_q, block_k=block_k, interpret=interpret,
-            scale_r=scale_r)
-    return ((dq * p2).astype(q.dtype).reshape(q.shape),
+            scale_r=scale_r, grad_dtype=grad_dtype, dq_scale=p2)
+    return (dq.astype(q.dtype).reshape(q.shape),
             dk.astype(k.dtype).reshape(k.shape),
             dv.astype(v.dtype).reshape(v.shape))
 
@@ -997,6 +1027,13 @@ def flash_attention(q, k, v, causal: bool = False,
         return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if not interpret and jnp.float16 in (q.dtype, k.dtype, v.dtype):
+        # float16 is not a native TPU type and Mosaic refuses the kernel
+        # outright (verified on v5e: even the forward fails to compile) —
+        # route to the mathematically identical scan implementation
+        # instead of crashing at compile time.  bf16 is the supported
+        # half-precision on TPU.
+        return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     if block_q is None:
         # 1024-row query blocks: the kernels are grid-overhead-bound at
         # these shapes (~3-5 us of fixed cost per grid step against ~1.4
