@@ -53,6 +53,10 @@ def bench_transformer(seq: int = None, batch: int = None,
         seq = int(os.environ.get("BENCH_SEQ", "1024"))
     if steps is None:
         steps = int(os.environ.get("BENCH_STEPS", "20"))
+    # Multi-step dispatch, as the resnet headline (r5: 305k -> 320k
+    # tok/s at seq 1024 going 1 -> 8); default 4 balances the gain
+    # against the ~unroll-fold compile time across the extras sweep.
+    unroll = max(1, int(os.environ.get("BENCH_UNROLL", "4")))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
     # bf16 logits STORAGE (f32 accumulation and f32 loss internals): the
@@ -73,16 +77,25 @@ def bench_transformer(seq: int = None, batch: int = None,
     tx = optax.adamw(1e-3)
     opt_state = tx.init(params)
 
-    # Donation lets XLA update params/opt state in place (no fresh HBM
-    # buffers per step), same as the image-model step below.
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, inputs, targets):
+    def one_step(params, opt_state, inputs, targets):
         def loss_fn(p):
             return next_token_loss(
                 model.apply({"params": p}, inputs), targets)
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
+
+    # Donation lets XLA update params/opt state in place (no fresh HBM
+    # buffers per step), same as the image-model step below.  inputs/
+    # targets MUST thread through as traced jit arguments — closed-over
+    # arrays would bake into the executable as constants, letting XLA
+    # specialize the program in ways impossible in real training.
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, inputs, targets):
+        for _ in range(unroll):
+            params, opt_state, loss = one_step(params, opt_state,
+                                               inputs, targets)
+        return params, opt_state, loss
 
     for _ in range(max(warmup, 1)):
         params, opt_state, loss = step(params, opt_state, inputs, targets)
@@ -93,7 +106,7 @@ def bench_transformer(seq: int = None, batch: int = None,
     final_loss = float(loss)
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss), final_loss
-    value = batch * seq * steps / dt
+    value = batch * seq * steps * unroll / dt
     if report:
         print(json.dumps({
             "metric": "transformer_train_tokens_per_sec_per_chip",
@@ -375,7 +388,9 @@ def main() -> None:
     # stays identical.  Default 8 for the resnet101 headline (measured
     # r5 over the full 240-step window: 1717/1723 -> 1843/1839 img/s,
     # +7%; short windows under-report the gain — see docs/benchmarks.md.
-    # Compile time grows ~K-fold, so other models keep 1).
+    # Compile time grows ~K-fold, so other image models keep 1; the
+    # transformer bench has its own default of 4, and an explicit
+    # BENCH_UNROLL overrides BOTH (the extras sweep inherits it).
     # Donating params/stats/opt_state lets XLA update
     # in place instead of allocating fresh HBM buffers every step (~1.5%
     # on resnet101).
@@ -441,6 +456,8 @@ def main() -> None:
                 s, b = (int(v) for v in cfg.split(":"))
             except ValueError:
                 extras[f"bad_config:{cfg.strip()}"] = "error: want seq:batch"
+                record["extra_metrics"] = dict(extras)
+                print(json.dumps(record), flush=True)  # visible even if last
                 continue
             key = ("transformer_train_tokens_per_sec_per_chip"
                    if s == 1024 else
@@ -460,8 +477,11 @@ def main() -> None:
             except Exception as exc:  # record, don't fail the headline
                 first = str(exc).splitlines()[0] if str(exc) else repr(exc)
                 extras[key] = f"error: {first[:160]}"
-        record["extra_metrics"] = extras
-        print(json.dumps(record), flush=True)
+            # Cumulative re-print after EVERY extra: if the driver kills
+            # the process mid-sweep, the last parseable line still
+            # carries the headline plus every extra completed so far.
+            record["extra_metrics"] = dict(extras)
+            print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":
