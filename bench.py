@@ -455,31 +455,32 @@ def main() -> None:
             try:  # a malformed config must not cost the headline metric
                 s, b = (int(v) for v in cfg.split(":"))
             except ValueError:
+                s = None
                 extras[f"bad_config:{cfg.strip()}"] = "error: want seq:batch"
-                record["extra_metrics"] = dict(extras)
-                print(json.dumps(record), flush=True)  # visible even if last
-                continue
-            key = ("transformer_train_tokens_per_sec_per_chip"
-                   if s == 1024 else
-                   f"transformer_seq{s}_tokens_per_sec_per_chip")
-            try:
-                if os.environ.get("BENCH_EXTRA_INJECT_FAIL"):
-                    # Test hook: the headline-survives-a-failing-extra
-                    # property is load-bearing (see r4 post-mortem above)
-                    # and must stay verifiable end-to-end.
-                    raise RuntimeError(
-                        "injected failure (BENCH_EXTRA_INJECT_FAIL)")
-                # Full default step count: steps cost ~1s while compile
-                # dominates the extras' runtime, and short windows
-                # under-report by several percent.
-                extras[key] = round(
-                    bench_transformer(seq=s, batch=b, report=False), 2)
-            except Exception as exc:  # record, don't fail the headline
-                first = str(exc).splitlines()[0] if str(exc) else repr(exc)
-                extras[key] = f"error: {first[:160]}"
-            # Cumulative re-print after EVERY extra: if the driver kills
-            # the process mid-sweep, the last parseable line still
-            # carries the headline plus every extra completed so far.
+            if s is not None:
+                key = ("transformer_train_tokens_per_sec_per_chip"
+                       if s == 1024 else
+                       f"transformer_seq{s}_tokens_per_sec_per_chip")
+                try:
+                    if os.environ.get("BENCH_EXTRA_INJECT_FAIL"):
+                        # Test hook: the headline-survives-a-failing-extra
+                        # property is load-bearing (see r4 post-mortem
+                        # above) and must stay verifiable end-to-end.
+                        raise RuntimeError(
+                            "injected failure (BENCH_EXTRA_INJECT_FAIL)")
+                    # Full default step count: steps cost ~1s while
+                    # compile dominates the extras' runtime, and short
+                    # windows under-report by several percent.
+                    extras[key] = round(
+                        bench_transformer(seq=s, batch=b, report=False), 2)
+                except Exception as exc:  # record, don't fail the headline
+                    first = (str(exc).splitlines()[0] if str(exc)
+                             else repr(exc))
+                    extras[key] = f"error: {first[:160]}"
+            # Cumulative re-print after EVERY config (incl. malformed):
+            # if the driver kills the process mid-sweep, the last
+            # parseable line still carries the headline plus every extra
+            # completed so far.
             record["extra_metrics"] = dict(extras)
             print(json.dumps(record), flush=True)
 
