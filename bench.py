@@ -236,7 +236,7 @@ def bench_allreduce() -> None:
     nbytes = int(os.environ.get("BENCH_BYTES", str(64 * 1024 * 1024)))
     iters = int(os.environ.get("BENCH_ITERS", "10"))
     code = f"""
-import time, numpy as np, horovod_tpu as hvd
+import json, time, numpy as np, horovod_tpu as hvd
 hvd.init()
 x = np.ones({nbytes} // 4, np.float32)
 hvd.allreduce(x, average=False, name="warmup")
@@ -249,10 +249,23 @@ if hvd.rank() == 0:
     n = hvd.size()
     algo_bytes = 2 * (n - 1) / n * {nbytes} * {iters}
     print("BW_GBPS", algo_bytes / dt / 1e9, flush=True)
+    # Collective-layer health alongside throughput (docs/metrics.md):
+    # the launcher env enables the registry, so the snapshot carries the
+    # op/byte/stall counters for this rank's run.
+    snap = hvd.metrics_snapshot()
+    print("METRICS_JSON " + json.dumps({{
+        "collective_ops": sum(sum(v.values()) for v in snap["ops"].values()),
+        "collective_bytes_in": sum(v["in"] for v in snap["bytes"].values()),
+        "collective_bytes_out": sum(v["out"] for v in snap["bytes"].values()),
+        "stall_events": snap["stalls"]["count"],
+    }}), flush=True)
 """
     repo = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ,
                PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    # Metrics ride along in extra_metrics (docs/metrics.md); an explicit
+    # HVD_TPU_METRICS=0 in the caller's env still wins.
+    env.setdefault("HVD_TPU_METRICS", "1")
     out = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_), "--",
          sys.executable, "-c", code],
@@ -265,12 +278,19 @@ if hvd.rank() == 0:
         assert bw >= float(floor), (
             f"engine ring-allreduce bandwidth {bw:.3f} GB/s at np={np_} "
             f"fell below the floor {float(floor):.3f} GB/s")
-    print(json.dumps({
+    record = {
         "metric": f"engine_ring_allreduce_bandwidth_np{np_}",
         "value": round(bw, 3),
         "unit": "GB/s",
         "vs_baseline": None,  # the reference published no allreduce number
-    }))
+    }
+    # Fold rank 0's metrics snapshot in so BENCH rounds track collective-
+    # layer health (ops, bytes, stalls) alongside the bandwidth headline.
+    for line in out.stdout.splitlines():
+        if line.startswith("METRICS_JSON "):
+            record["extra_metrics"] = json.loads(
+                line[len("METRICS_JSON "):])
+    print(json.dumps(record))
 
 
 def main() -> None:

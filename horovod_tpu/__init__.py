@@ -32,6 +32,8 @@ from horovod_tpu.common import (  # noqa: F401
     is_initialized,
     local_rank,
     local_size,
+    metrics_reset,
+    metrics_snapshot,
     mpi_threads_supported,
     rank,
     shutdown,

@@ -11,14 +11,16 @@ from __future__ import annotations
 
 import atexit
 import ctypes
+import json
 import os
 import threading
+import time
 import weakref
 from typing import Any, Optional, Sequence, Union
 
 import numpy as np
 
-from horovod_tpu.common import dtypes
+from horovod_tpu.common import dtypes, metrics
 from horovod_tpu.common.basics import ProcessSet, resolve_process_set
 from horovod_tpu.common.config import Config
 
@@ -50,6 +52,13 @@ _xla_plane = None
 # half types it widens; everything else (f64, bool, ...) stays on the engine.
 _XLA_PLANE_DTYPES = ("float32", "float16", "bfloat16", "int32", "int8",
                      "uint8")
+# Metrics plumbing: per-rank JSON dump path (HVD_TPU_METRICS_FILE) and the
+# count of engine stall events already folded into the Python registry.
+_metrics_file: Optional[str] = None
+_engine_stalls_seen = 0
+# Serializes _sync_engine_stalls: the monitor thread and API callers may
+# snapshot concurrently, and the ctypes stall-count read releases the GIL.
+_stall_sync_lock = threading.Lock()
 
 
 def _load_lib():
@@ -97,6 +106,10 @@ def _load_lib():
         lib.hvd_tpu_copy_result.argtypes = [
             ctypes.c_longlong, ctypes.c_void_p, ctypes.c_longlong]
         lib.hvd_tpu_release.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_stall_count.restype = ctypes.c_longlong
+        lib.hvd_tpu_stall_count.argtypes = []
+        lib.hvd_tpu_stall_info.restype = ctypes.c_char_p
+        lib.hvd_tpu_stall_info.argtypes = []
         lib.hvd_tpu_timeline_enabled.restype = ctypes.c_int
         lib.hvd_tpu_timeline_op_start.argtypes = [ctypes.c_char_p,
                                                   ctypes.c_char_p]
@@ -141,6 +154,27 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
             "engine initialization failed: "
             + lib.hvd_tpu_init_error().decode())
     _process_set = ps
+    # Metrics: enabled by HVD_TPU_METRICS=1 or implied by a dump file /
+    # monitor port (docs/metrics.md).  The monitor binds port+local_rank
+    # so several ranks on one host coexist; rank 0's local_rank is 0, so
+    # the scrape example `curl localhost:$HVD_TPU_MONITOR_PORT/metrics`
+    # always hits rank 0.
+    global _metrics_file
+    if cfg.metrics_enabled:
+        metrics.registry.enable()
+    _metrics_file = (f"{cfg.metrics_file}.{ps.rank}"
+                     if cfg.metrics_file else None)
+    if cfg.monitor_port is not None:
+        port = cfg.monitor_port + ps.local_rank if cfg.monitor_port else 0
+        try:
+            metrics.start_monitor(port, snapshot_fn=metrics_snapshot)
+        except OSError as exc:
+            import warnings
+
+            # A busy port must not take down the training job; metrics
+            # stay collectable through the API and the shutdown dump.
+            warnings.warn(f"metrics monitor could not bind port {port}: "
+                          f"{exc}; continuing without the HTTP endpoint.")
     # XLA data plane selection.  Like the reference's NCCL path — which
     # auto-selected whenever NCCL was compiled in, no runtime flag
     # (/root/reference/horovod/common/operations.cc:861-914) — the plane
@@ -197,7 +231,18 @@ def _tpu_visible() -> bool:
 
 
 def shutdown() -> None:
-    global _process_set, _xla_plane
+    global _process_set, _xla_plane, _metrics_file
+    if _metrics_file is not None:
+        path, _metrics_file = _metrics_file, None
+        try:
+            with open(path, "w") as f:
+                json.dump(metrics_snapshot(), f, indent=2)
+                f.write("\n")
+        except OSError as exc:
+            import warnings
+
+            warnings.warn(f"could not write metrics file {path}: {exc}")
+    metrics.stop_monitor()
     if _lib is not None and _lib.hvd_tpu_initialized():
         _lib.hvd_tpu_shutdown()
     _process_set = None
@@ -247,6 +292,61 @@ def mpi_threads_supported() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Collective metrics (common/metrics.py; docs/metrics.md).
+# ---------------------------------------------------------------------------
+
+
+def _sync_engine_stalls() -> None:
+    """Fold the engine's (C++, rank-0 sweep) stall warnings into the Python
+    registry.  The C side reports a cumulative event count plus a bounded
+    log of the most recent "name|seconds" records; we consume only the
+    events not yet seen, so repeated snapshots never double-count and
+    ``metrics_reset()`` keeps its clear-everything semantics."""
+    global _engine_stalls_seen
+    if _lib is None:
+        return
+    with _stall_sync_lock:
+        count = int(_lib.hvd_tpu_stall_count())
+        new = count - _engine_stalls_seen
+        if new <= 0:
+            return
+        _engine_stalls_seen = count
+        entries = [e for e in
+                   _lib.hvd_tpu_stall_info().decode().split(";") if e]
+        taken = entries[-new:]
+        for entry in taken:
+            name, _, sec = entry.partition("|")
+            try:
+                duration = float(sec)
+            except ValueError:
+                duration = 0.0
+            metrics.registry.record_stall(name, duration)
+        # The engine's log is bounded (64): events beyond it keep the
+        # total honest even though their tensor names are gone.
+        if new > len(taken):
+            metrics.registry.record_stall_count(new - len(taken))
+
+
+def metrics_snapshot() -> dict:
+    """Plain nested dict of the collective metrics registry: op/byte
+    counters per data plane, fusion-batch counters, latency/fill
+    histograms, and stall events (engine sweep + XLA-plane waits).  Always
+    callable; counters and histograms only accumulate while metrics are
+    enabled (``HVD_TPU_METRICS=1``, a metrics file, or a monitor port),
+    stall records always do."""
+    _sync_engine_stalls()
+    return metrics.registry.snapshot()
+
+
+def metrics_reset() -> None:
+    """Zero every counter, histogram, and stall record (the enabled flag
+    is unaffected).  Outstanding engine stall events are consumed first so
+    they cannot resurface in the next snapshot."""
+    _sync_engine_stalls()
+    metrics.registry.reset()
+
+
+# ---------------------------------------------------------------------------
 # Async numpy collectives -- the substrate for all framework bindings.
 # ---------------------------------------------------------------------------
 
@@ -266,6 +366,9 @@ class Handle:
         self._name = name
         self._finished = False
         self._finish_lock = threading.Lock()
+        # Metrics: end-to-end wait latency measured from enqueue.  One
+        # enabled check; 0.0 doubles as the "metrics off" sentinel.
+        self._t0 = time.perf_counter() if metrics.registry.enabled else 0.0
         # Engine (tick, seq) completion stamp, set by wait(): ops fused in
         # one negotiation cycle share a tick — observability for tests and
         # the timeline (the reference's cycle accounting).
@@ -300,6 +403,10 @@ class Handle:
                 nbytes = int(_lib.hvd_tpu_result_nbytes(self._raw))
                 dim0 = _lib.hvd_tpu_result_dim0(self._raw)
                 shape = (int(dim0),) + self._in.shape[1:]
+                if self._t0:
+                    metrics.registry.record_bytes_out("engine", nbytes)
+                    metrics.registry.observe(
+                        "wait_sec", time.perf_counter() - self._t0)
                 if not nbytes:
                     return np.empty(shape, dtype=self._in.dtype)
                 # Zero-copy: view the engine-owned result buffer directly
@@ -322,6 +429,10 @@ class Handle:
                 release = False
                 return np.frombuffer(view,
                                      dtype=self._in.dtype).reshape(shape)
+            if self._t0:
+                metrics.registry.record_bytes_out("engine", self._out.nbytes)
+                metrics.registry.observe(
+                    "wait_sec", time.perf_counter() - self._t0)
             return self._out
         finally:
             if release:
@@ -397,6 +508,8 @@ def allreduce_async(array: np.ndarray, average: bool = True,
         dims, ndim, dtypes.numpy_to_code(array.dtype), -1, int(average))
     if raw < 0:
         raise HorovodInternalError("engine is shut down")
+    if metrics.registry.enabled:
+        metrics.registry.record_enqueue("engine", "allreduce", array.nbytes)
     return Handle(raw, OP_ALLREDUCE, array, out, name)
 
 
@@ -418,6 +531,8 @@ def allgather_async(array: np.ndarray, name: Optional[str] = None) -> Handle:
         dims, ndim, dtypes.numpy_to_code(array.dtype), -1, 0)
     if raw < 0:
         raise HorovodInternalError("engine is shut down")
+    if metrics.registry.enabled:
+        metrics.registry.record_enqueue("engine", "allgather", array.nbytes)
     return Handle(raw, OP_ALLGATHER, array, None, name)
 
 
@@ -444,6 +559,8 @@ def broadcast_async(array: np.ndarray, root_rank: int,
         dims, ndim, dtypes.numpy_to_code(array.dtype), root_rank, 0)
     if raw < 0:
         raise HorovodInternalError("engine is shut down")
+    if metrics.registry.enabled:
+        metrics.registry.record_enqueue("engine", "broadcast", array.nbytes)
     return Handle(raw, OP_BROADCAST, array, out, name)
 
 

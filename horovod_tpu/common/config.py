@@ -49,6 +49,17 @@ class Config:
     # True = forced on; False ("0"/"false"/"off") = explicit opt-out.
     # Unsupported dtypes stay on the TCP engine either way.
     xla_data_plane: Optional[bool] = None
+    # Collective metrics registry (common/metrics.py, docs/metrics.md).
+    # `metrics` force-enables collection; setting a metrics file or a
+    # monitor port implies it (an empty registry serves nobody).
+    metrics: bool = False
+    metrics_file: str = ""           # JSON dump at shutdown, per rank
+    monitor_port: Optional[int] = None  # HTTP /metrics server (+local_rank)
+
+    @property
+    def metrics_enabled(self) -> bool:
+        return bool(self.metrics or self.metrics_file
+                    or self.monitor_port is not None)
 
     @staticmethod
     def from_env() -> "Config":
@@ -67,4 +78,8 @@ class Config:
             xla_data_plane=(None if (plane := _get(
                 "HVD_TPU_XLA_DATA_PLANE", "HOROVOD_XLA_DATA_PLANE")) is None
                 else _flag(plane)),
+            metrics=_flag(os.environ.get("HVD_TPU_METRICS")),
+            metrics_file=os.environ.get("HVD_TPU_METRICS_FILE", ""),
+            monitor_port=(int(port) if (port := os.environ.get(
+                "HVD_TPU_MONITOR_PORT")) else None),
         )

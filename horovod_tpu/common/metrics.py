@@ -1,0 +1,330 @@
+"""Collective metrics registry: live aggregates for both data planes.
+
+The timeline (docs/timeline.md) answers "what did tensor X do at time T";
+this registry answers the operator questions a trace file cannot: how many
+collectives ran, how many bytes moved, how full the fusion buckets are,
+where wall-clock time goes (negotiation vs dispatch vs execute vs wait),
+and which tensors are stalling — live, while the job runs.
+
+Three consumers sit on top of one process-local registry:
+
+* ``hvd.metrics_snapshot()`` / ``hvd.metrics_reset()`` — plain nested dict
+  for programmatic access (tests, notebooks, schedulers).
+* ``HVD_TPU_METRICS_FILE=<path>`` — JSON dump at ``shutdown()``, one file
+  per rank (``<path>.<rank>``), for offline diffing (tools/metrics_dump.py).
+* ``HVD_TPU_MONITOR_PORT=<port>`` — a daemon-thread HTTP server exposing
+  Prometheus text at ``/metrics`` and the raw snapshot at ``/metrics.json``
+  so a pod-slice job can be scraped mid-training.
+
+Hot-path discipline: every instrumentation site is guarded by a single
+``registry.enabled`` check (a plain attribute read); when disabled — the
+default — collectives pay one branch.  Counter/histogram updates are a few
+dict/int ops under one lock, safe against the engine's waiter threads and
+the XLA plane's flush-from-any-thread pattern.  Stall records are NOT
+gated on ``enabled``: they are rare by construction and tests must be able
+to assert on them without opting into full metrics.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+PLANES = ("engine", "xla")
+OPS = ("allreduce", "allgather", "broadcast")
+
+# Fixed bucket upper bounds.  Latencies: pseudo-log seconds covering 100us
+# (one engine cycle is 5ms) out to the 60s stall horizon; fills: linear
+# tenths of the fusion threshold.
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+FILL_BUCKETS: Tuple[float, ...] = tuple((i + 1) / 10 for i in range(10))
+
+# name -> (bucket bounds, what it measures).  All durations in seconds.
+HISTOGRAMS = {
+    "negotiation_sec": (LATENCY_BUCKETS,
+                        "XLA-plane control-plane negotiation wait "
+                        "(enqueue -> completion stamp)"),
+    "residency_sec": (LATENCY_BUCKETS,
+                      "XLA-plane queue/bucket residency "
+                      "(negotiated -> dispatched)"),
+    "dispatch_sec": (LATENCY_BUCKETS,
+                     "XLA-plane dispatch+execute "
+                     "(program launch -> host result)"),
+    "wait_sec": (LATENCY_BUCKETS,
+                 "end-to-end Handle.wait() latency, both planes"),
+    "bucket_fill": (FILL_BUCKETS,
+                    "fusion-bucket fill fraction of the fusion threshold"),
+    "step_sec": (LATENCY_BUCKETS,
+                 "jax build_train_step per-call dispatch time"),
+}
+
+# Cap on distinct stalled-tensor entries kept by name; beyond it new names
+# fold into a single overflow key so a pathological job (auto-named tensors
+# stalling forever) cannot grow the registry unboundedly.
+_MAX_STALL_TENSORS = 256
+_STALL_OVERFLOW_KEY = "<other>"
+
+
+class Histogram:
+    """Fixed-bucket histogram; Prometheus-compatible (le upper bounds plus
+    an implicit +Inf overflow bucket, sum, count).  Not self-locking: the
+    registry's lock covers every mutation."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Tuple[float, ...]):
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.sum += value
+        self.count += 1
+
+    def to_dict(self) -> dict:
+        return {"buckets": list(self.bounds), "counts": list(self.counts),
+                "sum": self.sum, "count": self.count}
+
+
+class MetricsRegistry:
+    """Process-local counters + histograms for the collective layer.
+
+    ``enabled`` is the single hot-path gate: instrumentation sites read it
+    once and skip everything when False.  All mutation happens under one
+    lock; both data planes touch the registry from background/waiter
+    threads (the engine's per-handle waits, the plane's flush-from-wait).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.enabled = False
+        self._init_state()
+
+    def _init_state(self) -> None:
+        self._ops = {p: {o: 0 for o in OPS} for p in PLANES}
+        self._bytes = {p: {"in": 0, "out": 0} for p in PLANES}
+        self._batches = {"dispatched": 0, "fused_tensors": 0}
+        self._stall_count = 0
+        self._stall_tensors: Dict[str, dict] = {}
+        self._hists = {name: Histogram(bounds)
+                       for name, (bounds, _) in HISTOGRAMS.items()}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._init_state()
+
+    # -- recording (call sites guard on `enabled`; stalls are ungated) ----
+
+    def record_enqueue(self, plane: str, op: str, nbytes: int) -> None:
+        with self._lock:
+            self._ops[plane][op] += 1
+            self._bytes[plane]["in"] += int(nbytes)
+
+    def record_bytes_out(self, plane: str, nbytes: int) -> None:
+        with self._lock:
+            self._bytes[plane]["out"] += int(nbytes)
+
+    def record_batch(self, n_ops: int) -> None:
+        with self._lock:
+            self._batches["dispatched"] += 1
+            self._batches["fused_tensors"] += int(n_ops)
+
+    def observe(self, hist: str, value: float) -> None:
+        with self._lock:
+            self._hists[hist].observe(float(value))
+
+    def record_stall_count(self, n: int) -> None:
+        """Bump the stall-event total without per-tensor detail (engine
+        events whose names fell off the bounded C-side log)."""
+        with self._lock:
+            self._stall_count += int(n)
+
+    def record_stall(self, name: str, duration_sec: float) -> None:
+        with self._lock:
+            self._stall_count += 1
+            if (name not in self._stall_tensors
+                    and len(self._stall_tensors) >= _MAX_STALL_TENSORS):
+                name = _STALL_OVERFLOW_KEY
+            entry = self._stall_tensors.setdefault(
+                name, {"count": 0, "last_duration_sec": 0.0})
+            entry["count"] += 1
+            entry["last_duration_sec"] = float(duration_sec)
+
+    # -- reading ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "ops": {p: dict(v) for p, v in self._ops.items()},
+                "bytes": {p: dict(v) for p, v in self._bytes.items()},
+                "batches": dict(self._batches),
+                "stalls": {
+                    "count": self._stall_count,
+                    "tensors": {k: dict(v)
+                                for k, v in self._stall_tensors.items()},
+                },
+                "histograms": {name: h.to_dict()
+                               for name, h in self._hists.items()},
+            }
+
+
+registry = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (format v0.0.4).
+# ---------------------------------------------------------------------------
+
+
+def _label_escape(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(value: float) -> str:
+    return repr(int(value)) if float(value).is_integer() else repr(value)
+
+
+def _prom_hist_name(name: str) -> str:
+    if name.endswith("_sec"):
+        return f"hvd_tpu_{name[:-4]}_seconds"
+    return f"hvd_tpu_{name}_ratio"
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Render a metrics snapshot as Prometheus text exposition."""
+    out: List[str] = []
+
+    out.append("# HELP hvd_tpu_ops_total collective operations enqueued")
+    out.append("# TYPE hvd_tpu_ops_total counter")
+    for plane, per_op in snapshot["ops"].items():
+        for op, n in per_op.items():
+            out.append(f'hvd_tpu_ops_total{{plane="{plane}",op="{op}"}} {n}')
+
+    out.append("# HELP hvd_tpu_bytes_total collective payload bytes")
+    out.append("# TYPE hvd_tpu_bytes_total counter")
+    for plane, per_dir in snapshot["bytes"].items():
+        for direction, n in per_dir.items():
+            out.append(f'hvd_tpu_bytes_total{{plane="{plane}",'
+                       f'direction="{direction}"}} {n}')
+
+    out.append("# HELP hvd_tpu_batches_dispatched_total "
+               "fused batches dispatched (XLA plane)")
+    out.append("# TYPE hvd_tpu_batches_dispatched_total counter")
+    out.append("hvd_tpu_batches_dispatched_total "
+               f"{snapshot['batches']['dispatched']}")
+    out.append("# HELP hvd_tpu_fused_tensors_total "
+               "tensors carried by dispatched batches")
+    out.append("# TYPE hvd_tpu_fused_tensors_total counter")
+    out.append("hvd_tpu_fused_tensors_total "
+               f"{snapshot['batches']['fused_tensors']}")
+
+    out.append("# HELP hvd_tpu_stall_events_total "
+               "stall warnings (engine sweep + XLA-plane wait)")
+    out.append("# TYPE hvd_tpu_stall_events_total counter")
+    out.append(f"hvd_tpu_stall_events_total {snapshot['stalls']['count']}")
+    out.append("# HELP hvd_tpu_stalled_tensor_total "
+               "stall warnings per tensor name")
+    out.append("# TYPE hvd_tpu_stalled_tensor_total counter")
+    for name, entry in snapshot["stalls"]["tensors"].items():
+        out.append(f'hvd_tpu_stalled_tensor_total{{tensor='
+                   f'"{_label_escape(name)}"}} {entry["count"]}')
+
+    for name, hist in snapshot["histograms"].items():
+        prom = _prom_hist_name(name)
+        out.append(f"# HELP {prom} {HISTOGRAMS[name][1]}")
+        out.append(f"# TYPE {prom} histogram")
+        cumulative = 0
+        for bound, n in zip(hist["buckets"], hist["counts"]):
+            cumulative += n
+            out.append(f'{prom}_bucket{{le="{_fmt(bound)}"}} {cumulative}')
+        out.append(f'{prom}_bucket{{le="+Inf"}} {hist["count"]}')
+        out.append(f"{prom}_sum {repr(float(hist['sum']))}")
+        out.append(f"{prom}_count {hist['count']}")
+
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# HTTP monitor (opt-in: HVD_TPU_MONITOR_PORT, or start_monitor() directly).
+# ---------------------------------------------------------------------------
+
+_monitor_lock = threading.Lock()
+_monitor = None  # (server, bound_port)
+
+
+def start_monitor(port: int,
+                  snapshot_fn: Optional[Callable[[], dict]] = None,
+                  host: str = "") -> int:
+    """Serve ``/metrics`` (Prometheus text) and ``/metrics.json`` from a
+    daemon thread; returns the bound port (useful with ``port=0``).
+    Idempotent: a second call returns the running monitor's port.
+    Starting the monitor enables the registry — a scrape target with all
+    counters frozen at zero would be worse than no target."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    global _monitor
+    with _monitor_lock:
+        if _monitor is not None:
+            return _monitor[1]
+        fn = snapshot_fn or registry.snapshot
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?")[0] == "/metrics":
+                    body = prometheus_text(fn()).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?")[0] == "/metrics.json":
+                    body = json.dumps(fn()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # keep scrapes off stderr
+                pass
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        server.daemon_threads = True
+        thread = threading.Thread(target=server.serve_forever,
+                                  name="hvd-tpu-monitor", daemon=True)
+        thread.start()
+        registry.enable()
+        _monitor = (server, server.server_address[1])
+        return _monitor[1]
+
+
+def stop_monitor() -> None:
+    global _monitor
+    with _monitor_lock:
+        if _monitor is None:
+            return
+        server, _ = _monitor
+        _monitor = None
+    server.shutdown()
+    server.server_close()
+
+
+def monitor_port() -> Optional[int]:
+    with _monitor_lock:
+        return _monitor[1] if _monitor else None

@@ -145,6 +145,17 @@ void* hvd_tpu_result_ptr(long long handle) {
 
 void hvd_tpu_release(long long handle) { GlobalEngine()->Release(handle); }
 
+// Stall observability for the Python metrics registry: cumulative count
+// of (tensor, sweep) stall warnings from the rank-0 coordinator sweep,
+// plus a bounded "name|seconds;..." log of the most recent ones.
+long long hvd_tpu_stall_count() { return GlobalEngine()->StallEvents(); }
+
+const char* hvd_tpu_stall_info() {
+  static thread_local std::string tl_stall_info;
+  tl_stall_info = GlobalEngine()->StallInfo();
+  return tl_stall_info.c_str();
+}
+
 // Timeline hooks for the XLA data plane (jax/eager_mesh.py): plane-side
 // execution phases land in the same Chrome-tracing file as the engine's
 // events.  All are no-ops when HOROVOD_TIMELINE is unset.

@@ -904,6 +904,16 @@ void Engine::CheckForStalledTensors() {
     if (now - kv.second.first_seen <
         std::chrono::duration<double>(opts_.stall_warning_sec))
       continue;
+    {
+      // Record for the Python metrics registry (hvd_tpu_stall_count /
+      // hvd_tpu_stall_info): one event per (tensor, sweep) warning.
+      double stalled_sec =
+          std::chrono::duration<double>(now - kv.second.first_seen).count();
+      std::lock_guard<std::mutex> lk(stall_mu_);
+      ++stall_events_;
+      stall_log_.emplace_back(kv.first, stalled_sec);
+      while (stall_log_.size() > 64) stall_log_.pop_front();
+    }
     if (!preamble) {
       fprintf(stderr,
               "[horovod_tpu] WARNING: One or more tensors were submitted to "
@@ -923,6 +933,24 @@ void Engine::CheckForStalledTensors() {
     fprintf(stderr, "%s [missing ranks: %s]\n", kv.first.c_str(),
             missing.c_str());
   }
+}
+
+int64_t Engine::StallEvents() {
+  std::lock_guard<std::mutex> lk(stall_mu_);
+  return stall_events_;
+}
+
+std::string Engine::StallInfo() {
+  std::lock_guard<std::mutex> lk(stall_mu_);
+  std::string out;
+  for (const auto& rec : stall_log_) {
+    if (!out.empty()) out += ';';
+    for (char c : rec.first) out += (c == ';' || c == '|') ? '_' : c;
+    char buf[32];
+    snprintf(buf, sizeof(buf), "|%.3f", rec.second);
+    out += buf;
+  }
+  return out;
 }
 
 // ---------------------------------------------------------------------------

@@ -135,6 +135,13 @@ class Engine {
   void* ResultPtr(int64_t handle);
   void Release(int64_t handle);
 
+  // Stall observability (Python metrics registry, common/metrics.py):
+  // cumulative count of stalled-tensor warnings emitted by the rank-0
+  // sweep, and a bounded log of the most recent ones serialized as
+  // "name|seconds;name|seconds" (names sanitized of the separators).
+  int64_t StallEvents();
+  std::string StallInfo();
+
   // The engine-owned Chrome-tracing timeline.  Exposed so the XLA data
   // plane (Python, jax/eager_mesh.py) can emit its BUCKET_BUILD /
   // XLA_DISPATCH / DEVICE_WAIT activities into the SAME trace file as the
@@ -225,6 +232,14 @@ class Engine {
   uint8_t last_fused_dtype_ = 255;  // dtype of the current fusion group
   Timeline timeline_;
   std::chrono::steady_clock::time_point last_stall_check_;
+
+  // Stall log: one entry per (stalled tensor, sweep) warning, bounded so a
+  // permanently wedged job cannot grow it; the counter is cumulative for
+  // the process (survives engine re-init, matching the Python side's
+  // consumed-events bookkeeping).
+  std::mutex stall_mu_;
+  int64_t stall_events_ = 0;
+  std::deque<std::pair<std::string, double>> stall_log_;
 };
 
 Engine* GlobalEngine();

@@ -54,6 +54,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from horovod_tpu.common import metrics as _metrics
+
 _lock = threading.Lock()
 _plane = None  # initialized XlaDataPlane, or False if init failed/disabled
 
@@ -82,10 +84,11 @@ class _Batch:
     shared by many handles, which may be waited from different threads —
     the lock keeps the lazy materialization single-shot."""
 
-    def __init__(self, arr):
+    def __init__(self, arr, t_disp: float = 0.0):
         self._arr = arr
         self._host = None
         self._mu = threading.Lock()
+        self._t_disp = t_disp  # metrics: dispatch timestamp (0.0 = off)
 
     def ready(self) -> bool:
         with self._mu:
@@ -96,12 +99,16 @@ class _Batch:
             if self._host is None:
                 self._host = np.asarray(self._arr)
                 self._arr = None
+                if self._t_disp:
+                    _metrics.registry.observe(
+                        "dispatch_sec", time.perf_counter() - self._t_disp)
             return self._host
 
 
 class _PlaneOp:
     __slots__ = ("name", "kind", "payload", "root", "handle", "neg_raw",
-                 "neg_in", "neg_out", "my_hash", "seq", "tick", "dim0s")
+                 "neg_in", "neg_out", "my_hash", "seq", "tick", "dim0s",
+                 "t_enq", "t_neg")
 
     def __init__(self, name, kind, payload, root, handle):
         self.name = name
@@ -116,6 +123,10 @@ class _PlaneOp:
         self.seq = None  # engine completion stamps once negotiated
         self.tick = None
         self.dim0s = None  # per-rank dim0 (allgather geometry)
+        # Metrics timestamps (0.0 = metrics disabled at enqueue): t_enq at
+        # submission, t_neg when the negotiation stamp lands.
+        self.t_enq = 0.0
+        self.t_neg = 0.0
 
 
 class XlaHandle:
@@ -140,6 +151,8 @@ class XlaHandle:
         self._error: Optional[Exception] = None
         self._finished = False
         self._tl_started = False  # timeline op row opened at dispatch
+        # Metrics: end-to-end wait latency from enqueue (0.0 = off).
+        self._t0 = time.perf_counter() if _metrics.registry.enabled else 0.0
         # Negotiation (tick, seq) stamp, mirrored from the engine metadata
         # op at dispatch time (duck-type parity with common.Handle).
         self.completion_tick: Optional[int] = None
@@ -188,16 +201,24 @@ class XlaHandle:
             tl_lib.hvd_tpu_timeline_activity_start(self._name.encode(),
                                                    b"DEVICE_WAIT")
         host = self._batch.host()
-        if tl_lib is not None:
+        if tl_lib is not None or self._t0:
             # This op's own extent, not the shared fused buffer's size
             # (which would over-report by the fusion factor).
+            # Caller-visible width: bf16/f16 allreduce widens the compute
+            # buffer to f32, but the tensor the caller moved is half that.
+            itemsize = np.dtype(self._dtype).itemsize
             if self._kind == "ag":
-                my_bytes = int(np.prod(self._shape)) * host.itemsize
+                my_bytes = int(np.prod(self._shape)) * itemsize
             else:
-                my_bytes = self._n * host.itemsize
-            tl_lib.hvd_tpu_timeline_activity_end(self._name.encode())
-            tl_lib.hvd_tpu_timeline_op_end(self._name.encode(),
-                                           int(my_bytes))
+                my_bytes = self._n * itemsize
+            if tl_lib is not None:
+                tl_lib.hvd_tpu_timeline_activity_end(self._name.encode())
+                tl_lib.hvd_tpu_timeline_op_end(self._name.encode(),
+                                               int(my_bytes))
+            if self._t0:
+                _metrics.registry.record_bytes_out("xla", int(my_bytes))
+                _metrics.registry.observe(
+                    "wait_sec", time.perf_counter() - self._t0)
         if self._kind == "ag":
             pad = self._ag_pad
             blocks = [host[r * pad:r * pad + int(d)]
@@ -259,6 +280,10 @@ class XlaDataPlane:
             op.tick = -1  # always closed
             op.dim0s = np.asarray(
                 [op.payload.shape[0] if op.payload.ndim else 0], np.int64)
+            if op.t_enq:
+                op.t_neg = time.perf_counter()
+                _metrics.registry.observe("negotiation_sec",
+                                          op.t_neg - op.t_enq)
             return
         dim0 = op.payload.shape[0] if op.payload.ndim else 0
         shape = (op.payload.shape[1:] if op.kind == "ag"
@@ -310,6 +335,10 @@ class XlaDataPlane:
                         f"submit the same collective with the same dtype "
                         f"and shape."))
                     op.seq = -1
+                if op.seq != -1 and op.t_enq:
+                    op.t_neg = time.perf_counter()
+                    _metrics.registry.observe("negotiation_sec",
+                                              op.t_neg - op.t_enq)
             lib.hvd_tpu_release(op.neg_raw)
             op.neg_raw = -1
             op.neg_in = op.neg_out = None
@@ -388,6 +417,10 @@ class XlaDataPlane:
                 with self._mu:
                     waiting = [op.name for op in self._pending
                                if op.seq is None]
+                # Ungated (like the engine's sweep records): tests and
+                # operators read metrics_snapshot()["stalls"] without
+                # opting into full metrics collection.
+                _metrics.registry.record_stall(handle._name, now - start)
                 import sys
 
                 print(
@@ -449,6 +482,16 @@ class XlaDataPlane:
 
     def _dispatch_inner(self, bucket: List[_PlaneOp], tl_lib) -> None:
         kind = bucket[0].kind
+        mx = _metrics.registry.enabled
+        if mx:
+            # Queue/bucket residency: negotiation stamp -> dispatch.  Ops
+            # enqueued while metrics were off carry t_neg == 0.0 and skip.
+            now = time.perf_counter()
+            for op in bucket:
+                if op.t_neg:
+                    _metrics.registry.observe("residency_sec",
+                                              now - op.t_neg)
+            _metrics.registry.record_batch(len(bucket))
         if kind == "ag":
             op = bucket[0]
             pad = _bucket_len(int(op.dim0s.max()), minimum=1)
@@ -457,7 +500,8 @@ class XlaDataPlane:
             block[:op.payload.shape[0]] = op.payload
             fn = self._jit_for("ag", (pad,) + rest, op.payload.dtype)
             self._tl_phase(tl_lib, bucket, b"XLA_DISPATCH")
-            batch = _Batch(self._traced_dispatch(fn, block, "ag", 1))
+            batch = _Batch(self._traced_dispatch(fn, block, "ag", 1),
+                           t_disp=time.perf_counter() if mx else 0.0)
             self._tl_phase(tl_lib, bucket, None)
             h = op.handle
             h._ag_pad = pad
@@ -481,9 +525,15 @@ class XlaDataPlane:
                 offs.append(off)
                 off += n
             fn = self._jit_for(kind, length, dtype, bucket[0].root)
+            if mx:
+                _metrics.registry.observe(
+                    "bucket_fill",
+                    min(1.0, sum(op.payload.nbytes for op in bucket)
+                        / max(self._fusion_threshold, 1)))
             self._tl_phase(tl_lib, bucket, b"XLA_DISPATCH")
             batch = _Batch(self._traced_dispatch(fn, flat, kind,
-                                                 len(bucket)))
+                                                 len(bucket)),
+                           t_disp=time.perf_counter() if mx else 0.0)
             self._tl_phase(tl_lib, bucket, None)
             for op, o, n in zip(bucket, offs, lens):
                 op.handle._set_result(batch, o, n, op.tick, op.seq)
@@ -514,9 +564,19 @@ class XlaDataPlane:
 
     # -- public enqueue API ----------------------------------------------
 
+    _OP_NAMES = {"ar": "allreduce", "bc": "broadcast", "ag": "allgather"}
+
     def _enqueue(self, kind: str, payload: np.ndarray, root: int,
                  handle: XlaHandle, name: str) -> XlaHandle:
         op = _PlaneOp(name, kind, payload, root, handle)
+        if _metrics.registry.enabled:
+            op.t_enq = time.perf_counter()
+            # Caller-visible payload bytes (pre-widening: bf16/f16 count
+            # at their own width, not the f32 compute copy's).
+            _metrics.registry.record_enqueue(
+                "xla", self._OP_NAMES[kind],
+                int(np.prod(handle._shape))
+                * np.dtype(handle._dtype).itemsize)
         with self._mu:
             self._negotiate(op)
             self._pending.append(op)

@@ -11,6 +11,7 @@ allreduce-during-backprop, /root/reference/horovod/torch/__init__.py:64-89).
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 import jax
@@ -34,7 +35,32 @@ def shard_map(fn, mesh, in_specs, out_specs, check_vma=True):
     return _shard_map(fn, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, **{kw: check_vma})
 
+from horovod_tpu.common import metrics as _metrics
 from horovod_tpu.jax import DistributedOptimizer
+
+
+class _TimedStep:
+    """Callable proxy over the jitted step that feeds the ``step_sec``
+    histogram of the metrics registry (docs/metrics.md) — per-epoch step
+    summaries for free wherever ``build_train_step`` is used.  The measured
+    interval is the on-host dispatch of one step call (jax dispatch is
+    async); training loops that fetch the loss each step see true step
+    time.  Every jit attribute (``lower``, ``trace``, ...) delegates to
+    the wrapped function."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, *args, **kwargs):
+        if not _metrics.registry.enabled:
+            return self._fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        _metrics.registry.observe("step_sec", time.perf_counter() - t0)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
 
 
 def build_train_step(loss_fn: Callable, optimizer, mesh: Mesh,
@@ -88,4 +114,5 @@ def build_train_step(loss_fn: Callable, optimizer, mesh: Mesh,
         in_specs=(P(), P(), batch_spec),
         out_specs=(P(),) * n_out,
         check_vma=check_vma)
-    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+    return _TimedStep(jax.jit(mapped, donate_argnums=(0, 1)
+                              if donate else ()))

@@ -52,6 +52,52 @@ class MetricAverageCallback(keras.callbacks.Callback):
                 logs[key] = float(out)
 
 
+class MetricsLoggingCallback(keras.callbacks.Callback):
+    """Per-epoch collective-layer summary from the metrics registry
+    (docs/metrics.md): ops enqueued, bytes moved, fused batches, and stall
+    events since the previous epoch, printed on ``root_rank`` only.  A
+    no-op unless metrics are enabled (``HVD_TPU_METRICS=1``, a metrics
+    file, or a monitor port) — except for stall events, which the registry
+    records unconditionally."""
+
+    def __init__(self, root_rank: int = 0,
+                 log_fn: Optional[Callable[[str], None]] = None):
+        super().__init__()
+        self.root_rank = root_rank
+        self._log = log_fn or print
+        self._last: Optional[dict] = None
+
+    @staticmethod
+    def _totals(snap: dict) -> dict:
+        return {
+            "ops": {p: sum(v.values()) for p, v in snap["ops"].items()},
+            "bytes_in": sum(v["in"] for v in snap["bytes"].values()),
+            "bytes_out": sum(v["out"] for v in snap["bytes"].values()),
+            "batches": snap["batches"]["dispatched"],
+            "stalls": snap["stalls"]["count"],
+        }
+
+    def on_epoch_end(self, epoch, logs=None):
+        snap = _common.metrics_snapshot()
+        if not (snap["enabled"] or snap["stalls"]["count"]):
+            return
+        cur = self._totals(snap)
+        prev = self._last or {"ops": {p: 0 for p in cur["ops"]},
+                              "bytes_in": 0, "bytes_out": 0,
+                              "batches": 0, "stalls": 0}
+        self._last = cur
+        if _common.is_initialized() and _common.rank() != self.root_rank:
+            return
+        ops = " ".join(f"{p}={cur['ops'][p] - prev['ops'][p]}"
+                       for p in cur["ops"])
+        self._log(
+            f"[hvd-metrics] epoch {epoch + 1}: ops {ops}, "
+            f"bytes in/out {cur['bytes_in'] - prev['bytes_in']}/"
+            f"{cur['bytes_out'] - prev['bytes_out']}, "
+            f"batches {cur['batches'] - prev['batches']}, "
+            f"stalls {cur['stalls'] - prev['stalls']}")
+
+
 class LearningRateScheduleCallback(keras.callbacks.Callback):
     """Multiply the initial LR by ``multiplier`` (a constant or a function
     of epoch).  ``staircase=True`` applies at epoch granularity; otherwise

@@ -18,6 +18,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.utils.jax_compat import axis_size as _axis_size
+from horovod_tpu.utils.jax_compat import vma as _aval_vma
+
 from horovod_tpu.ops import (blockwise_attention, flash_attention,
                              ring_attention)
 
@@ -42,7 +45,7 @@ def _qkv_project_fwd(x, w):
 
 def _vma(t):
     """Varying-manual-axes of a value under shard_map (empty outside)."""
-    return frozenset(getattr(jax.typeof(t), "vma", ()) or ())
+    return frozenset(_aval_vma(t) or ())
 
 
 def _qkv_project_bwd(res, cots):
@@ -470,6 +473,6 @@ def next_token_loss(logits, targets, mask=None, axis_name=None):
         axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
         n_shards = 1
         for a in axes:
-            n_shards *= lax.axis_size(a)
+            n_shards *= _axis_size(a)
         count = lax.psum(count, axes) / n_shards
     return (loss * mask).sum() / jnp.maximum(count, 1.0)

@@ -24,6 +24,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.utils.jax_compat import axis_size as _axis_size
+from horovod_tpu.utils.jax_compat import tpu_compiler_params as _compiler_params
+from horovod_tpu.utils.jax_compat import vma as _vma
+
 NEG_INF = -1e30  # big-negative instead of -inf: keeps exp() NaN-free when a
 # whole row is masked (fully-masked causal blocks)
 POS_BIG = 1e30   # logsumexp sentinel for fully-masked rows: exp(s - POS_BIG)
@@ -524,7 +528,7 @@ def _combined_bwd_kernel(*refs, causal, block_q, block_k, num_q_blocks,
         from horovod_tpu.ops.rdma import _device_id
 
         my = jax.lax.axis_index(axis_name)
-        n = jax.lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
         dst, id_type = _device_id(jax.lax.rem(my + 1, n), axis_name,
                                   mesh_axes)
         src, _ = _device_id(jax.lax.rem(my - 1 + n, n), axis_name,
@@ -682,7 +686,7 @@ def _combined_bwd_call(q, do, lse8, delta8, k_cur, v_cur, q_offset,
         ]
         scratch_shapes += [pltpu.SemaphoreType.DMA((4,))]
         args += [k_cur, v_cur]
-    vma = getattr(jax.typeof(q), "vma", None)
+    vma = _vma(q)
     if vma is not None:
         out_shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype, vma=vma)
                       for s in out_shapes]
@@ -693,7 +697,7 @@ def _combined_bwd_call(q, do, lse8, delta8, k_cur, v_cur, q_offset,
         out_specs=out_specs,
         scratch_shapes=scratch_shapes,
     )
-    compiler_params = pltpu.CompilerParams(
+    compiler_params = _compiler_params(
         collective_id=(collective_id if rotate and not interpret
                        else None),
         has_side_effects=rotate)

@@ -39,6 +39,11 @@ import functools
 import jax
 from jax import lax
 
+from horovod_tpu.utils.jax_compat import axis_size as _axis_size
+from horovod_tpu.utils.jax_compat import shape_dtype_struct as _shape_dtype_struct
+from horovod_tpu.utils.jax_compat import tpu_compiler_params as _compiler_params
+from horovod_tpu.utils.jax_compat import vma as _vma
+
 try:
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -81,7 +86,7 @@ def _ambient_mesh_axes(axis_name):
 def _permute_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis_name,
                     shift, barrier, mesh_axes):
     my = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     dst, id_type = _device_id(lax.rem(my + shift, n), axis_name, mesh_axes)
     if barrier:
         # Ready handshake: I may DMA into `dst` only once `dst` has
@@ -107,20 +112,20 @@ def _permute_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis_name,
 
 
 def _ring_permute_raw(x, axis_name, shift, interpret, phase):
-    shift = shift % lax.axis_size(axis_name)  # static: axis sizes are known
+    shift = shift % _axis_size(axis_name)  # static: axis sizes are known
     kernel = functools.partial(_permute_kernel, axis_name=axis_name,
                                shift=shift, barrier=not interpret,
                                mesh_axes=_ambient_mesh_axes(axis_name))
     # Propagate the varying-mesh-axes annotation so shard_map's vma check
     # accepts the pallas output (the result varies exactly as the input).
-    vma = getattr(jax.typeof(x), "vma", None)
+    vma = _vma(x)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype, vma=vma),
+        out_shape=_shape_dtype_struct(x.shape, x.dtype, vma=vma),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             collective_id=_COLLECTIVE_IDS[phase % 2],
             has_side_effects=True),
         interpret=interpret,

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.utils.jax_compat import axis_size as _axis_size
+
 from horovod_tpu.ops.attention import (
     NEG_INF,
     _block_attend,
@@ -62,7 +64,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
         return fused_ring_attention(q, k, v, axis_name, causal=causal,
                                     sm_scale=sm_scale)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     seq_local = q.shape[-2]
 

@@ -52,6 +52,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.utils.jax_compat import axis_size as _axis_size
+from horovod_tpu.utils.jax_compat import tpu_compiler_params as _compiler_params
+from horovod_tpu.utils.jax_compat import vma as _vma
+
 from horovod_tpu.ops.attention import (NEG_INF, POS_BIG, _attend_block,
                                        _bwd_plan, _combined_bwd_call,
                                        _finalize_flash, _init_state,
@@ -96,7 +100,7 @@ def _step_kernel(*refs, causal, block_q, block_k, num_q_blocks,
 
     if rotate:
         my = lax.axis_index(axis_name)
-        n = lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
         dst, id_type = _device_id(lax.rem(my + 1, n), axis_name, mesh_axes)
         src, _ = _device_id(lax.rem(my - 1 + n, n), axis_name, mesh_axes)
 
@@ -248,7 +252,7 @@ def _ring_flash_step(q, k_cur, v_cur, q_offset, k_offset, *,
         ]
         scratch_shapes += [pltpu.SemaphoreType.DMA((4,))]  # k/v send+recv
         args += [k_cur, v_cur]
-    vma = getattr(jax.typeof(q), "vma", None)
+    vma = _vma(q)
     if vma is not None:
         out_shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype, vma=vma)
                       for s in out_shapes]
@@ -260,7 +264,7 @@ def _ring_flash_step(q, k_cur, v_cur, q_offset, k_offset, *,
         scratch_shapes=scratch_shapes,
     )
     barrier = rotate and not interpret
-    compiler_params = pltpu.CompilerParams(
+    compiler_params = _compiler_params(
         # collective_id may only be set when the kernel takes the custom
         # barrier (the non-rotating last step has no barrier).
         collective_id=_COLLECTIVE_IDS[phase % 2] if barrier else None,
@@ -281,7 +285,7 @@ def _ring_flash_step(q, k_cur, v_cur, q_offset, k_offset, *,
 
 def _phase_closer_kernel(o_ref, *, axis_name, mesh_axes):
     my = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     src, id_type = _device_id(lax.rem(my - 1 + n, n), axis_name, mesh_axes)
     bar = pltpu.get_barrier_semaphore()
     pltpu.semaphore_signal(bar, inc=1, device_id=src,
@@ -302,7 +306,7 @@ def _phase_closer(axis_name):
         functools.partial(_phase_closer_kernel, axis_name=axis_name,
                           mesh_axes=_ambient_mesh_axes(axis_name)),
         out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             collective_id=_COLLECTIVE_IDS[1], has_side_effects=True),
     )()
 
@@ -348,7 +352,7 @@ def _merge(o1, lse1, o2, lse2):
 
 def _fused_forward(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
                    interpret):
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     sl = q.shape[-2]
     batch, heads = q.shape[0], q.shape[1]
@@ -391,7 +395,7 @@ def _fused_backward(q, k, v, out, lse, g, axis_name, causal, sm_scale,
     by in-kernel DMA while computing the shard's dk/dv and dq blocks from
     the saved (out, lse); the float32 dk/dv partials follow their shard
     around the ring as ppermute rotations between kernels."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     batch, heads, sl, d = q.shape
     bh = batch * heads

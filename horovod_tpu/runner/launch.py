@@ -70,7 +70,7 @@ def run_command(cmd: Sequence[str], np: int,
             env=rank_env,
             stdout=subprocess.PIPE if capture else None,
             stderr=subprocess.PIPE if capture else None,
-            text=True))
+            text=True, start_new_session=True))
     return _wait_all(cmd, procs, timeout)
 
 
@@ -94,6 +94,14 @@ def run_hosts(cmd: Sequence[str], np: int, hosts_spec: str,
     base_env = dict(env if env is not None else os.environ)
     overrides = {k: v for k, v in base_env.items()
                  if os.environ.get(k) != v}
+    # Remote ranks get a fresh login environment from ssh, not this
+    # process's: forward the accelerator/runtime selection explicitly so
+    # a remote rank resolves the same platform and imports as a local one
+    # (mpirun inherited these wholesale; ssh does not).
+    for key in ("JAX_PLATFORMS", "PYTHONPATH", "XLA_FLAGS",
+                "HVD_TPU_XLA_DATA_PLANE", "HOROVOD_XLA_DATA_PLANE"):
+        if key in base_env:
+            overrides.setdefault(key, base_env[key])
     procs = []
     for p in placements:
         rank_env = dict(base_env)
@@ -104,8 +112,22 @@ def run_hosts(cmd: Sequence[str], np: int, hosts_spec: str,
             argv, env=rank_env,
             stdout=subprocess.PIPE if capture else None,
             stderr=subprocess.PIPE if capture else None,
-            text=True))
+            text=True, start_new_session=True))
     return _wait_all(cmd, procs, timeout)
+
+
+def _kill_rank(p) -> None:
+    """Kill a rank and everything it spawned.  Ranks start in their own
+    session (start_new_session=True), so killing the process group reaches
+    grandchildren too — a rank that exec'd through a shell (the ssh path)
+    would otherwise leave a descendant holding the stdout/stderr pipes,
+    and communicate() below would block on them long past the timeout."""
+    import signal
+
+    try:
+        os.killpg(p.pid, signal.SIGKILL)
+    except OSError:
+        p.kill()
 
 
 def _wait_all(cmd: Sequence[str], procs, timeout: float) -> List[RankResult]:
@@ -117,21 +139,39 @@ def _wait_all(cmd: Sequence[str], procs, timeout: float) -> List[RankResult]:
     deadline = time.monotonic() + timeout
     grace_deadline = None
     timed_out = False
-    while any(p.poll() is None for p in procs):
-        now = time.monotonic()
-        if grace_deadline is None and any(
-                p.returncode not in (None, 0) for p in procs):
-            grace_deadline = now + 15.0
-        if now >= deadline or (grace_deadline and now >= grace_deadline):
-            timed_out = now >= deadline
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-            break
-        time.sleep(0.05)
+    try:
+        while any(p.poll() is None for p in procs):
+            now = time.monotonic()
+            if grace_deadline is None and any(
+                    p.returncode not in (None, 0) for p in procs):
+                grace_deadline = now + 15.0
+            if now >= deadline or (grace_deadline and now >= grace_deadline):
+                timed_out = now >= deadline
+                for p in procs:
+                    if p.poll() is None:
+                        _kill_rank(p)
+                break
+            time.sleep(0.05)
+    except BaseException:
+        # Ctrl-C / SIGTERM on the launcher: ranks run in their own
+        # sessions (no terminal signal fan-out), so propagate the kill
+        # to every rank group before re-raising.
+        for p in procs:
+            if p.poll() is None:
+                _kill_rank(p)
+        raise
     results = []
     for r, p in enumerate(procs):
-        out, errout = p.communicate()
+        try:
+            out, errout = p.communicate(timeout=30.0)
+        except subprocess.TimeoutExpired:
+            # A straggler (or an orphan sharing its pipes) survived: kill
+            # its group and salvage what it wrote; never hang the launcher.
+            _kill_rank(p)
+            try:
+                out, errout = p.communicate(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                out, errout = "", ""
         rc = p.returncode if p.returncode is not None else -9
         results.append(RankResult(r, rc, out or "", errout or ""))
     if timed_out:

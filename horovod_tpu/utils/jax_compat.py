@@ -1,0 +1,66 @@
+"""jax cross-version compatibility helpers.
+
+The repo supports the CI min-versions leg (jax 0.4.x) through current
+releases; API moves are funneled through here (the shard_map kwarg rename
+is handled in horovod_tpu/jax/train.py, which predates this module).
+"""
+
+from __future__ import annotations
+
+
+def axis_size(axis) -> int:
+    """Participant count of a mapped mesh axis.  ``lax.axis_size`` arrived
+    in jax 0.6; earlier versions use the classic psum-of-one idiom, which
+    constant-folds to a static int at trace time (so callers may use it in
+    shape arithmetic and static modulos)."""
+    from jax import lax
+
+    try:
+        return lax.axis_size(axis)
+    except AttributeError:
+        return lax.psum(1, axis)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across versions: renamed from
+    ``TPUCompilerParams`` in jax 0.6, which also gained new fields
+    (``has_side_effects``).  Kwargs the installed class does not accept
+    are dropped — on those versions they are compilation hints that do
+    not exist, not semantics we can emulate."""
+    import inspect
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+        params = inspect.signature(cls).parameters
+        kwargs = {k: v for k, v in kwargs.items() if k in params}
+    return cls(**kwargs)
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` with the vma annotation where the
+    installed jax supports it (0.6+); plain otherwise."""
+    import jax
+
+    if vma is not None:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def vma(x):
+    """The varying-manual-axes set of a value's abstract type, or None
+    where the concept does not exist.  ``jax.typeof`` arrived with the
+    vma machinery (jax 0.6); earlier versions have neither, and callers
+    treat None as "nothing varies" (the pre-vma semantics)."""
+    import jax
+
+    try:
+        aval = jax.typeof(x)
+    except AttributeError:
+        aval = getattr(x, "aval", None)
+    return getattr(aval, "vma", None)

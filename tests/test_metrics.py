@@ -1,0 +1,403 @@
+"""Collective metrics registry (horovod_tpu/common/metrics.py): snapshot
+shape, counter monotonicity, histogram accounting, reset semantics,
+thread-safety under concurrent collectives, stall surfacing, and the
+Prometheus/JSON monitor endpoints.  Tier-1, CPU-only, in-process (size-1
+engine); the multi-rank stall path is covered by the distributed test at
+the bottom."""
+
+import json
+import os
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tests.distributed import distributed_test
+
+
+@pytest.fixture
+def hvd_metrics():
+    """hvd.init() at size 1 with metrics collection enabled, registry
+    cleared before and after (it is process-global)."""
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA", "HVD_TPU_METRICS_FILE",
+                "HVD_TPU_MONITOR_PORT"):
+        os.environ.pop(var, None)
+    os.environ["HVD_TPU_METRICS"] = "1"
+    import horovod_tpu as hvd
+
+    hvd.init()
+    hvd.metrics_reset()
+    yield hvd
+    hvd.metrics_reset()
+    hvd.shutdown()
+    os.environ.pop("HVD_TPU_METRICS", None)
+    from horovod_tpu.common import metrics
+
+    metrics.registry.disable()
+
+
+def test_snapshot_shape(hvd_metrics):
+    hvd = hvd_metrics
+    hvd.allreduce(np.ones(100, np.float32), name="m.ar")
+    snap = hvd.metrics_snapshot()
+    assert snap["enabled"] is True
+    for plane in ("engine", "xla"):
+        assert set(snap["ops"][plane]) == {"allreduce", "allgather",
+                                           "broadcast"}
+        assert set(snap["bytes"][plane]) == {"in", "out"}
+    assert set(snap["batches"]) == {"dispatched", "fused_tensors"}
+    assert set(snap["stalls"]) == {"count", "tensors"}
+    for hist in snap["histograms"].values():
+        assert set(hist) == {"buckets", "counts", "sum", "count"}
+        assert len(hist["counts"]) == len(hist["buckets"]) + 1
+    # The whole snapshot is plain data: JSON round-trips.
+    assert json.loads(json.dumps(snap)) == snap
+
+
+def test_counters_and_monotonicity(hvd_metrics):
+    hvd = hvd_metrics
+    x = np.ones(256, np.float32)
+    hvd.allreduce(x, name="m.a")
+    hvd.broadcast(x, 0, name="m.b")
+    s1 = hvd.metrics_snapshot()
+    assert s1["ops"]["engine"]["allreduce"] == 1
+    assert s1["ops"]["engine"]["broadcast"] == 1
+    assert s1["bytes"]["engine"]["in"] == 2 * x.nbytes
+    assert s1["bytes"]["engine"]["out"] == 2 * x.nbytes
+    hvd.allgather(np.ones((4, 8), np.float32), name="m.g")
+    s2 = hvd.metrics_snapshot()
+    for plane in ("engine", "xla"):
+        for op in ("allreduce", "allgather", "broadcast"):
+            assert s2["ops"][plane][op] >= s1["ops"][plane][op]
+    assert s2["ops"]["engine"]["allgather"] == 1
+    assert s2["bytes"]["engine"]["in"] == s1["bytes"]["engine"]["in"] + 128
+
+
+def test_histogram_bucket_sums(hvd_metrics):
+    hvd = hvd_metrics
+    n = 7
+    for i in range(n):
+        hvd.allreduce(np.ones(32, np.float32), name=f"m.h{i}")
+    hist = hvd.metrics_snapshot()["histograms"]["wait_sec"]
+    assert hist["count"] == n
+    assert sum(hist["counts"]) == n  # bucket counts account for every obs
+    assert hist["sum"] > 0.0
+    # Buckets are sorted upper bounds.
+    assert hist["buckets"] == sorted(hist["buckets"])
+
+
+def test_reset_semantics(hvd_metrics):
+    hvd = hvd_metrics
+    hvd.allreduce(np.ones(8, np.float32), name="m.r")
+    assert hvd.metrics_snapshot()["ops"]["engine"]["allreduce"] == 1
+    hvd.metrics_reset()
+    snap = hvd.metrics_snapshot()
+    assert snap["ops"]["engine"]["allreduce"] == 0
+    assert snap["bytes"]["engine"]["in"] == 0
+    assert snap["stalls"] == {"count": 0, "tensors": {}}
+    assert all(h["count"] == 0 for h in snap["histograms"].values())
+    assert snap["enabled"] is True  # reset clears data, not the gate
+    # The registry keeps recording after a reset.
+    hvd.allreduce(np.ones(8, np.float32), name="m.r2")
+    assert hvd.metrics_snapshot()["ops"]["engine"]["allreduce"] == 1
+
+
+def test_thread_safety_smoke(hvd_metrics):
+    """Concurrent allreduces from several threads: every op and byte is
+    accounted exactly once (the engine supports concurrent enqueues; the
+    registry must too)."""
+    hvd = hvd_metrics
+    threads, per_thread, nbytes = 4, 8, 64 * 4
+    errors = []
+
+    def work(t):
+        try:
+            for i in range(per_thread):
+                out = hvd.allreduce(np.full(64, float(t), np.float32),
+                                    name=f"m.t{t}.{i}")
+                assert np.allclose(out, float(t))
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    ts = [threading.Thread(target=work, args=(t,)) for t in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors, errors
+    snap = hvd.metrics_snapshot()
+    total = threads * per_thread
+    assert snap["ops"]["engine"]["allreduce"] == total
+    assert snap["bytes"]["engine"]["in"] == total * nbytes
+    assert snap["bytes"]["engine"]["out"] == total * nbytes
+    assert snap["histograms"]["wait_sec"]["count"] == total
+
+
+def test_prometheus_endpoint_and_json(hvd_metrics):
+    from horovod_tpu.common import metrics
+
+    hvd = hvd_metrics
+    hvd.allreduce(np.ones(128, np.float32), name="m.p")
+    port = metrics.start_monitor(0, snapshot_fn=hvd.metrics_snapshot)
+    try:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        # Every non-comment line is "name{labels} value" or "name value".
+        sample = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [0-9.+\-einfa]+$")
+        lines = [l for l in text.splitlines() if l]
+        assert lines, text
+        for line in lines:
+            if line.startswith("#"):
+                assert line.startswith(("# HELP ", "# TYPE ")), line
+            else:
+                assert sample.match(line), line
+        assert 'hvd_tpu_ops_total{plane="engine",op="allreduce"} 1' in lines
+        # Histogram families expose cumulative buckets + +Inf + sum/count.
+        assert any(l.startswith('hvd_tpu_wait_seconds_bucket{le="+Inf"}')
+                   for l in lines)
+        assert "hvd_tpu_wait_seconds_count 1" in lines
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics.json",
+            timeout=10).read().decode()
+        snap = json.loads(raw)
+        # The JSON endpoint serves the same registry the API reads.
+        assert snap["ops"] == hvd.metrics_snapshot()["ops"]
+        assert urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).status == 200
+    finally:
+        metrics.stop_monitor()
+    from horovod_tpu.common.metrics import monitor_port
+
+    assert monitor_port() is None
+
+
+def test_monitor_env_and_metrics_file(tmp_path):
+    """HVD_TPU_MONITOR_PORT starts the monitor at init();
+    HVD_TPU_METRICS_FILE writes a per-rank JSON dump at shutdown()."""
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA"):
+        os.environ.pop(var, None)
+    path = str(tmp_path / "metrics.json")
+    os.environ["HVD_TPU_METRICS_FILE"] = path
+    os.environ["HVD_TPU_MONITOR_PORT"] = "0"  # ephemeral: avoids collisions
+    import horovod_tpu as hvd
+    from horovod_tpu.common import metrics
+
+    hvd.init()
+    try:
+        hvd.metrics_reset()
+        assert metrics.registry.enabled  # implied by file/port
+        port = metrics.monitor_port()
+        assert port
+        hvd.allreduce(np.ones(16, np.float32), name="mf.a")
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert 'hvd_tpu_ops_total{plane="engine",op="allreduce"} 1' in text
+    finally:
+        hvd.shutdown()
+        os.environ.pop("HVD_TPU_METRICS_FILE", None)
+        os.environ.pop("HVD_TPU_MONITOR_PORT", None)
+        metrics.registry.disable()
+        metrics.registry.reset()
+    dump = json.load(open(path + ".0"))  # rank-suffixed
+    assert dump["ops"]["engine"]["allreduce"] == 1
+    assert metrics.monitor_port() is None  # shutdown stops the monitor
+
+
+def test_plane_stall_recorded_in_registry(monkeypatch):
+    """Satellite: stall warnings are programmatic, not just stderr — the
+    XLA plane's wait loop records (tensor, duration) into the registry
+    even with metrics collection disabled."""
+    import time as _time
+
+    import horovod_tpu.common as common
+    from horovod_tpu.common import metrics
+    from horovod_tpu.jax.eager_mesh import XlaDataPlane, XlaHandle, _PlaneOp
+
+    metrics.registry.disable()
+    metrics.registry.reset()
+    monkeypatch.setenv("HVD_TPU_STALL_WARNING_SEC", "0.05")
+    plane = XlaDataPlane(mesh=None, spec_sharded=None, spec_replicated=None,
+                         rank=0, size=2, fusion_threshold=1 << 20)
+    handle = XlaHandle(plane, "ar", "stalled_metric", None, True, 2,
+                       np.float32, (2,))
+    op = _PlaneOp("stalled_metric", "ar", np.zeros(2, np.float32), 0, handle)
+    plane._pending.append(op)
+    monkeypatch.setattr(plane, "flush", lambda: None)
+
+    def unblock():
+        _time.sleep(0.3)
+        handle._error = RuntimeError("unblocked")
+
+    t = threading.Thread(target=unblock)
+    t.start()
+    plane._wait_dispatch(handle)
+    t.join()
+    snap = common.metrics_snapshot()
+    assert snap["stalls"]["count"] >= 1
+    assert "stalled_metric" in snap["stalls"]["tensors"]
+    entry = snap["stalls"]["tensors"]["stalled_metric"]
+    assert entry["count"] >= 1 and entry["last_duration_sec"] > 0
+    metrics.registry.reset()
+
+
+@distributed_test(np_=2, timeout=300.0)
+def test_engine_stall_surfaced_to_snapshot():
+    """Satellite (engine side): when a rank submits a collective its peer
+    does not, the coordinator's stall sweep is visible on rank 0 through
+    metrics_snapshot()["stalls"] — tensor name included — instead of only
+    a stderr line.  Metrics collection stays at its default (disabled):
+    stall records are ungated."""
+    import time
+
+    import horovod_tpu as hvd
+    import horovod_tpu.common as common
+
+    os.environ["HVD_TPU_STALL_WARNING_SEC"] = "0.3"
+    hvd.init()
+    if hvd.rank() == 0:
+        h = common.allreduce_async(np.ones(4, np.float32), average=False,
+                                   name="lonely")
+        snap = {}
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            snap = hvd.metrics_snapshot()
+            if snap["stalls"]["count"] >= 1:
+                break
+            time.sleep(0.1)
+        assert snap["stalls"]["count"] >= 1, snap["stalls"]
+        assert "lonely" in snap["stalls"]["tensors"], snap["stalls"]
+        assert snap["stalls"]["tensors"]["lonely"]["last_duration_sec"] > 0
+    else:
+        time.sleep(2.0)  # let rank 0's sweep fire before unblocking it
+        h = common.allreduce_async(np.ones(4, np.float32), average=False,
+                                   name="lonely")
+    out = h.wait()
+    assert np.allclose(out, 2.0), out
+
+
+@distributed_test(np_=2, timeout=300.0)
+def test_monitor_scrape_during_two_process_job():
+    """Acceptance: with HVD_TPU_MONITOR_PORT set, scraping /metrics during
+    a 2-process hvdrun CPU job returns Prometheus text whose allreduce op
+    count and byte totals match metrics_snapshot() on that rank."""
+    os.environ["HVD_TPU_MONITOR_PORT"] = "0"  # ephemeral: collision-proof
+    import horovod_tpu as hvd
+    from horovod_tpu.common import metrics
+
+    hvd.init()
+    hvd.metrics_reset()
+    r, n = hvd.rank(), hvd.size()
+    x = np.full(500, float(r), np.float32)
+    for i in range(3):
+        out = hvd.allreduce(x, average=False, name=f"scrape.{i}")
+        assert np.allclose(out, sum(range(n)))
+    port = metrics.monitor_port()
+    assert port, "monitor did not start from HVD_TPU_MONITOR_PORT"
+    text = urllib.request.urlopen(
+        f"http://localhost:{port}/metrics", timeout=10).read().decode()
+    snap = hvd.metrics_snapshot()
+    ar = snap["ops"]["engine"]["allreduce"]
+    bin_ = snap["bytes"]["engine"]["in"]
+    assert ar == 3 and bin_ == 3 * x.nbytes, snap
+    assert f'hvd_tpu_ops_total{{plane="engine",op="allreduce"}} {ar}' \
+        in text, text[:800]
+    assert f'hvd_tpu_bytes_total{{plane="engine",direction="in"}} {bin_}' \
+        in text, text[:800]
+    hvd.shutdown()
+
+
+def test_monitor_port_offsets_by_local_rank(monkeypatch):
+    """A non-zero HVD_TPU_MONITOR_PORT binds port+local_rank so several
+    ranks on one host coexist (rank 0 stays at the base port)."""
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA"):
+        os.environ.pop(var, None)
+    import horovod_tpu as hvd
+    from horovod_tpu.common import metrics
+
+    calls = []
+    monkeypatch.setenv("HVD_TPU_MONITOR_PORT", "19123")
+    monkeypatch.setattr(metrics, "start_monitor",
+                        lambda port, **kw: calls.append(port) or port)
+    hvd.init()
+    try:
+        assert calls == [19123]  # size-1: local_rank 0 -> base port
+    finally:
+        hvd.shutdown()
+        metrics.registry.disable()
+        metrics.registry.reset()
+
+
+def test_keras_metrics_logging_callback(hvd_metrics):
+    """MetricsLoggingCallback logs per-epoch deltas of the registry."""
+    keras = pytest.importorskip("keras")  # noqa: F841
+    from horovod_tpu.keras.callbacks import MetricsLoggingCallback
+
+    hvd = hvd_metrics
+    lines = []
+    cb = MetricsLoggingCallback(log_fn=lines.append)
+    hvd.allreduce(np.ones(64, np.float32), name="cb.0")
+    cb.on_epoch_end(0)
+    hvd.allreduce(np.ones(64, np.float32), name="cb.1")
+    hvd.allreduce(np.ones(64, np.float32), name="cb.2")
+    cb.on_epoch_end(1)
+    assert len(lines) == 2, lines
+    assert "ops engine=1" in lines[0], lines[0]
+    assert "ops engine=2" in lines[1], lines[1]  # delta, not cumulative
+    assert "stalls 0" in lines[1]
+
+
+def test_jax_train_step_feeds_step_histogram(hvd_metrics):
+    """Steps built by build_train_step record into the step_sec histogram
+    when metrics are enabled (and stay zero-overhead pass-throughs when
+    not — the proxy consults the gate per call)."""
+    jax = pytest.importorskip("jax")
+    optax = pytest.importorskip("optax")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P  # noqa: F401
+
+    from horovod_tpu.jax.train import build_train_step
+
+    hvd = hvd_metrics
+    mesh = Mesh(np.array(jax.devices()[:2]), ("hvd",))
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch @ params) ** 2)
+
+    tx = optax.sgd(0.1)
+    step = build_train_step(loss_fn, tx, mesh, axis_name="hvd")
+    params = jnp.ones((4,))
+    opt_state = tx.init(params)
+    batch = jnp.ones((2, 4))
+    before = hvd.metrics_snapshot()["histograms"]["step_sec"]["count"]
+    params, opt_state, loss = step(params, opt_state, batch)
+    float(loss)
+    after = hvd.metrics_snapshot()["histograms"]["step_sec"]["count"]
+    assert after == before + 1
+
+
+def test_prometheus_text_pure():
+    """prometheus_text renders a synthetic snapshot without an engine."""
+    from horovod_tpu.common.metrics import (MetricsRegistry,
+                                            prometheus_text)
+
+    reg = MetricsRegistry()
+    reg.record_enqueue("xla", "allreduce", 1024)
+    reg.record_bytes_out("xla", 1024)
+    reg.record_batch(3)
+    reg.observe("bucket_fill", 0.42)
+    reg.observe("negotiation_sec", 0.003)
+    reg.record_stall('we"ird\nname', 1.5)
+    text = prometheus_text(reg.snapshot())
+    assert 'hvd_tpu_ops_total{plane="xla",op="allreduce"} 1' in text
+    assert 'hvd_tpu_bytes_total{plane="xla",direction="out"} 1024' in text
+    assert "hvd_tpu_fused_tensors_total 3" in text
+    assert "hvd_tpu_stall_events_total 1" in text
+    assert '\\"' in text and "\\n" in text  # label escaping
+    assert "hvd_tpu_bucket_fill_ratio_count 1" in text
+    assert "hvd_tpu_negotiation_seconds_count 1" in text

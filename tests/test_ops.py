@@ -13,10 +13,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from horovod_tpu.ops import (blockwise_attention, flash_attention,
                              mha_reference, ring_attention)
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+# The train.py wrapper translates the check_vma/check_rep kwarg rename
+# across jax versions (CI min-versions leg).
+from horovod_tpu.jax.train import shard_map
 
 
 def _qkv(batch=2, heads=2, seq=256, d=64, seed=0, dtype=jnp.float32):

@@ -1,0 +1,104 @@
+"""Direct test of the engine's Chrome-tracing timeline (docs/timeline.md):
+run eager collectives in a fresh process with ``HVD_TPU_TIMELINE`` set,
+parse the output as JSON, and assert the NEGOTIATE -> op event nesting and
+non-decreasing timestamps.  (The XLA plane's timeline integration is
+covered by tests/test_xla_plane.py::test_xla_plane_timeline_activities;
+this covers the engine path itself, which previously had no direct test.)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_CHILD = """\
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+for i in range(3):
+    hvd.allreduce(np.ones(64, np.float32), name=f"t{i}")
+hvd.allgather(np.ones((2, 2), np.float32), name="g0")
+hvd.broadcast(np.arange(5, dtype=np.float32), 0, name="b0")
+hvd.shutdown()
+"""
+
+
+def _run_with_timeline(tmp_path):
+    path = str(tmp_path / "timeline.json")
+    env = dict(os.environ, HVD_TPU_TIMELINE=path, JAX_PLATFORMS="cpu")
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA"):
+        env.pop(var, None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", _CHILD],
+                          capture_output=True, text=True, env=env,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # The writer streams events with trailing commas and no closing "]"
+    # (Chrome's parser tolerates it); normalize before json.loads.
+    raw = open(path).read().rstrip().rstrip(",")
+    return json.loads(raw + "]")
+
+
+def test_timeline_negotiate_op_nesting_and_timestamps(tmp_path):
+    events = _run_with_timeline(tmp_path)
+    assert events, "empty timeline"
+
+    # pid metadata maps each trace row to its tensor name.
+    pid_names = {e["pid"]: e["args"]["name"]
+                 for e in events if e.get("ph") == "M"}
+    assert set(pid_names.values()) >= {"t0", "t1", "t2", "g0", "b0"}
+
+    # Timestamps never decrease in file order (one writer, one clock).
+    ts = [e["ts"] for e in events if "ts" in e]
+    assert ts == sorted(ts)
+
+    by_name = {}
+    for e in events:
+        if e.get("ph") in ("B", "E"):
+            by_name.setdefault(pid_names[e["pid"]], []).append(e)
+
+    expect_op = {"t0": "ALLREDUCE", "t1": "ALLREDUCE", "t2": "ALLREDUCE",
+                 "g0": "ALLGATHER", "b0": "BROADCAST"}
+    for name, op in expect_op.items():
+        evs = by_name[name]
+        cats = [e.get("name") for e in evs]
+        # NEGOTIATE opens first and closes before the op row opens:
+        # NEGOTIATE(B) ... E ... OP(B) ... E — per-tensor state machine.
+        assert cats[0] == "NEGOTIATE", (name, cats)
+        assert op in cats, (name, cats)
+        assert cats.index("NEGOTIATE") < cats.index(op), (name, cats)
+        neg_end = next(i for i, e in enumerate(evs)
+                       if e["ph"] == "E" and i > 0)
+        assert neg_end < cats.index(op), (name, cats)
+        # Begin/End events balance, and never go negative (no E before B).
+        depth = 0
+        for e in evs:
+            depth += 1 if e["ph"] == "B" else -1
+            assert depth >= 0, (name, cats)
+        assert depth == 0, (name, cats)
+        # The op's closing E carries the payload byte count.
+        closing = evs[-1]
+        assert closing["ph"] == "E", (name, evs[-1])
+        assert closing.get("args", {}).get("bytes", 0) > 0, (name, closing)
+
+
+def test_timeline_disabled_writes_nothing(tmp_path):
+    """Without HVD_TPU_TIMELINE the engine must not create a file (the
+    default path: timeline disabled, zero overhead)."""
+    path = tmp_path / "no_timeline.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("HVD_TPU_TIMELINE", None)
+    env.pop("HOROVOD_TIMELINE", None)
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA"):
+        env.pop(var, None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", _CHILD],
+                          capture_output=True, text=True, env=env,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert not path.exists()

@@ -10,10 +10,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from horovod_tpu.models import TransformerLM, next_token_loss
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+# The train.py wrapper translates the check_vma/check_rep kwarg rename
+# across jax versions (CI min-versions leg).
+from horovod_tpu.jax.train import shard_map
 
 VOCAB = 64
 

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Pretty-print / diff HVD_TPU_METRICS_FILE dumps (docs/metrics.md).
+
+A dump is the JSON written at shutdown() when HVD_TPU_METRICS_FILE is set
+(one file per rank: <path>.<rank>) — the same nested dict
+hvd.metrics_snapshot() returns.
+
+    python tools/metrics_dump.py run.json.0            # one dump
+    python tools/metrics_dump.py before.json.0 after.json.0   # diff (B - A)
+
+Prints the per-op table (ops and bytes per data plane), fusion-batch
+counters, stall events, and per-histogram count/mean/p50/p99 estimated
+from the fixed buckets (linear interpolation inside the bucket, the
+standard Prometheus histogram_quantile estimate) — made for BENCH_* round
+analysis next to bench.py's throughput numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+
+def quantile(hist: dict, q: float) -> Optional[float]:
+    """Estimate the q-quantile from fixed-bucket counts (linear
+    interpolation within the bucket; the overflow bucket clamps to the
+    last finite bound).  None for an empty histogram."""
+    total = hist["count"]
+    if not total:
+        return None
+    target = q * total
+    cumulative = 0
+    lo = 0.0
+    for bound, n in zip(hist["buckets"], hist["counts"]):
+        if cumulative + n >= target and n:
+            return lo + (bound - lo) * (target - cumulative) / n
+        cumulative += n
+        lo = bound
+    return hist["buckets"][-1]  # landed in the +Inf overflow bucket
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def _fmt_sec(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v < 1e-3:
+        return f"{v * 1e6:.0f}us"
+    if v < 1.0:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v:.3f}s"
+
+
+def _delta(b, a):
+    return b - a
+
+
+def render(snap: dict, base: Optional[dict] = None) -> str:
+    """Render one dump, or the difference ``snap - base``."""
+    lines = []
+    tag = " (delta: B - A)" if base else ""
+    lines.append(f"== collective ops{tag} ==")
+    lines.append(f"{'plane':<8}{'op':<12}{'count':>10}")
+    for plane, per_op in snap["ops"].items():
+        for op, n in per_op.items():
+            if base:
+                n = _delta(n, base["ops"][plane][op])
+            if n:
+                lines.append(f"{plane:<8}{op:<12}{n:>10}")
+    if len(lines) == 2:
+        lines.append("(no ops)")
+
+    lines.append("== bytes ==")
+    for plane, per_dir in snap["bytes"].items():
+        for direction, n in per_dir.items():
+            if base:
+                n = _delta(n, base["bytes"][plane][direction])
+            lines.append(f"{plane:<8}{direction:<12}{_fmt_bytes(n):>12}")
+
+    batches = dict(snap["batches"])
+    stalls = snap["stalls"]["count"]
+    if base:
+        batches = {k: _delta(v, base["batches"][k])
+                   for k, v in batches.items()}
+        stalls = _delta(stalls, base["stalls"]["count"])
+    lines.append("== fusion ==")
+    lines.append(f"batches dispatched {batches['dispatched']}, "
+                 f"tensors carried {batches['fused_tensors']}")
+    lines.append(f"== stalls == {stalls}")
+    for name, entry in snap["stalls"]["tensors"].items():
+        count = entry["count"]
+        if base and name in base["stalls"]["tensors"]:
+            count = _delta(count, base["stalls"]["tensors"][name]["count"])
+        if count:
+            lines.append(f"  {name}: x{count} "
+                         f"(last {entry['last_duration_sec']:.1f}s)")
+
+    lines.append("== histograms ==")
+    lines.append(f"{'name':<18}{'count':>8}{'mean':>10}{'p50':>10}"
+                 f"{'p99':>10}")
+    for name, hist in snap["histograms"].items():
+        if base:
+            b = base["histograms"][name]
+            hist = {"buckets": hist["buckets"],
+                    "counts": [x - y for x, y in zip(hist["counts"],
+                                                     b["counts"])],
+                    "sum": hist["sum"] - b["sum"],
+                    "count": hist["count"] - b["count"]}
+        mean = hist["sum"] / hist["count"] if hist["count"] else None
+        fmt = _fmt_sec if name.endswith("_sec") else (
+            lambda v: "-" if v is None else f"{v:.2f}")
+        lines.append(f"{name:<18}{hist['count']:>8}{fmt(mean):>10}"
+                     f"{fmt(quantile(hist, 0.5)):>10}"
+                     f"{fmt(quantile(hist, 0.99)):>10}")
+    return "\n".join(lines)
+
+
+def main(argv) -> int:
+    if len(argv) not in (2, 3) or argv[1] in ("-h", "--help"):
+        print(__doc__)
+        return 2
+    with open(argv[1]) as f:
+        a = json.load(f)
+    if len(argv) == 3:
+        with open(argv[2]) as f:
+            b = json.load(f)
+        print(f"A: {argv[1]}\nB: {argv[2]}")
+        print(render(b, base=a))
+    else:
+        print(render(a))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
