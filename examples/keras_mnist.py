@@ -20,6 +20,10 @@ parser.add_argument("--batch-size", type=int, default=128)
 parser.add_argument("--epochs", type=int, default=4)
 parser.add_argument("--lr", type=float, default=1.0)
 parser.add_argument("--train-samples", type=int, default=4096)
+parser.add_argument("--checkpoint-dir", default=".",
+                    help="where rank 0 writes per-epoch weights; under "
+                         "`hvdrun --max-restarts` a relaunched job resumes "
+                         "from the newest one (docs/fault-tolerance.md)")
 args = parser.parse_args()
 
 hvd.init()
@@ -61,13 +65,20 @@ model.compile(loss=keras.losses.categorical_crossentropy,
               optimizer=opt, metrics=["accuracy"])
 
 callbacks = [
-    # Replicate rank 0's initial weights on every worker.
-    hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+    # Replicate rank 0's initial weights on every worker — and, on a
+    # `hvdrun --max-restarts` relaunch, reload the newest checkpoint from
+    # checkpoint_dir on rank 0 first, so every rank resumes from it.
+    hvd_callbacks.BroadcastGlobalVariablesCallback(
+        0, checkpoint_dir=args.checkpoint_dir),
 ]
 # Checkpoint only on rank 0 to prevent conflicting writes.
 if hvd.rank() == 0:
+    import os
+
+    os.makedirs(args.checkpoint_dir, exist_ok=True)
     callbacks.append(keras.callbacks.ModelCheckpoint(
-        "./checkpoint-{epoch}.keras"))
+        os.path.join(args.checkpoint_dir, "ckpt-{epoch}.weights.h5"),
+        save_weights_only=True))
 
 model.fit(x_train, y_train,
           batch_size=args.batch_size,

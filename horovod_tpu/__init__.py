@@ -21,7 +21,10 @@ helpers on top of this substrate.
 """
 
 from horovod_tpu.common import (  # noqa: F401
+    CollectiveTimeoutError,
     HorovodInternalError,
+    HorovodNotInitializedError,
+    RanksDownError,
     allgather,
     allgather_async,
     allreduce,
@@ -36,6 +39,7 @@ from horovod_tpu.common import (  # noqa: F401
     metrics_snapshot,
     mpi_threads_supported,
     rank,
+    restart_epoch,
     shutdown,
     size,
 )

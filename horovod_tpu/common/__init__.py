@@ -36,10 +36,38 @@ ST_PRECONDITION = 2
 ST_ABORTED = 3
 ST_INVALID = 4
 ST_PENDING = 5
+ST_RANKS_DOWN = 6
+ST_TIMEOUT = 7
 
 
 class HorovodInternalError(RuntimeError):
     """An unrecoverable engine error (transport failure, shutdown race)."""
+
+
+class RanksDownError(HorovodInternalError):
+    """A coordinated abort because one or more ranks died (control-socket
+    EOF at the coordinator, or the coordinator itself went away).  The
+    message names the missing ranks and the collectives they left pending;
+    ``ranks`` carries them parsed (empty when unparsable).  The job cannot
+    make progress — restart it (``hvdrun --max-restarts``) and resume from
+    the latest checkpoint (docs/fault-tolerance.md)."""
+
+    def __init__(self, message: str, ranks: Sequence[int] = ()):  # noqa: D107
+        super().__init__(message)
+        self.ranks = list(ranks)
+
+
+class CollectiveTimeoutError(HorovodInternalError):
+    """A coordinated abort because a collective stalled past
+    ``HVD_TPU_COLLECTIVE_TIMEOUT_SEC``: a subset of ranks never submitted
+    the matching op (rank-divergent control flow, or a wedged — not dead —
+    peer).  The message names the stalled tensors and missing ranks."""
+
+
+class HorovodNotInitializedError(HorovodInternalError, ValueError):
+    """An operation that needs a running engine was called before
+    ``hvd.init()`` (or after ``hvd.shutdown()``).  Subclasses ValueError
+    for compatibility with the reference's pre-init contract."""
 
 
 _lib = None
@@ -53,9 +81,17 @@ _xla_plane = None
 _XLA_PLANE_DTYPES = ("float32", "float16", "bfloat16", "int32", "int8",
                      "uint8")
 # Metrics plumbing: per-rank JSON dump path (HVD_TPU_METRICS_FILE) and the
-# count of engine stall events already folded into the Python registry.
+# count of engine stall/abort events already folded into the Python
+# registry.
 _metrics_file: Optional[str] = None
 _engine_stalls_seen = 0
+_engine_aborts_seen = 0
+# Deterministic fault injection (common/faults.py, HVD_TPU_FAULT_SPEC):
+# the injector for this (rank, restart epoch), or None; and the per-process
+# submission index of user-level collectives it is driven by.
+_fault_injector = None
+_collective_seq = 0
+_fault_lock = threading.Lock()
 # Serializes _sync_engine_stalls: the monitor thread and API callers may
 # snapshot concurrently, and the ctypes stall-count read releases the GIL.
 _stall_sync_lock = threading.Lock()
@@ -75,7 +111,7 @@ def _load_lib():
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_double,
             ctypes.c_longlong, ctypes.c_double, ctypes.c_char_p,
-            ctypes.c_int]
+            ctypes.c_int, ctypes.c_double]
         lib.hvd_tpu_init_error.restype = ctypes.c_char_p
         lib.hvd_tpu_enqueue.restype = ctypes.c_longlong
         lib.hvd_tpu_enqueue.argtypes = [
@@ -110,6 +146,12 @@ def _load_lib():
         lib.hvd_tpu_stall_count.argtypes = []
         lib.hvd_tpu_stall_info.restype = ctypes.c_char_p
         lib.hvd_tpu_stall_info.argtypes = []
+        lib.hvd_tpu_abort_code.restype = ctypes.c_int
+        lib.hvd_tpu_abort_code.argtypes = []
+        lib.hvd_tpu_abort_message.restype = ctypes.c_char_p
+        lib.hvd_tpu_abort_message.argtypes = []
+        lib.hvd_tpu_abort_count.restype = ctypes.c_longlong
+        lib.hvd_tpu_abort_count.argtypes = []
         lib.hvd_tpu_timeline_enabled.restype = ctypes.c_int
         lib.hvd_tpu_timeline_op_start.argtypes = [ctypes.c_char_p,
                                                   ctypes.c_char_p]
@@ -148,12 +190,16 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
         ps.rank, ps.size, ps.local_rank, ps.local_size,
         (ps.coord_endpoint or "").encode(), data.encode(),
         cfg.cycle_time_ms, cfg.fusion_threshold, cfg.stall_warning_sec,
-        timeline.encode(), int(cfg.hierarchical_allreduce))
+        timeline.encode(), int(cfg.hierarchical_allreduce),
+        cfg.collective_timeout_sec)
     if rc != 0:
         raise HorovodInternalError(
             "engine initialization failed: "
             + lib.hvd_tpu_init_error().decode())
     _process_set = ps
+    if cfg.restart_epoch:
+        # Identify relaunched runs in metrics snapshots/dumps.
+        metrics.registry.set_restart_epoch(cfg.restart_epoch)
     # Metrics: enabled by HVD_TPU_METRICS=1 or implied by a dump file /
     # monitor port (docs/metrics.md).  The monitor binds port+local_rank
     # so several ranks on one host coexist; rank 0's local_rank is 0, so
@@ -215,6 +261,16 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
                         "engine.")
                 plane = None
         _xla_plane = plane
+    # Deterministic fault injection (docs/fault-tolerance.md), armed LAST:
+    # init()'s own internal collectives (the plane agreement above) must
+    # not consume fault-spec op indices — op=N counts the caller's
+    # collectives from 0.
+    global _fault_injector, _collective_seq
+    from horovod_tpu.common import faults as _faults
+
+    with _fault_lock:
+        _collective_seq = 0  # re-init after shutdown restarts the count
+    _fault_injector = _faults.from_env(ps.rank)
     atexit.register(shutdown)
 
 
@@ -231,7 +287,10 @@ def _tpu_visible() -> bool:
 
 
 def shutdown() -> None:
-    global _process_set, _xla_plane, _metrics_file
+    """Shut the engine down.  Idempotent: safe to call twice, or without a
+    prior ``init()`` (both are no-ops beyond flushing metrics plumbing)."""
+    global _process_set, _xla_plane, _metrics_file, _fault_injector
+    _fault_injector = None
     if _metrics_file is not None:
         path, _metrics_file = _metrics_file, None
         try:
@@ -251,12 +310,21 @@ def shutdown() -> None:
 
 def _check_initialized(lib) -> None:
     if not lib.hvd_tpu_initialized():
-        raise ValueError(
+        raise HorovodNotInitializedError(
             "Horovod-TPU has not been initialized; use hvd.init().")
 
 
 def is_initialized() -> bool:
+    """True between a successful ``init()`` and ``shutdown()``.  Never
+    loads or builds the native engine as a side effect."""
     return _lib is not None and bool(_lib.hvd_tpu_initialized())
+
+
+def restart_epoch() -> int:
+    """The ``hvdrun --max-restarts`` relaunch counter for this process: 0
+    on the first run, +1 per restart (``HVD_TPU_RESTART_EPOCH``).  Usable
+    before ``init()`` — checkpoint-resume glue runs early."""
+    return int(os.environ.get("HVD_TPU_RESTART_EPOCH") or 0)
 
 
 def rank() -> int:
@@ -327,6 +395,24 @@ def _sync_engine_stalls() -> None:
             metrics.registry.record_stall_count(new - len(taken))
 
 
+def _sync_engine_aborts() -> None:
+    """Fold the engine's coordinated-abort events into the registry (kind
+    from the latched status code: ranks_down / timeout).  Consumes only
+    unseen events, like the stall sync."""
+    global _engine_aborts_seen
+    if _lib is None:
+        return
+    with _stall_sync_lock:
+        count = int(_lib.hvd_tpu_abort_count())
+        new = count - _engine_aborts_seen
+        if new <= 0:
+            return
+        _engine_aborts_seen = count
+        code = int(_lib.hvd_tpu_abort_code())
+        kind = "timeout" if code == ST_TIMEOUT else "ranks_down"
+        metrics.registry.record_abort(kind, new)
+
+
 def metrics_snapshot() -> dict:
     """Plain nested dict of the collective metrics registry: op/byte
     counters per data plane, fusion-batch counters, latency/fill
@@ -335,6 +421,7 @@ def metrics_snapshot() -> dict:
     enabled (``HVD_TPU_METRICS=1``, a metrics file, or a monitor port),
     stall records always do."""
     _sync_engine_stalls()
+    _sync_engine_aborts()
     return metrics.registry.snapshot()
 
 
@@ -343,6 +430,7 @@ def metrics_reset() -> None:
     is unaffected).  Outstanding engine stall events are consumed first so
     they cannot resurface in the next snapshot."""
     _sync_engine_stalls()
+    _sync_engine_aborts()
     metrics.registry.reset()
 
 
@@ -439,10 +527,25 @@ class Handle:
                 _lib.hvd_tpu_release(self._raw)
 
 
+def _parse_down_ranks(msg: str) -> list:
+    """Extract the rank list from an engine abort message of the form
+    'ranks down: 0, 2 (...)'; empty when the shape is unexpected."""
+    import re
+
+    m = re.search(r"ranks down: ([0-9, ]+)", msg)
+    if not m:
+        return []
+    return [int(tok) for tok in m.group(1).split(",") if tok.strip()]
+
+
 def _status_error(code: int, msg: str, name: str) -> Exception:
     prefix = f"collective '{name}' failed: "
     if code == ST_PRECONDITION:
         return ValueError(prefix + msg)
+    if code == ST_RANKS_DOWN:
+        return RanksDownError(prefix + msg, ranks=_parse_down_ranks(msg))
+    if code == ST_TIMEOUT:
+        return CollectiveTimeoutError(prefix + msg)
     if code == ST_ABORTED:
         return HorovodInternalError(prefix + msg)
     return HorovodInternalError(prefix + (msg or f"status {code}"))
@@ -485,6 +588,21 @@ def _plane_eligible(array: np.ndarray) -> bool:
     return _xla_plane is not None and array.dtype.name in _XLA_PLANE_DTYPES
 
 
+def _fault_hook(name: str) -> None:
+    """Collective-boundary fault injection (common/faults.py).  Sits in
+    the shared entry points, so it covers BOTH data planes — the XLA plane
+    is dispatched from these same functions.  One None check when no spec
+    is active; the submission index only advances while an injector is
+    armed (it is the injector's coordinate system, nobody else's)."""
+    if _fault_injector is None:
+        return
+    global _collective_seq
+    with _fault_lock:
+        idx = _collective_seq
+        _collective_seq += 1
+    _fault_injector.on_collective(idx, name)
+
+
 def allreduce_async(array: np.ndarray, average: bool = True,
                     name: Optional[str] = None,
                     out: Optional[np.ndarray] = None) -> Handle:
@@ -496,6 +614,7 @@ def allreduce_async(array: np.ndarray, average: bool = True,
     else:
         _check_out(out, array)
     name = name or _auto_name("allreduce")
+    _fault_hook(name)
     if _plane_eligible(array):
         # Compiled XLA collective over the fabric; dispatch order and
         # shape/dtype consistency are negotiated over the control plane.
@@ -520,6 +639,7 @@ def allgather_async(array: np.ndarray, name: Optional[str] = None) -> Handle:
     if array.ndim == 0:
         raise ValueError("allgather requires tensors of rank >= 1")
     name = name or _auto_name("allgather")
+    _fault_hook(name)
     if _plane_eligible(array):
         # Compiled XLA all-gather over the fabric; ragged dim-0 geometry is
         # exchanged by the plane's metadata negotiation.
@@ -547,6 +667,7 @@ def broadcast_async(array: np.ndarray, root_rank: int,
     else:
         _check_out(out, array)
     name = name or _auto_name("broadcast")
+    _fault_hook(name)
     if _plane_eligible(array):
         if not (0 <= root_rank < (_process_set.size if _process_set else 1)):
             raise ValueError(f"broadcast root rank {root_rank} out of range")

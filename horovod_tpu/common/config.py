@@ -55,6 +55,20 @@ class Config:
     metrics: bool = False
     metrics_file: str = ""           # JSON dump at shutdown, per rank
     monitor_port: Optional[int] = None  # HTTP /metrics server (+local_rank)
+    # Fault tolerance (docs/fault-tolerance.md).  collective_timeout_sec:
+    # hard deadline for a collective stuck in negotiation — past it the
+    # coordinator escalates the stall warning to a coordinated abort
+    # (CollectiveTimeoutError on every rank); <= 0 disables.  Applies to
+    # both data planes (the engine's negotiation sweep and the XLA plane's
+    # dispatch wait).
+    collective_timeout_sec: float = 0.0
+    # Deterministic fault injection spec (common/faults.py), e.g.
+    # "rank=1:crash@op=12; rank=2:hang@op=5; rank=1:delay=3.0@op=7".
+    fault_spec: str = ""
+    # Restart counter exported by `hvdrun --max-restarts` (0 on the first
+    # run, +1 per relaunch).  Read by checkpoint-resume glue and gates
+    # fault clauses without an explicit epoch=N to the first run.
+    restart_epoch: int = 0
 
     @property
     def metrics_enabled(self) -> bool:
@@ -82,4 +96,9 @@ class Config:
             metrics_file=os.environ.get("HVD_TPU_METRICS_FILE", ""),
             monitor_port=(int(port) if (port := os.environ.get(
                 "HVD_TPU_MONITOR_PORT")) else None),
+            collective_timeout_sec=float(os.environ.get(
+                "HVD_TPU_COLLECTIVE_TIMEOUT_SEC") or 0.0),
+            fault_spec=os.environ.get("HVD_TPU_FAULT_SPEC", ""),
+            restart_epoch=int(os.environ.get(
+                "HVD_TPU_RESTART_EPOCH") or 0),
         )

@@ -1,0 +1,165 @@
+"""Deterministic fault injection at collective boundaries.
+
+Synchronous data parallelism means every fault-tolerance path — liveness
+detection, coordinated abort, structured error propagation, job restart —
+only triggers when a rank actually dies or wedges mid-job.  Real crashes
+are not reproducible on demand, so this module makes them so:
+``HVD_TPU_FAULT_SPEC`` describes exactly which rank misbehaves, how, and
+at which collective, and the injector fires at the moment that collective
+would be submitted, on both data planes (the hook lives in the shared
+``common.*_async`` entry points the XLA plane is dispatched from).
+
+Spec grammar (clauses separated by ``;`` or ``,``)::
+
+    rank=<r>:<action>@op=<n>[@epoch=<e>]
+
+    rank=1:crash@op=12          # rank 1 exits hard (no shutdown handshake)
+                                # instead of submitting its 12th collective
+    rank=2:hang@op=5            # rank 2's Python wedges forever at its 5th
+                                # (engine thread keeps ticking)
+    rank=1:delay=3.0@op=7       # rank 1 sleeps 3s, then proceeds
+    rank=2:freeze@op=5          # SIGSTOPs the whole process: engine thread
+                                # included, so sockets stay open but go
+                                # silent (the liveness-probe case)
+
+``op`` counts the rank's submitted collectives from 0, in program order
+(allreduce/allgather/broadcast alike; the XLA plane's internal ``__xp.*``
+metadata ops are not counted).  A clause without an explicit ``epoch=``
+fires only on the FIRST run (``HVD_TPU_RESTART_EPOCH`` 0), so a job under
+``hvdrun --max-restarts`` crashes once, restarts, and trains through —
+the end-to-end restart contract tier-1 tests exercise on CPU.
+
+Every firing is recorded in the metrics registry
+(``hvd.metrics_snapshot()["faults"]["injected"]``), ungated like stall
+records: fault runs are tests by construction and must be assertable
+without opting into full metrics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+from typing import List, Optional
+
+from horovod_tpu.common import metrics
+
+_ACTIONS = ("crash", "hang", "delay", "freeze")
+
+# Exit code for an injected crash: distinctive in launcher reports, and
+# outside the shell's 126/127/128+sig conventions.
+CRASH_EXIT_CODE = 43
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    rank: int
+    action: str  # "crash" | "hang" | "delay"
+    op: int      # 0-based index of the rank's submitted collectives
+    delay_sec: float = 0.0
+    epoch: int = 0  # HVD_TPU_RESTART_EPOCH this clause fires on
+
+
+def parse_spec(spec: str) -> List[Fault]:
+    """Parse ``HVD_TPU_FAULT_SPEC``; raises ValueError with the offending
+    clause on any syntax error (a silently ignored fault spec would make a
+    red test green)."""
+    faults: List[Fault] = []
+    for raw in spec.replace(",", ";").split(";"):
+        clause = raw.strip()
+        if not clause:
+            continue
+        try:
+            head, _, tail = clause.partition(":")
+            key, _, rank_s = head.partition("=")
+            if key.strip() != "rank":
+                raise ValueError("expected 'rank=<r>:'")
+            rank = int(rank_s)
+            parts = tail.split("@")
+            action_part = parts[0].strip()
+            action, _, delay_s = action_part.partition("=")
+            action = action.strip()
+            if action not in _ACTIONS:
+                raise ValueError(f"unknown action '{action}'")
+            delay = float(delay_s) if delay_s else 0.0
+            if action == "delay" and not delay_s:
+                raise ValueError("delay needs a duration: delay=<sec>")
+            op: Optional[int] = None
+            epoch = 0
+            for term in parts[1:]:
+                tkey, _, tval = term.partition("=")
+                tkey = tkey.strip()
+                if tkey == "op":
+                    op = int(tval)
+                elif tkey == "epoch":
+                    epoch = int(tval)
+                else:
+                    raise ValueError(f"unknown term '@{tkey}'")
+            if op is None:
+                raise ValueError("missing '@op=<n>'")
+            faults.append(Fault(rank=rank, action=action, op=op,
+                                delay_sec=delay, epoch=epoch))
+        except ValueError as exc:
+            raise ValueError(
+                f"bad HVD_TPU_FAULT_SPEC clause '{clause}': {exc}") from None
+    return faults
+
+
+class FaultInjector:
+    """The active faults for ONE (rank, restart epoch), keyed by op index.
+
+    ``on_collective`` is called from the shared collective entry points
+    with the submission index; it either returns immediately (no fault, a
+    plain dict lookup) or fires.  Not thread-safe by design: the op
+    counter it is driven by is already serialized by the caller.
+    """
+
+    def __init__(self, faults: List[Fault], rank: int, epoch: int):
+        self._by_op = {f.op: f for f in faults
+                       if f.rank == rank and f.epoch == epoch}
+        self._rank = rank
+
+    def __bool__(self) -> bool:
+        return bool(self._by_op)
+
+    def on_collective(self, op_index: int, name: str) -> None:
+        fault = self._by_op.get(op_index)
+        if fault is None:
+            return
+        metrics.registry.record_fault(fault.action)
+        print(f"[horovod_tpu] FAULT INJECTION: rank {self._rank} "
+              f"{fault.action} at op {op_index} ('{name}')",
+              file=sys.stderr, flush=True)
+        if fault.action == "crash":
+            # Hard death: no shutdown handshake, sockets drop — the
+            # coordinator sees EOF, exactly like a SIGKILL'd rank.
+            os._exit(CRASH_EXIT_CODE)
+        elif fault.action == "freeze":
+            # Whole-process stop (engine thread too): sockets stay open
+            # but fall silent — detectable only by the coordinator's
+            # control-plane liveness probe, never by EOF.
+            import signal
+
+            os.kill(os.getpid(), signal.SIGSTOP)
+        elif fault.action == "hang":
+            # Wedge this thread forever (the engine's background thread
+            # keeps ticking, so liveness looks healthy — only the stall /
+            # collective-timeout path can catch this, by design).
+            while True:
+                time.sleep(3600.0)
+        else:  # delay
+            time.sleep(fault.delay_sec)
+
+
+def from_env(rank: int) -> Optional[FaultInjector]:
+    """Build the injector for this rank from the environment; None when no
+    clause applies (the hot path then pays a single `is not None`)."""
+    from horovod_tpu.common.config import Config
+
+    cfg = Config.from_env()
+    if not cfg.fault_spec:
+        return None
+    injector = FaultInjector(parse_spec(cfg.fault_spec), rank,
+                             cfg.restart_epoch)
+    return injector if injector else None

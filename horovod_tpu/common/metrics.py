@@ -112,6 +112,12 @@ class MetricsRegistry:
         self._batches = {"dispatched": 0, "fused_tensors": 0}
         self._stall_count = 0
         self._stall_tensors: Dict[str, dict] = {}
+        # Fault tolerance (docs/fault-tolerance.md): injected faults by
+        # action (crash/hang/delay), coordinated aborts by kind
+        # (ranks_down/timeout), and the hvdrun restart epoch.  Recorded
+        # ungated, like stalls: rare by construction, and fault tests must
+        # assert on them without opting into full metrics.
+        self._faults = {"injected": {}, "aborts": {}, "restart_epoch": 0}
         self._hists = {name: Histogram(bounds)
                        for name, (bounds, _) in HISTOGRAMS.items()}
 
@@ -125,7 +131,11 @@ class MetricsRegistry:
 
     def reset(self) -> None:
         with self._lock:
+            epoch = self._faults["restart_epoch"]
             self._init_state()
+            # The restart epoch is job identity, not a counter; a mid-run
+            # metrics_reset() must not make the job look like a first run.
+            self._faults["restart_epoch"] = epoch
 
     # -- recording (call sites guard on `enabled`; stalls are ungated) ----
 
@@ -153,6 +163,23 @@ class MetricsRegistry:
         with self._lock:
             self._stall_count += int(n)
 
+    def record_fault(self, action: str) -> None:
+        """One injected fault fired (common/faults.py)."""
+        with self._lock:
+            self._faults["injected"][action] = (
+                self._faults["injected"].get(action, 0) + 1)
+
+    def record_abort(self, kind: str, n: int = 1) -> None:
+        """Coordinated abort events: ``ranks_down`` (peer EOF) or
+        ``timeout`` (collective deadline), folded in from the engine."""
+        with self._lock:
+            self._faults["aborts"][kind] = (
+                self._faults["aborts"].get(kind, 0) + int(n))
+
+    def set_restart_epoch(self, epoch: int) -> None:
+        with self._lock:
+            self._faults["restart_epoch"] = int(epoch)
+
     def record_stall(self, name: str, duration_sec: float) -> None:
         with self._lock:
             self._stall_count += 1
@@ -177,6 +204,11 @@ class MetricsRegistry:
                     "count": self._stall_count,
                     "tensors": {k: dict(v)
                                 for k, v in self._stall_tensors.items()},
+                },
+                "faults": {
+                    "injected": dict(self._faults["injected"]),
+                    "aborts": dict(self._faults["aborts"]),
+                    "restart_epoch": self._faults["restart_epoch"],
                 },
                 "histograms": {name: h.to_dict()
                                for name, h in self._hists.items()},
@@ -244,6 +276,24 @@ def prometheus_text(snapshot: dict) -> str:
     for name, entry in snapshot["stalls"]["tensors"].items():
         out.append(f'hvd_tpu_stalled_tensor_total{{tensor='
                    f'"{_label_escape(name)}"}} {entry["count"]}')
+
+    faults = snapshot.get("faults", {})
+    out.append("# HELP hvd_tpu_faults_injected_total "
+               "injected faults fired (HVD_TPU_FAULT_SPEC)")
+    out.append("# TYPE hvd_tpu_faults_injected_total counter")
+    for action, n in faults.get("injected", {}).items():
+        out.append(f'hvd_tpu_faults_injected_total{{action='
+                   f'"{_label_escape(action)}"}} {n}')
+    out.append("# HELP hvd_tpu_aborts_total "
+               "coordinated aborts (ranks_down / timeout)")
+    out.append("# TYPE hvd_tpu_aborts_total counter")
+    for kind, n in faults.get("aborts", {}).items():
+        out.append(f'hvd_tpu_aborts_total{{kind='
+                   f'"{_label_escape(kind)}"}} {n}')
+    out.append("# HELP hvd_tpu_restart_epoch "
+               "hvdrun restart counter (0 = first run)")
+    out.append("# TYPE hvd_tpu_restart_epoch gauge")
+    out.append(f"hvd_tpu_restart_epoch {faults.get('restart_epoch', 0)}")
 
     for name, hist in snapshot["histograms"].items():
         prom = _prom_hist_name(name)

@@ -42,7 +42,7 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
                  const char* coord_endpoint, const char* data_endpoints,
                  double cycle_time_ms, long long fusion_threshold,
                  double stall_warning_sec, const char* timeline_path,
-                 int hierarchical_allreduce) {
+                 int hierarchical_allreduce, double collective_timeout_sec) {
   EngineOptions opts;
   opts.rank = rank;
   opts.size = size;
@@ -55,6 +55,7 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
   opts.stall_warning_sec = stall_warning_sec;
   opts.timeline_path = timeline_path ? timeline_path : "";
   opts.hierarchical_allreduce = hierarchical_allreduce != 0;
+  opts.collective_timeout_sec = collective_timeout_sec;
   std::string err;
   int rc = GlobalEngine()->Init(opts, &err);
   if (rc != 0) {
@@ -155,6 +156,20 @@ const char* hvd_tpu_stall_info() {
   tl_stall_info = GlobalEngine()->StallInfo();
   return tl_stall_info.c_str();
 }
+
+// Coordinated-abort observability (docs/fault-tolerance.md): the latched
+// abort status of this engine (0 = never aborted; ST_RANKS_DOWN=6 /
+// ST_TIMEOUT=7 otherwise) with its structured message, and the
+// process-cumulative abort-event count for the metrics registry.
+int hvd_tpu_abort_code() { return GlobalEngine()->AbortCode(); }
+
+const char* hvd_tpu_abort_message() {
+  static thread_local std::string tl_abort_message;
+  tl_abort_message = GlobalEngine()->AbortMessage();
+  return tl_abort_message.c_str();
+}
+
+long long hvd_tpu_abort_count() { return GlobalEngine()->AbortEvents(); }
 
 // Timeline hooks for the XLA data plane (jax/eager_mesh.py): plane-side
 // execution phases land in the same Chrome-tracing file as the engine's

@@ -256,6 +256,15 @@ struct Engine::Coordinator {
       poisoned;
   uint64_t next_order = 0;
   bool shutdown_requested = false;
+  // Liveness (rank 0): workers whose control socket hit EOF/error.  The
+  // first death arms the coordinated abort below; later deaths are noted
+  // but the first abort wins.
+  std::vector<bool> rank_dead;
+  // Armed abort, broadcast in the next response list: ST_RANKS_DOWN or
+  // ST_TIMEOUT plus a structured message naming missing ranks / stalled
+  // tensors.  0 = not aborting.
+  int32_t abort_code = 0;
+  std::string abort_message;
 };
 
 Engine* GlobalEngine() {
@@ -283,7 +292,15 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   data_plane_failed_.store(false);
   completions_.store(0);
   ticks_done_.store(0);
+  {
+    // abort_events_ stays cumulative across re-init (metrics contract,
+    // like stall_events_); the latched status resets with the engine.
+    std::lock_guard<std::mutex> lk(abort_mu_);
+    abort_code_.store(0);
+    abort_message_.clear();
+  }
   coord_.reset(new Coordinator());
+  coord_->rank_dead.assign(opts_.size, false);
   if (opts_.rank == 0) timeline_.Initialize(opts_.timeline_path);
   std::string setup_err;
   if (!SetupSockets(&setup_err)) {
@@ -530,10 +547,20 @@ void Engine::BackgroundLoop() {
     table_.clear();
     queue_.clear();
   }
-  for (auto& e : leftovers)
-    CompleteEntry(e, ST_ABORTED,
-                  "Horovod-TPU has been shut down. This was caused by an "
-                  "exception on one of the ranks or an earlier shutdown.");
+  // A coordinated abort poisons the drain with its structured status
+  // (ST_RANKS_DOWN / ST_TIMEOUT naming the missing ranks or stalled
+  // tensors); a clean shutdown keeps the generic ST_ABORTED message.
+  int32_t code = abort_code_.load();
+  std::string msg;
+  if (code != 0) {
+    std::lock_guard<std::mutex> lk(abort_mu_);
+    msg = abort_message_;
+  } else {
+    code = ST_ABORTED;
+    msg = "Horovod-TPU has been shut down. This was caused by an "
+          "exception on one of the ranks or an earlier shutdown.";
+  }
+  for (auto& e : leftovers) CompleteEntry(e, code, msg);
 }
 
 int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
@@ -563,9 +590,16 @@ int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
     // the caller yet, so no waiter can exist; Wait's predicate check sees
     // the already-flipped (atomic) code.
     if (loop_exited_.load()) {
-      status->error =
-          "Horovod-TPU has been shut down; no further collectives can run.";
-      status->code.store(ST_ABORTED);
+      int32_t code = abort_code_.load();
+      if (code != 0) {
+        std::lock_guard<std::mutex> alk(abort_mu_);
+        status->error = abort_message_;
+      } else {
+        code = ST_ABORTED;
+        status->error =
+            "Horovod-TPU has been shut down; no further collectives can run.";
+      }
+      status->code.store(code);
       return handle;
     }
     if (table_.count(name)) {
@@ -617,11 +651,30 @@ bool Engine::RunLoopOnce() {
     coord_->shutdown_requested |= my_requests.shutdown;
     CoordinatorHandle(my_requests, 0);
     for (int r = 1; r < opts_.size; ++r) {
+      if (coord_->rank_dead[r]) continue;
+      // Liveness: a healthy worker's engine thread sends a frame every
+      // cycle (~5ms), so with a hard deadline configured, a deadline of
+      // control-plane silence means the worker PROCESS is frozen
+      // (SIGSTOP, OOM thrash) or partitioned — a state socket EOF never
+      // reports, and one that would otherwise block this recv (and with
+      // it the timeout sweep below) forever.
+      if (opts_.collective_timeout_sec > 0 &&
+          !WaitReadable(coord_fds_[r], opts_.collective_timeout_sec)) {
+        char why[96];
+        snprintf(why, sizeof(why),
+                 "no control-plane traffic for %.0fs; process frozen or "
+                 "network partitioned",
+                 opts_.collective_timeout_sec);
+        MarkRankDead(r, why);
+        continue;
+      }
       std::vector<uint8_t> buf;
       if (!RecvFrame(coord_fds_[r], &buf)) {
-        // A worker died: tear the job down (coordinated shutdown, the
-        // reference's SHUT_DOWN_ERROR path, operations.cc:1579-1605).
-        coord_->shutdown_requested = true;
+        // A worker died (control-socket EOF): escalate to a coordinated
+        // ABORT naming the missing rank and the tensors it left pending
+        // (sharpens the reference's SHUT_DOWN_ERROR path,
+        // operations.cc:1579-1605, into a structured status).
+        MarkRankDead(r, "connection lost at the coordinator");
         continue;
       }
       RequestList rl;
@@ -630,16 +683,38 @@ bool Engine::RunLoopOnce() {
         CoordinatorHandle(rl, r);
       }
     }
+    CheckCollectiveTimeout();
     responses = CoordinatorTick();
     std::vector<uint8_t> out = SerializeResponseList(responses);
     for (int r = 1; r < opts_.size; ++r) SendFrame(coord_fds_[r], out);
   } else {
     if (!SendFrame(coord_fd_, SerializeRequestList(my_requests))) {
-      responses.shutdown = true;
+      responses.abort_code = ST_RANKS_DOWN;
+      responses.abort_message =
+          "ranks down: 0 (coordinator connection lost); this job cannot "
+          "continue and should be restarted.";
     } else {
+      // Bound the response wait too: 2x the deadline plus slack, because
+      // a healthy coordinator may itself block up to one deadline probing
+      // a frozen THIRD rank before it aborts and responds.
+      bool alive =
+          opts_.collective_timeout_sec <= 0 ||
+          WaitReadable(coord_fd_, 2 * opts_.collective_timeout_sec + 5.0);
       std::vector<uint8_t> buf;
-      if (!RecvFrame(coord_fd_, &buf) || !ParseResponseList(buf, &responses))
-        responses.shutdown = true;
+      if (!alive) {
+        responses.abort_code = ST_RANKS_DOWN;
+        responses.abort_message =
+            "ranks down: 0 (coordinator unresponsive: no control-plane "
+            "traffic within the deadline; process frozen or network "
+            "partitioned); this job cannot continue and should be "
+            "restarted.";
+      } else if (!RecvFrame(coord_fd_, &buf) ||
+                 !ParseResponseList(buf, &responses)) {
+        responses.abort_code = ST_RANKS_DOWN;
+        responses.abort_message =
+            "ranks down: 0 (coordinator connection lost); this job cannot "
+            "continue and should be restarted.";
+      }
     }
   }
 
@@ -651,6 +726,12 @@ bool Engine::RunLoopOnce() {
 
   if (opts_.rank == 0) CheckForStalledTensors();
 
+  if (responses.abort_code != 0) {
+    // Coordinated abort: latch the structured status, then exit the loop;
+    // the BackgroundLoop drain fails everything still pending with it.
+    AbortLocal(responses.abort_code, responses.abort_message);
+    return false;
+  }
   if (responses.shutdown) return false;
 
   auto elapsed = std::chrono::steady_clock::now() - tick_start;
@@ -845,6 +926,16 @@ Response Engine::BuildResponse(const std::string& name) {
 ResponseList Engine::CoordinatorTick() {
   ResponseList out;
   out.shutdown = coord_->shutdown_requested;
+  if (coord_->abort_code != 0) {
+    // Coordinated abort: carry only the abort verdict.  Deliberately no
+    // op responses — a "ready" op would execute over ring sockets the
+    // dead rank just broke; draining everything with the abort status is
+    // uniform and safe.
+    out.abort_code = coord_->abort_code;
+    out.abort_message = coord_->abort_message;
+    out.shutdown = true;
+    return out;
+  }
   // Poison-deadline sweep: entries for a recently-mismatched base name
   // that are STILL short of full count at their deadline are stragglers
   // of the mismatched round — give them the typed error.
@@ -951,6 +1042,106 @@ std::string Engine::StallInfo() {
     out += buf;
   }
   return out;
+}
+
+// ---------------------------------------------------------------------------
+// Coordinated abort (fault tolerance, docs/fault-tolerance.md).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// "a, b [missing ranks: 1, 3]" for one pending tensor.
+std::string DescribePending(const std::string& name,
+                            const std::vector<Request>& reqs, int size) {
+  std::vector<bool> present(size, false);
+  for (const auto& r : reqs) present[r.rank] = true;
+  std::string missing;
+  for (int r = 0; r < size; ++r)
+    if (!present[r])
+      missing += (missing.empty() ? "" : ", ") + std::to_string(r);
+  return "'" + name + "' [missing ranks: " + missing + "]";
+}
+
+}  // namespace
+
+void Engine::MarkRankDead(int r, const std::string& reason) {
+  if (coord_->rank_dead[r]) return;
+  coord_->rank_dead[r] = true;
+  if (coord_->abort_code != 0) return;  // first abort wins
+  std::string down;
+  for (int i = 0; i < opts_.size; ++i)
+    if (coord_->rank_dead[i])
+      down += (down.empty() ? "" : ", ") + std::to_string(i);
+  std::string pending;
+  int listed = 0;
+  for (const auto& kv : coord_->message_table) {
+    if (listed == 8) {
+      pending += ", ...";
+      break;
+    }
+    pending += (pending.empty() ? "" : "; ") +
+               DescribePending(kv.first, kv.second.requests, opts_.size);
+    ++listed;
+  }
+  coord_->abort_code = ST_RANKS_DOWN;
+  coord_->abort_message =
+      "ranks down: " + down + " (" + reason + ")" +
+      (pending.empty() ? std::string(".")
+                       : "; pending collective(s): " + pending + ".") +
+      " The job was aborted; restart it (e.g. hvdrun --max-restarts) to "
+      "resume from the latest checkpoint.";
+}
+
+void Engine::CheckCollectiveTimeout() {
+  if (opts_.collective_timeout_sec <= 0 || coord_->abort_code != 0) return;
+  auto now = std::chrono::steady_clock::now();
+  std::string stalled;
+  double worst = 0.0;
+  int n_stalled = 0;
+  for (const auto& kv : coord_->message_table) {
+    if (kv.second.requests.empty() || !kv.second.forced_error.empty())
+      continue;
+    double age =
+        std::chrono::duration<double>(now - kv.second.first_seen).count();
+    if (age < opts_.collective_timeout_sec) continue;
+    worst = std::max(worst, age);
+    ++n_stalled;
+    if (n_stalled <= 8)
+      stalled += (stalled.empty() ? "" : "; ") +
+                 DescribePending(kv.first, kv.second.requests, opts_.size);
+  }
+  if (n_stalled == 0) return;
+  if (n_stalled > 8)
+    stalled += "; ... (" + std::to_string(n_stalled - 8) + " more)";
+  char worst_buf[32];
+  snprintf(worst_buf, sizeof(worst_buf), "%.1f", worst);
+  coord_->abort_code = ST_TIMEOUT;
+  coord_->abort_message =
+      std::string("collective timeout: tensor(s) stalled for ") + worst_buf +
+      "s (> HVD_TPU_COLLECTIVE_TIMEOUT_SEC=" +
+      std::to_string(static_cast<long long>(opts_.collective_timeout_sec)) +
+      "): " + stalled +
+      ". One or more ranks never submitted the matching collective; the "
+      "job was aborted instead of hanging.";
+}
+
+void Engine::AbortLocal(int32_t code, const std::string& message) {
+  {
+    std::lock_guard<std::mutex> lk(abort_mu_);
+    if (abort_code_.load() != 0) return;  // first abort wins
+    abort_message_ = message;
+    abort_code_.store(code);
+  }
+  abort_events_.fetch_add(1);
+  // A broken job must fail every subsequent collective uniformly.
+  data_plane_failed_.store(true);
+  fprintf(stderr, "[horovod_tpu] ERROR: coordinated abort on rank %d: %s\n",
+          opts_.rank, message.c_str());
+}
+
+std::string Engine::AbortMessage() {
+  std::lock_guard<std::mutex> lk(abort_mu_);
+  return abort_message_;
 }
 
 // ---------------------------------------------------------------------------

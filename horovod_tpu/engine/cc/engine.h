@@ -45,6 +45,13 @@ struct EngineOptions {
   double cycle_time_ms = 5.0;
   int64_t fusion_threshold = 64 * 1024 * 1024;
   double stall_warning_sec = 60.0;
+  // Hard deadline for a collective stuck in negotiation (a subset of ranks
+  // never announced it): past this, the coordinator escalates from the
+  // stall *warning* to a coordinated ABORT (ST_TIMEOUT) naming the stalled
+  // tensors and missing ranks, so the job fails fast instead of hanging
+  // until an outer launcher timeout.  <= 0 disables (warning-only, the
+  // pre-fault-tolerance behavior).  HVD_TPU_COLLECTIVE_TIMEOUT_SEC.
+  double collective_timeout_sec = 0.0;
   std::string timeline_path;
   // Two-level allreduce: reduce to the node-local leader, ring-allreduce
   // across leaders, broadcast back within the node — the reference's
@@ -142,6 +149,14 @@ class Engine {
   int64_t StallEvents();
   std::string StallInfo();
 
+  // Coordinated-abort observability: the latched abort status (0 = never
+  // aborted; ST_RANKS_DOWN / ST_TIMEOUT otherwise) with its structured
+  // message, and a process-cumulative abort-event count for the metrics
+  // registry (survives engine re-init, like StallEvents).
+  int32_t AbortCode() const { return abort_code_.load(); }
+  std::string AbortMessage();
+  int64_t AbortEvents() const { return abort_events_.load(); }
+
   // The engine-owned Chrome-tracing timeline.  Exposed so the XLA data
   // plane (Python, jax/eager_mesh.py) can emit its BUCKET_BUILD /
   // XLA_DISPATCH / DEVICE_WAIT activities into the SAME trace file as the
@@ -163,6 +178,17 @@ class Engine {
   ResponseList CoordinatorTick();
   Response BuildResponse(const std::string& name);
   void CheckForStalledTensors();
+  // Every-tick deadline sweep (rank 0): escalates a stall beyond
+  // opts_.collective_timeout_sec to a coordinated abort.
+  void CheckCollectiveTimeout();
+  // Latch the abort status locally (any rank).  The BackgroundLoop exit
+  // drain then fails everything pending with this status instead of the
+  // generic shutdown message.
+  void AbortLocal(int32_t code, const std::string& message);
+  // Rank 0: record a dead/unresponsive worker (`reason` says which) and,
+  // on the first death, arm the coordinated abort naming the missing
+  // ranks and the tensors they left pending.
+  void MarkRankDead(int r, const std::string& reason);
 
   // Execution.
   void PerformOperation(const Response& resp);
@@ -240,6 +266,13 @@ class Engine {
   std::mutex stall_mu_;
   int64_t stall_events_ = 0;
   std::deque<std::pair<std::string, double>> stall_log_;
+
+  // Coordinated-abort state.  code is latched once per engine lifetime
+  // (first abort wins); events_ is process-cumulative for metrics.
+  std::atomic<int32_t> abort_code_{0};
+  std::atomic<int64_t> abort_events_{0};
+  std::mutex abort_mu_;  // guards abort_message_
+  std::string abort_message_;
 };
 
 Engine* GlobalEngine();

@@ -11,6 +11,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstdio>
 #include <thread>
@@ -112,23 +113,43 @@ int ConnectRetry(const std::string& host, int port, double timeout_sec,
     return -1;
   }
   double deadline = NowSec() + timeout_sec;
+  // Exponential backoff with jitter: 20ms doubling to a ~1s cap.  N ranks
+  // hammering one late coordinator in 20ms lockstep both wastes CPU and
+  // synchronizes the SYN bursts; the jitter (+/-25%, cheap LCG seeded per
+  // call) de-correlates them.
+  double delay_ms = 20.0;
+  const double kMaxDelayMs = 1000.0;
+  uint32_t jitter_state =
+      static_cast<uint32_t>(NowSec() * 1e6) ^ (static_cast<uint32_t>(port) << 16);
+  int attempts = 0;
+  int last_errno = 0;
   while (true) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) {
       *err = strerror(errno);
       return -1;
     }
+    ++attempts;
     if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
       SetCommonOpts(fd);
       return fd;
     }
+    last_errno = errno;
     close(fd);
     if (NowSec() >= deadline) {
       *err = std::string("connect ") + host + ":" + std::to_string(port) +
-             " timed out: " + strerror(errno);
+             " timed out after " + std::to_string(attempts) +
+             " attempts: " + strerror(last_errno);
       return -1;
     }
-    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    jitter_state = jitter_state * 1664525u + 1013904223u;
+    double jitter = 0.75 + 0.5 * (jitter_state >> 8) / double(1u << 24);
+    double sleep_ms = delay_ms * jitter;
+    double remaining_ms = (deadline - NowSec()) * 1000.0;
+    if (sleep_ms > remaining_ms) sleep_ms = remaining_ms > 0 ? remaining_ms : 0;
+    std::this_thread::sleep_for(
+        std::chrono::duration<double, std::milli>(sleep_ms));
+    delay_ms = std::min(delay_ms * 2.0, kMaxDelayMs);
   }
 }
 
@@ -158,6 +179,19 @@ bool RecvAll(int fd, void* buf, size_t len) {
     len -= static_cast<size_t>(n);
   }
   return true;
+}
+
+bool WaitReadable(int fd, double timeout_sec) {
+  double deadline = NowSec() + timeout_sec;
+  while (true) {
+    double remaining = deadline - NowSec();
+    if (remaining < 0) remaining = 0;
+    struct pollfd p = {fd, POLLIN, 0};
+    int r = poll(&p, 1, static_cast<int>(remaining * 1000));
+    if (r > 0) return true;  // readable, error, or hup: let recv surface it
+    if (r == 0) return false;
+    if (errno != EINTR) return true;  // unexpected: defer to the recv path
+  }
 }
 
 bool SendFrame(int fd, const std::vector<uint8_t>& payload) {

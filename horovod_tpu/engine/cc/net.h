@@ -31,6 +31,13 @@ int ConnectRetry(const std::string& host, int port, double timeout_sec,
 bool SendAll(int fd, const void* buf, size_t len);
 bool RecvAll(int fd, void* buf, size_t len);
 
+// Wait until fd is readable (or in error/EOF, which a subsequent recv will
+// surface).  False on timeout — the liveness probe for the coordinator's
+// per-rank tick recv: a healthy engine thread sends a frame every cycle
+// (~5ms), so a deadline's worth of silence means the peer PROCESS is
+// frozen or the network is partitioned, which socket EOF never reports.
+bool WaitReadable(int fd, double timeout_sec);
+
 // Length-prefixed message framing ([u32 little-endian length][payload]).
 bool SendFrame(int fd, const std::vector<uint8_t>& payload);
 bool RecvFrame(int fd, std::vector<uint8_t>* payload);

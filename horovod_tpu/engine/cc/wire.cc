@@ -143,6 +143,8 @@ bool ParseRequestList(const std::vector<uint8_t>& buf, RequestList* rl) {
 std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   Writer w;
   w.U8(rl.shutdown ? 1 : 0);
+  w.I32(rl.abort_code);
+  w.Str(rl.abort_message);
   w.U32(static_cast<uint32_t>(rl.responses.size()));
   for (const auto& r : rl.responses) {
     w.U8(r.type);
@@ -158,6 +160,8 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
 bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
   Reader rd(buf);
   rl->shutdown = rd.U8() != 0;
+  rl->abort_code = rd.I32();
+  rl->abort_message = rd.Str();
   uint32_t n = rd.U32();
   rl->responses.clear();
   rl->responses.reserve(n);

@@ -39,6 +39,13 @@ enum StatusCode : int32_t {
   ST_ABORTED = 3,
   ST_INVALID = 4,
   ST_PENDING = 5,
+  // Coordinated-abort statuses (fault tolerance, docs/fault-tolerance.md):
+  // a peer rank died (control-socket EOF) or a collective stalled past
+  // HVD_TPU_COLLECTIVE_TIMEOUT_SEC.  Both carry a message naming the
+  // missing ranks / stalled tensors; Python maps them to
+  // RanksDownError / CollectiveTimeoutError.
+  ST_RANKS_DOWN = 6,
+  ST_TIMEOUT = 7,
 };
 
 size_t DataTypeSize(uint8_t dtype);
@@ -79,6 +86,12 @@ struct Response {
 
 struct ResponseList {
   bool shutdown = false;
+  // Coordinated abort (distinct from a clean shutdown): non-zero when the
+  // coordinator detected a dead rank (ST_RANKS_DOWN) or a collective
+  // stalled past the hard deadline (ST_TIMEOUT).  Every rank poisons its
+  // pending ops with this status + message and exits its loop.
+  int32_t abort_code = 0;
+  std::string abort_message;
   std::vector<Response> responses;
 };
 

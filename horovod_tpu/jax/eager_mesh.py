@@ -257,10 +257,24 @@ class XlaDataPlane:
 
         # Snapshot once: _wait_dispatch is per-handle hot path; <=0
         # disables the stall warning (the conventional "off" value).
-        self._stall_sec = Config.from_env().stall_warning_sec
+        cfg = Config.from_env()
+        self._stall_sec = cfg.stall_warning_sec
+        # Hard deadline for the dispatch wait (XLA-plane parity with the
+        # engine's coordinated abort): past it the handle FAILS with
+        # CollectiveTimeoutError instead of polling forever.  <= 0 = off.
+        self._timeout_sec = cfg.collective_timeout_sec
         self._fns = {}
         self._mu = threading.RLock()  # guards _fns, _pending, _local_seq
         self._pending: List[_PlaneOp] = []
+        # Ops withdrawn by a timed-out wait, pinned so the engine's raw
+        # pointers into their negotiation buffers stay valid (see
+        # _fail_timed_out).  Timeouts are terminal for the job; bounded in
+        # practice by the handful of ops outstanding at abort time.
+        self._abandoned: List[_PlaneOp] = []
+        # One stall = one abort event in the metrics, no matter how many
+        # outstanding handles time out on it (the engine's latched abort
+        # is synced separately and counts as its own detection event).
+        self._abort_recorded = False
         self._local_seq = 0  # single-process ordering (no negotiation)
         # Observability: dispatches counts compiled-program launches;
         # fused_tensors counts ops carried by them (tests assert N small
@@ -406,12 +420,16 @@ class XlaDataPlane:
         still outstanding — a peer that never submits the matching
         collective would otherwise spin here silently forever."""
         stall_sec = self._stall_sec
+        timeout_sec = self._timeout_sec
         start = last_warn = time.monotonic()
         while True:
             self.flush()
             if handle._error is not None or handle._batch is not None:
                 return
             now = time.monotonic()
+            if timeout_sec > 0 and now - start >= timeout_sec:
+                self._fail_timed_out(handle, now - start)
+                return
             if stall_sec > 0 and now - last_warn >= stall_sec:
                 last_warn = now
                 with self._mu:
@@ -430,6 +448,38 @@ class XlaDataPlane:
                     f"One or more ranks may not have submitted this "
                     f"collective.", file=sys.stderr, flush=True)
             time.sleep(0.001)
+
+    def _fail_timed_out(self, handle: XlaHandle, waited_sec: float) -> None:
+        """Dispatch-wait deadline (HVD_TPU_COLLECTIVE_TIMEOUT_SEC) hit:
+        fail the handle with a typed error naming the negotiations still
+        outstanding, and withdraw its op from the pending queue so a later
+        flush cannot dispatch a collective its waiter already abandoned
+        (the peers that DID time out would never dispatch the match, and a
+        half-dispatched bucket wedges the fabric)."""
+        from horovod_tpu import common
+
+        with self._mu:
+            waiting = [op.name for op in self._pending if op.seq is None]
+            mine = [op for op in self._pending if op.handle is handle]
+            self._pending = [op for op in self._pending
+                             if op.handle is not handle]
+            # The withdrawn op's negotiation may still be pending inside
+            # the engine, which holds raw pointers into neg_in/neg_out —
+            # pin the op (buffers and all) until shutdown rather than
+            # freeing memory the engine thread could still write.
+            self._abandoned.extend(mine)
+            record_abort = not self._abort_recorded
+            self._abort_recorded = True
+        _metrics.registry.record_stall(handle._name, waited_sec)
+        if record_abort:
+            _metrics.registry.record_abort("timeout")
+        handle._fail(common.CollectiveTimeoutError(
+            f"collective '{handle._name}' failed: XLA-plane dispatch wait "
+            f"exceeded HVD_TPU_COLLECTIVE_TIMEOUT_SEC "
+            f"({waited_sec:.1f}s > {self._timeout_sec:.1f}s); negotiations "
+            f"still pending: {waiting or '[none — tick not closed]'}. One "
+            f"or more ranks never submitted the matching collective; the "
+            f"wait was aborted instead of hanging."))
 
     def _jit_for(self, kind: str, length_or_shape, dtype, root: int = 0):
         import jax

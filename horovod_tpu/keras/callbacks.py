@@ -18,20 +18,74 @@ import numpy as np
 import horovod_tpu.common as _common
 
 
+def _latest_weights_file(directory: str) -> Optional[str]:
+    """Newest ``*.weights.h5`` under ``directory`` by checkpoint number
+    (``ckpt-<n>.weights.h5``, falling back to mtime for other names)."""
+    import os
+    import re
+
+    try:
+        names = [n for n in os.listdir(directory)
+                 if n.endswith(".weights.h5")]
+    except OSError:
+        return None
+    if not names:
+        return None
+
+    def key(name: str):
+        m = re.match(r"ckpt-(\d+)", name)
+        if m:
+            return (1, int(m.group(1)))
+        return (0, os.path.getmtime(os.path.join(directory, name)))
+
+    return os.path.join(directory, max(names, key=key))
+
+
 class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
     """Broadcast model + optimizer state from ``root_rank`` once, at the
-    start of training (reference lines 8-34)."""
+    start of training (reference lines 8-34).
 
-    def __init__(self, root_rank: int = 0):
+    ``checkpoint_dir`` adds the job-level-restart glue
+    (docs/fault-tolerance.md): on a relaunched run (``hvdrun
+    --max-restarts``, detected via ``HVD_TPU_RESTART_EPOCH``), the root
+    rank reloads the newest ``*.weights.h5`` in that directory before
+    broadcasting, so every rank resumes from the last checkpoint instead
+    of reinitialized weights.  Pair it with a
+    ``keras.callbacks.ModelCheckpoint`` writing into the same directory.
+
+    Scope: this resumes **weights only** — the optimizer (iteration
+    counter, momentum/slot variables) restarts fresh, so LR schedules
+    keyed on ``optimizer.iterations`` begin again at step 0.  For full
+    training-state resume, checkpoint whole models (``.keras``) and
+    reload via ``hvd.load_model`` before ``fit`` — the
+    ``examples/keras_imagenet_resnet50.py`` pattern.
+    """
+
+    def __init__(self, root_rank: int = 0,
+                 checkpoint_dir: Optional[str] = None):
         super().__init__()
         self.root_rank = root_rank
+        self.checkpoint_dir = checkpoint_dir
         self.broadcast_done = False
+        self.resumed_from: Optional[str] = None
 
     def on_train_begin(self, logs=None):  # noqa: D401
         if self.broadcast_done:
             return
         from horovod_tpu.keras import broadcast_global_variables
 
+        if (self.checkpoint_dir and _common.restart_epoch() > 0
+                and _common.rank() == self.root_rank):
+            latest = _latest_weights_file(self.checkpoint_dir)
+            if latest is not None:
+                # Root-only load; the broadcast below replicates it, so
+                # ranks whose local filesystem lacks the checkpoint (or
+                # holds a stale one) still resume consistently.
+                self.model.load_weights(latest)
+                self.resumed_from = latest
+                print(f"[horovod_tpu] restart epoch "
+                      f"{_common.restart_epoch()}: resumed weights from "
+                      f"{latest}")
         broadcast_global_variables(self.root_rank, model=self.model)
         self.broadcast_done = True
 

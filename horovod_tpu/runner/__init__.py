@@ -12,8 +12,11 @@ API:  from horovod_tpu.runner import run_command / launch_fn
 
 from horovod_tpu.runner.launch import (  # noqa: F401
     RankResult,
+    failure_report,
     launch_fn,
     make_rank_env,
     run_command,
+    run_elastic,
     run_hosts,
+    signal_name,
 )

@@ -15,6 +15,48 @@ class RankResult:
     returncode: int
     stdout: str
     stderr: str
+    # True on the rank the launcher saw fail FIRST — the one whose error is
+    # the real one; later nonzero exits are usually the kill cascade.
+    first_failure: bool = False
+
+
+def signal_name(returncode: int) -> str:
+    """Human label for a rank exit code: 'SIGKILL (signal 9)' for signal
+    deaths (negative returncodes, the subprocess convention), or the plain
+    code otherwise."""
+    if returncode >= 0:
+        return str(returncode)
+    import signal
+
+    try:
+        name = signal.Signals(-returncode).name
+    except ValueError:
+        name = f"signal {-returncode}"
+    return f"{name} (signal {-returncode})"
+
+
+def failure_report(results, tail_lines: int = 30) -> str:
+    """One-stop failure summary: every failing rank labeled (signal names
+    included), then the FIRST-failing rank's stderr tail — the root cause,
+    ahead of the kill cascade's -9 noise."""
+    lines = []
+    first = None
+    for r in results:
+        if r.returncode == 0:
+            continue
+        marker = "  <- first failure" if r.first_failure else ""
+        lines.append(
+            f"rank {r.rank} exited with {signal_name(r.returncode)}{marker}")
+        if r.first_failure:
+            first = r
+    if first is None:  # no flagged rank (e.g. all died in the same sweep)
+        first = next((r for r in results if r.returncode != 0), None)
+    if first is not None and first.stderr:
+        tail = first.stderr.strip().splitlines()[-tail_lines:]
+        lines.append(f"--- rank {first.rank} stderr (last {len(tail)} "
+                     f"lines) ---")
+        lines.extend(tail)
+    return "\n".join(lines)
 
 
 def make_rank_env(rank: int, size: int, coord: str, data: Sequence[str],
@@ -40,6 +82,41 @@ def allocate_endpoints(size: int, host: str = "127.0.0.1"):
     return coord, data
 
 
+class _StderrTee:
+    """Echo one rank's stderr to the launcher's stderr line-by-line while
+    retaining the last N lines.  Non-capture runs (the hvdrun CLI) keep
+    their live streaming AND get a first-failing-rank tail in the failure
+    report — without buffering whole-job output in memory."""
+
+    def __init__(self, pipe, tail_lines: int = 80):
+        import collections
+        import threading
+
+        self._pipe = pipe
+        self._tail = collections.deque(maxlen=tail_lines)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            for line in self._pipe:
+                sys.stderr.write(line)
+                self._tail.append(line)
+        except (ValueError, OSError):
+            pass  # pipe torn down mid-read (kill cascade)
+        finally:
+            try:
+                self._pipe.close()
+            except OSError:
+                pass
+
+    def join(self, timeout: float) -> None:
+        self._thread.join(timeout)
+
+    def text(self) -> str:
+        return "".join(self._tail)
+
+
 def run_command(cmd: Sequence[str], np: int,
                 env: Optional[Dict[str, str]] = None,
                 timeout: float = 300.0,
@@ -61,17 +138,22 @@ def run_command(cmd: Sequence[str], np: int,
         pin_envs = [pin_env(r, r, np, 0, 1, addresses, tpu_topology)
                     for r in range(np)]
     procs = []
+    tees = []
     for r in range(np):
         rank_env = make_rank_env(r, np, coord, data, env,
                                  xla_coord=xla_coord)
         rank_env.update(pin_envs[r])
-        procs.append(subprocess.Popen(
+        p = subprocess.Popen(
             list(cmd),
             env=rank_env,
             stdout=subprocess.PIPE if capture else None,
-            stderr=subprocess.PIPE if capture else None,
-            text=True, start_new_session=True))
-    return _wait_all(cmd, procs, timeout)
+            stderr=subprocess.PIPE,
+            text=True, start_new_session=True)
+        # Non-capture: tee stderr (live echo + retained tail for the
+        # failure report).  Capture: communicate() drains it as before.
+        tees.append(None if capture else _StderrTee(p.stderr))
+        procs.append(p)
+    return _wait_all(cmd, procs, timeout, tees)
 
 
 def run_hosts(cmd: Sequence[str], np: int, hosts_spec: str,
@@ -103,17 +185,20 @@ def run_hosts(cmd: Sequence[str], np: int, hosts_spec: str,
         if key in base_env:
             overrides.setdefault(key, base_env[key])
     procs = []
+    tees = []
     for p in placements:
         rank_env = dict(base_env)
         rank_env.update(p.env)
         argv = list(cmd) if p.is_local else ssh_command(
             p, cmd, ssh_args, extra_env=overrides)
-        procs.append(subprocess.Popen(
+        proc = subprocess.Popen(
             argv, env=rank_env,
             stdout=subprocess.PIPE if capture else None,
-            stderr=subprocess.PIPE if capture else None,
-            text=True, start_new_session=True))
-    return _wait_all(cmd, procs, timeout)
+            stderr=subprocess.PIPE,
+            text=True, start_new_session=True)
+        tees.append(None if capture else _StderrTee(proc.stderr))
+        procs.append(proc)
+    return _wait_all(cmd, procs, timeout, tees)
 
 
 def _kill_rank(p) -> None:
@@ -130,21 +215,33 @@ def _kill_rank(p) -> None:
         p.kill()
 
 
-def _wait_all(cmd: Sequence[str], procs, timeout: float) -> List[RankResult]:
+def _wait_all(cmd: Sequence[str], procs, timeout: float,
+              tees: Optional[List[Optional["_StderrTee"]]] = None
+              ) -> List[RankResult]:
     import time
 
     # Poll all ranks; when one fails, give the rest a grace period (the
-    # engine cascades a coordinated shutdown to every rank) and then kill
-    # stragglers -- the fail-fast the reference left to mpirun.
+    # engine cascades a coordinated shutdown/abort to every rank) and then
+    # kill stragglers -- the fail-fast the reference left to mpirun.  The
+    # grace is tunable (HVD_TPU_KILL_GRACE_SEC) so fault-injection tests
+    # with deliberately wedged ranks stay fast.
+    try:
+        grace_sec = float(os.environ.get("HVD_TPU_KILL_GRACE_SEC") or 15.0)
+    except ValueError:
+        grace_sec = 15.0
     deadline = time.monotonic() + timeout
     grace_deadline = None
+    first_failed = None  # rank index of the first observed nonzero exit
     timed_out = False
     try:
         while any(p.poll() is None for p in procs):
             now = time.monotonic()
-            if grace_deadline is None and any(
-                    p.returncode not in (None, 0) for p in procs):
-                grace_deadline = now + 15.0
+            if grace_deadline is None:
+                failed = [i for i, p in enumerate(procs)
+                          if p.returncode not in (None, 0)]
+                if failed:
+                    first_failed = failed[0]
+                    grace_deadline = now + grace_sec
             if now >= deadline or (grace_deadline and now >= grace_deadline):
                 timed_out = now >= deadline
                 for p in procs:
@@ -162,21 +259,104 @@ def _wait_all(cmd: Sequence[str], procs, timeout: float) -> List[RankResult]:
         raise
     results = []
     for r, p in enumerate(procs):
-        try:
-            out, errout = p.communicate(timeout=30.0)
-        except subprocess.TimeoutExpired:
-            # A straggler (or an orphan sharing its pipes) survived: kill
-            # its group and salvage what it wrote; never hang the launcher.
-            _kill_rank(p)
+        tee = tees[r] if tees else None
+        if tee is not None:
+            # Tee'd stderr is drained by its thread; only wait for the
+            # process (communicate() would race the reader on the pipe).
             try:
-                out, errout = p.communicate(timeout=5.0)
+                p.wait(timeout=30.0)
             except subprocess.TimeoutExpired:
-                out, errout = "", ""
+                _kill_rank(p)
+                try:
+                    p.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass
+            tee.join(timeout=5.0)
+            out, errout = "", tee.text()
+        else:
+            try:
+                out, errout = p.communicate(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                # A straggler (or an orphan sharing its pipes) survived:
+                # kill its group and salvage what it wrote; never hang the
+                # launcher.
+                _kill_rank(p)
+                try:
+                    out, errout = p.communicate(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    out, errout = "", ""
         rc = p.returncode if p.returncode is not None else -9
-        results.append(RankResult(r, rc, out or "", errout or ""))
+        results.append(RankResult(r, rc, out or "", errout or "",
+                                  first_failure=(r == first_failed)))
     if timed_out:
         raise subprocess.TimeoutExpired(cmd, timeout)
     return results
+
+
+def run_elastic(cmd: Sequence[str], np: int, max_restarts: int = 0,
+                env: Optional[Dict[str, str]] = None,
+                timeout: float = 300.0,
+                capture: bool = False,
+                host: str = "127.0.0.1",
+                hosts_spec: Optional[str] = None,
+                port_base: Optional[int] = None,
+                tpu_pin: bool = False,
+                tpu_topology: Optional[str] = None,
+                report: Callable[[str], None] = None):
+    """Job-level restart (docs/fault-tolerance.md): launch the job, and on
+    failure — any rank exiting nonzero, or the job timing out — group-kill
+    the survivors (``_wait_all`` already does) and relaunch ALL ranks with
+    ``HVD_TPU_RESTART_EPOCH`` incremented, up to ``max_restarts`` times.
+    Fresh endpoints are allocated per attempt, so a crashed job's
+    lingering sockets cannot poison the relaunch.  Returns
+    ``(results, restarts_used)``; the caller's training script is expected
+    to resume from its latest checkpoint (see
+    ``horovod_tpu.jax.train.load_latest_checkpoint`` / the keras
+    ``BroadcastGlobalVariablesCallback`` glue)."""
+    import time
+
+    if report is None:
+        def report(msg):
+            print(msg, file=sys.stderr, flush=True)
+    base_env = dict(env if env is not None else os.environ)
+    results: List[RankResult] = []
+    # `timeout` is the TOTAL wall-clock budget across every attempt (the
+    # --timeout contract: "kill the job after this many seconds"), not a
+    # per-attempt allowance that restarts would multiply.
+    deadline = time.monotonic() + timeout
+    for epoch in range(max_restarts + 1):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise subprocess.TimeoutExpired(list(cmd), timeout)
+        run_env = dict(base_env)
+        run_env["HVD_TPU_RESTART_EPOCH"] = str(epoch)
+        try:
+            if hosts_spec:
+                results = run_hosts(cmd, np, hosts_spec,
+                                    port_base=port_base, env=run_env,
+                                    timeout=remaining, capture=capture,
+                                    tpu_pin=tpu_pin,
+                                    tpu_topology=tpu_topology)
+            else:
+                results = run_command(cmd, np, env=run_env,
+                                      timeout=remaining,
+                                      capture=capture, host=host,
+                                      tpu_pin=tpu_pin,
+                                      tpu_topology=tpu_topology)
+        except subprocess.TimeoutExpired:
+            if epoch == max_restarts:
+                raise
+            report(f"hvdrun: job timed out (restart epoch {epoch}); "
+                   f"restarting ({epoch + 1}/{max_restarts})")
+            continue
+        if all(r.returncode == 0 for r in results):
+            return results, epoch
+        if epoch < max_restarts:
+            rpt = failure_report(results)
+            report(f"hvdrun: job failed (restart epoch {epoch}):"
+                   + (f"\n{rpt}" if rpt else "")
+                   + f"\nhvdrun: restarting ({epoch + 1}/{max_restarts})")
+    return results, max_restarts
 
 
 _FN_RUNNER = """\
@@ -229,6 +409,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "(single-host mode)")
     parser.add_argument("--timeout", type=float, default=0.0,
                         help="kill the job after this many seconds (0 = none)")
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="on job failure (a rank died, or the engine "
+                             "aborted on a dead/stalled rank), kill the "
+                             "survivors and relaunch all ranks up to N "
+                             "times with HVD_TPU_RESTART_EPOCH "
+                             "incremented; training scripts resume from "
+                             "their latest checkpoint (see "
+                             "docs/fault-tolerance.md)")
     parser.add_argument("--tpu-pin", action="store_true",
                         default=None,
                         help="pin one TPU chip per rank by local_rank "
@@ -250,29 +438,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     tpu_pin = pinning_requested(args.tpu_pin)
     try:
-        if args.hosts:
-            results = run_hosts(cmd, args.num_proc, args.hosts,
-                                port_base=args.port_base,
-                                timeout=args.timeout or 3e7,
-                                tpu_pin=tpu_pin,
-                                tpu_topology=args.tpu_topology)
-        else:
-            results = run_command(cmd, args.num_proc, host=args.host,
-                                  timeout=args.timeout or 3e7,
-                                  tpu_pin=tpu_pin,
-                                  tpu_topology=args.tpu_topology)
+        results, restarts = run_elastic(
+            cmd, args.num_proc, max_restarts=args.max_restarts,
+            timeout=args.timeout or 3e7, host=args.host,
+            hosts_spec=args.hosts, port_base=args.port_base,
+            tpu_pin=tpu_pin, tpu_topology=args.tpu_topology)
     except subprocess.TimeoutExpired:
         print("hvdrun: job timed out", file=sys.stderr)
         return 124
+    if restarts and all(r.returncode == 0 for r in results):
+        print(f"hvdrun: job succeeded after {restarts} restart(s)",
+              file=sys.stderr)
     rc = 0
+    report = failure_report(results)
+    if report:
+        print(f"hvdrun: {report}", file=sys.stderr)
     for r in results:
-        if r.returncode != 0:
-            print(f"hvdrun: rank {r.rank} exited with {r.returncode}",
-                  file=sys.stderr)
-            if rc == 0:
-                # Signal deaths have negative returncodes; report 128+sig
-                # like a shell would so the job never masks as success.
-                rc = r.returncode if r.returncode > 0 else 128 - r.returncode
+        if r.returncode != 0 and rc == 0:
+            # Signal deaths have negative returncodes; report 128+sig
+            # like a shell would so the job never masks as success.
+            rc = r.returncode if r.returncode > 0 else 128 - r.returncode
     return rc
 
 

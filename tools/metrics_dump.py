@@ -102,6 +102,28 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
             lines.append(f"  {name}: x{count} "
                          f"(last {entry['last_duration_sec']:.1f}s)")
 
+    # Fault-tolerance counters (docs/fault-tolerance.md); .get() keeps
+    # pre-fault-tolerance dumps readable.
+    faults = snap.get("faults", {})
+    base_faults = (base or {}).get("faults", {})
+    injected = dict(faults.get("injected", {}))
+    aborts = dict(faults.get("aborts", {}))
+    if base:
+        for k, v in base_faults.get("injected", {}).items():
+            injected[k] = injected.get(k, 0) - v
+        for k, v in base_faults.get("aborts", {}).items():
+            aborts[k] = aborts.get(k, 0) - v
+    lines.append("== faults ==")
+    epoch = faults.get("restart_epoch", 0)
+    parts = [f"restart epoch {epoch}"]
+    parts.append("injected " + (
+        ", ".join(f"{k}x{v}" for k, v in sorted(injected.items()) if v)
+        or "none"))
+    parts.append("aborts " + (
+        ", ".join(f"{k}x{v}" for k, v in sorted(aborts.items()) if v)
+        or "none"))
+    lines.append("; ".join(parts))
+
     lines.append("== histograms ==")
     lines.append(f"{'name':<18}{'count':>8}{'mean':>10}{'p50':>10}"
                  f"{'p99':>10}")
