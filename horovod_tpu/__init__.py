@@ -42,6 +42,9 @@ from horovod_tpu.common import (  # noqa: F401
     restart_epoch,
     shutdown,
     size,
+    timeline_enabled,
+    trace_marker,
+    trace_span,
 )
 
 __version__ = "0.1.0"

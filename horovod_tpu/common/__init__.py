@@ -10,6 +10,7 @@ binding builds on (the role the torch cffi interface plays in the reference,
 from __future__ import annotations
 
 import atexit
+import contextlib
 import ctypes
 import json
 import os
@@ -86,6 +87,11 @@ _XLA_PLANE_DTYPES = ("float32", "float16", "bfloat16", "int32", "int8",
 _metrics_file: Optional[str] = None
 _engine_stalls_seen = 0
 _engine_aborts_seen = 0
+# Announce-order sync state (straggler attribution): events already folded
+# into the Python registry, and the last cumulative per-rank
+# last-to-announce counts read from the engine.
+_engine_announces_seen = 0
+_engine_last_announce_seen: list = []
 # Deterministic fault injection (common/faults.py, HVD_TPU_FAULT_SPEC):
 # the injector for this (rank, restart epoch), or None; and the per-process
 # submission index of user-level collectives it is driven by.
@@ -152,6 +158,16 @@ def _load_lib():
         lib.hvd_tpu_abort_message.argtypes = []
         lib.hvd_tpu_abort_count.restype = ctypes.c_longlong
         lib.hvd_tpu_abort_count.argtypes = []
+        lib.hvd_tpu_clock_offset_us.restype = ctypes.c_longlong
+        lib.hvd_tpu_clock_offset_us.argtypes = []
+        lib.hvd_tpu_clock_rtt_us.restype = ctypes.c_longlong
+        lib.hvd_tpu_clock_rtt_us.argtypes = []
+        lib.hvd_tpu_announce_count.restype = ctypes.c_longlong
+        lib.hvd_tpu_announce_count.argtypes = []
+        lib.hvd_tpu_announce_log.restype = ctypes.c_char_p
+        lib.hvd_tpu_announce_log.argtypes = []
+        lib.hvd_tpu_last_announce_counts.restype = ctypes.c_char_p
+        lib.hvd_tpu_last_announce_counts.argtypes = []
         lib.hvd_tpu_timeline_enabled.restype = ctypes.c_int
         lib.hvd_tpu_timeline_op_start.argtypes = [ctypes.c_char_p,
                                                   ctypes.c_char_p]
@@ -160,8 +176,34 @@ def _load_lib():
         lib.hvd_tpu_timeline_activity_end.argtypes = [ctypes.c_char_p]
         lib.hvd_tpu_timeline_op_end.argtypes = [ctypes.c_char_p,
                                                 ctypes.c_longlong]
+        lib.hvd_tpu_timeline_instant.argtypes = [ctypes.c_char_p,
+                                                 ctypes.c_char_p]
+        lib.hvd_tpu_timeline_flush.argtypes = []
         _lib = lib
         return lib
+
+
+def _resolve_timeline_path(path: str, rank: int, epoch: int = 0) -> str:
+    """Resolve ``HOROVOD_TIMELINE``'s forms (docs/timeline.md) to this
+    rank's trace path: a ``%d`` template or a directory (existing, or a
+    trailing-separator path) yield one Chrome-trace file PER RANK; a plain
+    file path keeps the legacy rank-0-only single file.  A non-zero
+    restart epoch (``hvdrun --max-restarts``) lands in the filename
+    (``rank<N>.e<E>.json``) so a relaunch cannot truncate the crashed
+    attempt's post-mortem traces."""
+    if not path:
+        return ""
+    suffix = f".e{epoch}" if epoch else ""
+    if "%d" in path:
+        resolved = path.replace("%d", str(rank)) + suffix
+        parent = os.path.dirname(resolved)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        return resolved
+    if path.endswith(os.sep) or os.path.isdir(path):
+        os.makedirs(path, exist_ok=True)
+        return os.path.join(path, f"rank{rank}{suffix}.json")
+    return path if rank == 0 else ""
 
 
 def init(comm: Union[Sequence[int], Any, None] = None) -> None:
@@ -184,7 +226,8 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
         comm = comm_ranks(comm, resolve_process_set(None).rank)
     ps = resolve_process_set(comm)
     cfg = Config.from_env()
-    timeline = cfg.timeline_path if ps.rank == 0 else ""
+    timeline = _resolve_timeline_path(cfg.timeline_path, ps.rank,
+                                      cfg.restart_epoch)
     data = ",".join(ps.data_endpoints) if ps.data_endpoints else ""
     rc = lib.hvd_tpu_init(
         ps.rank, ps.size, ps.local_rank, ps.local_size,
@@ -413,15 +456,59 @@ def _sync_engine_aborts() -> None:
         metrics.registry.record_abort(kind, new)
 
 
+def _sync_engine_announces() -> None:
+    """Fold the coordinator's announce-order accounting into the registry
+    (straggler attribution, docs/troubleshooting.md).  Per-rank
+    last-to-announce counts come from an exact cumulative C-side vector;
+    the first->last skew histogram from a bounded event log — events that
+    fell off the log keep the per-rank totals honest but contribute no
+    skew sample.  Coordinator-side data: non-zero on rank 0 only."""
+    global _engine_announces_seen, _engine_last_announce_seen
+    if _lib is None:
+        return
+    with _stall_sync_lock:
+        counts_raw = _lib.hvd_tpu_last_announce_counts().decode()
+        counts = [int(tok) for tok in counts_raw.split(",") if tok]
+        for r, c in enumerate(counts):
+            prev = (_engine_last_announce_seen[r]
+                    if r < len(_engine_last_announce_seen) else 0)
+            if c > prev:
+                metrics.registry.record_last_announce(r, c - prev)
+        _engine_last_announce_seen = counts
+        # One C call carries "cumulative_count:entries", serialized under
+        # the engine's announce lock — pairing a separate count call with
+        # the log would race concurrent negotiations and mis-window the
+        # skew samples.
+        head, _, tail = _lib.hvd_tpu_announce_log().decode().partition(":")
+        try:
+            count = int(head)
+        except ValueError:
+            return
+        new = count - _engine_announces_seen
+        if new <= 0:
+            return
+        _engine_announces_seen = count
+        entries = [e for e in tail.split(";") if e]
+        for entry in entries[-new:]:
+            _, _, us = entry.partition("|")
+            try:
+                skew_sec = float(us) / 1e6
+            except ValueError:
+                continue
+            metrics.registry.observe("announce_skew_sec", skew_sec)
+
+
 def metrics_snapshot() -> dict:
     """Plain nested dict of the collective metrics registry: op/byte
     counters per data plane, fusion-batch counters, latency/fill
-    histograms, and stall events (engine sweep + XLA-plane waits).  Always
-    callable; counters and histograms only accumulate while metrics are
-    enabled (``HVD_TPU_METRICS=1``, a metrics file, or a monitor port),
-    stall records always do."""
+    histograms, stall events (engine sweep + XLA-plane waits), and the
+    coordinator's announce-order skew accounting (``"skew"``, rank 0).
+    Always callable; counters and histograms only accumulate while metrics
+    are enabled (``HVD_TPU_METRICS=1``, a metrics file, or a monitor
+    port); stall, fault, and skew records always do."""
     _sync_engine_stalls()
     _sync_engine_aborts()
+    _sync_engine_announces()
     return metrics.registry.snapshot()
 
 
@@ -431,7 +518,60 @@ def metrics_reset() -> None:
     they cannot resurface in the next snapshot."""
     _sync_engine_stalls()
     _sync_engine_aborts()
+    _sync_engine_announces()
     metrics.registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Application span API (docs/timeline.md): land app events in this rank's
+# trace next to the engine's collective rows.
+# ---------------------------------------------------------------------------
+
+
+def timeline_enabled() -> bool:
+    """True when this rank is writing a timeline (``HOROVOD_TIMELINE`` /
+    ``hvdrun --timeline``); span and marker calls are no-ops otherwise."""
+    return _lib is not None and bool(_lib.hvd_tpu_timeline_enabled())
+
+
+def _trace_begin(row: str, label: str) -> None:
+    """Open a span labelled `label` on trace row `row` (internal: the
+    framework hooks — keras callbacks, jax train steps — share it with
+    :func:`trace_span`)."""
+    if _lib is not None and _lib.hvd_tpu_timeline_enabled():
+        _lib.hvd_tpu_timeline_op_start(row.encode(), label.encode())
+
+
+def _trace_end(row: str) -> None:
+    if _lib is not None and _lib.hvd_tpu_timeline_enabled():
+        _lib.hvd_tpu_timeline_op_end(row.encode(), 0)
+
+
+@contextlib.contextmanager
+def trace_span(name: str, label: Optional[str] = None):
+    """Context manager landing an application span in this rank's timeline::
+
+        with hvd.trace_span("data_loading"):
+            batch = next(loader)
+
+    The span occupies the trace row ``name`` (same-row spans nest);
+    ``label`` overrides the event label (default: the row name).  A no-op
+    when the timeline is disabled — safe to leave in production code."""
+    if _lib is None or not _lib.hvd_tpu_timeline_enabled():
+        yield
+        return
+    _lib.hvd_tpu_timeline_op_start(name.encode(), (label or name).encode())
+    try:
+        yield
+    finally:
+        _lib.hvd_tpu_timeline_op_end(name.encode(), 0)
+
+
+def trace_marker(name: str, row: str = "app.markers") -> None:
+    """Drop an instant event named `name` on the ``app.markers`` trace row
+    (or `row`).  A no-op when the timeline is disabled."""
+    if _lib is not None and _lib.hvd_tpu_timeline_enabled():
+        _lib.hvd_tpu_timeline_instant(row.encode(), name.encode())
 
 
 # ---------------------------------------------------------------------------

@@ -132,6 +132,17 @@ class FaultInjector:
               f"{fault.action} at op {op_index} ('{name}')",
               file=sys.stderr, flush=True)
         if fault.action == "crash":
+            # Flush this rank's timeline before dying: an injected crash
+            # is a reproducible test crash, and the post-mortem trace
+            # contract (docs/timeline.md) says the file must still parse.
+            # Real SIGKILLs rely on the engine's abort-path flush instead.
+            try:
+                from horovod_tpu import common as _common
+
+                if _common._lib is not None:
+                    _common._lib.hvd_tpu_timeline_flush()
+            except Exception:
+                pass
             # Hard death: no shutdown handshake, sockets drop — the
             # coordinator sees EOF, exactly like a SIGKILL'd rank.
             os._exit(CRASH_EXIT_CODE)

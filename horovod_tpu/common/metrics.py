@@ -60,6 +60,9 @@ HISTOGRAMS = {
                     "fusion-bucket fill fraction of the fusion threshold"),
     "step_sec": (LATENCY_BUCKETS,
                  "jax build_train_step per-call dispatch time"),
+    "announce_skew_sec": (LATENCY_BUCKETS,
+                          "first-to-last announce skew per negotiated "
+                          "collective (rank-0 coordinator view)"),
 }
 
 # Cap on distinct stalled-tensor entries kept by name; beyond it new names
@@ -118,6 +121,11 @@ class MetricsRegistry:
         # ungated, like stalls: rare by construction, and fault tests must
         # assert on them without opting into full metrics.
         self._faults = {"injected": {}, "aborts": {}, "restart_epoch": 0}
+        # Straggler attribution (rank-0 coordinator view): how often each
+        # rank announced a collective LAST.  Ungated, like stalls: the
+        # acceptance path asserts on it without enabling full metrics; the
+        # matching skew distribution is the announce_skew_sec histogram.
+        self._skew = {"count": 0, "last_to_announce": {}}
         self._hists = {name: Histogram(bounds)
                        for name, (bounds, _) in HISTOGRAMS.items()}
 
@@ -180,6 +188,15 @@ class MetricsRegistry:
         with self._lock:
             self._faults["restart_epoch"] = int(epoch)
 
+    def record_last_announce(self, rank: int, n: int = 1) -> None:
+        """`rank` announced a negotiated collective last, `n` times
+        (coordinator view, folded in from the engine).  Ungated."""
+        with self._lock:
+            self._skew["count"] += int(n)
+            key = str(rank)
+            self._skew["last_to_announce"][key] = (
+                self._skew["last_to_announce"].get(key, 0) + int(n))
+
     def record_stall(self, name: str, duration_sec: float) -> None:
         with self._lock:
             self._stall_count += 1
@@ -209,6 +226,10 @@ class MetricsRegistry:
                     "injected": dict(self._faults["injected"]),
                     "aborts": dict(self._faults["aborts"]),
                     "restart_epoch": self._faults["restart_epoch"],
+                },
+                "skew": {
+                    "count": self._skew["count"],
+                    "last_to_announce": dict(self._skew["last_to_announce"]),
                 },
                 "histograms": {name: h.to_dict()
                                for name, h in self._hists.items()},
@@ -294,6 +315,19 @@ def prometheus_text(snapshot: dict) -> str:
                "hvdrun restart counter (0 = first run)")
     out.append("# TYPE hvd_tpu_restart_epoch gauge")
     out.append(f"hvd_tpu_restart_epoch {faults.get('restart_epoch', 0)}")
+
+    skew = snapshot.get("skew", {})
+    out.append("# HELP hvd_tpu_announce_total "
+               "negotiations reaching full count (coordinator view)")
+    out.append("# TYPE hvd_tpu_announce_total counter")
+    out.append(f"hvd_tpu_announce_total {skew.get('count', 0)}")
+    out.append("# HELP hvd_tpu_last_to_announce_total "
+               "negotiations this rank announced last (straggler "
+               "attribution, coordinator view)")
+    out.append("# TYPE hvd_tpu_last_to_announce_total counter")
+    for rank, n in skew.get("last_to_announce", {}).items():
+        out.append(f'hvd_tpu_last_to_announce_total{{rank='
+                   f'"{_label_escape(rank)}"}} {n}')
 
     for name, hist in snapshot["histograms"].items():
         prom = _prom_hist_name(name)

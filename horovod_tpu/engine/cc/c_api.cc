@@ -171,6 +171,34 @@ const char* hvd_tpu_abort_message() {
 
 long long hvd_tpu_abort_count() { return GlobalEngine()->AbortEvents(); }
 
+// Cross-rank clock alignment (docs/timeline.md): this rank's estimated
+// clock offset against rank 0 (µs) and the RTT error bound of the winning
+// NTP-style probe.  0 on rank 0 / single-process jobs.
+long long hvd_tpu_clock_offset_us() {
+  return GlobalEngine()->ClockOffsetUs();
+}
+
+long long hvd_tpu_clock_rtt_us() { return GlobalEngine()->ClockRttUs(); }
+
+// Announce-order observability for the Python metrics registry (straggler
+// attribution, rank-0 coordinator view): cumulative negotiation count, a
+// bounded log of the most recent ones as
+// "cumulative_count:last_rank|skew_us;..." (count and entries serialized
+// atomically), and exact per-rank last-to-announce counts as "n0,n1,...".
+long long hvd_tpu_announce_count() { return GlobalEngine()->AnnounceEvents(); }
+
+const char* hvd_tpu_announce_log() {
+  static thread_local std::string tl_announce_log;
+  tl_announce_log = GlobalEngine()->AnnounceLog();
+  return tl_announce_log.c_str();
+}
+
+const char* hvd_tpu_last_announce_counts() {
+  static thread_local std::string tl_last_announce;
+  tl_last_announce = GlobalEngine()->LastAnnounceCounts();
+  return tl_last_announce.c_str();
+}
+
 // Timeline hooks for the XLA data plane (jax/eager_mesh.py): plane-side
 // execution phases land in the same Chrome-tracing file as the engine's
 // events.  All are no-ops when HOROVOD_TIMELINE is unset.
@@ -194,5 +222,15 @@ void hvd_tpu_timeline_activity_end(const char* name) {
 void hvd_tpu_timeline_op_end(const char* name, long long bytes) {
   GlobalEngine()->timeline().End(name ? name : "", bytes);
 }
+
+// Instant event on `name`'s row — the Python span API's trace_marker.
+void hvd_tpu_timeline_instant(const char* name, const char* label) {
+  GlobalEngine()->timeline().Instant(name ? name : "", label ? label : "");
+}
+
+// Flush buffered trace events to disk without closing the file: the
+// fault injector calls this before an injected crash so the post-mortem
+// trace parses (docs/timeline.md).
+void hvd_tpu_timeline_flush() { GlobalEngine()->timeline().Flush(); }
 
 }  // extern "C"

@@ -299,15 +299,29 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
     abort_code_.store(0);
     abort_message_.clear();
   }
+  epoch_ = std::chrono::steady_clock::now();
+  clock_offset_us_.store(0);
+  clock_rtt_us_.store(0);
+  {
+    // Announce counts are process-cumulative (like stall_events_); only
+    // grow the per-rank vector if this job is wider than the last.
+    std::lock_guard<std::mutex> lk(announce_mu_);
+    if (static_cast<int>(last_announce_counts_.size()) < opts_.size)
+      last_announce_counts_.resize(opts_.size, 0);
+  }
   coord_.reset(new Coordinator());
   coord_->rank_dead.assign(opts_.size, false);
-  if (opts_.rank == 0) timeline_.Initialize(opts_.timeline_path);
+  // Every rank writes its own trace; the Python side resolves
+  // HOROVOD_TIMELINE's directory / %d forms to a per-rank path (a plain
+  // file path stays rank-0-only there, for the legacy single-file mode).
+  timeline_.Initialize(opts_.timeline_path, opts_.rank, epoch_);
   std::string setup_err;
   if (!SetupSockets(&setup_err)) {
     *err = setup_err;
     TeardownSockets();
     return 1;
   }
+  timeline_.WriteClockSync(clock_offset_us_.load(), clock_rtt_us_.load());
   last_stall_check_ = std::chrono::steady_clock::now();
   initialized_.store(true);
   background_ = std::thread([this]() { BackgroundLoop(); });
@@ -411,6 +425,9 @@ bool Engine::SetupSockets(std::string* err) {
     }
     opts_.hierarchical_allreduce = decision != 0;
   }
+  // Clock alignment for the per-rank timelines: NTP-style probes over the
+  // control sockets just established (docs/timeline.md).
+  if (!ClockSync(err)) return false;
   node_id_ = opts_.hierarchical_allreduce ? opts_.rank / opts_.local_size : 0;
   n_nodes_ = opts_.hierarchical_allreduce ? opts_.size / opts_.local_size : 1;
 
@@ -519,6 +536,121 @@ void Engine::TeardownSockets() {
   local_leader_fd_ = cross_left_fd_ = cross_right_fd_ = -1;
 }
 
+int64_t Engine::EpochNowUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - epoch_)
+      .count();
+}
+
+bool Engine::ClockSync(std::string* err) {
+  // K round trips per worker; the minimum-RTT sample gives the best
+  // offset estimate (symmetric-path assumption: the worker's timestamp
+  // was taken at the probe's midpoint), its RTT the error bound.  The
+  // verdict is sent back so each rank knows its own offset — each rank's
+  // timeline records it for tools/timeline_merge.py.
+  const int kProbes = 8;
+  if (opts_.size == 1) return true;
+  if (opts_.rank == 0) {
+    for (int r = 1; r < opts_.size; ++r) {
+      int64_t best_rtt = -1, best_off = 0;
+      for (int k = 0; k < kProbes; ++k) {
+        uint8_t probe = 1;
+        int64_t t0 = EpochNowUs();
+        if (!SendAll(coord_fds_[r], &probe, 1)) {
+          *err = "clock sync probe send failed (rank " + std::to_string(r) +
+                 ")";
+          return false;
+        }
+        int64_t worker_ts;
+        if (!RecvAll(coord_fds_[r], &worker_ts, 8)) {
+          *err = "clock sync reply recv failed (rank " + std::to_string(r) +
+                 ")";
+          return false;
+        }
+        int64_t t1 = EpochNowUs();
+        int64_t rtt = t1 - t0;
+        if (best_rtt < 0 || rtt < best_rtt) {
+          best_rtt = rtt;
+          best_off = worker_ts - (t0 + t1) / 2;
+        }
+      }
+      int64_t verdict[2] = {best_off, best_rtt};
+      if (!SendAll(coord_fds_[r], verdict, sizeof verdict)) {
+        *err = "clock sync verdict send failed (rank " + std::to_string(r) +
+               ")";
+        return false;
+      }
+    }
+  } else {
+    for (int k = 0; k < kProbes; ++k) {
+      uint8_t probe;
+      if (!RecvAll(coord_fd_, &probe, 1)) {
+        *err = "clock sync probe recv failed";
+        return false;
+      }
+      int64_t now = EpochNowUs();
+      if (!SendAll(coord_fd_, &now, 8)) {
+        *err = "clock sync reply send failed";
+        return false;
+      }
+    }
+    int64_t verdict[2];
+    if (!RecvAll(coord_fd_, verdict, sizeof verdict)) {
+      *err = "clock sync verdict recv failed";
+      return false;
+    }
+    clock_offset_us_.store(verdict[0]);
+    clock_rtt_us_.store(verdict[1]);
+  }
+  return true;
+}
+
+void Engine::RecordAnnounce(
+    int last_rank, std::chrono::steady_clock::time_point first_seen) {
+  int64_t skew_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                        std::chrono::steady_clock::now() - first_seen)
+                        .count();
+  std::lock_guard<std::mutex> lk(announce_mu_);
+  ++announce_events_;
+  if (last_rank >= 0 &&
+      last_rank < static_cast<int>(last_announce_counts_.size()))
+    ++last_announce_counts_[last_rank];
+  announce_log_.emplace_back(last_rank, skew_us);
+  while (announce_log_.size() > 1024) announce_log_.pop_front();
+}
+
+int64_t Engine::AnnounceEvents() {
+  std::lock_guard<std::mutex> lk(announce_mu_);
+  return announce_events_;
+}
+
+std::string Engine::AnnounceLog() {
+  // The cumulative event count is PREFIXED ("count:entries") under the
+  // same lock hold as the log serialization: a reader pairing a separate
+  // AnnounceEvents() call with this log could race concurrent
+  // negotiations and mis-window the entries (dropping or double-counting
+  // skew samples).
+  std::lock_guard<std::mutex> lk(announce_mu_);
+  std::string out = std::to_string(announce_events_) + ":";
+  bool first = true;
+  for (const auto& rec : announce_log_) {
+    if (!first) out += ';';
+    first = false;
+    out += std::to_string(rec.first) + "|" + std::to_string(rec.second);
+  }
+  return out;
+}
+
+std::string Engine::LastAnnounceCounts() {
+  std::lock_guard<std::mutex> lk(announce_mu_);
+  std::string out;
+  for (size_t i = 0; i < last_announce_counts_.size(); ++i) {
+    if (i) out += ',';
+    out += std::to_string(last_announce_counts_[i]);
+  }
+  return out;
+}
+
 void Engine::Shutdown() {
   if (!initialized_.load()) return;
   shut_down_.store(true);
@@ -561,6 +693,10 @@ void Engine::BackgroundLoop() {
           "exception on one of the ranks or an earlier shutdown.";
   }
   for (auto& e : leftovers) CompleteEntry(e, code, msg);
+  // Post-mortem traces must survive even if the process exits without
+  // reaching Shutdown() (docs/timeline.md): the loop exit — abort paths
+  // included — leaves the file parseable on disk.
+  timeline_.Flush();
 }
 
 int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
@@ -835,6 +971,10 @@ void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
         coord_->poisoned.erase(BaseName(req.name));
         pt.poison_deadline_tick = 0;
       }
+      // Straggler attribution: `from_rank`'s request list completed the
+      // count, so it announced last; skew = first -> last announce.  At
+      // size 1 every count completes instantly — pure noise, skip.
+      if (opts_.size > 1) RecordAnnounce(from_rank, pt.first_seen);
       timeline_.NegotiateEnd(req.name);
       coord_->ready.push_back(req.name);
     }
@@ -1135,6 +1275,10 @@ void Engine::AbortLocal(int32_t code, const std::string& message) {
   abort_events_.fetch_add(1);
   // A broken job must fail every subsequent collective uniformly.
   data_plane_failed_.store(true);
+  // Aborting jobs often die before Python reaches shutdown(): flush now
+  // so the trace on disk parses (the BackgroundLoop drain flushes again
+  // after the final completions land).
+  timeline_.Flush();
   fprintf(stderr, "[horovod_tpu] ERROR: coordinated abort on rank %d: %s\n",
           opts_.rank, message.c_str());
 }

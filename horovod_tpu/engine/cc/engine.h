@@ -157,6 +157,26 @@ class Engine {
   std::string AbortMessage();
   int64_t AbortEvents() const { return abort_events_.load(); }
 
+  // Cross-rank clock alignment (docs/timeline.md): this rank's estimated
+  // clock offset relative to rank 0's engine epoch (µs; subtract from
+  // local timeline ts to land on rank 0's clock) and the RTT of the
+  // winning NTP-style probe (the error bound).  0 on rank 0 and at size 1.
+  int64_t ClockOffsetUs() const { return clock_offset_us_.load(); }
+  int64_t ClockRttUs() const { return clock_rtt_us_.load(); }
+
+  // Announce-order observability (rank-0 coordinator; straggler
+  // attribution, docs/troubleshooting.md): cumulative count of fully
+  // negotiated collectives, per-rank last-to-announce counts serialized
+  // as "n0,n1,...", and a bounded log of the most recent negotiations as
+  // "cumulative_count:last_rank|skew_us;..." (skew = first -> last
+  // announce; count and entries under one lock hold).  All counts
+  // are process-cumulative (survive re-init, like StallEvents); the XLA
+  // plane's __xp.* metadata negotiations feed them too, since they ride
+  // this same coordinator.
+  int64_t AnnounceEvents();
+  std::string AnnounceLog();
+  std::string LastAnnounceCounts();
+
   // The engine-owned Chrome-tracing timeline.  Exposed so the XLA data
   // plane (Python, jax/eager_mesh.py) can emit its BUCKET_BUILD /
   // XLA_DISPATCH / DEVICE_WAIT activities into the SAME trace file as the
@@ -172,6 +192,17 @@ class Engine {
   bool RunLoopOnce();
   bool SetupSockets(std::string* err);
   void TeardownSockets();
+  // NTP-style clock sync over the coordinator star (end of SetupSockets):
+  // rank 0 probes each worker K times; the minimum-RTT round trip gives
+  // the best offset estimate (worker_ts - probe midpoint), which rank 0
+  // sends back so every rank knows its own offset.  Runs at every Init,
+  // so restart epochs re-align too.
+  bool ClockSync(std::string* err);
+  int64_t EpochNowUs() const;
+  // Rank 0: one negotiation reached full count; `last_rank` announced
+  // last, `first_seen` when the first announce arrived.
+  void RecordAnnounce(int last_rank,
+                      std::chrono::steady_clock::time_point first_seen);
 
   // Coordinator (rank 0) helpers.
   void CoordinatorHandle(const RequestList& rl, int from_rank);
@@ -273,6 +304,19 @@ class Engine {
   std::atomic<int64_t> abort_events_{0};
   std::mutex abort_mu_;  // guards abort_message_
   std::string abort_message_;
+
+  // Clock alignment: the engine's ts epoch (set at Init, shared with the
+  // timeline) and this rank's measured offset/RTT against rank 0.
+  std::chrono::steady_clock::time_point epoch_{};
+  std::atomic<int64_t> clock_offset_us_{0};
+  std::atomic<int64_t> clock_rtt_us_{0};
+
+  // Announce-order accounting (rank 0).  Counts are process-cumulative;
+  // the log is bounded so an unconsumed Python side cannot grow it.
+  std::mutex announce_mu_;
+  int64_t announce_events_ = 0;
+  std::vector<int64_t> last_announce_counts_;
+  std::deque<std::pair<int, int64_t>> announce_log_;
 };
 
 Engine* GlobalEngine();

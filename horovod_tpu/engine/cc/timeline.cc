@@ -28,7 +28,8 @@ std::string JsonEscape(const std::string& s) {
 }
 }  // namespace
 
-void Timeline::Initialize(const std::string& path) {
+void Timeline::Initialize(const std::string& path, int rank,
+                          std::chrono::steady_clock::time_point epoch) {
   if (path.empty()) return;
   std::lock_guard<std::mutex> lk(mu_);
   file_.open(path, std::ios::out | std::ios::trunc);
@@ -37,9 +38,18 @@ void Timeline::Initialize(const std::string& path) {
             path.c_str());
     return;
   }
+  // Re-init after a shutdown starts a fresh file: forget the previous
+  // run's pid rows so every tensor re-emits its process_name metadata.
+  tensor_pids_.clear();
+  open_labels_.clear();
+  start_ = epoch;
+  last_flush_ = std::chrono::steady_clock::now();
   file_ << "[\n";
-  start_ = std::chrono::steady_clock::now();
-  last_flush_ = start_;
+  // File identity for tools/timeline_merge.py: which rank wrote this
+  // trace.  pid 0 is reserved for metadata (tensor pids start at 1).
+  file_ << "{\"name\":\"hvd_rank\",\"ph\":\"M\",\"ts\":0,\"pid\":0,"
+        << "\"args\":{\"rank\":" << rank << "}},\n";
+  file_.flush();
   enabled_ = true;
 }
 
@@ -55,8 +65,9 @@ int64_t Timeline::TensorPid(const std::string& name) {
   int64_t pid = static_cast<int64_t>(tensor_pids_.size()) + 1;
   tensor_pids_[name] = pid;
   // Metadata event labels the pid row with the tensor name.
-  file_ << "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":" << pid
-        << ",\"args\":{\"name\":\"" << JsonEscape(name) << "\"}},\n";
+  file_ << "{\"name\":\"process_name\",\"ph\":\"M\",\"ts\":" << NowUs()
+        << ",\"pid\":" << pid << ",\"args\":{\"name\":\"" << JsonEscape(name)
+        << "\"}},\n";
   return pid;
 }
 
@@ -64,10 +75,21 @@ void Timeline::WriteEvent(const std::string& name, char phase,
                           const std::string& args,
                           const std::string& category) {
   int64_t pid = TensorPid(name);
+  // 'E' events repeat their opener's label (popped from the per-row
+  // stack) so every event carries a name.
+  std::string label = category;
+  if (phase == 'B') {
+    open_labels_[name].push_back(category);
+  } else if (phase == 'E') {
+    auto& stack = open_labels_[name];
+    if (!stack.empty()) {
+      label = stack.back();
+      stack.pop_back();
+    }
+  }
   file_ << "{\"ph\":\"" << phase << "\",\"ts\":" << NowUs()
-        << ",\"pid\":" << pid << ",\"tid\":0";
-  if (!category.empty())
-    file_ << ",\"name\":\"" << JsonEscape(category) << "\"";
+        << ",\"pid\":" << pid << ",\"tid\":0"
+        << ",\"name\":\"" << JsonEscape(label) << "\"";
   if (!args.empty()) file_ << ",\"args\":{" << args << "}";
   file_ << "},\n";
   auto now = std::chrono::steady_clock::now();
@@ -119,6 +141,27 @@ void Timeline::End(const std::string& name, int64_t bytes) {
   if (!enabled_) return;
   std::lock_guard<std::mutex> lk(mu_);
   WriteEvent(name, 'E', "\"bytes\":" + std::to_string(bytes), "");
+}
+
+void Timeline::Instant(const std::string& name, const std::string& label) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  WriteEvent(name, 'i', "", label);
+}
+
+void Timeline::WriteClockSync(int64_t offset_us, int64_t rtt_us) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!enabled_) return;
+  file_ << "{\"name\":\"hvd_clock_sync\",\"ph\":\"M\",\"ts\":" << NowUs()
+        << ",\"pid\":0,\"args\":{\"offset_us\":" << offset_us
+        << ",\"rtt_us\":" << rtt_us << "}},\n";
+  file_.flush();
+}
+
+void Timeline::Flush() {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!enabled_) return;
+  file_.flush();
 }
 
 void Timeline::Shutdown() {

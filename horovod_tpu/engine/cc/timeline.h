@@ -3,6 +3,14 @@
 // trace "pid" per tensor name, NEGOTIATE -> op -> activity nesting, JSON
 // written incrementally and flushed periodically; load the output in
 // chrome://tracing or Perfetto.
+//
+// Cross-rank tracing (docs/timeline.md): EVERY rank writes its own file
+// (the Python side resolves HOROVOD_TIMELINE's directory / %d forms to a
+// per-rank path).  Timestamps are anchored to the engine's Init-time
+// epoch, the coordinator measures each worker epoch's offset against its
+// own (engine.cc ClockSync), and each file records its rank plus that
+// offset as metadata — tools/timeline_merge.py uses them to fuse the
+// per-rank files onto rank 0's clock.
 #pragma once
 
 #include <chrono>
@@ -11,12 +19,16 @@
 #include <mutex>
 #include <string>
 #include <unordered_map>
+#include <vector>
 
 namespace hvdtpu {
 
 class Timeline {
  public:
-  void Initialize(const std::string& path);
+  // `epoch` anchors every ts (µs since it); `rank` is recorded as an
+  // "hvd_rank" metadata event so merged traces know who wrote what.
+  void Initialize(const std::string& path, int rank,
+                  std::chrono::steady_clock::time_point epoch);
   bool Enabled() const { return enabled_; }
 
   void NegotiateStart(const std::string& name, uint8_t op);
@@ -26,6 +38,16 @@ class Timeline {
   void ActivityStart(const std::string& name, const std::string& activity);
   void ActivityEnd(const std::string& name);
   void End(const std::string& name, int64_t bytes);
+  // Instant event ('i') on `name`'s row — the span API's trace_marker.
+  void Instant(const std::string& name, const std::string& label);
+  // "hvd_clock_sync" metadata: this rank's estimated clock offset against
+  // rank 0 (µs; subtract from ts to land on rank 0's clock) and the RTT of
+  // the winning probe (the error bound).  Flushed immediately so the merge
+  // tool can align even a trace whose writer later crashed.
+  void WriteClockSync(int64_t offset_us, int64_t rtt_us);
+  // Flush buffered events to disk without closing (abort/crash paths:
+  // post-mortem traces must parse, docs/timeline.md).
+  void Flush();
   void Shutdown();
 
  private:
@@ -38,6 +60,10 @@ class Timeline {
   std::ofstream file_;
   std::mutex mu_;
   std::unordered_map<std::string, int64_t> tensor_pids_;
+  // Per-row stack of open 'B' labels so every 'E' event can repeat its
+  // opener's name — the structural-validation contract (tests require
+  // ph/ts/pid/name on every row) without breaking Chrome's B/E pairing.
+  std::unordered_map<std::string, std::vector<std::string>> open_labels_;
   std::chrono::steady_clock::time_point start_{};
   std::chrono::steady_clock::time_point last_flush_{};
 };

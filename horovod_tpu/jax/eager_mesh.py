@@ -31,6 +31,11 @@ operations.cc:301-503): a mismatch surfaces as a typed ``ValueError`` on
 every rank instead of an opaque XLA error or a hang.  The per-rank dim0
 slots carry ragged allgather geometry, so eager allgather rides the plane
 too (the reference's MPI_Allgatherv displs, operations.cc:778-838).
+Because these ``__xp.*`` metadata ops negotiate through the same rank-0
+coordinator as engine collectives, they feed the coordinator's
+announce-order accounting for free: plane collectives show up in
+``metrics_snapshot()["skew"]`` (last-to-announce counts, skew histogram)
+and in rank 0's NEGOTIATE timeline rows exactly like engine ones.
 
 Tensor fusion
 -------------

@@ -118,21 +118,34 @@ def load_latest_checkpoint(directory: str):
 class _TimedStep:
     """Callable proxy over the jitted step that feeds the ``step_sec``
     histogram of the metrics registry (docs/metrics.md) — per-epoch step
-    summaries for free wherever ``build_train_step`` is used.  The measured
-    interval is the on-host dispatch of one step call (jax dispatch is
-    async); training loops that fetch the loss each step see true step
-    time.  Every jit attribute (``lower``, ``trace``, ...) delegates to
-    the wrapped function."""
+    summaries for free wherever ``build_train_step`` is used — and, when a
+    timeline is active (docs/timeline.md), wraps each call in a
+    ``jax.train_step`` span on this rank's trace.  The measured interval
+    is the on-host dispatch of one step call (jax dispatch is async);
+    training loops that fetch the loss each step see true step time.
+    Every jit attribute (``lower``, ``trace``, ...) delegates to the
+    wrapped function."""
 
     def __init__(self, fn):
         self._fn = fn
 
     def __call__(self, *args, **kwargs):
-        if not _metrics.registry.enabled:
+        from horovod_tpu import common as _common
+
+        tl = _common.timeline_enabled()
+        mx = _metrics.registry.enabled
+        if not tl and not mx:
             return self._fn(*args, **kwargs)
+        if tl:
+            _common._trace_begin("jax.train_step", "TRAIN_STEP")
         t0 = time.perf_counter()
-        out = self._fn(*args, **kwargs)
-        _metrics.registry.observe("step_sec", time.perf_counter() - t0)
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            if tl:
+                _common._trace_end("jax.train_step")
+        if mx:
+            _metrics.registry.observe("step_sec", time.perf_counter() - t0)
         return out
 
     def __getattr__(self, name):

@@ -152,6 +152,34 @@ class MetricsLoggingCallback(keras.callbacks.Callback):
             f"stalls {cur['stalls'] - prev['stalls']}")
 
 
+class TimelineCallback(keras.callbacks.Callback):
+    """Epoch and (optionally) step spans into this rank's timeline
+    (docs/timeline.md): a ``keras.epoch`` trace row with one span per
+    epoch and — with ``steps=True`` — a ``keras.step`` row with one span
+    per train batch, so collective rows line up against the training loop
+    that issued them.  Every hook is a no-op when the timeline is disabled
+    (``HOROVOD_TIMELINE`` unset), so the callback can stay wired in
+    production configs."""
+
+    def __init__(self, steps: bool = True):
+        super().__init__()
+        self.steps = steps
+
+    def on_epoch_begin(self, epoch, logs=None):
+        _common._trace_begin("keras.epoch", f"EPOCH_{epoch}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        _common._trace_end("keras.epoch")
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self.steps:
+            _common._trace_begin("keras.step", "STEP")
+
+    def on_train_batch_end(self, batch, logs=None):
+        if self.steps:
+            _common._trace_end("keras.step")
+
+
 class LearningRateScheduleCallback(keras.callbacks.Callback):
     """Multiply the initial LR by ``multiplier`` (a constant or a function
     of epoch).  ``staircase=True`` applies at epoch granularity; otherwise

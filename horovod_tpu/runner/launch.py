@@ -409,6 +409,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "(single-host mode)")
     parser.add_argument("--timeout", type=float, default=0.0,
                         help="kill the job after this many seconds (0 = none)")
+    parser.add_argument("--timeline", default=None, metavar="DIR",
+                        help="write one Chrome-trace file per rank under "
+                             "DIR (rank0.json, rank1.json, ...; sets "
+                             "HVD_TPU_TIMELINE=DIR).  Merge them with "
+                             "tools/timeline_merge.py — see "
+                             "docs/timeline.md")
     parser.add_argument("--max-restarts", type=int, default=0,
                         help="on job failure (a rank died, or the engine "
                              "aborted on a dead/stalled rank), kill the "
@@ -437,10 +443,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from horovod_tpu.runner.tpu_pin import pinning_requested
 
     tpu_pin = pinning_requested(args.tpu_pin)
+    env = None
+    if args.timeline:
+        os.makedirs(args.timeline, exist_ok=True)
+        env = dict(os.environ)
+        # Trailing separator forces the directory form on EVERY rank —
+        # remote (ssh) hosts don't share the launcher's filesystem, so a
+        # bare path that only exists locally would fall back to the
+        # legacy single-file mode there; ranks mkdir the trailing-sep
+        # form themselves.
+        env["HVD_TPU_TIMELINE"] = args.timeline.rstrip(os.sep) + os.sep
     try:
         results, restarts = run_elastic(
             cmd, args.num_proc, max_restarts=args.max_restarts,
-            timeout=args.timeout or 3e7, host=args.host,
+            env=env, timeout=args.timeout or 3e7, host=args.host,
             hosts_spec=args.hosts, port_base=args.port_base,
             tpu_pin=tpu_pin, tpu_topology=args.tpu_topology)
     except subprocess.TimeoutExpired:

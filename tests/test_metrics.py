@@ -381,6 +381,190 @@ def test_jax_train_step_feeds_step_histogram(hvd_metrics):
     assert after == before + 1
 
 
+def test_skew_section_and_prometheus_families():
+    """Straggler attribution plumbing: record_last_announce feeds the
+    snapshot's ungated "skew" section and the last_to_announce /
+    announce_total Prometheus families; reset clears it."""
+    from horovod_tpu.common.metrics import MetricsRegistry, prometheus_text
+
+    reg = MetricsRegistry()
+    reg.record_last_announce(2, 3)
+    reg.record_last_announce(0)
+    reg.observe("announce_skew_sec", 0.2)
+    snap = reg.snapshot()
+    assert snap["skew"] == {"count": 4,
+                            "last_to_announce": {"2": 3, "0": 1}}
+    assert snap["histograms"]["announce_skew_sec"]["count"] == 1
+    text = prometheus_text(snap)
+    assert 'hvd_tpu_last_to_announce_total{rank="2"} 3' in text
+    assert "hvd_tpu_announce_total 4" in text
+    assert "hvd_tpu_announce_skew_seconds_count 1" in text
+    reg.reset()
+    assert reg.snapshot()["skew"] == {"count": 0, "last_to_announce": {}}
+
+
+def _fully_populated_registry():
+    """One of everything, so every exposition family renders (shared by
+    the conformance test and mirroring tools/check_metric_names.py)."""
+    from horovod_tpu.common import metrics
+
+    reg = metrics.MetricsRegistry()
+    reg.record_enqueue("engine", "allreduce", 1024)
+    reg.record_enqueue("xla", "broadcast", 64)
+    reg.record_bytes_out("engine", 1024)
+    reg.record_batch(3)
+    reg.record_stall("conf.tensor", 1.0)
+    reg.record_fault("crash")
+    reg.record_abort("ranks_down")
+    reg.record_last_announce(1, 2)
+    reg.set_restart_epoch(1)
+    for name in metrics.HISTOGRAMS:
+        reg.observe(name, 0.001)
+    return reg
+
+
+def test_prometheus_exposition_conformance():
+    """Satellite: scrape /metrics and check exposition-format conformance
+    — # HELP/# TYPE pairing per family, metric-name charset, samples only
+    under declared families — and that every registry section
+    (ops/bytes/batches/stalls/faults/skew + every histogram) is
+    exposed."""
+    from horovod_tpu.common import metrics
+
+    reg = _fully_populated_registry()
+    port = metrics.start_monitor(0, snapshot_fn=reg.snapshot)
+    try:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    finally:
+        metrics.stop_monitor()
+        metrics.registry.disable()  # start_monitor enables the global one
+
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    helps, types, order = {}, {}, []
+    for i, line in enumerate(text.splitlines()):
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in helps, f"duplicate HELP for {name}"
+            helps[name] = i
+        elif line.startswith("# TYPE "):
+            parts = line.split()
+            name, kind = parts[2], parts[3]
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = i
+            order.append(name)
+            assert kind in ("counter", "gauge", "histogram"), line
+    # Pairing: every TYPE has a HELP immediately before it, and vice versa.
+    assert set(helps) == set(types), (set(helps) ^ set(types))
+    for name in order:
+        assert types[name] == helps[name] + 1, f"HELP/TYPE split for {name}"
+        assert name_re.match(name), name
+    # Samples belong to a declared family (histograms via their suffixes).
+    declared = set(types)
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        sample = line.split("{")[0].split(" ")[0]
+        assert name_re.match(sample), line
+        base = sample
+        for suffix in ("_bucket", "_sum", "_count"):
+            if sample.endswith(suffix) and sample[:-len(suffix)] in declared:
+                base = sample[:-len(suffix)]
+                break
+        assert base in declared, f"undeclared sample {sample}"
+    # Every registry section is exposed, PR-2 faults and skew included.
+    expected = {"hvd_tpu_ops_total", "hvd_tpu_bytes_total",
+                "hvd_tpu_batches_dispatched_total",
+                "hvd_tpu_fused_tensors_total",
+                "hvd_tpu_stall_events_total",
+                "hvd_tpu_stalled_tensor_total",
+                "hvd_tpu_faults_injected_total", "hvd_tpu_aborts_total",
+                "hvd_tpu_restart_epoch", "hvd_tpu_announce_total",
+                "hvd_tpu_last_to_announce_total"}
+    expected |= {metrics._prom_hist_name(h) for h in metrics.HISTOGRAMS}
+    assert expected <= declared, expected - declared
+    assert 'hvd_tpu_last_to_announce_total{rank="1"} 2' in text
+
+
+def test_check_metric_names_lint():
+    """Satellite: the metric-name lint (snake_case, hvd_tpu_ prefix, no
+    duplicate families) passes — run from tier-1 so drift fails CI."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               PYTHONPATH=repo + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools",
+                                      "check_metric_names.py")],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout, proc.stdout
+
+
+def test_check_metric_names_lint_detects_violations():
+    """The lint rejects camelCase, missing prefixes, and duplicates (a
+    lint that passes everything would let names drift silently)."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_names",
+        os.path.join(repo, "tools", "check_metric_names.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = ("# HELP hvd_tpu_camelCase_total x\n"
+           "# TYPE hvd_tpu_camelCase_total counter\n"
+           "hvd_tpu_camelCase_total 1\n"
+           "# HELP wrong_prefix_total x\n"
+           "# TYPE wrong_prefix_total counter\n"
+           "wrong_prefix_total 1\n"
+           "# HELP hvd_tpu_dup_total x\n"
+           "# TYPE hvd_tpu_dup_total counter\n"
+           "# HELP hvd_tpu_dup_total x\n"
+           "# TYPE hvd_tpu_dup_total counter\n"
+           "hvd_tpu_orphan_total 1\n")
+    errors = "\n".join(mod.lint(bad))
+    assert "camelCase" in errors
+    assert "wrong_prefix_total" in errors
+    assert "duplicate metric family 'hvd_tpu_dup_total'" in errors
+    assert "orphan" in errors
+
+
+def test_metrics_dump_stragglers_view(tmp_path):
+    """Satellite: `metrics_dump.py --stragglers` ranks ranks by
+    last_to_announce share and prints the skew histogram's p50/p99."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "metrics_dump", os.path.join(repo, "tools", "metrics_dump.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    reg = _fully_populated_registry()
+    reg.record_last_announce(3, 7)
+    for _ in range(5):
+        reg.observe("announce_skew_sec", 0.2)
+    path = tmp_path / "dump.json.0"
+    path.write_text(json.dumps(reg.snapshot()))
+    out = mod.render_stragglers(json.loads(path.read_text()))
+    assert "dominant straggler: rank 3" in out, out
+    assert "p50=" in out and "p99=" in out, out
+    # And via the CLI flag.
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "metrics_dump.py"),
+         "--stragglers", str(path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "dominant straggler: rank 3" in proc.stdout, proc.stdout
+
+
 def test_prometheus_text_pure():
     """prometheus_text renders a synthetic snapshot without an engine."""
     from horovod_tpu.common.metrics import (MetricsRegistry,

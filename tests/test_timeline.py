@@ -1,15 +1,26 @@
-"""Direct test of the engine's Chrome-tracing timeline (docs/timeline.md):
-run eager collectives in a fresh process with ``HVD_TPU_TIMELINE`` set,
-parse the output as JSON, and assert the NEGOTIATE -> op event nesting and
-non-decreasing timestamps.  (The XLA plane's timeline integration is
-covered by tests/test_xla_plane.py::test_xla_plane_timeline_activities;
-this covers the engine path itself, which previously had no direct test.)
-"""
+"""Timeline tests (docs/timeline.md): Chrome-trace structural validation
+for both data planes, per-rank trace files with clock-sync metadata, the
+span API (``hvd.trace_span`` / ``hvd.trace_marker``), the
+``tools/timeline_merge.py`` merge + straggler report, and post-mortem
+trace survival across a coordinated abort."""
 
+import importlib.util
 import json
 import os
 import subprocess
 import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_timeline_merge():
+    spec = importlib.util.spec_from_file_location(
+        "timeline_merge", os.path.join(REPO, "tools", "timeline_merge.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 _CHILD = """\
 import numpy as np
@@ -24,35 +35,67 @@ hvd.shutdown()
 """
 
 
-def _run_with_timeline(tmp_path):
-    path = str(tmp_path / "timeline.json")
-    env = dict(os.environ, HVD_TPU_TIMELINE=path, JAX_PLATFORMS="cpu")
-    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
-                "HVD_TPU_DATA"):
-        env.pop(var, None)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.run([sys.executable, "-c", _CHILD],
-                          capture_output=True, text=True, env=env,
-                          timeout=120)
-    assert proc.returncode == 0, proc.stderr[-2000:]
+def _load_trace(path):
     # The writer streams events with trailing commas and no closing "]"
     # (Chrome's parser tolerates it); normalize before json.loads.
     raw = open(path).read().rstrip().rstrip(",")
     return json.loads(raw + "]")
 
 
-def test_timeline_negotiate_op_nesting_and_timestamps(tmp_path):
-    events = _run_with_timeline(tmp_path)
-    assert events, "empty timeline"
+def _child_env(extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA", "HVD_TPU_TIMELINE", "HOROVOD_TIMELINE",
+                "HVD_TPU_FAULT_SPEC", "HVD_TPU_XLA_DATA_PLANE"):
+        env.pop(var, None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra or {})
+    return env
 
-    # pid metadata maps each trace row to its tensor name.
-    pid_names = {e["pid"]: e["args"]["name"]
-                 for e in events if e.get("ph") == "M"}
+
+def _run_child(code, env, timeout=180):
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc
+
+
+def validate_chrome_events(events):
+    """Satellite: structural Chrome-trace validation — the required keys
+    (``ph``, ``ts``, ``pid``, ``name``) on every row and non-decreasing
+    ``ts`` per row (one writer, one clock per file)."""
+    assert events, "empty timeline"
+    last_ts = {}
+    for e in events:
+        for key in ("ph", "ts", "pid", "name"):
+            assert key in e, (key, e)
+        pid = e["pid"]
+        assert e["ts"] >= last_ts.get(pid, 0), (e, last_ts.get(pid, 0))
+        last_ts[pid] = e["ts"]
+
+
+def _pid_names(events):
+    return {e["pid"]: e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+
+
+def test_timeline_negotiate_op_nesting_and_timestamps(tmp_path):
+    path = str(tmp_path / "timeline.json")
+    env = _child_env({"HVD_TPU_TIMELINE": path})
+    _run_child(_CHILD, env, timeout=120)
+    events = _load_trace(path)
+    validate_chrome_events(events)
+
+    # pid metadata maps each trace row to its tensor name, and the file
+    # carries its rank + clock-sync metadata for the merge tool.
+    pid_names = _pid_names(events)
     assert set(pid_names.values()) >= {"t0", "t1", "t2", "g0", "b0"}
+    metas = {e["name"] for e in events if e.get("ph") == "M"}
+    assert "hvd_rank" in metas and "hvd_clock_sync" in metas
 
     # Timestamps never decrease in file order (one writer, one clock).
-    ts = [e["ts"] for e in events if "ts" in e]
+    ts = [e["ts"] for e in events]
     assert ts == sorted(ts)
 
     by_name = {}
@@ -85,20 +128,251 @@ def test_timeline_negotiate_op_nesting_and_timestamps(tmp_path):
         assert closing.get("args", {}).get("bytes", 0) > 0, (name, closing)
 
 
+def test_timeline_structural_validation_xla_plane(tmp_path):
+    """Satellite: the same structural contract holds with the XLA data
+    plane active (``HVD_TPU_XLA_DATA_PLANE=1``) — plane execution rows
+    and engine ``__xp.*`` negotiation rows share one valid file."""
+    pytest.importorskip("jax")
+    path = str(tmp_path / "timeline_plane.json")
+    env = _child_env({"HVD_TPU_TIMELINE": path,
+                      "HVD_TPU_XLA_DATA_PLANE": "1"})
+    _run_child(_CHILD, env, timeout=240)
+    events = _load_trace(path)
+    validate_chrome_events(events)
+    names = {e.get("name") for e in events}
+    assert "XLA_ALLREDUCE" in names, names
+    for phase in ("BUCKET_BUILD", "XLA_DISPATCH", "DEVICE_WAIT"):
+        assert phase in names, names
+    rows = set(_pid_names(events).values())
+    assert "t0" in rows, rows
+
+
 def test_timeline_disabled_writes_nothing(tmp_path):
     """Without HVD_TPU_TIMELINE the engine must not create a file (the
     default path: timeline disabled, zero overhead)."""
     path = tmp_path / "no_timeline.json"
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.pop("HVD_TPU_TIMELINE", None)
-    env.pop("HOROVOD_TIMELINE", None)
-    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
-                "HVD_TPU_DATA"):
-        env.pop(var, None)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.run([sys.executable, "-c", _CHILD],
-                          capture_output=True, text=True, env=env,
-                          timeout=120)
-    assert proc.returncode == 0, proc.stderr[-2000:]
+    env = _child_env()
+    _run_child(_CHILD, env, timeout=120)
     assert not path.exists()
+
+
+# ---------------------------------------------------------------------------
+# Span API (hvd.trace_span / hvd.trace_marker).
+# ---------------------------------------------------------------------------
+
+_CHILD_SPANS = """\
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+assert hvd.timeline_enabled()
+with hvd.trace_span("data_loading"):
+    hvd.allreduce(np.ones(8, np.float32), name="s0")
+hvd.trace_marker("epoch_boundary")
+hvd.shutdown()
+"""
+
+
+def test_trace_span_and_marker_land_in_trace(tmp_path):
+    path = str(tmp_path / "spans.json")
+    env = _child_env({"HVD_TPU_TIMELINE": path})
+    _run_child(_CHILD_SPANS, env, timeout=120)
+    events = _load_trace(path)
+    validate_chrome_events(events)
+    rows = set(_pid_names(events).values())
+    assert "data_loading" in rows and "app.markers" in rows, rows
+    spans = [e for e in events if e.get("name") == "data_loading"
+             and e["ph"] in ("B", "E")]
+    assert [e["ph"] for e in spans] == ["B", "E"], spans
+    # The collective issued inside the span sits between its B and E.
+    s0_ts = [e["ts"] for e in events
+             if e.get("ph") in ("B", "E")
+             and _pid_names(events).get(e["pid"]) == "s0"]
+    assert s0_ts and spans[0]["ts"] <= s0_ts[0] <= spans[1]["ts"]
+    markers = [e for e in events
+               if e["ph"] == "i" and e["name"] == "epoch_boundary"]
+    assert markers, events
+
+
+def test_trace_span_noop_without_timeline():
+    """Spans/markers must be safe to leave in production code: no-ops (no
+    crash, no file) when no timeline is configured."""
+    import horovod_tpu as hvd
+
+    assert hvd.timeline_enabled() is False
+    with hvd.trace_span("x"):
+        pass
+    hvd.trace_marker("y")
+
+
+def test_keras_timeline_callback_noop_smoke():
+    """TimelineCallback hooks are callable (and no-ops) without an active
+    timeline — safe in production configs."""
+    pytest.importorskip("keras")
+    from horovod_tpu.keras.callbacks import TimelineCallback
+
+    cb = TimelineCallback(steps=True)
+    cb.on_epoch_begin(0)
+    cb.on_train_batch_begin(0)
+    cb.on_train_batch_end(0)
+    cb.on_epoch_end(0)
+
+
+# ---------------------------------------------------------------------------
+# Per-rank files + clock alignment + merge toolchain (tentpole acceptance).
+# ---------------------------------------------------------------------------
+
+
+def test_per_rank_timelines_merge_and_straggler_attribution(tmp_path):
+    """Acceptance: a 4-rank CPU job with a timeline directory and an
+    injected delay on rank 2 produces per-rank trace files that
+    tools/timeline_merge.py fuses into one valid Chrome/Perfetto JSON,
+    and BOTH the merge tool's straggler report and rank 0's
+    metrics_snapshot()["skew"] name rank 2 as the dominant
+    last-announcer."""
+    from horovod_tpu.runner import run_command
+
+    tl = str(tmp_path / "tl")
+    os.makedirs(tl)
+    code = (
+        "import numpy as np, horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "for i in range(6):\n"
+        "    hvd.allreduce(np.ones(32, np.float32), name=f'acc.{i}')\n"
+        "if hvd.rank() == 0:\n"
+        "    snap = hvd.metrics_snapshot()\n"
+        "    last = snap['skew']['last_to_announce']\n"
+        "    assert last, snap['skew']\n"
+        "    assert max(last, key=last.get) == '2', last\n"
+        "    assert snap['histograms']['announce_skew_sec']['count'] > 0\n"
+        "hvd.shutdown()\n"
+    )
+    # Delays on 4 of 6 collectives: rank 2 is deterministically last on
+    # those, which no other rank can match on the remaining negotiations.
+    spec = ";".join(f"rank=2:delay=0.2@op={i}" for i in (1, 2, 3, 4))
+    env = _child_env({"HVD_TPU_TIMELINE": tl, "HVD_TPU_FAULT_SPEC": spec})
+    results = run_command([sys.executable, "-c", code], 4, env=env,
+                          timeout=120.0, capture=True)
+    for r in results:
+        assert r.returncode == 0, (r.rank, r.stderr[-2000:])
+    files = sorted(n for n in os.listdir(tl) if n.startswith("rank"))
+    assert files == [f"rank{r}.json" for r in range(4)], files
+    # Every rank's file is independently valid, with clock metadata.
+    for name in files:
+        events = _load_trace(os.path.join(tl, name))
+        validate_chrome_events(events)
+        metas = {e["name"] for e in events if e.get("ph") == "M"}
+        assert "hvd_rank" in metas and "hvd_clock_sync" in metas, name
+
+    merged_path = str(tmp_path / "merged.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "timeline_merge.py"),
+         tl, "-o", merged_path],
+        capture_output=True, text=True, env=_child_env(), timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dominant straggler: rank 2" in proc.stdout, proc.stdout
+    assert "announce skew:" in proc.stdout, proc.stdout
+    merged = json.load(open(merged_path))  # complete, valid JSON
+    events = merged["traceEvents"]
+    procs = {e["args"]["name"] for e in events
+             if e.get("name") == "process_name"}
+    assert procs >= {f"rank {r}" for r in range(4)}, procs
+    # Offsets applied: timestamps were rebased onto one clock, and every
+    # rank contributed events.
+    contributing = {e["pid"] for e in events if e.get("ph") != "M"}
+    assert contributing >= set(range(4)), contributing
+
+
+def test_resolve_timeline_path_forms(tmp_path):
+    """HOROVOD_TIMELINE resolution: %d template, directory (existing or
+    trailing-sep), legacy plain file, and the restart-epoch suffix that
+    keeps a relaunch from truncating the crashed attempt's traces."""
+    from horovod_tpu.common import _resolve_timeline_path as resolve
+
+    d = str(tmp_path / "tl")
+    assert resolve("", 1) == ""
+    assert resolve(str(tmp_path / "t-%d.json"), 2) == \
+        str(tmp_path / "t-2.json")
+    assert resolve(d + os.sep, 1) == os.path.join(d, "rank1.json")
+    assert os.path.isdir(d)  # trailing-sep form creates the directory
+    assert resolve(d, 0) == os.path.join(d, "rank0.json")  # now existing
+    plain = str(tmp_path / "single.json")
+    assert resolve(plain, 0) == plain
+    assert resolve(plain, 1) == ""  # legacy: rank 0 only
+    # Restart epochs land in the filename for the per-rank forms.
+    assert resolve(d, 3, epoch=2) == os.path.join(d, "rank3.e2.json")
+    assert resolve(str(tmp_path / "t-%d.json"), 1, epoch=1) == \
+        str(tmp_path / "t-1.json.e1")
+
+
+def test_timeline_merge_prefers_latest_epoch(tmp_path):
+    """The merge tool's directory form keeps only the latest restart
+    epoch per rank, so two attempts never interleave in one trace."""
+    tm = _load_timeline_merge()
+    for name, rank in (("rank0.json", 0), ("rank0.e1.json", 0),
+                       ("rank1.e1.json", 1)):
+        (tmp_path / name).write_text(
+            '[\n{"name":"hvd_rank","ph":"M","ts":0,"pid":0,'
+            f'"args":{{"rank":{rank}}}}},\n')
+    files = tm.resolve_inputs([str(tmp_path)])
+    assert [os.path.basename(f) for f in files] == \
+        ["rank0.e1.json", "rank1.e1.json"], files
+
+
+def test_hvdrun_timeline_flag_writes_per_rank_files(tmp_path):
+    """`hvdrun --timeline DIR` wires HVD_TPU_TIMELINE per rank: one trace
+    file per rank appears under DIR."""
+    tl = str(tmp_path / "tl")
+    code = ("import numpy as np, horovod_tpu as hvd; hvd.init(); "
+            "hvd.allreduce(np.ones(4, np.float32), name='cli.0'); "
+            "hvd.shutdown()")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         "--timeline", tl, "--", sys.executable, "-c", code],
+        capture_output=True, text=True, env=_child_env(), timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    files = sorted(n for n in os.listdir(tl) if n.endswith(".json"))
+    assert files == ["rank0.json", "rank1.json"], files
+    for name in files:
+        validate_chrome_events(_load_trace(os.path.join(tl, name)))
+
+
+def test_timeline_survives_crash_abort(tmp_path):
+    """Satellite: a coordinated abort (``rank=1:crash``) leaves parseable
+    per-rank traces — the crashed rank flushes before dying, the
+    survivors flush on the abort path and close at shutdown."""
+    from horovod_tpu.common.faults import CRASH_EXIT_CODE
+    from horovod_tpu.runner import run_command
+
+    tl = str(tmp_path / "tl")
+    os.makedirs(tl)
+    code = (
+        "import numpy as np, horovod_tpu as hvd\n"
+        "from horovod_tpu.common import RanksDownError\n"
+        "hvd.init()\n"
+        "try:\n"
+        "    for i in range(4):\n"
+        "        hvd.allreduce(np.ones(8, np.float32), name=f'c.{i}')\n"
+        "    raise SystemExit(9)\n"  # survivors must NOT complete
+        "except RanksDownError:\n"
+        "    raise SystemExit(0)\n"
+    )
+    env = _child_env({"HVD_TPU_TIMELINE": tl,
+                      "HVD_TPU_FAULT_SPEC": "rank=1:crash@op=2",
+                      "HVD_TPU_KILL_GRACE_SEC": "3"})
+    results = run_command([sys.executable, "-c", code], 2, env=env,
+                          timeout=90.0, capture=True)
+    by_rank = {r.rank: r for r in results}
+    assert by_rank[1].returncode == CRASH_EXIT_CODE, by_rank[1]
+    assert by_rank[0].returncode == 0, by_rank[0].stderr[-2000:]
+    # The survivor shut down cleanly: its file must parse strictly.  The
+    # crashed rank's file goes through the merge tool's salvaging loader
+    # (an ofstream auto-flush can tear its final line), and must still
+    # yield a valid, non-empty event stream.
+    validate_chrome_events(_load_trace(os.path.join(tl, "rank0.json")))
+    salvage = _load_timeline_merge().load_events
+    validate_chrome_events(salvage(os.path.join(tl, "rank1.json")))
+    # The survivor traced the collectives that completed before the abort.
+    rows = set(_pid_names(
+        _load_trace(os.path.join(tl, "rank0.json"))).values())
+    assert "c.0" in rows, rows

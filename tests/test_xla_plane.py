@@ -303,9 +303,10 @@ def test_xla_plane_timeline_activities():
     for phase in ("BUCKET_BUILD", "XLA_DISPATCH", "DEVICE_WAIT"):
         assert phase in names, names
     assert "NEGOTIATE" in names  # engine rows (__xp.*) share the file
-    # Plane rows are per REAL tensor name.
+    # Plane rows are per REAL tensor name.  (Filter to process_name rows:
+    # the file also carries hvd_rank / hvd_clock_sync metadata now.)
     pid_names = {e["args"]["name"] for e in events
-                 if e.get("ph") == "M" and "args" in e}
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
     assert "tlp.0" in pid_names and "__xp.tlp.0" in pid_names, pid_names
     os.unlink(path)
 

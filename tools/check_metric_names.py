@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Lint the Prometheus metric names exposed by the collective metrics
+registry (horovod_tpu/common/metrics.py): every family must be
+snake_case, carry the ``hvd_tpu_`` prefix, pair a ``# HELP`` with its
+``# TYPE``, and be unique across registry sections — so new metrics can't
+silently drift from the naming convention.  Runs against a registry with
+one of everything recorded, so every family actually renders.
+
+Tier-1 runs it (tests/test_metrics.py::test_check_metric_names_lint);
+standalone:
+
+    python tools/check_metric_names.py
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from collections import Counter
+
+NAME_RE = re.compile(r"^hvd_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
+HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def populated_registry():
+    """A registry with at least one sample in every section, so the
+    exposition renders every family the code can produce."""
+    from horovod_tpu.common import metrics
+
+    reg = metrics.MetricsRegistry()
+    reg.record_enqueue("engine", "allreduce", 1024)
+    reg.record_bytes_out("engine", 1024)
+    reg.record_batch(2)
+    reg.record_stall("lint.tensor", 1.0)
+    reg.record_fault("crash")
+    reg.record_abort("ranks_down")
+    reg.record_last_announce(1, 2)
+    reg.set_restart_epoch(1)
+    for name in metrics.HISTOGRAMS:
+        reg.observe(name, 0.001)
+    return reg
+
+
+def lint(text: str) -> list:
+    """Return the list of naming-convention violations in a Prometheus
+    text exposition (empty = clean)."""
+    errors = []
+    helps = []
+    families = []
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            helps.append(line.split()[2])
+        elif line.startswith("# TYPE "):
+            families.append(line.split()[2])
+        elif line.startswith("#"):
+            errors.append(f"unexpected comment line: {line!r}")
+    for name in families:
+        if not NAME_RE.match(name):
+            errors.append(
+                f"metric family '{name}' violates the naming convention "
+                f"(snake_case with hvd_tpu_ prefix)")
+        if name not in helps:
+            errors.append(f"metric family '{name}' has # TYPE but no "
+                          f"# HELP")
+    for name in helps:
+        if name not in families:
+            errors.append(f"metric family '{name}' has # HELP but no "
+                          f"# TYPE")
+    for name, n in Counter(families).items():
+        if n > 1:
+            errors.append(
+                f"duplicate metric family '{name}': two registry sections "
+                f"export the same name")
+    declared = set(families)
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        sample = line.split("{")[0].split(" ")[0]
+        base = sample
+        for suffix in HIST_SUFFIXES:
+            if sample.endswith(suffix) and sample[:-len(suffix)] in declared:
+                base = sample[:-len(suffix)]
+                break
+        if base not in declared:
+            errors.append(f"sample '{sample}' has no # TYPE declaration")
+    return errors
+
+
+def main() -> int:
+    from horovod_tpu.common import metrics
+
+    text = metrics.prometheus_text(populated_registry().snapshot())
+    errors = lint(text)
+    for err in errors:
+        print(f"check_metric_names: {err}", file=sys.stderr)
+    if not errors:
+        n = len([l for l in text.splitlines() if l.startswith("# TYPE ")])
+        print(f"check_metric_names: OK ({n} metric families)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,12 +7,18 @@ hvd.metrics_snapshot() returns.
 
     python tools/metrics_dump.py run.json.0            # one dump
     python tools/metrics_dump.py before.json.0 after.json.0   # diff (B - A)
+    python tools/metrics_dump.py --stragglers run.json.0      # skew view
 
 Prints the per-op table (ops and bytes per data plane), fusion-batch
 counters, stall events, and per-histogram count/mean/p50/p99 estimated
 from the fixed buckets (linear interpolation inside the bucket, the
 standard Prometheus histogram_quantile estimate) — made for BENCH_* round
 analysis next to bench.py's throughput numbers.
+
+``--stragglers`` renders the straggler view instead: ranks ordered by
+their share of ``last_to_announce`` (the coordinator's announce-order
+accounting — use rank 0's dump) plus the announce-skew histogram's
+estimated p50/p99 (docs/troubleshooting.md "Diagnosing stragglers").
 """
 
 from __future__ import annotations
@@ -124,6 +130,27 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
         or "none"))
     lines.append("; ".join(parts))
 
+    # Announce-order skew (coordinator dumps; .get keeps older dumps
+    # readable).  Full detail lives behind --stragglers.
+    skew = snap.get("skew", {})
+    counts = dict(skew.get("last_to_announce", {}))
+    if base:
+        for k, v in (base or {}).get("skew", {}).get(
+                "last_to_announce", {}).items():
+            counts[k] = counts.get(k, 0) - v
+    lines.append("== skew ==")
+    nonzero = {k: v for k, v in counts.items() if v}
+    if nonzero:
+        worst = max(nonzero, key=nonzero.get)
+        lines.append(f"negotiations {sum(nonzero.values())}; "
+                     f"last_to_announce " +
+                     ", ".join(f"rank{k}x{v}"
+                               for k, v in sorted(nonzero.items())) +
+                     f"; dominant rank {worst}")
+    else:
+        lines.append("(no negotiations recorded — single rank, or not the "
+                     "coordinator's dump)")
+
     lines.append("== histograms ==")
     lines.append(f"{'name':<18}{'count':>8}{'mean':>10}{'p50':>10}"
                  f"{'p99':>10}")
@@ -144,12 +171,51 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
     return "\n".join(lines)
 
 
+def render_stragglers(snap: dict) -> str:
+    """The --stragglers view: ranks by last_to_announce share plus the
+    announce-skew histogram's estimated p50/p99."""
+    lines = ["== stragglers (last_to_announce share, coordinator view) =="]
+    counts = {int(k): v for k, v in
+              snap.get("skew", {}).get("last_to_announce", {}).items()}
+    total = sum(counts.values())
+    if not total:
+        lines.append("(no negotiations recorded — single rank, or not the "
+                     "coordinator's dump; use rank 0's file)")
+    else:
+        lines.append(f"{'rank':<6}{'last':>8}{'share':>9}")
+        for r, n in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])):
+            lines.append(f"{r:<6}{n:>8}{100.0 * n / total:>8.1f}%")
+        worst = max(counts, key=counts.get)
+        lines.append(f"dominant straggler: rank {worst} "
+                     f"({100.0 * counts[worst] / total:.1f}% of "
+                     f"{total} negotiations)")
+    hist = snap.get("histograms", {}).get("announce_skew_sec")
+    if hist and hist.get("count"):
+        lines.append(f"announce skew: n={hist['count']} "
+                     f"p50={_fmt_sec(quantile(hist, 0.5))} "
+                     f"p99={_fmt_sec(quantile(hist, 0.99))}")
+    else:
+        lines.append("announce skew: (empty histogram)")
+    return "\n".join(lines)
+
+
 def main(argv) -> int:
+    argv = list(argv)
+    stragglers = "--stragglers" in argv
+    if stragglers:
+        argv.remove("--stragglers")
     if len(argv) not in (2, 3) or argv[1] in ("-h", "--help"):
         print(__doc__)
         return 2
+    if stragglers and len(argv) != 2:
+        print("--stragglers takes a single dump (the coordinator's, "
+              "rank 0)", file=sys.stderr)
+        return 2
     with open(argv[1]) as f:
         a = json.load(f)
+    if stragglers:
+        print(render_stragglers(a))
+        return 0
     if len(argv) == 3:
         with open(argv[2]) as f:
             b = json.load(f)

@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Merge per-rank Horovod-TPU timeline files into one Perfetto/Chrome
+trace and report stragglers (docs/timeline.md).
+
+Per-rank files come from the directory / ``%d`` forms of
+``HOROVOD_TIMELINE`` or from ``hvdrun --timeline DIR``.  Each rank's
+events become one process group in the merged trace (pid = rank, one
+thread row per tensor/span), with the coordinator's NTP-style clock
+offsets — the ``hvd_clock_sync`` metadata every rank records at init —
+subtracted so all timestamps land on rank 0's clock.
+
+    python tools/timeline_merge.py /tmp/tl -o merged.json
+    python tools/timeline_merge.py /tmp/tl/rank0.json /tmp/tl/rank1.json
+
+The straggler report (stdout; ``--no-report`` to skip) reads rank 0's
+NEGOTIATE rows: per-tensor announce order (RANK_READY instants), which
+rank announced last and by how many µs, and p50/p99 of the first->last
+skew distribution.  Crash-truncated files are salvaged by dropping the
+torn tail, so post-mortem traces from aborted jobs merge too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from collections import Counter
+from typing import List, Optional, Tuple
+
+
+def load_events(path: str) -> list:
+    """Parse one timeline file.  The writer streams events with trailing
+    commas and no closing ``]`` (Chrome tolerates it); normalize, and on a
+    torn tail (a rank crashed mid-write) drop lines until it parses."""
+    with open(path) as f:
+        raw = f.read()
+    lines = raw.rstrip().splitlines()
+    while lines:
+        body = "\n".join(lines).rstrip().rstrip(",")
+        if body in ("", "["):
+            return []
+        try:
+            return json.loads(body + "]")
+        except json.JSONDecodeError:
+            lines.pop()
+    return []
+
+
+def trace_meta(events: list) -> Tuple[Optional[int], int, int]:
+    """(rank, clock_offset_us, clock_rtt_us) from a file's metadata
+    events; rank None / offset 0 when absent (pre-clock-sync traces)."""
+    rank, offset, rtt = None, 0, 0
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "hvd_rank":
+            rank = int(e.get("args", {}).get("rank", 0))
+        elif e.get("name") == "hvd_clock_sync":
+            args = e.get("args", {})
+            offset = int(args.get("offset_us", 0))
+            rtt = int(args.get("rtt_us", 0))
+    return rank, offset, rtt
+
+
+_RANK_FILE_RE = re.compile(r"^rank(\d+)(?:\.e(\d+))?\.json$")
+
+
+def resolve_inputs(paths: List[str]) -> List[str]:
+    """Expand a single directory argument to its trace files.  A job run
+    under ``--max-restarts`` leaves one file per (rank, restart epoch)
+    — ``rank<N>.json``, ``rank<N>.e1.json``, ... — so the directory form
+    keeps only the LATEST epoch per rank (merging two attempts of the
+    same rank into one trace would interleave unrelated runs); pass
+    explicit files to merge an earlier attempt's post-mortem traces."""
+    if len(paths) == 1 and os.path.isdir(paths[0]):
+        per_rank = {}
+        others = []
+        for name in sorted(os.listdir(paths[0])):
+            if not name.endswith(".json"):
+                continue
+            m = _RANK_FILE_RE.match(name)
+            if not m:
+                others.append(name)
+                continue
+            rank, epoch = int(m.group(1)), int(m.group(2) or 0)
+            kept = per_rank.get(rank)
+            if kept is None or epoch > kept[0]:
+                per_rank[rank] = (epoch, name)
+        skipped = sum(
+            1 for name in sorted(os.listdir(paths[0]))
+            if name.endswith(".json") and _RANK_FILE_RE.match(name)
+            and name not in {v[1] for v in per_rank.values()})
+        if skipped:
+            print(f"timeline_merge: note: {skipped} earlier-epoch file(s) "
+                  f"in {paths[0]} skipped (pass them explicitly to merge "
+                  f"a previous attempt)")
+        files = [os.path.join(paths[0], v[1])
+                 for _, v in sorted(per_rank.items())]
+        files += [os.path.join(paths[0], n) for n in others]
+        if not files:
+            raise SystemExit(f"timeline_merge: no .json files in {paths[0]}")
+        return files
+    return paths
+
+
+def merge(files: List[str]):
+    """Fuse per-rank files: one process group per rank, offsets applied.
+    Returns (merged_events, per_rank_events keyed by rank)."""
+    merged = []
+    by_rank = {}
+    for path in files:
+        events = load_events(path)
+        rank, offset, _ = trace_meta(events)
+        if rank is None:
+            m = re.search(r"rank(\d+)", os.path.basename(path))
+            rank = int(m.group(1)) if m else len(by_rank)
+        by_rank[rank] = events
+        merged.append({"name": "process_name", "ph": "M", "ts": 0,
+                       "pid": rank, "args": {"name": f"rank {rank}"}})
+        for e in events:
+            if e.get("ph") == "M":
+                if e.get("name") == "process_name":
+                    # Tensor-row label becomes a thread name inside this
+                    # rank's process group.
+                    merged.append({"name": "thread_name", "ph": "M",
+                                   "ts": 0, "pid": rank, "tid": e["pid"],
+                                   "args": dict(e.get("args", {}))})
+                continue  # hvd_rank / hvd_clock_sync: consumed above
+            out = dict(e)
+            out["pid"] = rank
+            out["tid"] = e.get("pid", 0)
+            out["ts"] = int(e.get("ts", 0)) - offset
+            merged.append(out)
+    # Rebase so the earliest event sits at ts 0 (offset-corrected worker
+    # events may precede rank 0's epoch), then order by time.
+    timed = [e["ts"] for e in merged if e.get("ph") != "M"]
+    base = min(timed) if timed else 0
+    for e in merged:
+        if e.get("ph") != "M":
+            e["ts"] -= base
+    merged.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
+                               e.get("ts", 0)))
+    return merged, by_rank
+
+
+def negotiations(rank0_events: list) -> List[Tuple[str, int, int, list]]:
+    """Per-negotiation (tensor, last_rank, skew_us, announce_order) from
+    the coordinator's NEGOTIATE rows: the RANK_READY instants between a
+    NEGOTIATE B and its E carry each rank's announce, in order."""
+    pid_names = {e["pid"]: e.get("args", {}).get("name", "")
+                 for e in rank0_events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+    open_neg = {}
+    out = []
+    for e in rank0_events:
+        ph, pid = e.get("ph"), e.get("pid")
+        if ph == "B" and e.get("name") == "NEGOTIATE":
+            open_neg[pid] = []
+        elif (ph == "i" and e.get("name") == "RANK_READY"
+              and pid in open_neg):
+            open_neg[pid].append((int(e.get("ts", 0)),
+                                  e.get("args", {}).get("rank")))
+        elif ph == "E" and e.get("name") == "NEGOTIATE" and pid in open_neg:
+            readies = open_neg.pop(pid)
+            if readies:
+                first_ts = readies[0][0]
+                last_ts, last_rank = readies[-1]
+                out.append((pid_names.get(pid, f"pid{pid}"), last_rank,
+                            last_ts - first_ts, [r for _, r in readies]))
+    return out
+
+
+def _fmt_us(us: float) -> str:
+    if us >= 1e6:
+        return f"{us / 1e6:.2f}s"
+    if us >= 1e3:
+        return f"{us / 1e3:.1f}ms"
+    return f"{us:.0f}us"
+
+
+def _pct(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return float(sorted_vals[idx])
+
+
+def render_report(negs: List[Tuple[str, int, int, list]],
+                  top_tensors: int = 10) -> str:
+    lines = ["== straggler report (rank-0 coordinator announce order) =="]
+    if not negs:
+        lines.append("(no NEGOTIATE rows found — was a rank-0/coordinator "
+                     "trace among the inputs?)")
+        return "\n".join(lines)
+    lines.append(f"negotiations: {len(negs)}")
+    last_counts = Counter(last for _, last, _, _ in negs
+                          if last is not None)
+    total = sum(last_counts.values()) or 1
+    lines.append(f"{'rank':<6}{'last_count':>12}{'share':>9}")
+    ranked = last_counts.most_common()
+    for rank, n in ranked:
+        lines.append(f"{rank:<6}{n:>12}{100.0 * n / total:>8.1f}%")
+    if ranked:
+        rank, n = ranked[0]
+        lines.append(f"dominant straggler: rank {rank} "
+                     f"({100.0 * n / total:.1f}% of last announces)")
+    skews = sorted(skew for _, _, skew, _ in negs)
+    lines.append(f"announce skew: p50={_fmt_us(_pct(skews, 0.5))} "
+                 f"p99={_fmt_us(_pct(skews, 0.99))} "
+                 f"max={_fmt_us(skews[-1])}")
+    lines.append(f"worst tensors (top {min(top_tensors, len(negs))} by "
+                 f"skew):")
+    for name, last, skew, order in sorted(
+            negs, key=lambda t: -t[2])[:top_tensors]:
+        lines.append(f"  {name}: last=rank {last}, skew={_fmt_us(skew)}, "
+                     f"announce order {order}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="timeline_merge",
+        description="Merge per-rank HOROVOD_TIMELINE files into one "
+                    "Perfetto/Chrome trace and report stragglers.")
+    parser.add_argument("inputs", nargs="+",
+                        help="a timeline directory, or the per-rank files")
+    parser.add_argument("-o", "--output", default="timeline_merged.json",
+                        help="merged trace path (default "
+                             "timeline_merged.json)")
+    parser.add_argument("--no-report", action="store_true",
+                        help="skip the straggler report")
+    args = parser.parse_args(argv)
+
+    files = resolve_inputs(args.inputs)
+    # Writing the merged file into the timeline directory must not feed
+    # it back into a later merge.
+    out_abs = os.path.abspath(args.output)
+    files = [f for f in files if os.path.abspath(f) != out_abs]
+    merged, by_rank = merge(files)
+    with open(args.output, "w") as f:
+        json.dump({"traceEvents": merged}, f)
+        f.write("\n")
+    print(f"timeline_merge: wrote {len(merged)} events from "
+          f"{len(files)} rank file(s) to {args.output}")
+    if not args.no_report:
+        coordinator = by_rank.get(0) or next(iter(by_rank.values()), [])
+        print(render_report(negotiations(coordinator)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
