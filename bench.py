@@ -17,9 +17,12 @@ Extra errors are clipped to one short line.  BENCH_EXTRA=0 disables,
 BENCH_EXTRA_CONFIGS="seq:batch,..." overrides the sweep.
 
 Env knobs: BENCH_MODEL (resnet101|resnet50|resnet18|vgg16|inception_v3|
-mnist|transformer|allreduce|scaling), BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
+mnist|transformer|allreduce|small_allreduce|scaling), BENCH_BATCH,
+BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
 length); transformer adds BENCH_SEQ/BENCH_VOCAB/BENCH_D_MODEL/BENCH_LAYERS/
-BENCH_HEADS; allreduce adds BENCH_NP/BENCH_BYTES/BENCH_ITERS.
+BENCH_HEADS; allreduce adds BENCH_NP/BENCH_BYTES/BENCH_ITERS;
+small_allreduce (the negotiation-bound cache microbench) adds
+BENCH_NP/BENCH_TENSORS/BENCH_STEPS.
 """
 
 from __future__ import annotations
@@ -293,6 +296,98 @@ if hvd.rank() == 0:
     print(json.dumps(record))
 
 
+def bench_small_allreduce() -> None:
+    """Negotiation-bound microbench (docs/performance.md): BENCH_TENSORS
+    tiny named allreduces repeated steady-state for BENCH_STEPS steps over
+    BENCH_NP local ranks.  The payload is 32 bytes, so throughput here is
+    pure control plane: coordinator roundtrips, string (de)serialization,
+    and the engine tick — exactly what the response cache and adaptive
+    tick attack.  Runs twice (cache on, then HVD_TPU_RESPONSE_CACHE=0) and
+    folds the comparison, rank 0's cache hit/miss counters, and the
+    negotiation_sec p50 into extra_metrics."""
+    import subprocess
+    import sys
+
+    # 256 tensors/step puts the run squarely in the regime the cache
+    # targets: with a handful of tensors the frame round trip dominates
+    # and cache on/off measure within noise of each other.
+    np_ = int(os.environ.get("BENCH_NP", "4"))
+    tensors = int(os.environ.get("BENCH_TENSORS", "256"))
+    steps = int(os.environ.get("BENCH_STEPS", "50"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = f"""
+import json, sys, time, numpy as np, horovod_tpu as hvd
+sys.path.insert(0, {repo!r})
+from tools.metrics_dump import quantile
+hvd.init()
+K, S = {tensors}, {steps}
+# Realistic gradient-style names: string volume on the wire and at the
+# coordinator is what the cache removes, and production tensor names are
+# long ("model/layer_42/attention/query/kernel_grad"), not "t3".
+names = [f"model.layer_{{k:04d}}.attention.query.kernel.grad"
+         for k in range(K)]
+xs = [np.ones(8, np.float32) for _ in range(K)]
+def step():
+    hs = [hvd.allreduce_async(xs[k], average=False, name=names[k])
+          for k in range(K)]
+    for h in hs:
+        h.wait()
+step()  # warm: full negotiation populates the cache
+t0 = time.perf_counter()
+for s in range(S - 1):
+    step()
+dt = time.perf_counter() - t0
+if hvd.rank() == 0:
+    snap = hvd.metrics_snapshot()
+    p50 = quantile(snap["histograms"]["negotiation_sec"], 0.5)
+    print("SMALL_JSON " + json.dumps({{
+        "ops_per_sec": K * (S - 1) / dt,
+        "cache": snap["cache"]["engine"],
+        "negotiation_p50_us": round((p50 or 0.0) * 1e6, 1),
+    }}), flush=True)
+"""
+
+    def run(cache_on: bool) -> dict:
+        env = dict(os.environ,
+                   PYTHONPATH=repo + os.pathsep +
+                   os.environ.get("PYTHONPATH", ""),
+                   HVD_TPU_RESPONSE_CACHE="1" if cache_on else "0")
+        env.setdefault("HVD_TPU_METRICS", "1")
+        # A tight idle cycle keeps the (cache-independent) co-arrival
+        # alignment window from drowning the negotiation-work delta this
+        # bench exists to measure; override to probe other regimes.
+        env.setdefault("HVD_TPU_CYCLE_TIME_MS", "1")
+        out = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
+             "--", sys.executable, "-c", code],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return next(json.loads(line[len("SMALL_JSON "):])
+                    for line in out.stdout.splitlines()
+                    if line.startswith("SMALL_JSON "))
+
+    on = run(True)
+    off = run(False)
+    hits, misses = on["cache"]["hits"], on["cache"]["misses"]
+    record = {
+        "metric": f"small_allreduce_ops_per_sec_np{np_}",
+        "value": round(on["ops_per_sec"], 1),
+        "unit": "ops/sec",
+        "vs_baseline": None,  # the reference published no such number
+        "extra_metrics": {
+            "cache_off_ops_per_sec": round(off["ops_per_sec"], 1),
+            "cache_speedup": round(on["ops_per_sec"]
+                                   / max(off["ops_per_sec"], 1e-9), 3),
+            "cache_hits": hits,
+            "cache_misses": misses,
+            "cache_hit_rate": round(hits / max(hits + misses, 1), 4),
+            "negotiation_p50_us_cached": on["negotiation_p50_us"],
+            "negotiation_p50_us_uncached": off["negotiation_p50_us"],
+        },
+    }
+    print(json.dumps(record))
+
+
 def main() -> None:
     import jax
 
@@ -313,6 +408,8 @@ def main() -> None:
         return bench_transformer()
     if model_name == "allreduce":
         return bench_allreduce()
+    if model_name == "small_allreduce":
+        return bench_small_allreduce()
     if model_name == "scaling":
         return bench_scaling()
     batch = int(os.environ.get("BENCH_BATCH", "64"))

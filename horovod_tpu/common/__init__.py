@@ -29,6 +29,10 @@ from horovod_tpu.common.config import Config
 OP_ALLREDUCE = 0
 OP_ALLGATHER = 1
 OP_BROADCAST = 2
+# Negotiation-only (no data moves): the XLA plane's metadata-cache fast
+# path replays a verified cross-rank agreement through this op to keep
+# the global dispatch order without the metadata allreduce.
+OP_NOOP = 3
 
 # Status codes (engine/cc/wire.h StatusCode).
 ST_OK = 0
@@ -92,6 +96,9 @@ _engine_aborts_seen = 0
 # last-to-announce counts read from the engine.
 _engine_announces_seen = 0
 _engine_last_announce_seen: list = []
+# Response-cache sync state (docs/performance.md): engine-cumulative
+# hit/miss/eviction counts already folded into the registry.
+_engine_cache_seen = [0, 0, 0]
 # Deterministic fault injection (common/faults.py, HVD_TPU_FAULT_SPEC):
 # the injector for this (rank, restart epoch), or None; and the per-process
 # submission index of user-level collectives it is driven by.
@@ -117,7 +124,7 @@ def _load_lib():
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_double,
             ctypes.c_longlong, ctypes.c_double, ctypes.c_char_p,
-            ctypes.c_int, ctypes.c_double]
+            ctypes.c_int, ctypes.c_double, ctypes.c_longlong]
         lib.hvd_tpu_init_error.restype = ctypes.c_char_p
         lib.hvd_tpu_enqueue.restype = ctypes.c_longlong
         lib.hvd_tpu_enqueue.argtypes = [
@@ -136,6 +143,8 @@ def _load_lib():
         lib.hvd_tpu_completion_seq.argtypes = [ctypes.c_longlong]
         lib.hvd_tpu_completion_tick.restype = ctypes.c_longlong
         lib.hvd_tpu_completion_tick.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_negotiation_us.restype = ctypes.c_longlong
+        lib.hvd_tpu_negotiation_us.argtypes = [ctypes.c_longlong]
         lib.hvd_tpu_ticks_done.restype = ctypes.c_longlong
         lib.hvd_tpu_ticks_done.argtypes = []
         lib.hvd_tpu_result_nbytes.restype = ctypes.c_longlong
@@ -168,6 +177,14 @@ def _load_lib():
         lib.hvd_tpu_announce_log.argtypes = []
         lib.hvd_tpu_last_announce_counts.restype = ctypes.c_char_p
         lib.hvd_tpu_last_announce_counts.argtypes = []
+        lib.hvd_tpu_cache_hit_count.restype = ctypes.c_longlong
+        lib.hvd_tpu_cache_hit_count.argtypes = []
+        lib.hvd_tpu_cache_miss_count.restype = ctypes.c_longlong
+        lib.hvd_tpu_cache_miss_count.argtypes = []
+        lib.hvd_tpu_cache_eviction_count.restype = ctypes.c_longlong
+        lib.hvd_tpu_cache_eviction_count.argtypes = []
+        lib.hvd_tpu_cache_size.restype = ctypes.c_longlong
+        lib.hvd_tpu_cache_size.argtypes = []
         lib.hvd_tpu_timeline_enabled.restype = ctypes.c_int
         lib.hvd_tpu_timeline_op_start.argtypes = [ctypes.c_char_p,
                                                   ctypes.c_char_p]
@@ -234,7 +251,7 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
         (ps.coord_endpoint or "").encode(), data.encode(),
         cfg.cycle_time_ms, cfg.fusion_threshold, cfg.stall_warning_sec,
         timeline.encode(), int(cfg.hierarchical_allreduce),
-        cfg.collective_timeout_sec)
+        cfg.collective_timeout_sec, cfg.effective_cache_capacity)
     if rc != 0:
         raise HorovodInternalError(
             "engine initialization failed: "
@@ -498,6 +515,31 @@ def _sync_engine_announces() -> None:
             metrics.registry.observe("announce_skew_sec", skew_sec)
 
 
+def _sync_engine_cache() -> None:
+    """Fold the engine's response-cache counters (C++, cumulative) into
+    the registry's ``"cache"`` section.  Consumes only unseen events, like
+    the stall sync, so snapshots never double-count and the cache size
+    gauge always reflects the engine's current entry count."""
+    if _lib is None:
+        return
+    with _stall_sync_lock:
+        counts = (int(_lib.hvd_tpu_cache_hit_count()),
+                  int(_lib.hvd_tpu_cache_miss_count()),
+                  int(_lib.hvd_tpu_cache_eviction_count()))
+        for kind, total, seen_idx in (("hits", counts[0], 0),
+                                      ("misses", counts[1], 1),
+                                      ("evictions", counts[2], 2)):
+            new = total - _engine_cache_seen[seen_idx]
+            if new > 0:
+                metrics.registry.record_cache("engine", kind, new)
+            _engine_cache_seen[seen_idx] = total
+        metrics.registry.set_cache_size("engine",
+                                        int(_lib.hvd_tpu_cache_size()))
+        meta = getattr(_xla_plane, "_meta_cache", None)
+        if meta is not None:
+            metrics.registry.set_cache_size("xla", len(meta))
+
+
 def metrics_snapshot() -> dict:
     """Plain nested dict of the collective metrics registry: op/byte
     counters per data plane, fusion-batch counters, latency/fill
@@ -509,6 +551,7 @@ def metrics_snapshot() -> dict:
     _sync_engine_stalls()
     _sync_engine_aborts()
     _sync_engine_announces()
+    _sync_engine_cache()
     return metrics.registry.snapshot()
 
 
@@ -519,6 +562,7 @@ def metrics_reset() -> None:
     _sync_engine_stalls()
     _sync_engine_aborts()
     _sync_engine_announces()
+    _sync_engine_cache()
     metrics.registry.reset()
 
 
@@ -627,6 +671,14 @@ class Handle:
                 _lib.hvd_tpu_completion_tick(self._raw))
             self.completion_seq = int(
                 _lib.hvd_tpu_completion_seq(self._raw))
+            if self._t0:
+                # Engine-plane negotiation latency (enqueue -> agreed
+                # response), stamped by the engine thread — the number the
+                # response cache exists to shrink (docs/performance.md).
+                neg_us = int(_lib.hvd_tpu_negotiation_us(self._raw))
+                if neg_us >= 0:
+                    metrics.registry.observe("negotiation_sec",
+                                             neg_us / 1e6)
             if self._op == OP_ALLGATHER:
                 nbytes = int(_lib.hvd_tpu_result_nbytes(self._raw))
                 dim0 = _lib.hvd_tpu_result_dim0(self._raw)

@@ -69,6 +69,20 @@ class Config:
     # run, +1 per relaunch).  Read by checkpoint-resume glue and gates
     # fault clauses without an explicit epoch=N to the first run.
     restart_epoch: int = 0
+    # Negotiation response cache (docs/performance.md): once a collective
+    # has been fully negotiated, every rank replays the agreement from a
+    # compact slot index instead of re-serializing string requests (and
+    # the XLA plane skips its `__xp.*` metadata allreduce entirely).
+    # HVD_TPU_RESPONSE_CACHE=0 is the kill switch; HVD_TPU_CACHE_CAPACITY
+    # bounds the per-rank entry count (LRU eviction past it).
+    response_cache: bool = True
+    cache_capacity: int = 1024
+
+    @property
+    def effective_cache_capacity(self) -> int:
+        """Slots the engine is told to keep: 0 (disabled) when the kill
+        switch is thrown, else the configured capacity."""
+        return self.cache_capacity if self.response_cache else 0
 
     @property
     def metrics_enabled(self) -> bool:
@@ -78,7 +92,12 @@ class Config:
     @staticmethod
     def from_env() -> "Config":
         fusion = _get("HVD_TPU_FUSION_THRESHOLD", "HOROVOD_FUSION_THRESHOLD")
-        cycle = _get("HVD_TPU_CYCLE_TIME", "HOROVOD_CYCLE_TIME")
+        # HVD_TPU_CYCLE_TIME_MS is the documented spelling (the idle-tick
+        # floor of the adaptive engine loop, docs/performance.md); the
+        # older unsuffixed names still work.
+        cycle = os.environ.get(
+            "HVD_TPU_CYCLE_TIME_MS",
+            _get("HVD_TPU_CYCLE_TIME", "HOROVOD_CYCLE_TIME"))
         stall = _get("HVD_TPU_STALL_WARNING_SEC", "HOROVOD_STALL_WARNING_SEC")
         timeline = _get("HVD_TPU_TIMELINE", "HOROVOD_TIMELINE")
         return Config(
@@ -101,4 +120,8 @@ class Config:
             fault_spec=os.environ.get("HVD_TPU_FAULT_SPEC", ""),
             restart_epoch=int(os.environ.get(
                 "HVD_TPU_RESTART_EPOCH") or 0),
+            response_cache=_flag(os.environ.get(
+                "HVD_TPU_RESPONSE_CACHE", "1")),
+            cache_capacity=int(os.environ.get(
+                "HVD_TPU_CACHE_CAPACITY") or 1024),
         )

@@ -46,8 +46,8 @@ FILL_BUCKETS: Tuple[float, ...] = tuple((i + 1) / 10 for i in range(10))
 # name -> (bucket bounds, what it measures).  All durations in seconds.
 HISTOGRAMS = {
     "negotiation_sec": (LATENCY_BUCKETS,
-                        "XLA-plane control-plane negotiation wait "
-                        "(enqueue -> completion stamp)"),
+                        "control-plane negotiation wait "
+                        "(enqueue -> agreed response), both planes"),
     "residency_sec": (LATENCY_BUCKETS,
                       "XLA-plane queue/bucket residency "
                       "(negotiated -> dispatched)"),
@@ -126,6 +126,13 @@ class MetricsRegistry:
         # acceptance path asserts on it without enabling full metrics; the
         # matching skew distribution is the announce_skew_sec histogram.
         self._skew = {"count": 0, "last_to_announce": {}}
+        # Negotiation response cache (docs/performance.md): hit/miss/
+        # eviction events per plane ("engine" = the TCP engine's response
+        # cache, "xla" = the plane's metadata cache) plus the current
+        # entry-count gauge.  Ungated, like stalls: the acceptance path
+        # asserts a hit rate without enabling full metrics.
+        self._cache = {p: {"hits": 0, "misses": 0, "evictions": 0,
+                           "size": 0} for p in PLANES}
         self._hists = {name: Histogram(bounds)
                        for name, (bounds, _) in HISTOGRAMS.items()}
 
@@ -188,6 +195,17 @@ class MetricsRegistry:
         with self._lock:
             self._faults["restart_epoch"] = int(epoch)
 
+    def record_cache(self, plane: str, kind: str, n: int = 1) -> None:
+        """`n` response-cache events of `kind` ("hits" / "misses" /
+        "evictions") on `plane`.  Ungated."""
+        with self._lock:
+            self._cache[plane][kind] += int(n)
+
+    def set_cache_size(self, plane: str, size: int) -> None:
+        """Current entry count of `plane`'s response cache (a gauge)."""
+        with self._lock:
+            self._cache[plane]["size"] = int(size)
+
     def record_last_announce(self, rank: int, n: int = 1) -> None:
         """`rank` announced a negotiated collective last, `n` times
         (coordinator view, folded in from the engine).  Ungated."""
@@ -231,6 +249,7 @@ class MetricsRegistry:
                     "count": self._skew["count"],
                     "last_to_announce": dict(self._skew["last_to_announce"]),
                 },
+                "cache": {p: dict(v) for p, v in self._cache.items()},
                 "histograms": {name: h.to_dict()
                                for name, h in self._hists.items()},
             }
@@ -315,6 +334,22 @@ def prometheus_text(snapshot: dict) -> str:
                "hvdrun restart counter (0 = first run)")
     out.append("# TYPE hvd_tpu_restart_epoch gauge")
     out.append(f"hvd_tpu_restart_epoch {faults.get('restart_epoch', 0)}")
+
+    cache = snapshot.get("cache", {})
+    out.append("# HELP hvd_tpu_response_cache_events_total "
+               "negotiation response cache events (docs/performance.md)")
+    out.append("# TYPE hvd_tpu_response_cache_events_total counter")
+    for plane, per_kind in cache.items():
+        for kind in ("hits", "misses", "evictions"):
+            out.append(f'hvd_tpu_response_cache_events_total{{plane='
+                       f'"{plane}",event="{kind}"}} '
+                       f'{per_kind.get(kind, 0)}')
+    out.append("# HELP hvd_tpu_response_cache_size "
+               "current response-cache entry count")
+    out.append("# TYPE hvd_tpu_response_cache_size gauge")
+    for plane, per_kind in cache.items():
+        out.append(f'hvd_tpu_response_cache_size{{plane="{plane}"}} '
+                   f'{per_kind.get("size", 0)}')
 
     skew = snapshot.get("skew", {})
     out.append("# HELP hvd_tpu_announce_total "

@@ -42,7 +42,8 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
                  const char* coord_endpoint, const char* data_endpoints,
                  double cycle_time_ms, long long fusion_threshold,
                  double stall_warning_sec, const char* timeline_path,
-                 int hierarchical_allreduce, double collective_timeout_sec) {
+                 int hierarchical_allreduce, double collective_timeout_sec,
+                 long long cache_capacity) {
   EngineOptions opts;
   opts.rank = rank;
   opts.size = size;
@@ -56,6 +57,7 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
   opts.timeline_path = timeline_path ? timeline_path : "";
   opts.hierarchical_allreduce = hierarchical_allreduce != 0;
   opts.collective_timeout_sec = collective_timeout_sec;
+  opts.cache_capacity = cache_capacity;
   std::string err;
   int rc = GlobalEngine()->Init(opts, &err);
   if (rc != 0) {
@@ -124,6 +126,14 @@ long long hvd_tpu_completion_tick(long long handle) {
   return GlobalEngine()->CompletionTick(handle);
 }
 
+// Negotiation latency (µs, enqueue -> agreed response arriving at this
+// rank) for a finished handle; -1 while pending / unknown / failed before
+// negotiation.  Feeds the negotiation_sec histogram for the engine data
+// plane (docs/metrics.md).
+long long hvd_tpu_negotiation_us(long long handle) {
+  return GlobalEngine()->NegotiationUs(handle);
+}
+
 long long hvd_tpu_ticks_done() { return GlobalEngine()->TicksDone(); }
 
 long long hvd_tpu_result_nbytes(long long handle) {
@@ -170,6 +180,21 @@ const char* hvd_tpu_abort_message() {
 }
 
 long long hvd_tpu_abort_count() { return GlobalEngine()->AbortEvents(); }
+
+// Response-cache observability (docs/performance.md): process-cumulative
+// hit/miss/eviction counts (survive re-init, like stalls) plus the
+// current entry count of this engine's cache.
+long long hvd_tpu_cache_hit_count() { return GlobalEngine()->CacheHits(); }
+
+long long hvd_tpu_cache_miss_count() {
+  return GlobalEngine()->CacheMisses();
+}
+
+long long hvd_tpu_cache_eviction_count() {
+  return GlobalEngine()->CacheEvictions();
+}
+
+long long hvd_tpu_cache_size() { return GlobalEngine()->CacheSize(); }
 
 // Cross-rank clock alignment (docs/timeline.md): this rank's estimated
 // clock offset against rank 0 (µs) and the RTT error bound of the winning

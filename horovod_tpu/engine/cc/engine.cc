@@ -206,7 +206,122 @@ std::string DimsToString(const std::vector<int64_t>& dims) {
   return s + "]";
 }
 
+// Fusion predicate shared by fresh negotiations (CoordinatorTick) and
+// cached replays (ProcessCacheHits): may `bytes` more of `dtype` merge
+// into the current allreduce `group`?  Both paths MUST stay equivalent,
+// or replayed steps would get different ring-pass bucket boundaries than
+// their first negotiation.
+bool FusesInto(const Response& group, int64_t group_bytes,
+               uint8_t group_dtype, uint8_t dtype, int64_t bytes,
+               int64_t threshold) {
+  return group.type == RESP_ALLREDUCE && group.names.size() < 1024 &&
+         group_dtype == dtype && group_bytes + bytes <= threshold;
+}
+
+// "1, 3" for the ranks NOT marked in `present`.
+std::string MissingRanks(const std::vector<bool>& present) {
+  std::string missing;
+  for (size_t r = 0; r < present.size(); ++r)
+    if (!present[r])
+      missing += (missing.empty() ? "" : ", ") + std::to_string(r);
+  return missing;
+}
+
 }  // namespace
+
+// ---------------------------------------------------------------------------
+// Negotiation response cache (docs/performance.md).  All mutation happens
+// on the engine thread while processing the broadcast response lists, in
+// list order, so every rank's cache evolves in lockstep — the property
+// that lets a bare slot index stand in for a full string request.
+// ---------------------------------------------------------------------------
+
+int ResponseCache::Lookup(const Request& req) const {
+  auto it = by_name_.find(req.name);
+  if (it == by_name_.end()) return -1;
+  const CacheSlot& s = slots_[it->second];
+  if (s.op != req.op || s.dtype != req.dtype ||
+      s.root_rank != req.root_rank || s.dims != req.dims)
+    return -1;
+  return it->second;
+}
+
+int ResponseCache::SlotByName(const std::string& name) const {
+  auto it = by_name_.find(name);
+  return it == by_name_.end() ? -1 : it->second;
+}
+
+const CacheSlot* ResponseCache::Get(int slot) const {
+  if (slot < 0 || slot >= static_cast<int>(slots_.size()) ||
+      !slots_[slot].valid)
+    return nullptr;
+  return &slots_[slot];
+}
+
+int ResponseCache::Put(const std::string& name, uint8_t op, uint8_t dtype,
+                       const std::vector<int64_t>& dims, int32_t root_rank,
+                       const Response& response, CacheSlot* evicted) {
+  evicted->valid = false;
+  auto it = by_name_.find(name);
+  int slot;
+  if (it != by_name_.end()) {
+    slot = it->second;
+  } else if (static_cast<int64_t>(by_name_.size()) < capacity_) {
+    // Lowest free slot (deterministic: slot states evolve in lockstep).
+    slot = -1;
+    for (int i = 0; i < static_cast<int>(slots_.size()); ++i)
+      if (!slots_[i].valid) {
+        slot = i;
+        break;
+      }
+    if (slot < 0) {
+      slot = static_cast<int>(slots_.size());
+      slots_.emplace_back();
+    }
+  } else {
+    // Full: evict the least-recently-touched entry.  The linear scan only
+    // runs on fresh-name inserts past capacity — steady state is pure
+    // hits, which never reach here.
+    slot = 0;
+    for (int i = 1; i < static_cast<int>(slots_.size()); ++i)
+      if (slots_[i].valid &&
+          (!slots_[slot].valid ||
+           slots_[i].last_touch < slots_[slot].last_touch))
+        slot = i;
+    *evicted = slots_[slot];
+    by_name_.erase(slots_[slot].name);
+  }
+  CacheSlot& s = slots_[slot];
+  s.valid = true;
+  s.last_touch = ++touch_counter_;
+  s.name = name;
+  s.op = op;
+  s.dtype = dtype;
+  s.root_rank = root_rank;
+  s.dims = dims;
+  s.response = response;
+  by_name_[name] = slot;
+  return slot;
+}
+
+void ResponseCache::Touch(int slot) {
+  if (slot >= 0 && slot < static_cast<int>(slots_.size()) &&
+      slots_[slot].valid)
+    slots_[slot].last_touch = ++touch_counter_;
+}
+
+void ResponseCache::Erase(const std::string& name) {
+  auto it = by_name_.find(name);
+  if (it == by_name_.end()) return;
+  slots_[it->second] = CacheSlot();
+  by_name_.erase(it);
+}
+
+void ResponseCache::Clear() {
+  slots_.clear();
+  by_name_.clear();
+  // touch_counter_ keeps rolling: only relative order matters.
+}
 
 // ---------------------------------------------------------------------------
 // Coordinator state (rank 0).  Analogue of the reference MessageTable +
@@ -256,6 +371,18 @@ struct Engine::Coordinator {
       poisoned;
   uint64_t next_order = 0;
   bool shutdown_requested = false;
+  // Response-cache intersection: per-slot bit announcements still short of
+  // full count (the integer-keyed analogue of message_table — no strings,
+  // no per-tensor Request rebuild on the steady-state path).
+  struct PendingBits {
+    std::vector<bool> ranks;
+    int count = 0;
+    std::chrono::steady_clock::time_point first_seen;
+  };
+  std::unordered_map<uint32_t, PendingBits> cache_pending;
+  // Slots every rank announced, in agreement order; broadcast as
+  // ResponseList.cache_hits next tick.
+  std::vector<uint32_t> cached_ready;
   // Liveness (rank 0): workers whose control socket hit EOF/error.  The
   // first death arms the coordinated abort below; later deaths are noted
   // but the first abort wins.
@@ -311,6 +438,8 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   }
   coord_.reset(new Coordinator());
   coord_->rank_dead.assign(opts_.size, false);
+  fast_ticks_ = 0;
+  last_fusion_use_ = epoch_;
   // Every rank writes its own trace; the Python side resolves
   // HOROVOD_TIMELINE's directory / %d forms to a per-rank path (a plain
   // file path stays rank-0-only there, for the legacy single-file mode).
@@ -322,6 +451,16 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
     return 1;
   }
   timeline_.WriteClockSync(clock_offset_us_.load(), clock_rtt_us_.load());
+  // The response cache starts cold every engine lifetime: restart epochs
+  // and in-process re-inits must renegotiate (the peers' caches are
+  // gone).  Hit/miss/eviction counters stay process-cumulative, like
+  // stalls.  The capacity is the JOB-WIDE agreement SetupSockets just
+  // negotiated — per-rank env divergence (one rank with the kill switch
+  // thrown, or a smaller HVD_TPU_CACHE_CAPACITY) would otherwise make a
+  // slot index mean different things on different ranks.
+  cache_.set_capacity(opts_.cache_capacity);
+  cache_.Clear();
+  cache_size_.store(0);
   last_stall_check_ = std::chrono::steady_clock::now();
   initialized_.store(true);
   background_ = std::thread([this]() { BackgroundLoop(); });
@@ -381,19 +520,27 @@ bool Engine::SetupSockets(std::string* err) {
   // A per-rank decision could diverge (e.g. interleaved placement passing
   // the modular check on some ranks only) and deadlock the socket setup.
   {
-    uint32_t mine[3] = {(uint32_t)opts_.local_rank, (uint32_t)opts_.local_size,
-                        opts_.hierarchical_allreduce ? 1u : 0u};
-    uint8_t decision = 0;
+    // The 4th slot agrees on the response-cache capacity job-wide (the
+    // minimum across ranks — a thrown kill switch anywhere disables it
+    // everywhere): per-rank divergence would make a cache-slot index
+    // mean different collectives on different ranks.
+    uint32_t cap32 = static_cast<uint32_t>(std::min<int64_t>(
+        std::max<int64_t>(opts_.cache_capacity, 0), 0x7fffffff));
+    uint32_t mine[4] = {(uint32_t)opts_.local_rank, (uint32_t)opts_.local_size,
+                        opts_.hierarchical_allreduce ? 1u : 0u, cap32};
+    uint32_t reply[2] = {0, cap32};  // {hierarchical decision, capacity}
     if (opts_.rank == 0) {
       std::vector<uint32_t> lr(opts_.size), ls(opts_.size), hr(opts_.size);
       lr[0] = mine[0]; ls[0] = mine[1]; hr[0] = mine[2];
+      uint32_t agreed_cap = cap32;
       for (int r = 1; r < opts_.size; ++r) {
-        uint32_t peer[3];
+        uint32_t peer[4];
         if (!RecvAll(coord_fds_[r], peer, sizeof peer)) {
           *err = "topology agreement recv failed";
           return false;
         }
         lr[r] = peer[0]; ls[r] = peer[1]; hr[r] = peer[2];
+        agreed_cap = std::min(agreed_cap, peer[3]);
       }
       bool want = true, valid = true;
       for (int r = 0; r < opts_.size; ++r) want = want && hr[r] != 0;
@@ -409,21 +556,23 @@ bool Engine::SetupSockets(std::string* err) {
                 "equal local_size on every rank and ranks grouped in "
                 "contiguous blocks of local_size; falling back to the flat "
                 "ring.\n");
-      decision = (want && valid) ? 1 : 0;
+      reply[0] = (want && valid) ? 1 : 0;
+      reply[1] = agreed_cap;
       for (int r = 1; r < opts_.size; ++r) {
-        if (!SendAll(coord_fds_[r], &decision, 1)) {
+        if (!SendAll(coord_fds_[r], reply, sizeof reply)) {
           *err = "topology agreement send failed";
           return false;
         }
       }
     } else {
       if (!SendAll(coord_fd_, mine, sizeof mine) ||
-          !RecvAll(coord_fd_, &decision, 1)) {
+          !RecvAll(coord_fd_, reply, sizeof reply)) {
         *err = "topology agreement exchange failed";
         return false;
       }
     }
-    opts_.hierarchical_allreduce = decision != 0;
+    opts_.hierarchical_allreduce = reply[0] != 0;
+    opts_.cache_capacity = static_cast<int64_t>(reply[1]);
   }
   // Clock alignment for the per-rank timelines: NTP-style probes over the
   // control sockets just established (docs/timeline.md).
@@ -767,13 +916,35 @@ int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
 bool Engine::RunLoopOnce() {
   auto tick_start = std::chrono::steady_clock::now();
 
+  // Reclaim the fusion buffer after a sustained idle stretch (it
+  // previously only ever grew, pinning its high-water mark for the life
+  // of the process): a burst of big fused allreduces no longer holds tens
+  // of MB through hours of, say, evaluation-only phases.
+  if (!fusion_buffer_.empty() &&
+      tick_start - last_fusion_use_ > std::chrono::seconds(10)) {
+    std::vector<char>().swap(fusion_buffer_);
+    std::vector<char>().swap(stage_buffer_);
+  }
+
   RequestList my_requests;
   my_requests.shutdown = shut_down_.load();
   {
     std::lock_guard<std::mutex> lk(mu_);
     while (!queue_.empty()) {
-      my_requests.requests.push_back(std::move(queue_.front()));
+      Request req = std::move(queue_.front());
       queue_.pop_front();
+      // Response-cache fast path: a signature-identical repeat announces
+      // its slot index; everything else (first occurrence, or a changed
+      // shape/dtype/root — the fallback that keeps the PR-2 mismatch
+      // validation live) goes out as a full string request.
+      int slot = cache_.enabled() ? cache_.Lookup(req) : -1;
+      if (slot >= 0) {
+        my_requests.cache_bits.push_back(static_cast<uint32_t>(slot));
+        cache_hits_.fetch_add(1);
+      } else {
+        if (cache_.enabled()) cache_misses_.fetch_add(1);
+        my_requests.requests.push_back(std::move(req));
+      }
     }
   }
 
@@ -854,6 +1025,7 @@ bool Engine::RunLoopOnce() {
     }
   }
 
+  ProcessCacheHits(responses.cache_hits);
   for (const auto& resp : responses.responses) PerformOperation(resp);
   // The response list (identical on every rank) is fully processed: close
   // the tick.  Completions stamped with tick t are all visible once
@@ -870,8 +1042,48 @@ bool Engine::RunLoopOnce() {
   }
   if (responses.shutdown) return false;
 
-  auto elapsed = std::chrono::steady_clock::now() - tick_start;
+  // Adaptive tick (docs/performance.md): with requests PENDING, the
+  // fixed cycle sleep — not the negotiation itself — dominated latency
+  // (a bit-vector agreement costs ~µs, the sleep ~5ms per round, and a
+  // skewed multi-round negotiation paid it per ROUND on every rank).
+  // While work flows — this tick announced requests or carried
+  // responses — tick again immediately; the control-plane frame round
+  // trip itself paces the loop.  With work outstanding but nothing
+  // moving, run a bounded number of fast ticks (a multi-tick negotiation
+  // finishing) before falling back to the configured cycle, so a
+  // genuinely missing peer cannot spin the control plane at full speed.
+  // Fully idle, take ONE cycle-length sleep (no fine-grained polling — an
+  // idle fleet must not wake 5000x/s): fresh enqueues deliberately wait
+  // for the cycle boundary, because the remainder of the cycle is the
+  // CO-ARRIVAL window that lets an enqueue-all-then-wait group land in
+  // one negotiation round and fuse into one ring pass (tests pin this).
+  // HVD_TPU_CYCLE_TIME_MS therefore trades fusion window against
+  // first-announce latency; once announced, rounds run at wire speed.
+  const auto kPollSlice = std::chrono::microseconds(200);
+  const int kMaxFastTicks = 64;
+  bool flowed = !my_requests.requests.empty() ||
+                !my_requests.cache_bits.empty() ||
+                !responses.responses.empty() || !responses.cache_hits.empty();
+  bool outstanding;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    outstanding = !queue_.empty() || !table_.empty();
+  }
+  if (coord_ && (opts_.rank == 0 || opts_.size == 1))
+    outstanding = outstanding || !coord_->message_table.empty() ||
+                  !coord_->cache_pending.empty();
+  if (flowed) {
+    fast_ticks_ = 0;
+    return true;
+  }
+  if (outstanding && fast_ticks_ < kMaxFastTicks) {
+    ++fast_ticks_;
+    std::this_thread::sleep_for(kPollSlice);
+    return true;
+  }
+  fast_ticks_ = 0;
   auto cycle = std::chrono::duration<double, std::milli>(opts_.cycle_time_ms);
+  auto elapsed = std::chrono::steady_clock::now() - tick_start;
   if (elapsed < cycle)
     std::this_thread::sleep_for(cycle - elapsed);
   return true;
@@ -901,6 +1113,110 @@ static std::string BaseName(const std::string& name) {
 
 void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
   for (const auto& req : rl.requests) {
+    // A full string request for a name whose slot (or whose
+    // cross-transport sibling's slot) has outstanding cache bits means
+    // some rank fell back to full negotiation — a signature change, or a
+    // dtype split across transports.  Fold those bits back into their
+    // equivalent full requests first, so the validation below sees every
+    // rank and the PR-2 mismatch/typed-error contract still fires.
+    CoordinatorDrainBitsFor(req.name);
+    CoordinatorDrainBitsFor(SiblingName(req.name));
+    HandleOneRequest(req, from_rank);
+  }
+  CoordinatorHandleBits(rl.cache_bits, from_rank);
+}
+
+Request Engine::SynthesizeFromSlot(const CacheSlot& slot, int rank) const {
+  Request r;
+  r.rank = rank;
+  r.op = slot.op;
+  r.dtype = slot.dtype;
+  r.root_rank = slot.root_rank;
+  r.name = slot.name;
+  r.dims = slot.dims;
+  // The stored dims are THIS rank's; ragged allgather geometry differs
+  // per rank — restore `rank`'s dim0 from the agreed response.
+  if (slot.op == OP_ALLGATHER && !r.dims.empty() &&
+      rank < static_cast<int>(slot.response.rank_dim0.size()))
+    r.dims[0] = slot.response.rank_dim0[rank];
+  return r;
+}
+
+void Engine::CoordinatorDrainBitsFor(const std::string& name) {
+  if (coord_->cache_pending.empty()) return;
+  int slot = cache_.SlotByName(name);
+  if (slot < 0) return;
+  const CacheSlot* s = cache_.Get(slot);
+  if (s != nullptr) CoordinatorDrainSlot(slot, *s);
+}
+
+void Engine::CoordinatorDrainSlot(int slot, const CacheSlot& contents) {
+  auto it = coord_->cache_pending.find(static_cast<uint32_t>(slot));
+  if (it == coord_->cache_pending.end()) return;
+  Coordinator::PendingBits pb = std::move(it->second);
+  coord_->cache_pending.erase(it);
+  // Close the NEGOTIATE row the first bit opened; the synthesized
+  // requests below re-open it on the full-negotiation path.
+  timeline_.NegotiateEnd(contents.name);
+  for (int r = 0; r < opts_.size; ++r)
+    if (pb.ranks[r]) HandleOneRequest(SynthesizeFromSlot(contents, r), r);
+}
+
+void Engine::CoordinatorHandleBits(const std::vector<uint32_t>& bits,
+                                   int from_rank) {
+  for (uint32_t bit : bits) {
+    const CacheSlot* s = cache_.Get(static_cast<int>(bit));
+    if (s == nullptr) {
+      // Unreachable when every rank runs the same cache state — which
+      // Init enforces by agreeing on one job-wide capacity over the
+      // coordinator star and the lockstep mutation contract maintains.
+      // If it happens anyway, DROPPING the bit would leave the
+      // announcing rank waiting forever; abort the job with a crisp
+      // status instead.
+      if (coord_->abort_code == 0) {
+        coord_->abort_code = ST_INVALID;
+        coord_->abort_message =
+            "response-cache protocol error: rank " +
+            std::to_string(from_rank) + " announced cache slot " +
+            std::to_string(bit) +
+            ", unknown to the coordinator (the ranks disagree on the "
+            "negotiation response cache state); this job cannot continue "
+            "and should be restarted.";
+      }
+      continue;
+    }
+    if (coord_->message_table.count(s->name)) {
+      // A full (re-)negotiation of this name is in flight: fold the bit
+      // in as its equivalent full request so validation sees this rank.
+      HandleOneRequest(SynthesizeFromSlot(*s, from_rank), from_rank);
+      continue;
+    }
+    auto& pb = coord_->cache_pending[bit];
+    if (pb.ranks.empty()) {
+      pb.ranks.assign(opts_.size, false);
+      pb.first_seen = std::chrono::steady_clock::now();
+      timeline_.NegotiateStart(s->name, s->op);
+    }
+    if (!pb.ranks[from_rank]) {
+      pb.ranks[from_rank] = true;
+      ++pb.count;
+      timeline_.NegotiateRankReady(s->name, from_rank);
+    }
+    if (pb.count == opts_.size) {
+      // Agreement by pure bit intersection: no strings were parsed, no
+      // Requests rebuilt.  Keep the announce/straggler accounting live in
+      // steady state, and mark the NEGOTIATE row as a cache hit.
+      if (opts_.size > 1) RecordAnnounce(from_rank, pb.first_seen);
+      timeline_.Instant(s->name, "NEGOTIATE_CACHED");
+      timeline_.NegotiateEnd(s->name);
+      coord_->cached_ready.push_back(bit);
+      coord_->cache_pending.erase(bit);
+    }
+  }
+}
+
+void Engine::HandleOneRequest(const Request& req, int from_rank) {
+  {
     auto& pt = coord_->message_table[req.name];
     if (pt.requests.empty()) {
       pt.first_seen = std::chrono::steady_clock::now();
@@ -1003,16 +1319,33 @@ Response Engine::BuildResponse(const std::string& name) {
   std::string error;
   for (size_t i = 1; i < reqs.size() && error.empty(); ++i) {
     const Request& r = reqs[i];
-    if (r.op != first.op)
-      error = "Mismatched collective operations: rank " +
-              std::to_string(r.rank) + " requested " + OpName(r.op) +
-              ", rank " + std::to_string(first.rank) + " requested " +
-              OpName(first.op) + ".";
-    else if (r.dtype != first.dtype)
+    if (r.op != first.op) {
+      if (r.op == OP_NOOP || first.op == OP_NOOP) {
+        // One camp replayed the cached cross-rank agreement (the XLA
+        // plane's metadata-cache fast path) while another re-submitted
+        // changed metadata: the shape/dtype/root consistency the metadata
+        // allreduce would have checked no longer holds across ranks.
+        int noop_rank = r.op == OP_NOOP ? r.rank : first.rank;
+        int full_rank = r.op == OP_NOOP ? first.rank : r.rank;
+        error = "Mismatched collective metadata for tensor '" +
+                BaseName(name) + "': rank " + std::to_string(noop_rank) +
+                " replayed the cached cross-rank agreement while rank " +
+                std::to_string(full_rank) +
+                " submitted changed metadata (shape/dtype/root); every "
+                "rank must submit the same collective with the same shape "
+                "and dtype.";
+      } else {
+        error = "Mismatched collective operations: rank " +
+                std::to_string(r.rank) + " requested " + OpName(r.op) +
+                ", rank " + std::to_string(first.rank) + " requested " +
+                OpName(first.op) + ".";
+      }
+    } else if (r.dtype != first.dtype)
       error = std::string("Mismatched data types: one rank sent ") +
               DataTypeName(r.dtype) + ", another sent " +
               DataTypeName(first.dtype) + ".";
-    else if (first.op == OP_ALLREDUCE && r.dims != first.dims)
+    else if ((first.op == OP_ALLREDUCE || first.op == OP_NOOP) &&
+             r.dims != first.dims)
       error = "Mismatched allreduce tensor shapes: one rank sent " +
               DimsToString(r.dims) + ", another sent " +
               DimsToString(first.dims) + ".";
@@ -1052,6 +1385,8 @@ Response Engine::BuildResponse(const std::string& name) {
     resp.error_message = error;
   } else if (first.op == OP_ALLREDUCE) {
     resp.type = RESP_ALLREDUCE;
+  } else if (first.op == OP_NOOP) {
+    resp.type = RESP_NOOP;
   } else if (first.op == OP_BROADCAST) {
     resp.type = RESP_BROADCAST;
   } else {
@@ -1076,6 +1411,9 @@ ResponseList Engine::CoordinatorTick() {
     out.shutdown = true;
     return out;
   }
+  // Cache hits agreed this tick: broadcast the slot indices; every rank
+  // replays its stored response for each, in this order.
+  out.cache_hits.swap(coord_->cached_ready);
   // Poison-deadline sweep: entries for a recently-mismatched base name
   // that are STILL short of full count at their deadline are stragglers
   // of the mismatched round — give them the typed error.
@@ -1109,9 +1447,8 @@ ResponseList Engine::CoordinatorTick() {
     // Tensor fusion: merge consecutive same-dtype allreduces while the fused
     // payload stays under the threshold (operations.cc:1607-1642).
     if (r.type == RESP_ALLREDUCE && !responses.empty() &&
-        responses.back().type == RESP_ALLREDUCE &&
-        responses.back().names.size() < 1024 && last_fused_dtype_ == dtype &&
-        nbytes.back() + bytes <= opts_.fusion_threshold) {
+        FusesInto(responses.back(), nbytes.back(), last_fused_dtype_, dtype,
+                  bytes, opts_.fusion_threshold)) {
       responses.back().names.push_back(name);
       nbytes.back() += bytes;
     } else {
@@ -1131,18 +1468,18 @@ void Engine::CheckForStalledTensors() {
     return;
   last_stall_check_ = now;
   bool preamble = false;
-  for (const auto& kv : coord_->message_table) {
-    if (now - kv.second.first_seen <
-        std::chrono::duration<double>(opts_.stall_warning_sec))
-      continue;
+  // One record per stalled negotiation, whether it is pending as full
+  // string requests (message_table) or as cache-bit announcements.
+  auto warn = [&](const std::string& name, const std::vector<bool>& present,
+                  std::chrono::steady_clock::time_point first_seen) {
     {
       // Record for the Python metrics registry (hvd_tpu_stall_count /
       // hvd_tpu_stall_info): one event per (tensor, sweep) warning.
       double stalled_sec =
-          std::chrono::duration<double>(now - kv.second.first_seen).count();
+          std::chrono::duration<double>(now - first_seen).count();
       std::lock_guard<std::mutex> lk(stall_mu_);
       ++stall_events_;
-      stall_log_.emplace_back(kv.first, stalled_sec);
+      stall_log_.emplace_back(name, stalled_sec);
       while (stall_log_.size() > 64) stall_log_.pop_front();
     }
     if (!preamble) {
@@ -1156,13 +1493,24 @@ void Engine::CheckForStalledTensors() {
               opts_.stall_warning_sec);
       preamble = true;
     }
+    fprintf(stderr, "%s [missing ranks: %s]\n", name.c_str(),
+            MissingRanks(present).c_str());
+  };
+  for (const auto& kv : coord_->message_table) {
+    if (now - kv.second.first_seen <
+        std::chrono::duration<double>(opts_.stall_warning_sec))
+      continue;
     std::vector<bool> present(opts_.size, false);
     for (const auto& r : kv.second.requests) present[r.rank] = true;
-    std::string missing;
-    for (int r = 0; r < opts_.size; ++r)
-      if (!present[r]) missing += (missing.empty() ? "" : ", ") + std::to_string(r);
-    fprintf(stderr, "%s [missing ranks: %s]\n", kv.first.c_str(),
-            missing.c_str());
+    warn(kv.first, present, kv.second.first_seen);
+  }
+  for (const auto& kv : coord_->cache_pending) {
+    if (now - kv.second.first_seen <
+        std::chrono::duration<double>(opts_.stall_warning_sec))
+      continue;
+    const CacheSlot* s = cache_.Get(static_cast<int>(kv.first));
+    warn(s ? s->name : "<cache slot " + std::to_string(kv.first) + ">",
+         kv.second.ranks, kv.second.first_seen);
   }
 }
 
@@ -1223,6 +1571,17 @@ void Engine::MarkRankDead(int r, const std::string& reason) {
                DescribePending(kv.first, kv.second.requests, opts_.size);
     ++listed;
   }
+  for (const auto& kv : coord_->cache_pending) {
+    if (listed == 8) {
+      pending += ", ...";
+      break;
+    }
+    const CacheSlot* s = cache_.Get(static_cast<int>(kv.first));
+    pending += (pending.empty() ? "" : "; ") + std::string("'") +
+               (s ? s->name : "<cache slot>") +
+               "' [missing ranks: " + MissingRanks(kv.second.ranks) + "]";
+    ++listed;
+  }
   coord_->abort_code = ST_RANKS_DOWN;
   coord_->abort_message =
       "ranks down: " + down + " (" + reason + ")" +
@@ -1250,6 +1609,19 @@ void Engine::CheckCollectiveTimeout() {
       stalled += (stalled.empty() ? "" : "; ") +
                  DescribePending(kv.first, kv.second.requests, opts_.size);
   }
+  for (const auto& kv : coord_->cache_pending) {
+    double age =
+        std::chrono::duration<double>(now - kv.second.first_seen).count();
+    if (age < opts_.collective_timeout_sec) continue;
+    worst = std::max(worst, age);
+    ++n_stalled;
+    if (n_stalled <= 8) {
+      const CacheSlot* s = cache_.Get(static_cast<int>(kv.first));
+      stalled += (stalled.empty() ? "" : "; ") + std::string("'") +
+                 (s ? s->name : "<cache slot>") +
+                 "' [missing ranks: " + MissingRanks(kv.second.ranks) + "]";
+    }
+  }
   if (n_stalled == 0) return;
   if (n_stalled > 8)
     stalled += "; ... (" + std::to_string(n_stalled - 8) + " more)";
@@ -1275,6 +1647,11 @@ void Engine::AbortLocal(int32_t code, const std::string& message) {
   abort_events_.fetch_add(1);
   // A broken job must fail every subsequent collective uniformly.
   data_plane_failed_.store(true);
+  // Invalidate the response cache: the peers' caches die with the job,
+  // and a relaunch must renegotiate from scratch (docs/performance.md).
+  cache_.Clear();
+  cache_size_.store(0);
+  if (coord_) coord_->cache_pending.clear();
   // Aborting jobs often die before Python reaches shutdown(): flush now
   // so the trace on disk parses (the BackgroundLoop drain flushes again
   // after the final completions land).
@@ -1292,8 +1669,40 @@ std::string Engine::AbortMessage() {
 // Execution.
 // ---------------------------------------------------------------------------
 
-void Engine::PerformOperation(const Response& resp) {
+void Engine::ProcessCacheHits(const std::vector<uint32_t>& hits) {
+  if (hits.empty()) return;
+  // Replay the stored responses in broadcast order, re-fusing consecutive
+  // same-dtype allreduces under the threshold exactly like the
+  // coordinator fuses fresh negotiations — steady-state repeats keep
+  // their one-ring-pass-per-bucket behavior.
+  std::vector<Response> merged;
+  std::vector<int64_t> merged_bytes;
+  uint8_t fused_dtype = 255;
+  for (uint32_t hit : hits) {
+    const CacheSlot* s = cache_.Get(static_cast<int>(hit));
+    if (s == nullptr) continue;  // unreachable with lockstep caches
+    // Broadcast-driven LRU touch: identical order on every rank, so
+    // eviction decisions stay in lockstep.
+    cache_.Touch(static_cast<int>(hit));
+    int64_t bytes =
+        NumElements(s->dims) * static_cast<int64_t>(DataTypeSize(s->dtype));
+    if (s->response.type == RESP_ALLREDUCE && !merged.empty() &&
+        FusesInto(merged.back(), merged_bytes.back(), fused_dtype, s->dtype,
+                  bytes, opts_.fusion_threshold)) {
+      merged.back().names.push_back(s->name);
+      merged_bytes.back() += bytes;
+    } else {
+      merged.push_back(s->response);
+      merged_bytes.push_back(bytes);
+      fused_dtype = s->dtype;
+    }
+  }
+  for (const auto& resp : merged) PerformOperation(resp, /*from_cache=*/true);
+}
+
+void Engine::PerformOperation(const Response& resp, bool from_cache) {
   std::vector<TableEntry> entries;
+  auto arrived = std::chrono::steady_clock::now();
   {
     std::lock_guard<std::mutex> lk(mu_);
     for (const auto& name : resp.names) {
@@ -1303,11 +1712,49 @@ void Engine::PerformOperation(const Response& resp) {
       table_.erase(it);
     }
   }
+  if (resp.type == RESP_ERROR && cache_.enabled()) {
+    // A name that negotiated to an error must renegotiate from scratch:
+    // drop any stale agreement so later (consistent) reuse is a clean
+    // miss, not a replay of dead metadata.  Driven by resp.names alone —
+    // NOT the local entries — so even a rank that never submitted this
+    // round (a poison-window straggler scenario) evicts in lockstep.
+    for (const auto& name : resp.names) cache_.Erase(name);
+    cache_size_.store(cache_.size());
+  }
   if (entries.empty()) return;
+  // Negotiation latency stamp (negotiation_sec histogram, both planes):
+  // enqueue -> the agreed response reaching this rank, before execution.
+  for (auto& e : entries)
+    e.negotiation_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                           arrived - e.enqueued_at)
+                           .count();
 
   if (resp.type == RESP_ERROR) {
     for (auto& e : entries) CompleteEntry(e, ST_PRECONDITION, resp.error_message);
     return;
+  }
+  if (cache_.enabled() && !from_cache) {
+    // Freshly negotiated: store each name's agreement so its next
+    // signature-identical submission announces a compact cache bit.
+    // Slot assignment and LRU order are driven by the broadcast list —
+    // lockstep on every rank.
+    for (auto& e : entries) {
+      Response single;
+      single.type = resp.type;
+      single.names.push_back(e.name);
+      single.rank_dim0 = resp.rank_dim0;
+      CacheSlot evicted;
+      int slot = cache_.Put(e.name, e.op, e.dtype, e.dims, e.root_rank,
+                            single, &evicted);
+      if (evicted.valid) {
+        cache_evictions_.fetch_add(1);
+        // Rank 0: bits still pending against the evicted entry can no
+        // longer be matched by index — convert them back to full
+        // requests so their negotiation completes by name.
+        CoordinatorDrainSlot(slot, evicted);
+      }
+    }
+    cache_size_.store(cache_.size());
   }
   if (data_plane_failed_.load()) {
     for (auto& e : entries)
@@ -1326,6 +1773,11 @@ void Engine::PerformOperation(const Response& resp) {
       break;
     case RESP_BROADCAST:
       ExecuteBroadcast(resp, entries[0]);
+      break;
+    case RESP_NOOP:
+      // Negotiation-only (the XLA plane's cached metadata agreement): the
+      // completion stamp IS the payload — no data moves.
+      for (auto& e : entries) CompleteEntry(e, ST_OK, "");
       break;
     default:
       for (auto& e : entries)
@@ -1369,6 +1821,7 @@ void Engine::ExecuteAllreduce(const Response& resp,
     // Fuse into one contiguous buffer, one ring pass, scatter back out --
     // the reference's fusion-buffer dance (operations.cc:1109-1186) with
     // half types widened to f32 for the reduction.
+    last_fusion_use_ = std::chrono::steady_clock::now();
     if (fusion_buffer_.size() < static_cast<size_t>(total_elems) * wsize)
       fusion_buffer_.resize(static_cast<size_t>(total_elems) * wsize);
     char* fb = fusion_buffer_.data();
@@ -1502,6 +1955,7 @@ void Engine::CompleteEntry(const TableEntry& e, int32_t code,
     std::lock_guard<std::mutex> lk(status->mu);
     status->completion_seq = completions_.fetch_add(1);
     status->completion_tick = ticks_done_.load();
+    status->negotiation_us = e.negotiation_us;
     status->error = error;
     status->code.store(code);
   }
@@ -1806,6 +2260,13 @@ int64_t Engine::CompletionTick(int64_t handle) {
   auto it = handles_.find(handle);
   if (it == handles_.end() || it->second->code.load() == ST_PENDING) return -1;
   return it->second->completion_tick;
+}
+
+int64_t Engine::NegotiationUs(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end() || it->second->code.load() == ST_PENDING) return -1;
+  return it->second->negotiation_us;
 }
 
 int64_t Engine::ResultBytes(int64_t handle) {

@@ -52,6 +52,11 @@ struct EngineOptions {
   // until an outer launcher timeout.  <= 0 disables (warning-only, the
   // pre-fault-tolerance behavior).  HVD_TPU_COLLECTIVE_TIMEOUT_SEC.
   double collective_timeout_sec = 0.0;
+  // Negotiation response cache (docs/performance.md): number of negotiated
+  // collectives each rank remembers so repeats announce a compact slot
+  // index instead of a full string request.  HVD_TPU_CACHE_CAPACITY
+  // (default 1024); 0 disables (HVD_TPU_RESPONSE_CACHE=0 kill switch).
+  int64_t cache_capacity = 1024;
   std::string timeline_path;
   // Two-level allreduce: reduce to the node-local leader, ring-allreduce
   // across leaders, broadcast back within the node — the reference's
@@ -85,6 +90,60 @@ struct HandleStatus {
   // /root/reference/horovod/common/operations.cc:1644-1650).
   int64_t completion_seq = -1;   // per-engine monotonic completion index
   int64_t completion_tick = -1;  // index of the response list that carried it
+  int64_t negotiation_us = -1;   // enqueue -> response arrival; -1 on errors
+};
+
+// One slot of the negotiation response cache: the request signature this
+// rank last negotiated under `name` plus the agreed response to replay on
+// a hit.  `dims` are THIS rank's dims (they differ per rank for ragged
+// allgather; the stored response's rank_dim0 carries the full geometry).
+struct CacheSlot {
+  bool valid = false;
+  uint64_t last_touch = 0;  // LRU stamp (monotonic counter, not time)
+  std::string name;
+  uint8_t op = OP_ALLREDUCE;
+  uint8_t dtype = HVD_FLOAT32;
+  int32_t root_rank = -1;
+  std::vector<int64_t> dims;
+  Response response;  // single-name response replayed on a hit
+};
+
+// Negotiation response cache (the role Horovod's response cache plays in
+// the reference's successors): once a named collective has been fully
+// negotiated, every rank stores the agreed response under a compact slot
+// index.  Subsequent steps announce slot indices (RequestList.cache_bits)
+// instead of string requests; the coordinator intersects and broadcasts
+// hit indices; every rank replays the stored response.
+//
+// Determinism contract: Put/Touch/Erase happen ONLY while processing the
+// broadcast response lists, in list order — identical on every rank — so
+// slot numbering and LRU order stay in lockstep and a slot index means
+// the same collective everywhere.  Lookup (rank-local, at queue drain)
+// never mutates.
+class ResponseCache {
+ public:
+  bool enabled() const { return capacity_ > 0; }
+  void set_capacity(int64_t capacity) { capacity_ = capacity; }
+  int64_t size() const { return static_cast<int64_t>(by_name_.size()); }
+  // Exact-signature match (name, op, dtype, dims, root); -1 on miss.
+  int Lookup(const Request& req) const;
+  int SlotByName(const std::string& name) const;
+  const CacheSlot* Get(int slot) const;
+  // Insert or update `name` (touching it); returns the slot used.  When a
+  // full cache forced an eviction, *evicted holds the victim's old
+  // contents (evicted->valid true) and the victim's slot is reused.
+  int Put(const std::string& name, uint8_t op, uint8_t dtype,
+          const std::vector<int64_t>& dims, int32_t root_rank,
+          const Response& response, CacheSlot* evicted);
+  void Touch(int slot);
+  void Erase(const std::string& name);
+  void Clear();
+
+ private:
+  int64_t capacity_ = 0;
+  uint64_t touch_counter_ = 0;
+  std::vector<CacheSlot> slots_;
+  std::unordered_map<std::string, int> by_name_;
 };
 
 // One enqueued tensor awaiting negotiation + execution.
@@ -99,6 +158,11 @@ struct TableEntry {
   bool average = false;
   int64_t handle = -1;
   std::chrono::steady_clock::time_point enqueued_at;
+  // Negotiation latency (enqueue -> response arrival), stamped when the
+  // response pops this entry; -1 on error/drain paths.  Surfaced per
+  // handle so Python can feed the negotiation_sec histogram for the
+  // engine data plane too (the XLA plane times its own metadata ops).
+  int64_t negotiation_us = -1;
 };
 
 class Engine {
@@ -131,6 +195,9 @@ class Engine {
   // Completion stamps for a finished handle (-1 while pending / unknown).
   int64_t CompletionSeq(int64_t handle);
   int64_t CompletionTick(int64_t handle);
+  // Negotiation latency (µs, enqueue -> response arrival) for a finished
+  // handle; -1 while pending, unknown, or failed before negotiation.
+  int64_t NegotiationUs(int64_t handle);
   // Number of fully processed response lists; a tick t is "closed" (all its
   // completions are visible, on every rank) once TicksDone() > t.
   int64_t TicksDone() const { return ticks_done_.load(); }
@@ -177,6 +244,16 @@ class Engine {
   std::string AnnounceLog();
   std::string LastAnnounceCounts();
 
+  // Response-cache observability (docs/performance.md): hit = a drained
+  // request announced as a cache bit, miss = a full string request sent
+  // while the cache was enabled, eviction = a capacity-forced slot reuse.
+  // Process-cumulative (survive re-init, like StallEvents); size is the
+  // current entry count of this engine's cache.
+  int64_t CacheHits() const { return cache_hits_.load(); }
+  int64_t CacheMisses() const { return cache_misses_.load(); }
+  int64_t CacheEvictions() const { return cache_evictions_.load(); }
+  int64_t CacheSize() const { return cache_size_.load(); }
+
   // The engine-owned Chrome-tracing timeline.  Exposed so the XLA data
   // plane (Python, jax/eager_mesh.py) can emit its BUCKET_BUILD /
   // XLA_DISPATCH / DEVICE_WAIT activities into the SAME trace file as the
@@ -206,6 +283,25 @@ class Engine {
 
   // Coordinator (rank 0) helpers.
   void CoordinatorHandle(const RequestList& rl, int from_rank);
+  // One full string request (shared by wire requests and the synthesized
+  // ones below).
+  void HandleOneRequest(const Request& req, int from_rank);
+  // Response-cache coordination: count one rank's cache-bit announcements
+  // (full count -> a broadcast hit); convert any bits still pending for
+  // `name`'s slot back into full synthesized requests (a peer fell back
+  // to string negotiation — renegotiation or cross-transport split — so
+  // validation must see every rank); drain a capacity-evicted slot's
+  // orphaned bits the same way.
+  void CoordinatorHandleBits(const std::vector<uint32_t>& bits,
+                             int from_rank);
+  void CoordinatorDrainBitsFor(const std::string& name);
+  void CoordinatorDrainSlot(int slot, const CacheSlot& contents);
+  // The request rank `rank` would have sent for the cached collective
+  // (per-rank dim0 restored from the stored allgather geometry).
+  Request SynthesizeFromSlot(const CacheSlot& slot, int rank) const;
+  // Replay broadcast cache hits in order, re-fusing consecutive
+  // same-dtype allreduces like the coordinator does for fresh responses.
+  void ProcessCacheHits(const std::vector<uint32_t>& hits);
   ResponseList CoordinatorTick();
   Response BuildResponse(const std::string& name);
   void CheckForStalledTensors();
@@ -221,8 +317,9 @@ class Engine {
   // ranks and the tensors they left pending.
   void MarkRankDead(int r, const std::string& reason);
 
-  // Execution.
-  void PerformOperation(const Response& resp);
+  // Execution.  `from_cache` marks a replayed response: its cache slot was
+  // already touched by ProcessCacheHits, so skip the (re-)insert.
+  void PerformOperation(const Response& resp, bool from_cache = false);
   void ExecuteAllreduce(const Response& resp,
                         std::vector<TableEntry>& entries);
   void ExecuteAllgather(const Response& resp, TableEntry& e);
@@ -289,6 +386,25 @@ class Engine {
   uint8_t last_fused_dtype_ = 255;  // dtype of the current fusion group
   Timeline timeline_;
   std::chrono::steady_clock::time_point last_stall_check_;
+
+  // Negotiation response cache.  Engine-thread only: mutated while
+  // processing response lists, read at queue drain; contents reset at
+  // Init (restart epochs start cold) and cleared on coordinated abort.
+  // The hit/miss/eviction counters are process-cumulative for metrics.
+  ResponseCache cache_;
+  std::atomic<int64_t> cache_hits_{0};
+  std::atomic<int64_t> cache_misses_{0};
+  std::atomic<int64_t> cache_evictions_{0};
+  std::atomic<int64_t> cache_size_{0};
+
+  // Adaptive tick (docs/performance.md): consecutive progress-less ticks
+  // with work still outstanding — bounds how long the loop runs at full
+  // speed before falling back to the HVD_TPU_CYCLE_TIME_MS idle cadence.
+  int fast_ticks_ = 0;
+  // Fusion-buffer reclamation: last time ExecuteAllreduce staged through
+  // fusion_buffer_; after a sustained idle stretch the buffer (which only
+  // ever grew before) is released back to the allocator.
+  std::chrono::steady_clock::time_point last_fusion_use_{};
 
   // Stall log: one entry per (stalled tensor, sweep) warning, bounded so a
   // permanently wedged job cannot grow it; the counter is cumulative for

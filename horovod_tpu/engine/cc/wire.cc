@@ -44,6 +44,7 @@ const char* OpName(uint8_t op) {
     case OP_ALLREDUCE: return "allreduce";
     case OP_ALLGATHER: return "allgather";
     case OP_BROADCAST: return "broadcast";
+    case OP_NOOP: return "cached-negotiation";
     default: return "<unknown op>";
   }
 }
@@ -117,6 +118,8 @@ std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
     w.U8(static_cast<uint8_t>(r.dims.size()));
     for (int64_t d : r.dims) w.I64(d);
   }
+  w.U32(static_cast<uint32_t>(rl.cache_bits.size()));
+  for (uint32_t b : rl.cache_bits) w.U32(b);
   return std::move(w.buf);
 }
 
@@ -137,6 +140,10 @@ bool ParseRequestList(const std::vector<uint8_t>& buf, RequestList* rl) {
     for (uint8_t j = 0; j < nd; ++j) r.dims.push_back(rd.I64());
     rl->requests.push_back(std::move(r));
   }
+  rl->cache_bits.clear();
+  uint32_t nb = rd.U32();
+  for (uint32_t i = 0; i < nb && rd.ok; ++i)
+    rl->cache_bits.push_back(rd.U32());
   return rd.ok;
 }
 
@@ -154,6 +161,8 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
     w.U32(static_cast<uint32_t>(r.rank_dim0.size()));
     for (int64_t d : r.rank_dim0) w.I64(d);
   }
+  w.U32(static_cast<uint32_t>(rl.cache_hits.size()));
+  for (uint32_t h : rl.cache_hits) w.U32(h);
   return std::move(w.buf);
 }
 
@@ -175,6 +184,10 @@ bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
     for (uint32_t j = 0; j < ns; ++j) r.rank_dim0.push_back(rd.I64());
     rl->responses.push_back(std::move(r));
   }
+  rl->cache_hits.clear();
+  uint32_t nh = rd.U32();
+  for (uint32_t i = 0; i < nh && rd.ok; ++i)
+    rl->cache_hits.push_back(rd.U32());
   return rd.ok;
 }
 

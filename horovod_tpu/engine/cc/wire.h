@@ -29,6 +29,11 @@ enum OpType : uint8_t {
   OP_ALLREDUCE = 0,
   OP_ALLGATHER = 1,
   OP_BROADCAST = 2,
+  // Negotiation-only: agree on order + stamp completion, move no data.
+  // The XLA plane's metadata-cache fast path (jax/eager_mesh.py) submits
+  // these instead of repeating the "__xp.*" metadata allreduce once every
+  // rank holds the cached agreement (docs/performance.md).
+  OP_NOOP = 3,
 };
 
 // Status codes -- shared with Python.
@@ -65,6 +70,12 @@ struct Request {
 struct RequestList {
   bool shutdown = false;
   std::vector<Request> requests;
+  // Response-cache announcements (docs/performance.md): slot indices of
+  // already-negotiated collectives this rank re-submitted unchanged.  A
+  // few bytes per op instead of a string-named Request — the steady-state
+  // fast path.  Caches mutate in broadcast response-list order on every
+  // rank, so a slot index names the same collective everywhere.
+  std::vector<uint32_t> cache_bits;
 };
 
 enum ResponseType : uint8_t {
@@ -72,6 +83,7 @@ enum ResponseType : uint8_t {
   RESP_ALLGATHER = 1,
   RESP_BROADCAST = 2,
   RESP_ERROR = 3,
+  RESP_NOOP = 4,  // negotiation-only (OP_NOOP): stamp completion, no data
 };
 
 // Coordinator verdict: either an (optionally fused) operation every rank must
@@ -93,6 +105,9 @@ struct ResponseList {
   int32_t abort_code = 0;
   std::string abort_message;
   std::vector<Response> responses;
+  // Cache slots every rank announced: replay the stored response for each,
+  // in order, before executing `responses` (identical order everywhere).
+  std::vector<uint32_t> cache_hits;
 };
 
 std::vector<uint8_t> SerializeRequestList(const RequestList& rl);

@@ -37,6 +37,21 @@ announce-order accounting for free: plane collectives show up in
 ``metrics_snapshot()["skew"]`` (last-to-announce counts, skew histogram)
 and in rank 0's NEGOTIATE timeline rows exactly like engine ones.
 
+Metadata cache (steady state)
+-----------------------------
+Training repeats the identical collective sequence every step, so after
+the first step the metadata allreduce re-derives an agreement every rank
+already holds.  A ``(name, my_hash)``-keyed cache (insert-only, filled in
+dispatch order, which is prefix-consistent across ranks) lets repeat
+ops replay the verified agreement through a negotiation-only engine noop
+(``OP_NOOP``): zero ``__xp.*`` data movement, and — once the engine's own
+response cache warms — a per-op cache *bit* on the wire instead of a
+string request.  A rank whose metadata changed misses locally and submits
+the real ``__xp.`` op; the coordinator converts that split into a typed
+mismatched-metadata error on every rank.  Allgathers never cache: their
+ragged per-rank dim0 must keep flowing through the metadata allreduce.
+``HVD_TPU_RESPONSE_CACHE=0`` disables (docs/performance.md).
+
 Tensor fusion
 -------------
 flush() concatenates consecutive same-dtype allreduces of one tick into a
@@ -50,6 +65,7 @@ training reuses one executable per step.
 
 from __future__ import annotations
 
+import collections
 import ctypes
 import hashlib
 import os
@@ -63,6 +79,12 @@ from horovod_tpu.common import metrics as _metrics
 
 _lock = threading.Lock()
 _plane = None  # initialized XlaDataPlane, or False if init failed/disabled
+
+# Compiled-executable cache bound (_jit_for): steady-state training reuses
+# a handful of (op, padded length, dtype) keys, but a pathological shape
+# stream (e.g. per-sample ragged allgathers) used to grow the dict — and
+# jax's compilation cache behind it — without bound.  LRU past this.
+_JIT_CACHE_CAPACITY = 128
 
 
 def _meta_hash(kind: str, dtype, shape, root: int) -> int:
@@ -113,7 +135,7 @@ class _Batch:
 class _PlaneOp:
     __slots__ = ("name", "kind", "payload", "root", "handle", "neg_raw",
                  "neg_in", "neg_out", "my_hash", "seq", "tick", "dim0s",
-                 "t_enq", "t_neg")
+                 "t_enq", "t_neg", "cached")
 
     def __init__(self, name, kind, payload, root, handle):
         self.name = name
@@ -121,6 +143,7 @@ class _PlaneOp:
         self.payload = payload  # compute-dtype, C-contiguous
         self.root = root
         self.handle = handle
+        self.cached = False  # metadata-cache hit: negotiation-only noop
         self.neg_raw = -1
         self.neg_in = None  # pinned until negotiation completes
         self.neg_out = None
@@ -268,7 +291,22 @@ class XlaDataPlane:
         # engine's coordinated abort): past it the handle FAILS with
         # CollectiveTimeoutError instead of polling forever.  <= 0 = off.
         self._timeout_sec = cfg.collective_timeout_sec
-        self._fns = {}
+        self._fns = collections.OrderedDict()  # LRU-bounded compile cache
+        # Metadata cache (docs/performance.md): name -> verified my_hash.
+        # A repeat op whose hash matches replays the cached cross-rank
+        # agreement through a negotiation-only engine noop and skips the
+        # "__xp.*" metadata allreduce entirely.  Entries are inserted in
+        # DISPATCH order — the one sequence that is prefix-consistent
+        # across ranks (module docstring) — and are insert-only/immutable
+        # (see _meta_update), so every rank's cache holds the same
+        # entries and a hit on one rank is a hit on all (a divergence
+        # would surface as the engine's typed cached-vs-changed-metadata
+        # error, never a hang).  Allgathers are excluded: their per-rank
+        # dim0 may legitimately change step to step, and that geometry
+        # must keep flowing through the metadata allreduce.
+        cfg_cap = cfg.effective_cache_capacity
+        self._meta_cache = {} if cfg_cap > 0 else None
+        self._meta_capacity = cfg_cap
         self._mu = threading.RLock()  # guards _fns, _pending, _local_seq
         self._pending: List[_PlaneOp] = []
         # Ops withdrawn by a timed-out wait, pinned so the engine's raw
@@ -308,6 +346,29 @@ class XlaDataPlane:
         shape = (op.payload.shape[1:] if op.kind == "ag"
                  else op.payload.shape)
         op.my_hash = _meta_hash(op.kind, op.handle._dtype, shape, op.root)
+        if self._meta_cache is not None and op.kind != "ag":
+            if self._meta_cache.get(op.name) == op.my_hash:
+                # Metadata-cache hit: every rank holding this verified
+                # agreement replays it through a negotiation-only engine
+                # noop — global dispatch order still comes from the
+                # engine's completion stamps, but no metadata allreduce
+                # runs and, once the engine's own response cache warms, no
+                # string negotiation either.  A rank whose metadata
+                # changed misses here and submits the real "__xp." op; the
+                # engine's coordinator then converts the split into the
+                # typed mismatched-metadata error (engine.cc).
+                dims = (ctypes.c_longlong * 1)(2 * self._size)
+                raw = common._lib.hvd_tpu_enqueue(
+                    common.OP_NOOP, ("__xp." + op.name).encode(),
+                    None, None, dims, 1, _dt.numpy_to_code(np.dtype(np.int64)),
+                    -1, 0)
+                if raw < 0:
+                    raise common.HorovodInternalError("engine is shut down")
+                op.cached = True
+                op.neg_raw = raw
+                _metrics.registry.record_cache("xla", "hits")
+                return
+            _metrics.registry.record_cache("xla", "misses")
         vec = np.zeros(2 * self._size, np.int64)
         vec[self._rank] = op.my_hash
         vec[self._size + self._rank] = dim0
@@ -339,6 +400,22 @@ class XlaDataPlane:
                 msg = lib.hvd_tpu_error(op.neg_raw).decode()
                 op.handle._fail(common._status_error(code, msg, op.name))
                 op.seq = -1  # consumed; never dispatched
+                # A name that negotiated to an error (e.g. the cached-vs-
+                # changed-metadata mismatch) must renegotiate from
+                # scratch: drop the stale agreement.  The error reaches
+                # every rank, so every cache evicts together.
+                if self._meta_cache is not None:
+                    self._meta_cache.pop(op.name, None)
+            elif op.cached:
+                # Negotiation-only replay: the cross-rank agreement was
+                # verified when the entry was stored; only the ordering
+                # stamps matter here.
+                op.seq = int(lib.hvd_tpu_completion_seq(op.neg_raw))
+                op.tick = int(lib.hvd_tpu_completion_tick(op.neg_raw))
+                if op.t_enq:
+                    op.t_neg = time.perf_counter()
+                    _metrics.registry.observe("negotiation_sec",
+                                              op.t_neg - op.t_enq)
             else:
                 op.seq = int(lib.hvd_tpu_completion_seq(op.neg_raw))
                 op.tick = int(lib.hvd_tpu_completion_tick(op.neg_raw))
@@ -354,6 +431,8 @@ class XlaDataPlane:
                         f"submit the same collective with the same dtype "
                         f"and shape."))
                     op.seq = -1
+                    if self._meta_cache is not None:
+                        self._meta_cache.pop(op.name, None)
                 if op.seq != -1 and op.t_enq:
                     op.t_neg = time.perf_counter()
                     _metrics.registry.observe("negotiation_sec",
@@ -391,6 +470,12 @@ class XlaDataPlane:
             failed = [op for op in self._pending if op.seq == -1]
             dispatched = set()
             ready.sort(key=lambda o: o.seq)
+            # Metadata-cache maintenance rides dispatch order — the one
+            # sequence that is prefix-consistent across ranks — so every
+            # rank stores, touches, and evicts the same entries in the
+            # same order (see _meta_update).
+            for op in ready:
+                self._meta_update(op)
             bucket: List[_PlaneOp] = []
             bucket_key = None
             bucket_bytes = 0
@@ -486,6 +571,29 @@ class XlaDataPlane:
             f"or more ranks never submitted the matching collective; the "
             f"wait was aborted instead of hanging."))
 
+    def _meta_update(self, op: _PlaneOp) -> None:
+        """Store `op`'s verified cross-rank agreement.  INSERT-ONLY and
+        IMMUTABLE: entries are added in dispatch order (prefix-consistent
+        across ranks) until the capacity is reached, never churn-evicted
+        and never re-hashed in place.  An LRU eviction or in-place
+        refresh would be applied at rank-local moments — two ranks
+        mid-flush could disagree about it, and a fully consistent program
+        would then split into cached/uncached camps and die with the
+        typed mismatched-metadata error.  A stable entry set keeps the
+        hit/miss decision identical on every rank; entries leave only
+        through per-name error eviction (the typed error reaches every
+        rank's op together).  Names beyond the capacity, and names whose
+        metadata changed after caching, simply keep paying the metadata
+        allreduce (the engine's response cache still makes its
+        negotiation cheap).  Allgathers never cache: their ragged
+        per-rank dim0 must keep flowing through the metadata exchange."""
+        # Size 1 never negotiates (no hash is computed): nothing to cache.
+        if self._meta_cache is None or op.kind == "ag" or self._size == 1:
+            return
+        if (op.name not in self._meta_cache
+                and len(self._meta_cache) < self._meta_capacity):
+            self._meta_cache[op.name] = op.my_hash
+
     def _jit_for(self, kind: str, length_or_shape, dtype, root: int = 0):
         import jax
 
@@ -502,6 +610,13 @@ class XlaDataPlane:
                 fn = jax.jit(lambda a: a.reshape((-1,) + a.shape[2:]),
                              out_shardings=self._out_sharding)
             self._fns[key] = fn
+            # LRU bound: a pathological shape stream (per-sample ragged
+            # allgathers) used to grow this — and jax's compile cache
+            # behind it — without limit.
+            while len(self._fns) > _JIT_CACHE_CAPACITY:
+                self._fns.popitem(last=False)
+        else:
+            self._fns.move_to_end(key)
         return fn
 
     def _global_array(self, local: np.ndarray, replicated: bool = False):

@@ -36,6 +36,9 @@ def populated_registry():
     reg.record_abort("ranks_down")
     reg.record_last_announce(1, 2)
     reg.set_restart_epoch(1)
+    reg.record_cache("engine", "hits")
+    reg.record_cache("xla", "misses")
+    reg.set_cache_size("engine", 1)
     for name in metrics.HISTOGRAMS:
         reg.observe(name, 0.001)
     return reg

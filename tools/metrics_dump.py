@@ -10,7 +10,8 @@ hvd.metrics_snapshot() returns.
     python tools/metrics_dump.py --stragglers run.json.0      # skew view
 
 Prints the per-op table (ops and bytes per data plane), fusion-batch
-counters, stall events, and per-histogram count/mean/p50/p99 estimated
+counters, stall events, response-cache hit rates (docs/performance.md),
+and per-histogram count/mean/p50/p99 estimated
 from the fixed buckets (linear interpolation inside the bucket, the
 standard Prometheus histogram_quantile estimate) — made for BENCH_* round
 analysis next to bench.py's throughput numbers.
@@ -150,6 +151,32 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
     else:
         lines.append("(no negotiations recorded — single rank, or not the "
                      "coordinator's dump)")
+
+    # Response cache (docs/performance.md); .get keeps pre-cache dumps
+    # readable.  The hit-rate line is the first thing to look at when a
+    # job's negotiation_sec p50 is higher than expected.
+    cache = snap.get("cache", {})
+    base_cache = (base or {}).get("cache", {})
+    lines.append("== response cache ==")
+    printed = False
+    for plane in sorted(cache):
+        c = {k: cache[plane].get(k, 0)
+             for k in ("hits", "misses", "evictions")}
+        if base:
+            for k in c:
+                c[k] -= base_cache.get(plane, {}).get(k, 0)
+        total = c["hits"] + c["misses"]
+        if not total and not c["evictions"]:
+            continue
+        printed = True
+        rate = 100.0 * c["hits"] / total if total else 0.0
+        size = "" if base else f", size {cache[plane].get('size', 0)}"
+        lines.append(f"{plane:<8}hits {c['hits']}, misses {c['misses']}, "
+                     f"evictions {c['evictions']}, "
+                     f"hit-rate {rate:.1f}%{size}")
+    if not printed:
+        lines.append("(no cache traffic — disabled, single step, or a "
+                     "pre-cache dump)")
 
     lines.append("== histograms ==")
     lines.append(f"{'name':<18}{'count':>8}{'mean':>10}{'p50':>10}"
