@@ -256,11 +256,15 @@ if hvd.rank() == 0:
     # the launcher env enables the registry, so the snapshot carries the
     # op/byte/stall counters for this rank's run.
     snap = hvd.metrics_snapshot()
+    at = snap["autotune"]
     print("METRICS_JSON " + json.dumps({{
         "collective_ops": sum(sum(v.values()) for v in snap["ops"].values()),
         "collective_bytes_in": sum(v["in"] for v in snap["bytes"].values()),
         "collective_bytes_out": sum(v["out"] for v in snap["bytes"].values()),
         "stall_events": snap["stalls"]["count"],
+        "autotune": {{k: at[k] for k in ("enabled", "frozen", "windows",
+                                         "fusion_threshold",
+                                         "cycle_time_ms")}},
     }}), flush=True)
 """
     repo = os.path.dirname(os.path.abspath(__file__))
@@ -269,6 +273,14 @@ if hvd.rank() == 0:
     # Metrics ride along in extra_metrics (docs/metrics.md); an explicit
     # HVD_TPU_METRICS=0 in the caller's env still wins.
     env.setdefault("HVD_TPU_METRICS", "1")
+    if os.environ.get("BENCH_AUTOTUNE", "0") != "0":
+        # Autotune ride-along (docs/performance.md#autotuning): tune while
+        # the bandwidth bench runs and fold the applied params into
+        # extra_metrics.  Small windows — the bench only runs
+        # BENCH_ITERS collectives.
+        env["HVD_TPU_AUTOTUNE"] = "1"
+        env.setdefault("HVD_TPU_AUTOTUNE_WINDOW", "4")
+        env.setdefault("HVD_TPU_AUTOTUNE_WARMUP", "1")
     out = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_), "--",
          sys.executable, "-c", code],
@@ -304,7 +316,14 @@ def bench_small_allreduce() -> None:
     and the engine tick — exactly what the response cache and adaptive
     tick attack.  Runs twice (cache on, then HVD_TPU_RESPONSE_CACHE=0) and
     folds the comparison, rank 0's cache hit/miss counters, and the
-    negotiation_sec p50 into extra_metrics."""
+    negotiation_sec p50 into extra_metrics.
+
+    BENCH_AUTOTUNE=1 adds a third run: online autotuning from
+    deliberately bad initial params (fusion threshold 1024 B, cycle 50 ms
+    — the docs/performance.md#autotuning acceptance shape), training
+    until the search freezes, then measuring steady-state throughput.
+    extra_metrics gains the tuned ops/sec, tuned-vs-default ratio,
+    windows-to-convergence, and the frozen params."""
     import subprocess
     import sys
 
@@ -316,7 +335,7 @@ def bench_small_allreduce() -> None:
     steps = int(os.environ.get("BENCH_STEPS", "50"))
     repo = os.path.dirname(os.path.abspath(__file__))
     code = f"""
-import json, sys, time, numpy as np, horovod_tpu as hvd
+import json, os, sys, time, numpy as np, horovod_tpu as hvd
 sys.path.insert(0, {repo!r})
 from tools.metrics_dump import quantile
 hvd.init()
@@ -333,6 +352,18 @@ def step():
     for h in hs:
         h.wait()
 step()  # warm: full negotiation populates the cache
+if os.environ.get("HVD_TPU_AUTOTUNE"):
+    # Autotune mode: train through the search (bad initial params) until
+    # it freezes, so the timed window below measures the TUNED steady
+    # state, not the climb.  The break is decided COLLECTIVELY: ranks
+    # observe the freeze broadcast at different wall times, and a
+    # rank-local break would leave the others' last step unmatched.
+    for s in range(4000):
+        step()
+        f = np.asarray([int(hvd.autotune_report()["frozen"])], np.int32)
+        if int(hvd.allreduce(f, average=False,
+                             name="at.poll")[0]) == hvd.size():
+            break
 t0 = time.perf_counter()
 for s in range(S - 1):
     step()
@@ -344,19 +375,31 @@ if hvd.rank() == 0:
         "ops_per_sec": K * (S - 1) / dt,
         "cache": snap["cache"]["engine"],
         "negotiation_p50_us": round((p50 or 0.0) * 1e6, 1),
+        "autotune": snap["autotune"],
     }}), flush=True)
 """
 
-    def run(cache_on: bool) -> dict:
+    def run(cache_on: bool, autotune: bool = False) -> dict:
         env = dict(os.environ,
                    PYTHONPATH=repo + os.pathsep +
                    os.environ.get("PYTHONPATH", ""),
                    HVD_TPU_RESPONSE_CACHE="1" if cache_on else "0")
         env.setdefault("HVD_TPU_METRICS", "1")
-        # A tight idle cycle keeps the (cache-independent) co-arrival
-        # alignment window from drowning the negotiation-work delta this
-        # bench exists to measure; override to probe other regimes.
-        env.setdefault("HVD_TPU_CYCLE_TIME_MS", "1")
+        if autotune:
+            # The acceptance shape (docs/performance.md#autotuning):
+            # deliberately bad initial params the search must climb out
+            # of before the timed window runs.
+            env["HVD_TPU_AUTOTUNE"] = "1"
+            env["HVD_TPU_FUSION_THRESHOLD"] = "1024"
+            env["HVD_TPU_CYCLE_TIME_MS"] = "50"
+            env.setdefault("HVD_TPU_AUTOTUNE_WINDOW", "256")
+        else:
+            env.pop("HVD_TPU_AUTOTUNE", None)
+            # A tight idle cycle keeps the (cache-independent) co-arrival
+            # alignment window from drowning the negotiation-work delta
+            # this bench exists to measure; override to probe other
+            # regimes.
+            env.setdefault("HVD_TPU_CYCLE_TIME_MS", "1")
         out = subprocess.run(
             [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
              "--", sys.executable, "-c", code],
@@ -385,6 +428,21 @@ if hvd.rank() == 0:
             "negotiation_p50_us_uncached": off["negotiation_p50_us"],
         },
     }
+    if os.environ.get("BENCH_AUTOTUNE", "0") != "0":
+        tuned = run(True, autotune=True)
+        at = tuned.get("autotune", {})
+        record["extra_metrics"].update({
+            "autotune_ops_per_sec": round(tuned["ops_per_sec"], 1),
+            # >= 0.9 is the acceptance bar: starting from deliberately
+            # bad params the tuner must recover (nearly) the hand-tuned
+            # default throughput.
+            "autotune_vs_default": round(
+                tuned["ops_per_sec"] / max(on["ops_per_sec"], 1e-9), 3),
+            "autotune_windows_to_convergence": at.get("windows"),
+            "autotune_frozen": at.get("frozen"),
+            "autotune_fusion_threshold": at.get("fusion_threshold"),
+            "autotune_cycle_time_ms": at.get("cycle_time_ms"),
+        })
     print(json.dumps(record))
 
 

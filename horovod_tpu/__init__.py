@@ -29,6 +29,8 @@ from horovod_tpu.common import (  # noqa: F401
     allgather_async,
     allreduce,
     allreduce_async,
+    autotune_report,
+    autotune_set,
     broadcast,
     broadcast_async,
     init,

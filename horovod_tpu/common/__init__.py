@@ -124,7 +124,9 @@ def _load_lib():
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_double,
             ctypes.c_longlong, ctypes.c_double, ctypes.c_char_p,
-            ctypes.c_int, ctypes.c_double, ctypes.c_longlong]
+            ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
+            ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_double]
         lib.hvd_tpu_init_error.restype = ctypes.c_char_p
         lib.hvd_tpu_enqueue.restype = ctypes.c_longlong
         lib.hvd_tpu_enqueue.argtypes = [
@@ -185,6 +187,27 @@ def _load_lib():
         lib.hvd_tpu_cache_eviction_count.argtypes = []
         lib.hvd_tpu_cache_size.restype = ctypes.c_longlong
         lib.hvd_tpu_cache_size.argtypes = []
+        lib.hvd_tpu_autotune_enabled.restype = ctypes.c_int
+        lib.hvd_tpu_autotune_enabled.argtypes = []
+        lib.hvd_tpu_autotune_frozen.restype = ctypes.c_int
+        lib.hvd_tpu_autotune_frozen.argtypes = []
+        lib.hvd_tpu_autotune_windows.restype = ctypes.c_longlong
+        lib.hvd_tpu_autotune_windows.argtypes = []
+        lib.hvd_tpu_autotune_fusion_threshold.restype = ctypes.c_longlong
+        lib.hvd_tpu_autotune_fusion_threshold.argtypes = []
+        lib.hvd_tpu_autotune_cycle_time_us.restype = ctypes.c_longlong
+        lib.hvd_tpu_autotune_cycle_time_us.argtypes = []
+        lib.hvd_tpu_autotune_best_score.restype = ctypes.c_double
+        lib.hvd_tpu_autotune_best_score.argtypes = []
+        lib.hvd_tpu_autotune_history.restype = ctypes.c_char_p
+        lib.hvd_tpu_autotune_history.argtypes = []
+        lib.hvd_tpu_autotune_applied.restype = ctypes.c_char_p
+        lib.hvd_tpu_autotune_applied.argtypes = []
+        lib.hvd_tpu_autotune_set.restype = ctypes.c_int
+        lib.hvd_tpu_autotune_set.argtypes = [ctypes.c_longlong,
+                                             ctypes.c_double]
+        lib.hvd_tpu_fusion_threshold_at.restype = ctypes.c_longlong
+        lib.hvd_tpu_fusion_threshold_at.argtypes = [ctypes.c_longlong]
         lib.hvd_tpu_timeline_enabled.restype = ctypes.c_int
         lib.hvd_tpu_timeline_op_start.argtypes = [ctypes.c_char_p,
                                                   ctypes.c_char_p]
@@ -246,12 +269,19 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
     timeline = _resolve_timeline_path(cfg.timeline_path, ps.rank,
                                       cfg.restart_epoch)
     data = ",".join(ps.data_endpoints) if ps.data_endpoints else ""
+    from horovod_tpu.common import autotune as _autotune
+
+    # Pin-spec errors must surface at init, not be silently dropped into
+    # a knob the user asked to hold (common/autotune.py).
+    fix_fusion, fix_cycle = _autotune.parse_fix(cfg.autotune_fix)
     rc = lib.hvd_tpu_init(
         ps.rank, ps.size, ps.local_rank, ps.local_size,
         (ps.coord_endpoint or "").encode(), data.encode(),
         cfg.cycle_time_ms, cfg.fusion_threshold, cfg.stall_warning_sec,
         timeline.encode(), int(cfg.hierarchical_allreduce),
-        cfg.collective_timeout_sec, cfg.effective_cache_capacity)
+        cfg.collective_timeout_sec, cfg.effective_cache_capacity,
+        int(cfg.autotune), cfg.autotune_warmup, cfg.autotune_window,
+        fix_fusion, fix_cycle)
     if rc != 0:
         raise HorovodInternalError(
             "engine initialization failed: "
@@ -540,18 +570,35 @@ def _sync_engine_cache() -> None:
             metrics.registry.set_cache_size("xla", len(meta))
 
 
+def _sync_engine_autotune() -> None:
+    """Mirror the engine's autotuning state into the registry's ungated
+    ``"autotune"`` section (docs/performance.md#autotuning).  Unlike the
+    event syncs above this is a state COPY, not a delta fold: the report
+    is current-state plus bounded logs, so overwriting is idempotent and
+    a ``metrics_reset()`` simply re-mirrors on the next snapshot."""
+    if _lib is None:
+        return
+    from horovod_tpu.common import autotune as _autotune
+
+    with _stall_sync_lock:
+        metrics.registry.set_autotune(_autotune.report(_lib))
+
+
 def metrics_snapshot() -> dict:
     """Plain nested dict of the collective metrics registry: op/byte
     counters per data plane, fusion-batch counters, latency/fill
-    histograms, stall events (engine sweep + XLA-plane waits), and the
-    coordinator's announce-order skew accounting (``"skew"``, rank 0).
-    Always callable; counters and histograms only accumulate while metrics
-    are enabled (``HVD_TPU_METRICS=1``, a metrics file, or a monitor
-    port); stall, fault, and skew records always do."""
+    histograms, stall events (engine sweep + XLA-plane waits), the
+    coordinator's announce-order skew accounting (``"skew"``, rank 0),
+    and the online-autotuning state (``"autotune"``: applied params,
+    freeze state, per-window search history).  Always callable; counters
+    and histograms only accumulate while metrics are enabled
+    (``HVD_TPU_METRICS=1``, a metrics file, or a monitor port); stall,
+    fault, skew, and autotune records always do."""
     _sync_engine_stalls()
     _sync_engine_aborts()
     _sync_engine_announces()
     _sync_engine_cache()
+    _sync_engine_autotune()
     return metrics.registry.snapshot()
 
 
@@ -564,6 +611,41 @@ def metrics_reset() -> None:
     _sync_engine_announces()
     _sync_engine_cache()
     metrics.registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Online autotuning API (common/autotune.py; docs/performance.md).
+# ---------------------------------------------------------------------------
+
+
+def autotune_report() -> dict:
+    """The online-autotuning report: whether the tuner is enabled/frozen,
+    the currently applied ``fusion_threshold`` / ``cycle_time_ms`` (set by
+    lockstep broadcast — identical on every rank of a healthy job), the
+    per-rank ``applied`` parameter log, and — on rank 0 — the per-window
+    search ``history`` with scores.  Callable without ``init()`` (returns
+    the empty shape) so post-shutdown tooling can read the last state."""
+    from horovod_tpu.common import autotune as _autotune
+
+    if _lib is None:
+        return _autotune.empty_report()
+    return _autotune.report(_lib)
+
+
+def autotune_set(fusion_threshold: Optional[int] = None,
+                 cycle_time_ms: Optional[float] = None) -> None:
+    """Inject engine parameters for lockstep broadcast at the next
+    negotiation tick — the pluggable-policy seam: a custom tuning policy
+    runs on rank 0, reads ``metrics_snapshot()``, and drives the same
+    broadcast machinery the built-in search uses, so every rank applies
+    the change at the same tick boundary.  Works with the built-in tuner
+    disabled or frozen; while a search is live it resumes from the
+    nearest grid point.  Rank 0 only (``ValueError`` elsewhere)."""
+    lib = _load_lib()
+    _check_initialized(lib)
+    from horovod_tpu.common import autotune as _autotune
+
+    _autotune.set_params(lib, fusion_threshold, cycle_time_ms)
 
 
 # ---------------------------------------------------------------------------

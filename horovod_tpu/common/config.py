@@ -77,6 +77,17 @@ class Config:
     # bounds the per-rank entry count (LRU eviction past it).
     response_cache: bool = True
     cache_capacity: int = 1024
+    # Online autotuning (common/autotune.py, docs/performance.md
+    # #autotuning): HVD_TPU_AUTOTUNE=1 lets the coordinator tune
+    # fusion_threshold and cycle_time_ms online, broadcasting candidates
+    # in the response list so every rank applies them in lockstep.  The
+    # first `autotune_warmup` windows (of `autotune_window` negotiated
+    # collectives each) are discarded; `autotune_fix` pins knobs
+    # ("fusion_threshold=67108864,cycle_time_ms=5").
+    autotune: bool = False
+    autotune_warmup: int = 2
+    autotune_window: int = 32
+    autotune_fix: str = ""
 
     @property
     def effective_cache_capacity(self) -> int:
@@ -124,4 +135,10 @@ class Config:
                 "HVD_TPU_RESPONSE_CACHE", "1")),
             cache_capacity=int(os.environ.get(
                 "HVD_TPU_CACHE_CAPACITY") or 1024),
+            autotune=_flag(os.environ.get("HVD_TPU_AUTOTUNE")),
+            autotune_warmup=int(os.environ.get(
+                "HVD_TPU_AUTOTUNE_WARMUP") or 2),
+            autotune_window=int(os.environ.get(
+                "HVD_TPU_AUTOTUNE_WINDOW") or 32),
+            autotune_fix=os.environ.get("HVD_TPU_AUTOTUNE_FIX", ""),
         )

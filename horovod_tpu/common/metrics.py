@@ -133,6 +133,17 @@ class MetricsRegistry:
         # asserts a hit rate without enabling full metrics.
         self._cache = {p: {"hits": 0, "misses": 0, "evictions": 0,
                            "size": 0} for p in PLANES}
+        # Online autotuning (docs/performance.md#autotuning): a mirror of
+        # the engine's state (applied params, freeze verdict, per-window
+        # search history), refreshed on every snapshot by
+        # hvd.metrics_snapshot().  Ungated, like stalls: the acceptance
+        # path asserts frozen params without enabling full metrics.
+        # Local import: this module loads from common/__init__.py, so a
+        # module-level sibling import would run during the package's
+        # partial initialization.
+        from horovod_tpu.common.autotune import empty_report
+
+        self._autotune = empty_report()
         self._hists = {name: Histogram(bounds)
                        for name, (bounds, _) in HISTOGRAMS.items()}
 
@@ -206,6 +217,13 @@ class MetricsRegistry:
         with self._lock:
             self._cache[plane]["size"] = int(size)
 
+    def set_autotune(self, report: dict) -> None:
+        """Mirror the engine's autotuning report (a state copy — the
+        report carries current values plus bounded logs, so overwriting
+        is idempotent).  Ungated."""
+        with self._lock:
+            self._autotune = dict(report)
+
     def record_last_announce(self, rank: int, n: int = 1) -> None:
         """`rank` announced a negotiated collective last, `n` times
         (coordinator view, folded in from the engine).  Ungated."""
@@ -250,6 +268,13 @@ class MetricsRegistry:
                     "last_to_announce": dict(self._skew["last_to_announce"]),
                 },
                 "cache": {p: dict(v) for p, v in self._cache.items()},
+                "autotune": {
+                    **self._autotune,
+                    "history": [dict(h) for h in
+                                self._autotune.get("history", [])],
+                    "applied": [dict(a) for a in
+                                self._autotune.get("applied", [])],
+                },
                 "histograms": {name: h.to_dict()
                                for name, h in self._hists.items()},
             }
@@ -350,6 +375,35 @@ def prometheus_text(snapshot: dict) -> str:
     for plane, per_kind in cache.items():
         out.append(f'hvd_tpu_response_cache_size{{plane="{plane}"}} '
                    f'{per_kind.get("size", 0)}')
+
+    tune = snapshot.get("autotune", {})
+    out.append("# HELP hvd_tpu_autotune_enabled "
+               "online autotuning opted in (HVD_TPU_AUTOTUNE)")
+    out.append("# TYPE hvd_tpu_autotune_enabled gauge")
+    out.append(f"hvd_tpu_autotune_enabled {int(tune.get('enabled', False))}")
+    out.append("# HELP hvd_tpu_autotune_frozen "
+               "autotuning search converged and froze")
+    out.append("# TYPE hvd_tpu_autotune_frozen gauge")
+    out.append(f"hvd_tpu_autotune_frozen {int(tune.get('frozen', False))}")
+    out.append("# HELP hvd_tpu_autotune_windows_total "
+               "tuning windows scored (coordinator view)")
+    out.append("# TYPE hvd_tpu_autotune_windows_total counter")
+    out.append(f"hvd_tpu_autotune_windows_total {tune.get('windows', 0)}")
+    out.append("# HELP hvd_tpu_autotune_fusion_threshold_bytes "
+               "currently applied tensor-fusion threshold")
+    out.append("# TYPE hvd_tpu_autotune_fusion_threshold_bytes gauge")
+    out.append("hvd_tpu_autotune_fusion_threshold_bytes "
+               f"{tune.get('fusion_threshold', 0)}")
+    out.append("# HELP hvd_tpu_autotune_cycle_time_seconds "
+               "currently applied negotiation cycle time")
+    out.append("# TYPE hvd_tpu_autotune_cycle_time_seconds gauge")
+    out.append("hvd_tpu_autotune_cycle_time_seconds "
+               f"{repr(float(tune.get('cycle_time_ms', 0.0)) / 1000.0)}")
+    out.append("# HELP hvd_tpu_autotune_best_score "
+               "best window score seen (payload bytes+ops per second)")
+    out.append("# TYPE hvd_tpu_autotune_best_score gauge")
+    out.append(f"hvd_tpu_autotune_best_score "
+               f"{repr(float(tune.get('best_score', 0.0)))}")
 
     skew = snapshot.get("skew", {})
     out.append("# HELP hvd_tpu_announce_total "

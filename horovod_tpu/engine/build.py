@@ -11,11 +11,13 @@ from __future__ import annotations
 
 import hashlib
 import os
+import shutil
 import subprocess
 import tempfile
 
 _CC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cc")
-_SOURCES = ["net.cc", "wire.cc", "timeline.cc", "engine.cc", "c_api.cc"]
+_SOURCES = ["net.cc", "wire.cc", "timeline.cc", "autotune.cc", "engine.cc",
+            "c_api.cc"]
 _LIB_NAME = "libhvdtpu.so"
 
 # -O3 + native SIMD for the AccumulateSum / half-conversion hot loops.
@@ -69,29 +71,73 @@ def needs_build() -> bool:
     return False
 
 
+def _sweep_stale_tmp() -> None:
+    """Remove build droppings an earlier interrupted build left next to
+    the sources: tmp*.so from the pre-temp-dir scheme (SIGKILL — e.g. the
+    launcher's kill cascade — mid-compile leaked the mkstemp file), and
+    stage_*.so.part from a kill during the staging copy.  Staging files
+    are age-gated: a young one may belong to a CONCURRENT builder
+    mid-copy and must not be unlinked from under it."""
+    import time
+
+    try:
+        for fname in os.listdir(_CC_DIR):
+            path = os.path.join(_CC_DIR, fname)
+            stale = fname.startswith("tmp") and (
+                fname.endswith(".so") or fname.endswith(".so.part"))
+            if fname.startswith("stage_") and fname.endswith(".so.part"):
+                try:
+                    stale = time.time() - os.path.getmtime(path) > 300
+                except OSError:
+                    stale = False
+            if stale:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
 def build(verbose: bool = False) -> str:
     """Compile the engine; returns the .so path.  Raises on failure."""
     lib = lib_path()
     if not needs_build():
         return lib
+    _sweep_stale_tmp()
     cxx = os.environ.get("CXX", "g++")
     srcs = [os.path.join(_CC_DIR, s) for s in _SOURCES]
-    # Build into a temp file then atomically rename, so concurrent test
-    # processes racing to build don't load a half-written .so.
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CC_DIR)
-    os.close(fd)
-    cmd = [cxx] + _FLAGS + ["-o", tmp] + srcs
+    # Compile in a throwaway temp DIRECTORY (system tmp, not the package
+    # tree): a process killed mid-compile — the common leak source was the
+    # launcher's kill cascade landing during a ~10 s rebuild — can no
+    # longer strand tmp*.so files next to the sources.  The finished
+    # binary is then staged next to the target and atomically renamed, so
+    # concurrent test processes racing to build don't load a half-written
+    # .so; the staging window is a few ms of copy, not the whole compile.
+    tmpdir = tempfile.mkdtemp(prefix="hvdtpu_build_")
+    stage = None
     try:
+        out = os.path.join(tmpdir, _LIB_NAME)
+        cmd = [cxx] + _FLAGS + ["-o", out] + srcs
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"failed to build {_LIB_NAME}:\n{proc.stderr}")
-        os.replace(tmp, lib)
+        # prefix "stage_", NOT the mkstemp default "tmp": _sweep_stale_tmp
+        # matches tmp* and must never unlink a CONCURRENT builder's live
+        # staging file mid-copy.
+        fd, stage = tempfile.mkstemp(prefix="stage_", suffix=".so.part",
+                                     dir=_CC_DIR)
+        os.close(fd)
+        shutil.copy(out, stage)  # tmpdir may be another filesystem
+        os.replace(stage, lib)
+        stage = None
         with open(_stamp_path(), "w") as f:
             f.write(_build_stamp())
     finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+        if stage is not None and os.path.exists(stage):
+            os.unlink(stage)
+        shutil.rmtree(tmpdir, ignore_errors=True)
     if verbose:
         print(f"[horovod_tpu] built {lib}")
     return lib

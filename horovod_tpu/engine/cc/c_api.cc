@@ -43,7 +43,10 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
                  double cycle_time_ms, long long fusion_threshold,
                  double stall_warning_sec, const char* timeline_path,
                  int hierarchical_allreduce, double collective_timeout_sec,
-                 long long cache_capacity) {
+                 long long cache_capacity, int autotune,
+                 long long autotune_warmup, long long autotune_window,
+                 long long autotune_fix_fusion,
+                 double autotune_fix_cycle_ms) {
   EngineOptions opts;
   opts.rank = rank;
   opts.size = size;
@@ -58,6 +61,11 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
   opts.hierarchical_allreduce = hierarchical_allreduce != 0;
   opts.collective_timeout_sec = collective_timeout_sec;
   opts.cache_capacity = cache_capacity;
+  opts.autotune = autotune != 0;
+  opts.autotune_warmup = autotune_warmup;
+  opts.autotune_window = autotune_window;
+  opts.autotune_fix_fusion = autotune_fix_fusion;
+  opts.autotune_fix_cycle_ms = autotune_fix_cycle_ms;
   std::string err;
   int rc = GlobalEngine()->Init(opts, &err);
   if (rc != 0) {
@@ -222,6 +230,63 @@ const char* hvd_tpu_last_announce_counts() {
   static thread_local std::string tl_last_announce;
   tl_last_announce = GlobalEngine()->LastAnnounceCounts();
   return tl_last_announce.c_str();
+}
+
+// Online-autotuning observability and control (docs/performance.md
+// #autotuning).  The applied parameters come from lockstep broadcasts, so
+// they agree across the ranks of a healthy job; history/best-score are
+// coordinator-side (rank 0).
+int hvd_tpu_autotune_enabled() {
+  return GlobalEngine()->AutotuneEnabled() ? 1 : 0;
+}
+
+int hvd_tpu_autotune_frozen() {
+  return GlobalEngine()->AutotuneFrozen() ? 1 : 0;
+}
+
+long long hvd_tpu_autotune_windows() {
+  return GlobalEngine()->AutotuneWindows();
+}
+
+long long hvd_tpu_autotune_fusion_threshold() {
+  return GlobalEngine()->CurrentFusionThreshold();
+}
+
+long long hvd_tpu_autotune_cycle_time_us() {
+  return GlobalEngine()->CurrentCycleTimeUs();
+}
+
+double hvd_tpu_autotune_best_score() {
+  return GlobalEngine()->AutotuneBestScore();
+}
+
+// Rank-0 per-window search history, "window|fusion|cycle_us|score;...".
+const char* hvd_tpu_autotune_history() {
+  static thread_local std::string tl_autotune_history;
+  tl_autotune_history = GlobalEngine()->AutotuneHistory();
+  return tl_autotune_history.c_str();
+}
+
+// Per-rank applied-parameter log, "tick|fusion|cycle_us|frozen;..." —
+// identical on every rank (the lockstep determinism contract).
+const char* hvd_tpu_autotune_applied() {
+  static thread_local std::string tl_autotune_applied;
+  tl_autotune_applied = GlobalEngine()->AutotuneApplied();
+  return tl_autotune_applied.c_str();
+}
+
+// Manual parameter injection (hvd.autotune_set; the pluggable-policy
+// seam): broadcast fusion/cycle (< 0 keeps the current value) at the next
+// tick.  0 ok, 1 not-the-coordinator, 2 uninitialized.
+int hvd_tpu_autotune_set(long long fusion_threshold, double cycle_time_ms) {
+  return GlobalEngine()->AutotuneInject(fusion_threshold, cycle_time_ms);
+}
+
+// Fusion threshold in force at engine tick `tick` (the XLA plane keys its
+// bucket boundaries off this so autotuned thresholds move them in
+// lockstep across ranks).
+long long hvd_tpu_fusion_threshold_at(long long tick) {
+  return GlobalEngine()->FusionThresholdAt(tick);
 }
 
 // Timeline hooks for the XLA data plane (jax/eager_mesh.py): plane-side

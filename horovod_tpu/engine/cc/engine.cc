@@ -461,6 +461,24 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   cache_.set_capacity(opts_.cache_capacity);
   cache_.Clear();
   cache_size_.store(0);
+  // Online autotuning (docs/performance.md#autotuning): the search runs
+  // at the coordinator only; every rank tracks the applied parameters.
+  // State is per-engine-lifetime — a restart epoch re-tunes from its env
+  // (the winning params are in the previous run's report for pinning).
+  tuner_.Configure(opts_.autotune && (opts_.rank == 0 || opts_.size == 1),
+                   opts_.autotune_warmup, opts_.autotune_window,
+                   opts_.autotune_fix_fusion, opts_.autotune_fix_cycle_ms,
+                   opts_.fusion_threshold, opts_.cycle_time_ms);
+  cur_fusion_.store(opts_.fusion_threshold);
+  cur_cycle_us_.store(static_cast<int64_t>(opts_.cycle_time_ms * 1000.0));
+  autotune_frozen_.store(false);
+  applied_window_.store(0);
+  {
+    std::lock_guard<std::mutex> lk(autotune_mu_);
+    applied_log_.clear();
+    fusion_history_.clear();
+    fusion_history_.emplace_back(0, opts_.fusion_threshold);
+  }
   last_stall_check_ = std::chrono::steady_clock::now();
   initialized_.store(true);
   background_ = std::thread([this]() { BackgroundLoop(); });
@@ -954,6 +972,7 @@ bool Engine::RunLoopOnce() {
     coord_->shutdown_requested |= my_requests.shutdown;
     CoordinatorHandle(my_requests, 0);
     responses = CoordinatorTick();
+    AttachTunedParams(&responses);
   } else if (opts_.rank == 0) {
     coord_->shutdown_requested |= my_requests.shutdown;
     CoordinatorHandle(my_requests, 0);
@@ -992,6 +1011,7 @@ bool Engine::RunLoopOnce() {
     }
     CheckCollectiveTimeout();
     responses = CoordinatorTick();
+    AttachTunedParams(&responses);
     std::vector<uint8_t> out = SerializeResponseList(responses);
     for (int r = 1; r < opts_.size; ++r) SendFrame(coord_fds_[r], out);
   } else {
@@ -1025,6 +1045,12 @@ bool Engine::RunLoopOnce() {
     }
   }
 
+  // Tuned parameters apply BEFORE this tick's cache-hit replay: the
+  // replay re-fuses under opts_.fusion_threshold, and every rank
+  // processes this same list at this same tick index, so fusion-plan
+  // changes land at one lockstep boundary instead of splitting the job
+  // into old-threshold and new-threshold camps.
+  if (responses.tuned_present) ApplyTunedParams(responses);
   ProcessCacheHits(responses.cache_hits);
   for (const auto& resp : responses.responses) PerformOperation(resp);
   // The response list (identical on every rank) is fully processed: close
@@ -1209,6 +1235,18 @@ void Engine::CoordinatorHandleBits(const std::vector<uint32_t>& bits,
       if (opts_.size > 1) RecordAnnounce(from_rank, pb.first_seen);
       timeline_.Instant(s->name, "NEGOTIATE_CACHED");
       timeline_.NegotiateEnd(s->name);
+      // Autotune window accounting: a bit agreement is one negotiated
+      // collective of the slot's payload size (the steady-state path the
+      // tuner mostly scores).  NOOP slots score zero bytes, matching the
+      // fresh-negotiation path — their dims are metadata geometry, not
+      // payload, and mixed scoring would bias windows by cache-hit mix.
+      if (tuner_.active())
+        tuner_.Record(
+            s->op == OP_NOOP
+                ? 0
+                : NumElements(s->dims) *
+                      static_cast<int64_t>(DataTypeSize(s->dtype)),
+            1);
       coord_->cached_ready.push_back(bit);
       coord_->cache_pending.erase(bit);
     }
@@ -1444,6 +1482,10 @@ ResponseList Engine::CoordinatorTick() {
                     static_cast<int64_t>(DataTypeSize(first.dtype));
     uint8_t dtype = first.dtype;
     Response r = BuildResponse(name);
+    // Autotune window accounting: one fresh negotiation of `bytes`
+    // payload (negotiation-only no-ops score as ops moving zero bytes).
+    if (tuner_.active() && r.type != RESP_ERROR)
+      tuner_.Record(r.type == RESP_NOOP ? 0 : bytes, 1);
     // Tensor fusion: merge consecutive same-dtype allreduces while the fused
     // payload stays under the threshold (operations.cc:1607-1642).
     if (r.type == RESP_ALLREDUCE && !responses.empty() &&
@@ -1663,6 +1705,98 @@ void Engine::AbortLocal(int32_t code, const std::string& message) {
 std::string Engine::AbortMessage() {
   std::lock_guard<std::mutex> lk(abort_mu_);
   return abort_message_;
+}
+
+// ---------------------------------------------------------------------------
+// Online autotuning (docs/performance.md#autotuning).
+// ---------------------------------------------------------------------------
+
+void Engine::AttachTunedParams(ResponseList* out) {
+  // No proposals on abort/shutdown ticks: the job is ending, and the
+  // drain paths must not race a parameter mutation.
+  if (out->abort_code != 0 || out->shutdown) return;
+  ParameterManager::Proposal p;
+  tuner_.Tick(std::chrono::steady_clock::now(), cur_fusion_.load(),
+              static_cast<double>(cur_cycle_us_.load()) / 1000.0, &p);
+  if (!p.present) return;
+  out->tuned_present = true;
+  out->tuned_frozen = p.frozen;
+  out->tuned_fusion_threshold = p.fusion_threshold;
+  out->tuned_cycle_time_us = p.cycle_time_us;
+  out->tuned_window = p.window;
+}
+
+void Engine::ApplyTunedParams(const ResponseList& rl) {
+  // Runs on the engine thread of EVERY rank while processing the same
+  // broadcast list, before this tick's cache-hit replay: the tick index
+  // below is therefore identical everywhere, which is what makes the
+  // applied log comparable across ranks and the fusion history a
+  // deterministic function of the tick.
+  int64_t tick = ticks_done_.load();
+  opts_.fusion_threshold = rl.tuned_fusion_threshold;
+  opts_.cycle_time_ms =
+      static_cast<double>(rl.tuned_cycle_time_us) / 1000.0;
+  cur_fusion_.store(rl.tuned_fusion_threshold);
+  cur_cycle_us_.store(rl.tuned_cycle_time_us);
+  if (rl.tuned_frozen) autotune_frozen_.store(true);
+  applied_window_.store(rl.tuned_window);
+  {
+    std::lock_guard<std::mutex> lk(autotune_mu_);
+    char buf[96];
+    snprintf(buf, sizeof(buf), "%lld|%lld|%lld|%d",
+             static_cast<long long>(tick),
+             static_cast<long long>(rl.tuned_fusion_threshold),
+             static_cast<long long>(rl.tuned_cycle_time_us),
+             rl.tuned_frozen ? 1 : 0);
+    applied_log_.emplace_back(buf);
+    while (applied_log_.size() > 256) applied_log_.pop_front();
+    if (fusion_history_.empty() ||
+        fusion_history_.back().second != rl.tuned_fusion_threshold)
+      fusion_history_.emplace_back(tick, rl.tuned_fusion_threshold);
+    // Bounded: a pathological external policy (hvd.autotune_set per
+    // phase, for hours) must not grow this without limit.  Dropping the
+    // oldest change point makes the second-oldest the floor for all
+    // earlier ticks — safe, because the plane only queries ticks that
+    // closed recently.
+    while (fusion_history_.size() > 1024) fusion_history_.pop_front();
+  }
+  timeline_.Instant("autotune",
+                    rl.tuned_frozen ? "AUTOTUNE_FREEZE" : "AUTOTUNE_APPLY");
+}
+
+int64_t Engine::AutotuneWindows() {
+  if (opts_.rank == 0 || opts_.size == 1) return tuner_.windows();
+  return applied_window_.load();
+}
+
+std::string Engine::AutotuneApplied() {
+  std::lock_guard<std::mutex> lk(autotune_mu_);
+  std::string out;
+  for (const auto& e : applied_log_) {
+    if (!out.empty()) out += ';';
+    out += e;
+  }
+  return out;
+}
+
+int Engine::AutotuneInject(int64_t fusion, double cycle_ms) {
+  if (!initialized_.load()) return 2;
+  if (opts_.rank != 0 && opts_.size > 1) return 1;
+  tuner_.Inject(fusion, cycle_ms);
+  return 0;
+}
+
+int64_t Engine::FusionThresholdAt(int64_t tick) {
+  std::lock_guard<std::mutex> lk(autotune_mu_);
+  if (fusion_history_.empty()) return cur_fusion_.load();
+  // Last change point at or before `tick` (the history is tiny: one
+  // entry per applied threshold change).
+  int64_t value = fusion_history_.front().second;
+  for (const auto& e : fusion_history_) {
+    if (e.first > tick) break;
+    value = e.second;
+  }
+  return value;
 }
 
 // ---------------------------------------------------------------------------

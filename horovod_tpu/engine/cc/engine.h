@@ -30,6 +30,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "autotune.h"
 #include "timeline.h"
 #include "wire.h"
 
@@ -58,6 +59,18 @@ struct EngineOptions {
   // (default 1024); 0 disables (HVD_TPU_RESPONSE_CACHE=0 kill switch).
   int64_t cache_capacity = 1024;
   std::string timeline_path;
+  // Online autotuning (docs/performance.md#autotuning): rank 0 scores
+  // tuning windows of `autotune_window` negotiated collectives from the
+  // throughput the coordinator already observes and broadcasts the next
+  // (fusion_threshold, cycle_time_ms) candidate in the response list so
+  // every rank applies it at the same tick boundary.  HVD_TPU_AUTOTUNE=1
+  // opts in; the first `autotune_warmup` windows are discarded; a fix
+  // value >= 0 pins that knob (HVD_TPU_AUTOTUNE_FIX=k=v,...).
+  bool autotune = false;
+  int64_t autotune_warmup = 2;
+  int64_t autotune_window = 32;
+  int64_t autotune_fix_fusion = -1;
+  double autotune_fix_cycle_ms = -1.0;
   // Two-level allreduce: reduce to the node-local leader, ring-allreduce
   // across leaders, broadcast back within the node — the reference's
   // HOROVOD_HIERARCHICAL_ALLREDUCE (operations.cc:1003-1048) mapped to
@@ -254,6 +267,34 @@ class Engine {
   int64_t CacheEvictions() const { return cache_evictions_.load(); }
   int64_t CacheSize() const { return cache_size_.load(); }
 
+  // Online-autotuning observability (docs/performance.md#autotuning).
+  // Current applied parameters come from the lockstep broadcasts, so
+  // they are identical on every rank of a healthy job; the per-window
+  // search history and best score live at the coordinator (rank 0).
+  bool AutotuneEnabled() const { return opts_.autotune; }
+  bool AutotuneFrozen() const { return autotune_frozen_.load(); }
+  // Rank 0: completed tuning windows; workers: the window count carried
+  // by the last applied broadcast (equal once the search freezes).
+  int64_t AutotuneWindows();
+  int64_t CurrentFusionThreshold() const { return cur_fusion_.load(); }
+  int64_t CurrentCycleTimeUs() const { return cur_cycle_us_.load(); }
+  double AutotuneBestScore() { return tuner_.best_score(); }
+  // Rank 0 search history: "window|fusion|cycle_us|score;...".
+  std::string AutotuneHistory() { return tuner_.History(); }
+  // Per-rank applied-parameter log, "tick|fusion|cycle_us|frozen;..." —
+  // identical on every rank (the lockstep determinism contract; tests
+  // allgather and compare it).
+  std::string AutotuneApplied();
+  // Manual parameter injection (hvd.autotune_set, rank 0 only): broadcast
+  // `fusion` / `cycle_ms` (< 0 keeps the current value) next tick.
+  // Returns 0 ok, 1 when called off the coordinator, 2 uninitialized.
+  int AutotuneInject(int64_t fusion, double cycle_ms);
+  // Fusion threshold in force at engine tick `tick` (the XLA plane's
+  // bucket boundaries must follow autotuned thresholds in lockstep;
+  // jax/eager_mesh.py).  Past ticks are stable: the history is
+  // append-only with increasing tick stamps.
+  int64_t FusionThresholdAt(int64_t tick);
+
   // The engine-owned Chrome-tracing timeline.  Exposed so the XLA data
   // plane (Python, jax/eager_mesh.py) can emit its BUCKET_BUILD /
   // XLA_DISPATCH / DEVICE_WAIT activities into the SAME trace file as the
@@ -316,6 +357,16 @@ class Engine {
   // on the first death, arm the coordinated abort naming the missing
   // ranks and the tensors they left pending.
   void MarkRankDead(int r, const std::string& reason);
+
+  // Online autotuning (docs/performance.md#autotuning).  AttachTunedParams
+  // runs at the coordinator after CoordinatorTick: it gives the
+  // ParameterManager its per-tick chance to close a window / flush a
+  // manual injection, and folds the proposal into the outgoing response
+  // list.  ApplyTunedParams runs on EVERY rank while processing that
+  // (identical) list, before cache-hit replay, so fusion-plan changes
+  // take effect at the same tick boundary everywhere.
+  void AttachTunedParams(ResponseList* out);
+  void ApplyTunedParams(const ResponseList& rl);
 
   // Execution.  `from_cache` marks a replayed response: its cache slot was
   // already touched by ProcessCacheHits, so skip the (re-)insert.
@@ -426,6 +477,24 @@ class Engine {
   std::chrono::steady_clock::time_point epoch_{};
   std::atomic<int64_t> clock_offset_us_{0};
   std::atomic<int64_t> clock_rtt_us_{0};
+
+  // Online autotuning.  The tuner lives at the coordinator (rank 0 /
+  // single-process); the applied-parameter state below is per-rank,
+  // driven by the lockstep broadcasts.  cur_* mirror opts_ values for
+  // lock-free reads from Python API threads (opts_ itself is engine-
+  // thread-only once the loop runs).
+  ParameterManager tuner_;
+  std::atomic<int64_t> cur_fusion_{0};
+  std::atomic<int64_t> cur_cycle_us_{0};
+  std::atomic<bool> autotune_frozen_{false};
+  std::atomic<int64_t> applied_window_{0};
+  std::mutex autotune_mu_;  // guards applied_log_, fusion_history_
+  std::deque<std::string> applied_log_;  // "tick|fusion|cycle_us|frozen"
+  // (first_effective_tick, fusion_threshold) change points, appended in
+  // tick order and BOUNDED (oldest change points collapse into the
+  // floor entry — the plane only ever queries recently closed ticks);
+  // FusionThresholdAt walks this short log linearly.
+  std::deque<std::pair<int64_t, int64_t>> fusion_history_;
 
   // Announce-order accounting (rank 0).  Counts are process-cumulative;
   // the log is bounded so an unconsumed Python side cannot grow it.

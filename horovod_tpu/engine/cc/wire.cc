@@ -163,6 +163,12 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   }
   w.U32(static_cast<uint32_t>(rl.cache_hits.size()));
   for (uint32_t h : rl.cache_hits) w.U32(h);
+  w.U8((rl.tuned_present ? 1 : 0) | (rl.tuned_frozen ? 2 : 0));
+  if (rl.tuned_present) {
+    w.I64(rl.tuned_fusion_threshold);
+    w.I64(rl.tuned_cycle_time_us);
+    w.I64(rl.tuned_window);
+  }
   return std::move(w.buf);
 }
 
@@ -188,6 +194,14 @@ bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
   uint32_t nh = rd.U32();
   for (uint32_t i = 0; i < nh && rd.ok; ++i)
     rl->cache_hits.push_back(rd.U32());
+  uint8_t tuned_flags = rd.U8();
+  rl->tuned_present = (tuned_flags & 1) != 0;
+  rl->tuned_frozen = (tuned_flags & 2) != 0;
+  if (rl->tuned_present) {
+    rl->tuned_fusion_threshold = rd.I64();
+    rl->tuned_cycle_time_us = rd.I64();
+    rl->tuned_window = rd.I64();
+  }
   return rd.ok;
 }
 

@@ -108,6 +108,18 @@ struct ResponseList {
   // Cache slots every rank announced: replay the stored response for each,
   // in order, before executing `responses` (identical order everywhere).
   std::vector<uint32_t> cache_hits;
+  // Online autotuning (docs/performance.md#autotuning): when present, the
+  // coordinator's ParameterManager proposed new engine parameters this
+  // tick.  Every rank applies them BEFORE replaying this list's cache
+  // hits, so fusion-plan changes land at the same tick boundary
+  // everywhere — the lockstep-mutation contract the response cache
+  // established.  `tuned_frozen` marks the search's final verdict;
+  // `tuned_window` is the coordinator's completed-window count.
+  bool tuned_present = false;
+  bool tuned_frozen = false;
+  int64_t tuned_fusion_threshold = 0;
+  int64_t tuned_cycle_time_us = 0;
+  int64_t tuned_window = 0;
 };
 
 std::vector<uint8_t> SerializeRequestList(const RequestList& rl);

@@ -307,6 +307,18 @@ class XlaDataPlane:
         cfg_cap = cfg.effective_cache_capacity
         self._meta_cache = {} if cfg_cap > 0 else None
         self._meta_capacity = cfg_cap
+        # Online autotuning (docs/performance.md#autotuning): the engine's
+        # fusion threshold can change at tick boundaries, and the plane's
+        # bucket boundaries must follow it IDENTICALLY on every rank (a
+        # fused bucket is one compiled collective — a split into
+        # old-threshold and new-threshold camps would dispatch mismatched
+        # programs).  Memoized per tick: the engine's applied-parameter
+        # history is append-only, so a closed tick's threshold is stable.
+        self._tick_thresholds: dict = {}
+        # Single-process ops carry tick -1 (no negotiation): their
+        # threshold is the live engine value, read ONCE per flush — not
+        # per op, the bucketing loop is the dispatch hot path.
+        self._live_threshold: Optional[int] = None
         self._mu = threading.RLock()  # guards _fns, _pending, _local_seq
         self._pending: List[_PlaneOp] = []
         # Ops withdrawn by a timed-out wait, pinned so the engine's raw
@@ -463,6 +475,7 @@ class XlaDataPlane:
                 ticks_done = 0  # local ticks are -1: always closed
             else:
                 ticks_done = int(common._lib.hvd_tpu_ticks_done())
+            self._live_threshold = None  # re-read at most once per flush
             self._poll_negotiations()
             ready = [op for op in self._pending
                      if op.seq is not None and op.seq >= 0
@@ -486,7 +499,8 @@ class XlaDataPlane:
                 else:
                     key = (op.kind, op.tick, op.payload.dtype.str, op.root)
                 if (key != bucket_key
-                        or bucket_bytes + nbytes > self._fusion_threshold):
+                        or bucket_bytes + nbytes
+                        > self._threshold_for(op.tick)):
                     if bucket:
                         self._dispatch(bucket)
                     bucket = []
@@ -500,6 +514,32 @@ class XlaDataPlane:
             consumed = dispatched | {id(op) for op in failed}
             self._pending = [op for op in self._pending
                              if id(op) not in consumed]
+
+    def _threshold_for(self, tick: int) -> int:
+        """Fusion threshold in force at engine tick `tick`.  The autotuner
+        mutates the threshold in lockstep at tick boundaries (every rank
+        applies the same broadcast at the same tick index), so keying the
+        bucket limit off the op's completion tick keeps plane bucket
+        boundaries cross-rank deterministic even while the knob moves.
+        Without autotuning the engine history holds only the initial
+        value, so this degrades to the static threshold.  `tick` < 0
+        (single-process: no negotiation) reads the live value."""
+        from horovod_tpu import common
+
+        if common._lib is None:  # engine never loaded: static fallback
+            return self._fusion_threshold
+        if tick < 0:
+            if self._live_threshold is None:
+                self._live_threshold = int(
+                    common._lib.hvd_tpu_autotune_fusion_threshold())
+            return self._live_threshold
+        thr = self._tick_thresholds.get(tick)
+        if thr is None:
+            thr = int(common._lib.hvd_tpu_fusion_threshold_at(tick))
+            if len(self._tick_thresholds) > 4096:
+                self._tick_thresholds.clear()
+            self._tick_thresholds[tick] = thr
+        return thr
 
     def _wait_dispatch(self, handle: XlaHandle) -> None:
         """Block until `handle`'s op is dispatched (or failed).  Bounded by
@@ -699,7 +739,7 @@ class XlaDataPlane:
                 _metrics.registry.observe(
                     "bucket_fill",
                     min(1.0, sum(op.payload.nbytes for op in bucket)
-                        / max(self._fusion_threshold, 1)))
+                        / max(self._threshold_for(bucket[0].tick), 1)))
             self._tl_phase(tl_lib, bucket, b"XLA_DISPATCH")
             batch = _Batch(self._traced_dispatch(fn, flat, kind,
                                                  len(bucket)),
@@ -798,6 +838,13 @@ def initialize(ps) -> Optional[XlaDataPlane]:
     global _plane
     with _lock:
         if _plane is not None:
+            if _plane:
+                # Re-init in the same process: the engine's tick counter
+                # and applied-parameter history restarted, so tick-keyed
+                # fusion thresholds memoized in the previous lifetime are
+                # stale (and, being per-rank wall-time artifacts, would
+                # split ranks into different bucket plans).
+                _plane._tick_thresholds.clear()
             return _plane or None
         try:
             import jax

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Diff two bench records and fail on throughput regressions.
+
+Compares the headline ``value`` (and, with ``--extras``, every shared
+numeric ``extra_metrics`` entry) of two bench results and exits non-zero
+when the new run regressed by more than the threshold — usable locally
+("did my change cost throughput?") and as a CI gate between rounds:
+
+    python bench.py > /tmp/new.json
+    python tools/bench_compare.py BENCH_r05.json /tmp/new.json --threshold 5
+
+Accepted file shapes (all produced in this repo):
+
+* raw ``bench.py`` output — one or more JSON lines; the LAST line carrying
+  a ``metric`` key wins (bench.py re-prints the headline enriched with
+  extras, so the last parseable line is the most complete record);
+* a driver round record (``BENCH_r*.json``) — a JSON object whose
+  ``parsed`` field holds the bench record.
+
+Every metric in this repo is a throughput (higher is better); lower-is-
+better metrics would need a sign convention this tool deliberately does
+not grow until one exists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Tuple
+
+
+def load_record(path: str) -> dict:
+    """The bench record in `path` (see module docstring); raises
+    ValueError when none is found."""
+    with open(path) as f:
+        text = f.read()
+    record = None
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict):
+            record = obj.get("parsed") if isinstance(obj.get("parsed"),
+                                                     dict) else obj
+    except json.JSONDecodeError:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                record = obj
+    if not isinstance(record, dict) or "metric" not in record:
+        raise ValueError(f"{path}: no bench record found (want a JSON "
+                         f"object with a 'metric' key, a driver record "
+                         f"with 'parsed', or JSON lines)")
+    return record
+
+
+def _numeric(value) -> Optional[float]:
+    # bool is an int subclass, but True/False extras are flags, not rates.
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        return float(value)
+    return None
+
+
+def compare(old: dict, new: dict, threshold_pct: float,
+            extras: bool) -> Tuple[list, list]:
+    """(regressions, report_lines) between two bench records.  Only pairs
+    present in BOTH records compare; the headline compares only when the
+    metric names match (diffing a resnet record against a transformer
+    record is a usage error surfaced in the report)."""
+    regressions = []
+    lines = []
+
+    def check(name: str, ov: float, nv: float) -> None:
+        if ov <= 0:
+            lines.append(f"  {name}: old={ov:g} (not comparable)")
+            return
+        delta_pct = (nv - ov) / ov * 100.0
+        flag = ""
+        if delta_pct < -threshold_pct:
+            regressions.append((name, ov, nv, delta_pct))
+            flag = "  << REGRESSION"
+        lines.append(f"  {name}: {ov:g} -> {nv:g} "
+                     f"({delta_pct:+.1f}%){flag}")
+
+    if old["metric"] == new["metric"]:
+        ov, nv = _numeric(old.get("value")), _numeric(new.get("value"))
+        if ov is not None and nv is not None:
+            check(old["metric"], ov, nv)
+    else:
+        lines.append(f"  headline metrics differ: {old['metric']} vs "
+                     f"{new['metric']} (not compared)")
+    if extras:
+        oe = old.get("extra_metrics") or {}
+        ne = new.get("extra_metrics") or {}
+        for key in sorted(set(oe) & set(ne)):
+            ov, nv = _numeric(oe[key]), _numeric(ne[key])
+            if ov is not None and nv is not None:
+                check(key, ov, nv)
+    return regressions, lines
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="diff two bench records; exit 1 on a >threshold% "
+                    "throughput regression")
+    parser.add_argument("old", help="baseline bench/driver JSON file")
+    parser.add_argument("new", help="candidate bench/driver JSON file")
+    parser.add_argument("--threshold", type=float, default=10.0,
+                        metavar="PCT",
+                        help="regression tolerance in percent (default 10)")
+    parser.add_argument("--extras", action="store_true",
+                        help="also gate shared numeric extra_metrics")
+    args = parser.parse_args(argv)
+    try:
+        old = load_record(args.old)
+        new = load_record(args.new)
+    except (OSError, ValueError) as exc:
+        print(f"bench_compare: {exc}", file=sys.stderr)
+        return 2
+    regressions, lines = compare(old, new, args.threshold, args.extras)
+    print(f"bench_compare: {args.old} -> {args.new} "
+          f"(threshold {args.threshold:g}%)")
+    for line in lines:
+        print(line)
+    if regressions:
+        print(f"bench_compare: FAIL — {len(regressions)} metric(s) "
+              f"regressed more than {args.threshold:g}%", file=sys.stderr)
+        return 1
+    print("bench_compare: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

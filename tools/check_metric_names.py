@@ -39,6 +39,15 @@ def populated_registry():
     reg.record_cache("engine", "hits")
     reg.record_cache("xla", "misses")
     reg.set_cache_size("engine", 1)
+    reg.set_autotune({
+        "enabled": True, "frozen": True, "windows": 3,
+        "fusion_threshold": 1 << 20, "cycle_time_ms": 2.5,
+        "best_score": 123.4,
+        "history": [{"window": 1, "fusion_threshold": 1 << 20,
+                     "cycle_time_ms": 2.5, "score": 123.4}],
+        "applied": [{"tick": 7, "fusion_threshold": 1 << 20,
+                     "cycle_time_ms": 2.5, "frozen": True}],
+    })
     for name in metrics.HISTOGRAMS:
         reg.observe(name, 0.001)
     return reg

@@ -178,6 +178,18 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
         lines.append("(no cache traffic — disabled, single step, or a "
                      "pre-cache dump)")
 
+    # Online autotuning (docs/performance.md#autotuning); only rendered
+    # when the job opted in, so pre-autotune dumps stay unchanged.
+    tune = snap.get("autotune", {})
+    if tune.get("enabled"):
+        lines.append("== autotune ==")
+        state = "frozen" if tune.get("frozen") else "searching"
+        lines.append(
+            f"{state} after {tune.get('windows', 0)} window(s): "
+            f"fusion {_fmt_bytes(tune.get('fusion_threshold', 0))}, "
+            f"cycle {tune.get('cycle_time_ms', 0.0):g} ms, "
+            f"best score {tune.get('best_score', 0.0):.0f}")
+
     lines.append("== histograms ==")
     lines.append(f"{'name':<18}{'count':>8}{'mean':>10}{'p50':>10}"
                  f"{'p99':>10}")
