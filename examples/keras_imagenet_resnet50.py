@@ -13,6 +13,7 @@ Real data: pass --train-dir/--val-dir with an ImageNet directory layout.
 import argparse
 import math
 import os
+import tempfile
 
 import keras
 import numpy as np
@@ -24,7 +25,10 @@ parser = argparse.ArgumentParser(description="Keras ImageNet ResNet-50")
 parser.add_argument("--train-dir", default=None,
                     help="ImageNet train directory (synthetic data if unset)")
 parser.add_argument("--val-dir", default=None)
-parser.add_argument("--checkpoint-format", default="./checkpoint-{epoch}.keras")
+parser.add_argument("--checkpoint-format",
+                    default=os.path.join(tempfile.gettempdir(),
+                                         "hvd_tpu_keras_resnet50",
+                                         "checkpoint-{epoch}.keras"))
 parser.add_argument("--batch-size", type=int, default=32,
                     help="per-worker training batch size")
 parser.add_argument("--val-batch-size", type=int, default=32)
@@ -116,6 +120,8 @@ callbacks = [
         multiplier=1e-3, start_epoch=80),
 ]
 if hvd.rank() == 0:
+    os.makedirs(os.path.dirname(args.checkpoint_format) or ".",
+                exist_ok=True)
     callbacks.append(keras.callbacks.ModelCheckpoint(args.checkpoint_format))
 
 if isinstance(train_data, tuple):

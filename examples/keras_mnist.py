@@ -8,6 +8,8 @@ Run:  python -m horovod_tpu.runner -np 4 -- python examples/keras_mnist.py
 """
 
 import argparse
+import os
+import tempfile
 
 import keras
 import numpy as np
@@ -20,7 +22,9 @@ parser.add_argument("--batch-size", type=int, default=128)
 parser.add_argument("--epochs", type=int, default=4)
 parser.add_argument("--lr", type=float, default=1.0)
 parser.add_argument("--train-samples", type=int, default=4096)
-parser.add_argument("--checkpoint-dir", default=".",
+parser.add_argument("--checkpoint-dir",
+                    default=os.path.join(tempfile.gettempdir(),
+                                         "hvd_tpu_keras_mnist"),
                     help="where rank 0 writes per-epoch weights; under "
                          "`hvdrun --max-restarts` a relaunched job resumes "
                          "from the newest one (docs/fault-tolerance.md)")
@@ -73,8 +77,6 @@ callbacks = [
 ]
 # Checkpoint only on rank 0 to prevent conflicting writes.
 if hvd.rank() == 0:
-    import os
-
     os.makedirs(args.checkpoint_dir, exist_ok=True)
     callbacks.append(keras.callbacks.ModelCheckpoint(
         os.path.join(args.checkpoint_dir, "ckpt-{epoch}.weights.h5"),

@@ -9,6 +9,8 @@ Run:  python -m horovod_tpu.runner -np 4 -- python examples/keras_mnist_advanced
 
 import argparse
 import math
+import os
+import tempfile
 
 import keras
 import numpy as np
@@ -75,8 +77,11 @@ callbacks = [
         warmup_epochs=args.warmup_epochs, verbose=1),
 ]
 if hvd.rank() == 0:
+    _ckpt_dir = os.path.join(tempfile.gettempdir(),
+                             "hvd_tpu_keras_mnist_advanced")
+    os.makedirs(_ckpt_dir, exist_ok=True)
     callbacks.append(keras.callbacks.ModelCheckpoint(
-        "./checkpoint-{epoch}.keras"))
+        os.path.join(_ckpt_dir, "checkpoint-{epoch}.keras")))
 
 model.fit(x_train, y_train,
           batch_size=args.batch_size,

@@ -11,6 +11,7 @@ Run:  python -m horovod_tpu.runner -np 4 -- \
 
 import argparse
 import os
+import tempfile
 
 import numpy as np
 import torch
@@ -93,7 +94,10 @@ parser = argparse.ArgumentParser(description="PyTorch ImageNet ResNet-50")
 parser.add_argument("--train-dir", default=None,
                     help="ImageNet train directory (synthetic data if unset)")
 parser.add_argument("--val-dir", default=None)
-parser.add_argument("--checkpoint-format", default="checkpoint-{epoch}.pth.tar")
+parser.add_argument("--checkpoint-format",
+                    default=os.path.join(tempfile.gettempdir(),
+                                         "hvd_tpu_pt_resnet50",
+                                         "checkpoint-{epoch}.pth.tar"))
 parser.add_argument("--batch-size", type=int, default=32)
 parser.add_argument("--val-batch-size", type=int, default=32)
 parser.add_argument("--epochs", type=int, default=90)
@@ -252,6 +256,8 @@ def validate(epoch):
 
 def save_checkpoint(epoch):
     if hvd.rank() == 0:
+        os.makedirs(os.path.dirname(args.checkpoint_format) or ".",
+                    exist_ok=True)
         torch.save({"model": model.state_dict(),
                     "optimizer": optimizer.state_dict()},
                    args.checkpoint_format.format(epoch=epoch + 1))

@@ -12,6 +12,7 @@ Synthetic MNIST-like data by default (no downloads needed).
 
 import argparse
 import os
+import tempfile
 
 import numpy as np
 import tensorflow as tf
@@ -23,7 +24,9 @@ parser.add_argument("--batch-size", type=int, default=100)
 parser.add_argument("--steps", type=int, default=200)
 parser.add_argument("--lr", type=float, default=0.001)
 parser.add_argument("--train-samples", type=int, default=4096)
-parser.add_argument("--checkpoint-dir", default="./checkpoints")
+parser.add_argument("--checkpoint-dir",
+                    default=os.path.join(tempfile.gettempdir(),
+                                         "hvd_tpu_tf_mnist_checkpoints"))
 args = parser.parse_args()
 
 hvd.init()
@@ -78,6 +81,8 @@ def train_step(images, labels):
 
 
 ckpt_dir = args.checkpoint_dir if hvd.rank() == 0 else None
+if ckpt_dir:
+    os.makedirs(ckpt_dir, exist_ok=True)
 checkpoint = tf.train.Checkpoint(model=model, optimizer=opt)
 
 for step, (batch_images, batch_labels) in enumerate(

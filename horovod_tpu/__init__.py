@@ -24,6 +24,7 @@ from horovod_tpu.common import (  # noqa: F401
     CollectiveTimeoutError,
     HorovodInternalError,
     HorovodNotInitializedError,
+    MembershipChangedError,
     RanksDownError,
     allgather,
     allgather_async,
@@ -37,6 +38,8 @@ from horovod_tpu.common import (  # noqa: F401
     is_initialized,
     local_rank,
     local_size,
+    membership_ack,
+    membership_epoch,
     metrics_reset,
     metrics_snapshot,
     mpi_threads_supported,
@@ -47,6 +50,10 @@ from horovod_tpu.common import (  # noqa: F401
     timeline_enabled,
     trace_marker,
     trace_span,
+)
+from horovod_tpu.common.elastic import (  # noqa: F401
+    ElasticState,
+    run_elastic,
 )
 
 __version__ = "0.1.0"

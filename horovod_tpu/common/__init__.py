@@ -43,6 +43,7 @@ ST_INVALID = 4
 ST_PENDING = 5
 ST_RANKS_DOWN = 6
 ST_TIMEOUT = 7
+ST_RESHAPE = 8
 
 
 class HorovodInternalError(RuntimeError):
@@ -67,6 +68,20 @@ class CollectiveTimeoutError(HorovodInternalError):
     ``HVD_TPU_COLLECTIVE_TIMEOUT_SEC``: a subset of ranks never submitted
     the matching op (rank-divergent control flow, or a wedged — not dead —
     peer).  The message names the stalled tensors and missing ranks."""
+
+
+class MembershipChangedError(HorovodInternalError):
+    """RETRYABLE (docs/fault-tolerance.md#elastic-membership): the elastic
+    job reshaped — ranks died and the survivors re-negotiated size/rank at
+    a tick boundary (or a standby was admitted) — and this collective was
+    cancelled at the barrier.  No process relaunch or checkpoint reload is
+    needed: re-enter agreement and resync state by root broadcast
+    (``hvd.run_elastic`` does both).  ``lost_ranks`` names the dead ranks
+    in the previous membership's numbering (empty on pure grows)."""
+
+    def __init__(self, message: str, lost_ranks: Sequence[int] = ()):  # noqa: D107
+        super().__init__(message)
+        self.lost_ranks = list(lost_ranks)
 
 
 class HorovodNotInitializedError(HorovodInternalError, ValueError):
@@ -126,7 +141,8 @@ def _load_lib():
             ctypes.c_longlong, ctypes.c_double, ctypes.c_char_p,
             ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
             ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong,
-            ctypes.c_longlong, ctypes.c_double]
+            ctypes.c_longlong, ctypes.c_double, ctypes.c_int,
+            ctypes.c_longlong, ctypes.c_int]
         lib.hvd_tpu_init_error.restype = ctypes.c_char_p
         lib.hvd_tpu_enqueue.restype = ctypes.c_longlong
         lib.hvd_tpu_enqueue.argtypes = [
@@ -208,6 +224,17 @@ def _load_lib():
                                              ctypes.c_double]
         lib.hvd_tpu_fusion_threshold_at.restype = ctypes.c_longlong
         lib.hvd_tpu_fusion_threshold_at.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_elastic_enabled.restype = ctypes.c_int
+        lib.hvd_tpu_elastic_enabled.argtypes = []
+        lib.hvd_tpu_membership_epoch.restype = ctypes.c_longlong
+        lib.hvd_tpu_membership_epoch.argtypes = []
+        lib.hvd_tpu_membership_reshapes.restype = ctypes.c_longlong
+        lib.hvd_tpu_membership_reshapes.argtypes = []
+        lib.hvd_tpu_membership_info.restype = ctypes.c_char_p
+        lib.hvd_tpu_membership_info.argtypes = []
+        lib.hvd_tpu_membership_ack_pending.restype = ctypes.c_int
+        lib.hvd_tpu_membership_ack_pending.argtypes = []
+        lib.hvd_tpu_membership_ack.argtypes = []
         lib.hvd_tpu_timeline_enabled.restype = ctypes.c_int
         lib.hvd_tpu_timeline_op_start.argtypes = [ctypes.c_char_p,
                                                   ctypes.c_char_p]
@@ -266,8 +293,12 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
         comm = comm_ranks(comm, resolve_process_set(None).rank)
     ps = resolve_process_set(comm)
     cfg = Config.from_env()
-    timeline = _resolve_timeline_path(cfg.timeline_path, ps.rank,
-                                      cfg.restart_epoch)
+    # A rejoining standby's rank is a placeholder until the coordinator
+    # admits it, so a rank-keyed timeline path would collide with the live
+    # rank that currently owns that number; standbys skip the timeline.
+    timeline = ("" if cfg.rejoin else
+                _resolve_timeline_path(cfg.timeline_path, ps.rank,
+                                       cfg.restart_epoch))
     data = ",".join(ps.data_endpoints) if ps.data_endpoints else ""
     from horovod_tpu.common import autotune as _autotune
 
@@ -281,7 +312,8 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
         timeline.encode(), int(cfg.hierarchical_allreduce),
         cfg.collective_timeout_sec, cfg.effective_cache_capacity,
         int(cfg.autotune), cfg.autotune_warmup, cfg.autotune_window,
-        fix_fusion, fix_cycle)
+        fix_fusion, fix_cycle, int(cfg.elastic or cfg.rejoin),
+        cfg.min_np, int(cfg.rejoin))
     if rc != 0:
         raise HorovodInternalError(
             "engine initialization failed: "
@@ -316,10 +348,23 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
     # (/root/reference/horovod/common/operations.cc:861-914) — the plane
     # is AUTO-enabled when jax reports TPU devices; HVD_TPU_XLA_DATA_PLANE
     # (or HOROVOD_XLA_DATA_PLANE) forces it on (=1) or off (=0).
+    global _xla_plane
     auto = cfg.xla_data_plane is None
     enable = _tpu_visible() if auto else cfg.xla_data_plane
-    if enable or auto:
-        global _xla_plane
+    if cfg.elastic or cfg.rejoin:
+        # Elastic membership rides the TCP engine only: the XLA plane's
+        # device mesh is fixed at init and cannot survive a reshape, and a
+        # standby must not enqueue the init-time plane agreement into a
+        # job that is not running one.
+        if enable and not auto:
+            import warnings
+
+            warnings.warn(
+                "elastic membership (HVD_TPU_ELASTIC/--min-np) does not "
+                "support the XLA data plane; eager collectives will use "
+                "the TCP engine.")
+        _xla_plane = None
+    elif enable or auto:
         plane = None
         if enable:
             try:
@@ -415,6 +460,28 @@ def restart_epoch() -> int:
     on the first run, +1 per restart (``HVD_TPU_RESTART_EPOCH``).  Usable
     before ``init()`` — checkpoint-resume glue runs early."""
     return int(os.environ.get("HVD_TPU_RESTART_EPOCH") or 0)
+
+
+def membership_epoch() -> int:
+    """The elastic-membership epoch of this engine lifetime: 0 until the
+    first reshape, +1 per reshape survived (shrink or grow).  After a
+    reshape, ``hvd.rank()``/``hvd.size()`` re-resolve to the new dense
+    membership; this counter is how drivers notice the change
+    (docs/fault-tolerance.md#elastic-membership).  0 before ``init()``."""
+    if _lib is None:
+        return 0
+    return int(_lib.hvd_tpu_membership_epoch())
+
+
+def membership_ack() -> None:
+    """Acknowledge the latest membership reshape: clears the engine's
+    post-reshape enqueue poison so collectives negotiate again in the new
+    membership.  Call only once every rank is about to re-enter agreement
+    from a synchronized point — ``hvd.run_elastic`` does this (followed by
+    the root-broadcast state resync) and is the normal way to consume
+    reshapes."""
+    if _lib is not None:
+        _lib.hvd_tpu_membership_ack()
 
 
 def rank() -> int:
@@ -570,6 +637,29 @@ def _sync_engine_cache() -> None:
             metrics.registry.set_cache_size("xla", len(meta))
 
 
+def _sync_engine_membership() -> None:
+    """Mirror the engine's elastic-membership state into the registry's
+    ungated ``"membership"`` section (epoch, current size, reshapes, ranks
+    lost/joined).  A state copy like the autotune sync: overwriting is
+    idempotent and ``metrics_reset()`` re-mirrors on the next snapshot."""
+    if _lib is None:
+        return
+    with _stall_sync_lock:
+        info = _lib.hvd_tpu_membership_info().decode()
+        parts = (info.split("|") + ["", "", "", ""])[:4]
+        try:
+            epoch, size_now = int(parts[0]), int(parts[1])
+        except ValueError:
+            return
+        metrics.registry.set_membership({
+            "epoch": epoch,
+            "size": size_now,
+            "reshapes": int(_lib.hvd_tpu_membership_reshapes()),
+            "ranks_lost": [int(tok) for tok in parts[2].split(",") if tok],
+            "ranks_joined": [int(tok) for tok in parts[3].split(",") if tok],
+        })
+
+
 def _sync_engine_autotune() -> None:
     """Mirror the engine's autotuning state into the registry's ungated
     ``"autotune"`` section (docs/performance.md#autotuning).  Unlike the
@@ -599,6 +689,7 @@ def metrics_snapshot() -> dict:
     _sync_engine_announces()
     _sync_engine_cache()
     _sync_engine_autotune()
+    _sync_engine_membership()
     return metrics.registry.snapshot()
 
 
@@ -820,6 +911,9 @@ def _status_error(code: int, msg: str, name: str) -> Exception:
         return RanksDownError(prefix + msg, ranks=_parse_down_ranks(msg))
     if code == ST_TIMEOUT:
         return CollectiveTimeoutError(prefix + msg)
+    if code == ST_RESHAPE:
+        return MembershipChangedError(prefix + msg,
+                                      lost_ranks=_parse_down_ranks(msg))
     if code == ST_ABORTED:
         return HorovodInternalError(prefix + msg)
     return HorovodInternalError(prefix + (msg or f"status {code}"))

@@ -88,6 +88,16 @@ class Config:
     autotune_warmup: int = 2
     autotune_window: int = 32
     autotune_fix: str = ""
+    # Elastic membership (docs/fault-tolerance.md#elastic-membership).
+    # HVD_TPU_ELASTIC=1 (set by `hvdrun --min-np/--max-np`): when a rank
+    # dies, survivors re-negotiate size/rank at the next tick and keep
+    # training (shrink-and-continue) instead of aborting, as long as at
+    # least `min_np` ranks remain; `HVD_TPU_REJOIN=1` marks a standby
+    # process that registers with a live coordinator and is admitted at
+    # the next reshape barrier.
+    elastic: bool = False
+    min_np: int = 1
+    rejoin: bool = False
 
     @property
     def effective_cache_capacity(self) -> int:
@@ -141,4 +151,7 @@ class Config:
             autotune_window=int(os.environ.get(
                 "HVD_TPU_AUTOTUNE_WINDOW") or 32),
             autotune_fix=os.environ.get("HVD_TPU_AUTOTUNE_FIX", ""),
+            elastic=_flag(os.environ.get("HVD_TPU_ELASTIC")),
+            min_np=int(os.environ.get("HVD_TPU_MIN_NP") or 1),
+            rejoin=_flag(os.environ.get("HVD_TPU_REJOIN")),
         )

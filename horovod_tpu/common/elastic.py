@@ -1,0 +1,198 @@
+"""Elastic training driver: shrink-and-continue without checkpoint reload.
+
+PR 2's fault tolerance recovers from a dead rank by relaunching *every*
+rank and reloading a checkpoint — minutes of lost work per preemption.
+With elastic membership (``hvdrun --min-np/--max-np``,
+docs/fault-tolerance.md#elastic-membership) the engine instead reshapes
+the job in place: survivors re-negotiate ``size()``/``rank()`` at a tick
+boundary, in-flight collectives fail with the RETRYABLE
+:class:`~horovod_tpu.common.MembershipChangedError`, and training state
+is resynced by a root broadcast from the lowest surviving rank (always
+the coordinator, rank 0).  This module is the loop that drives that
+contract::
+
+    state = hvd.ElasticState(weights=w, step=0)
+
+    def train(state):
+        for s in range(state.step, TOTAL):
+            state.weights += hvd.allreduce(grad(state.weights),
+                                           name=f"grad.{s}")
+            state.step = s + 1
+        return state.weights
+
+    result = hvd.run_elastic(train, state)
+
+``train_fn`` must be RE-ENTERABLE from ``state``: after a reshape the
+driver resyncs every state leaf from the root and calls it again, so any
+progress marker (the step counter above) has to live in the state.  The
+checkpoint path stays the fallback — when survivors drop below
+``--min-np`` the engine aborts fatally and the launcher's
+``--max-restarts`` relaunch takes over.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+class ElasticState:
+    """Named training state synchronized across membership changes.
+
+    Keyword arguments become attributes; each is a numpy array, an
+    array-convertible value (scalars round-trip through 0-d arrays and
+    come back as Python numbers), or a pytree of arrays — nested
+    dicts/lists/tuples, e.g. jax ``params``/``opt_state`` — whose array
+    leaves are broadcast one by one in a deterministic order.
+    :meth:`sync` replaces every leaf with the root rank's value via
+    broadcast, using names keyed by the membership epoch so a resync in
+    the new membership can never be confused with a stale pre-reshape
+    negotiation.
+    """
+
+    def __init__(self, **leaves: Any):
+        if not leaves:
+            raise ValueError("ElasticState needs at least one named leaf")
+        self._keys = sorted(leaves)
+        for key, value in leaves.items():
+            setattr(self, key, value)
+
+    def keys(self):
+        return list(self._keys)
+
+    def sync(self, root: int = 0, key: int = 0) -> None:
+        """Replace every leaf with the root's value (root broadcast)."""
+        from horovod_tpu import common as _common
+
+        for name in self._keys:
+            value = getattr(self, name)
+            if isinstance(value, (dict, list, tuple)):
+                # A pytree leaf (jax params/opt_state): broadcast every
+                # array leaf under an index-suffixed name.  Flattening
+                # order is deterministic across ranks (same structure on
+                # every member — the SPMD contract).
+                flat, rebuild = _tree_flatten(value)
+                synced = [
+                    _common.broadcast(
+                        np.asarray(x), root,
+                        name=f"__elastic.sync.{key}.{name}.{i}")
+                    for i, x in enumerate(flat)
+                ]
+                setattr(self, name, rebuild(synced))
+                continue
+            arr = np.asarray(value)
+            out = _common.broadcast(arr, root,
+                                    name=f"__elastic.sync.{key}.{name}")
+            if isinstance(value, np.ndarray):
+                setattr(self, name, out)
+            elif isinstance(value, (bool, np.bool_)):
+                setattr(self, name, bool(out))
+            elif isinstance(value, (int, np.integer)):
+                setattr(self, name, int(out))
+            elif isinstance(value, (float, np.floating)):
+                setattr(self, name, float(out))
+            else:
+                setattr(self, name, out)
+
+
+def _tree_flatten(tree: Any):
+    """``(leaves, rebuild)`` for a pytree of arrays.  Uses
+    ``jax.tree_util`` when importable (handles registered custom nodes —
+    optax states and the like); otherwise a deterministic pure-python
+    walk over dicts (sorted keys), lists, tuples, and namedtuples."""
+    try:
+        from jax import tree_util
+
+        leaves, treedef = tree_util.tree_flatten(tree)
+        return leaves, lambda new: tree_util.tree_unflatten(treedef, new)
+    except ImportError:
+        pass
+
+    leaves: list = []
+
+    def flatten(node):
+        if isinstance(node, dict):
+            keys = sorted(node)
+            subs = [flatten(node[k]) for k in keys]
+            return lambda it: {k: s(it) for k, s in zip(keys, subs)}
+        if isinstance(node, (list, tuple)):
+            subs = [flatten(v) for v in node]
+            if isinstance(node, tuple) and hasattr(node, "_fields"):
+                return lambda it: type(node)(*(s(it) for s in subs))
+            if isinstance(node, tuple):
+                return lambda it: tuple(s(it) for s in subs)
+            return lambda it: [s(it) for s in subs]
+        idx = len(leaves)
+        leaves.append(node)
+        return lambda it: it[idx]
+
+    rebuild = flatten(tree)
+    return leaves, rebuild
+
+
+def run_elastic(train_fn: Callable[[ElasticState], Any],
+                state: ElasticState,
+                reshape_timeout: Optional[float] = None) -> Any:
+    """Run ``train_fn(state)`` under elastic membership, returning its
+    result.
+
+    On entry (and again after every reshape) the driver acknowledges the
+    current membership and resyncs ``state`` from rank 0 by root
+    broadcast — the entry-time sync doubles as the classic initial-state
+    broadcast.  When a collective fails with a retryable engine error
+    (:class:`MembershipChangedError`, or the transport errors that precede
+    the reshape broadcast when a rank dies mid-ring), the driver waits for
+    the membership epoch to advance and re-enters ``train_fn``.
+
+    Fatal errors re-raise unchanged: :class:`RanksDownError` (the
+    coordinator died, or survivors fell below ``--min-np`` — the
+    checkpoint-restart fallback), :class:`CollectiveTimeoutError`
+    (rank-divergent code, which shrinking cannot fix), and any
+    non-engine exception from ``train_fn`` itself.
+
+    ``reshape_timeout`` bounds the wait for the reshape broadcast after a
+    retryable failure (default: twice ``HVD_TPU_COLLECTIVE_TIMEOUT_SEC``
+    plus slack, min 30s); if no reshape lands in time the original error
+    re-raises.
+    """
+    from horovod_tpu import common as _common
+    from horovod_tpu.common import (CollectiveTimeoutError,
+                                    HorovodInternalError,
+                                    HorovodNotInitializedError,
+                                    RanksDownError)
+    from horovod_tpu.common.config import Config
+
+    lib = _common._load_lib()
+    _common._check_initialized(lib)
+    if reshape_timeout is None:
+        deadline_sec = Config.from_env().collective_timeout_sec
+        reshape_timeout = max(2.0 * deadline_sec + 10.0, 30.0)
+    synced = -1
+    while True:
+        try:
+            epoch = int(lib.hvd_tpu_membership_epoch())
+            if epoch != synced:
+                # Ack BEFORE the resync broadcasts: they are the first
+                # collectives of the new membership and must not hit the
+                # engine's post-reshape enqueue poison.
+                lib.hvd_tpu_membership_ack()
+                state.sync(root=0, key=epoch)
+                synced = epoch
+            return train_fn(state)
+        except (RanksDownError, CollectiveTimeoutError,
+                HorovodNotInitializedError):
+            raise
+        except HorovodInternalError as exc:
+            # Retryable iff a reshape (re)shapes the job around the
+            # failure.  The epoch may already have advanced (the reshape
+            # broadcast often lands before the failed handle is waited
+            # on); otherwise wait for the coordinator's barrier.
+            deadline = time.monotonic() + reshape_timeout
+            while int(lib.hvd_tpu_membership_epoch()) == synced:
+                if (time.monotonic() >= deadline
+                        or not lib.hvd_tpu_initialized()):
+                    raise
+                time.sleep(0.02)
+            del exc  # consumed: the reshape explains the failure

@@ -144,6 +144,13 @@ class MetricsRegistry:
         from horovod_tpu.common.autotune import empty_report
 
         self._autotune = empty_report()
+        # Elastic membership (docs/fault-tolerance.md#elastic-membership):
+        # a mirror of the engine's membership state (epoch, current size,
+        # reshape count, ranks lost/joined), refreshed on every snapshot.
+        # Ungated, like stalls: reshape tests assert on it without
+        # enabling full metrics.
+        self._membership = {"epoch": 0, "size": 0, "reshapes": 0,
+                            "ranks_lost": [], "ranks_joined": []}
         self._hists = {name: Histogram(bounds)
                        for name, (bounds, _) in HISTOGRAMS.items()}
 
@@ -217,6 +224,12 @@ class MetricsRegistry:
         with self._lock:
             self._cache[plane]["size"] = int(size)
 
+    def set_membership(self, state: dict) -> None:
+        """Mirror the engine's elastic-membership state (a state copy —
+        idempotent overwrite, like the autotune mirror).  Ungated."""
+        with self._lock:
+            self._membership = dict(state)
+
     def set_autotune(self, report: dict) -> None:
         """Mirror the engine's autotuning report (a state copy — the
         report carries current values plus bounded logs, so overwriting
@@ -268,6 +281,13 @@ class MetricsRegistry:
                     "last_to_announce": dict(self._skew["last_to_announce"]),
                 },
                 "cache": {p: dict(v) for p, v in self._cache.items()},
+                "membership": {
+                    **self._membership,
+                    "ranks_lost": list(
+                        self._membership.get("ranks_lost", [])),
+                    "ranks_joined": list(
+                        self._membership.get("ranks_joined", [])),
+                },
                 "autotune": {
                     **self._autotune,
                     "history": [dict(h) for h in
@@ -404,6 +424,31 @@ def prometheus_text(snapshot: dict) -> str:
     out.append("# TYPE hvd_tpu_autotune_best_score gauge")
     out.append(f"hvd_tpu_autotune_best_score "
                f"{repr(float(tune.get('best_score', 0.0)))}")
+
+    member = snapshot.get("membership", {})
+    out.append("# HELP hvd_tpu_membership_epoch "
+               "elastic membership epoch (reshapes survived this job)")
+    out.append("# TYPE hvd_tpu_membership_epoch gauge")
+    out.append(f"hvd_tpu_membership_epoch {member.get('epoch', 0)}")
+    out.append("# HELP hvd_tpu_membership_size "
+               "current job size after elastic reshapes")
+    out.append("# TYPE hvd_tpu_membership_size gauge")
+    out.append(f"hvd_tpu_membership_size {member.get('size', 0)}")
+    out.append("# HELP hvd_tpu_membership_reshapes_total "
+               "elastic membership reshape barriers applied")
+    out.append("# TYPE hvd_tpu_membership_reshapes_total counter")
+    out.append("hvd_tpu_membership_reshapes_total "
+               f"{member.get('reshapes', 0)}")
+    out.append("# HELP hvd_tpu_membership_ranks_lost_total "
+               "ranks lost to elastic shrinks")
+    out.append("# TYPE hvd_tpu_membership_ranks_lost_total counter")
+    out.append("hvd_tpu_membership_ranks_lost_total "
+               f"{len(member.get('ranks_lost', []))}")
+    out.append("# HELP hvd_tpu_membership_ranks_joined_total "
+               "standby ranks admitted by elastic grows")
+    out.append("# TYPE hvd_tpu_membership_ranks_joined_total counter")
+    out.append("hvd_tpu_membership_ranks_joined_total "
+               f"{len(member.get('ranks_joined', []))}")
 
     skew = snapshot.get("skew", {})
     out.append("# HELP hvd_tpu_announce_total "

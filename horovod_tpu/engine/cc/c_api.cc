@@ -46,7 +46,8 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
                  long long cache_capacity, int autotune,
                  long long autotune_warmup, long long autotune_window,
                  long long autotune_fix_fusion,
-                 double autotune_fix_cycle_ms) {
+                 double autotune_fix_cycle_ms, int elastic,
+                 long long min_size, int rejoin) {
   EngineOptions opts;
   opts.rank = rank;
   opts.size = size;
@@ -66,6 +67,9 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
   opts.autotune_window = autotune_window;
   opts.autotune_fix_fusion = autotune_fix_fusion;
   opts.autotune_fix_cycle_ms = autotune_fix_cycle_ms;
+  opts.elastic = elastic != 0;
+  opts.min_size = min_size > 0 ? min_size : 1;
+  opts.rejoin = rejoin != 0;
   std::string err;
   int rc = GlobalEngine()->Init(opts, &err);
   if (rc != 0) {
@@ -288,6 +292,36 @@ int hvd_tpu_autotune_set(long long fusion_threshold, double cycle_time_ms) {
 long long hvd_tpu_fusion_threshold_at(long long tick) {
   return GlobalEngine()->FusionThresholdAt(tick);
 }
+
+// Elastic-membership observability and control
+// (docs/fault-tolerance.md#elastic-membership).  The epoch counts
+// reshapes survived by this engine lifetime; the reshape total is
+// process-cumulative.  Info serializes "epoch|size|lost_csv|joined_csv".
+// Ack clears the post-reshape enqueue poison after Python has resynced
+// state in the new membership (hvd.run_elastic calls it).
+int hvd_tpu_elastic_enabled() {
+  return GlobalEngine()->ElasticEnabled() ? 1 : 0;
+}
+
+long long hvd_tpu_membership_epoch() {
+  return GlobalEngine()->MembershipEpoch();
+}
+
+long long hvd_tpu_membership_reshapes() {
+  return GlobalEngine()->ReshapeEvents();
+}
+
+const char* hvd_tpu_membership_info() {
+  static thread_local std::string tl_membership_info;
+  tl_membership_info = GlobalEngine()->MembershipInfo();
+  return tl_membership_info.c_str();
+}
+
+int hvd_tpu_membership_ack_pending() {
+  return GlobalEngine()->ReshapeAckPending() ? 1 : 0;
+}
+
+void hvd_tpu_membership_ack() { GlobalEngine()->MembershipAck(); }
 
 // Timeline hooks for the XLA data plane (jax/eager_mesh.py): plane-side
 // execution phases land in the same Chrome-tracing file as the engine's

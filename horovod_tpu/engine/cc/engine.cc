@@ -392,7 +392,36 @@ struct Engine::Coordinator {
   // tensors.  0 = not aborting.
   int32_t abort_code = 0;
   std::string abort_message;
+  // Elastic membership (docs/fault-tolerance.md#elastic-membership).
+  // reshape_pending arms a SHRINK barrier at the next tick (a worker died
+  // but >= min_size survive); pending joiners are standbys that connected
+  // to the control listen socket and await admission at the next barrier.
+  bool reshape_pending = false;
+  std::vector<int> pending_join_fds;
+  std::vector<std::string> pending_join_endpoints;
+  // Accepted control-plane connects whose JOIN handshake bytes have not
+  // arrived yet.  The handshake is completed only once the fd is
+  // readable, so a connect that never sends anything (health probe, port
+  // scanner) costs the negotiation tick nothing and is dropped at its
+  // deadline instead of stalling every worker's response wait.
+  struct Handshake {
+    int fd;
+    std::chrono::steady_clock::time_point deadline;
+    std::vector<uint8_t> buf;  // handshake bytes assembled so far
+  };
+  std::vector<Handshake> handshaking;
+  // When the FIRST currently-pending joiner registered: a grow barrier
+  // prefers a quiesced tick, but a fully pipelined training loop may
+  // never quiesce — past a bounded wait the barrier is forced (in-flight
+  // collectives get the same retryable ST_RESHAPE a shrink hands out),
+  // so standby admission cannot starve behind steady traffic.
+  std::chrono::steady_clock::time_point join_wait_since;
 };
+
+// Control-plane hello a standby sends instead of a rank number when
+// rejoining a live elastic job (rank hellos are < size, so this cannot
+// collide).
+static const uint32_t kJoinHello = 0xFFFFFFFEu;
 
 Engine* GlobalEngine() {
   // Intentionally leaked: outlives any Python teardown order, mirroring the
@@ -408,6 +437,14 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   opts_ = opts;
   if (opts_.hierarchical_allreduce && opts_.size == 1)
     opts_.hierarchical_allreduce = false;
+  if (opts_.elastic && opts_.hierarchical_allreduce) {
+    // Reshapes rebuild only the flat ring; the two-level topology's
+    // node-local stars would go stale at the first membership change.
+    fprintf(stderr,
+            "[horovod_tpu] WARNING: elastic membership forces the flat "
+            "ring (hierarchical allreduce disabled).\n");
+    opts_.hierarchical_allreduce = false;
+  }
   // The multi-rank layout validation (ranks in contiguous blocks of
   // local_size, the hvdrun layout — analogue of the reference's
   // MPI_Comm_split_type shared-memory split, operations.cc:1364-1373)
@@ -444,12 +481,31 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   // HOROVOD_TIMELINE's directory / %d forms to a per-rank path (a plain
   // file path stays rank-0-only there, for the legacy single-file mode).
   timeline_.Initialize(opts_.timeline_path, opts_.rank, epoch_);
+  // Elastic membership starts each lifetime at epoch 0.  The lost/joined
+  // lists and reshapes_total_ stay PROCESS-CUMULATIVE (like
+  // stall_events_): their lengths back the hvd_tpu_membership_*_total
+  // Prometheus counters, which must never decrease across an in-process
+  // re-init.  Only the poison message is per-lifetime.
+  membership_epoch_.store(0);
+  reshape_ack_pending_.store(false);
+  {
+    std::lock_guard<std::mutex> lk(membership_mu_);
+    reshape_message_.clear();
+  }
   std::string setup_err;
-  if (!SetupSockets(&setup_err)) {
+  bool setup_ok = opts_.rejoin ? SetupRejoinSockets(&setup_err)
+                               : SetupSockets(&setup_err);
+  if (!setup_ok) {
     *err = setup_err;
     TeardownSockets();
     return 1;
   }
+  // rank/size may have been (re)assigned by the rejoin admission; the
+  // atomics below are what Python's hvd.rank()/hvd.size() read.
+  cur_rank_.store(opts_.rank);
+  cur_size_.store(opts_.size);
+  cur_local_rank_.store(opts_.local_rank);
+  cur_local_size_.store(opts_.local_size);
   timeline_.WriteClockSync(clock_offset_us_.load(), clock_rtt_us_.load());
   // The response cache starts cold every engine lifetime: restart epochs
   // and in-process re-inits must renegotiate (the peers' caches are
@@ -486,7 +542,30 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
 }
 
 bool Engine::SetupSockets(std::string* err) {
-  if (opts_.size == 1) return true;
+  if (opts_.size == 1) {
+    // A solo ELASTIC coordinator still needs its listen sockets: the
+    // control listener is where standbys register (a job launched at or
+    // shrunk to one rank must keep accepting joiners) and the data
+    // listener is what RebuildRing accepts the first grow's neighbour
+    // on.  Endpoints come from the launcher env; a plain single-process
+    // init without them simply stays non-growable.
+    if (opts_.elastic && !opts_.coord_endpoint.empty() &&
+        !opts_.data_endpoints.empty()) {
+      std::string host;
+      int port;
+      if (ParseEndpoint(opts_.coord_endpoint, &host, &port))
+        coord_listen_fd_ = Listen("0.0.0.0", port, err);
+      if (coord_listen_fd_ >= 0 &&
+          ParseEndpoint(opts_.data_endpoints[0], &host, &port))
+        data_listen_fd_ = Listen("0.0.0.0", port, err);
+      if (coord_listen_fd_ < 0 || data_listen_fd_ < 0) {
+        *err = "elastic single-rank listen failed: " + *err;
+        return false;
+      }
+      coord_fds_.assign(1, -1);
+    }
+    return true;
+  }
   std::string host;
   int port;
   const double kTimeout = 120.0;
@@ -509,15 +588,31 @@ bool Engine::SetupSockets(std::string* err) {
 
   if (opts_.rank == 0) {
     coord_fds_.assign(opts_.size, -1);
-    for (int i = 1; i < opts_.size; ++i) {
+    for (int pending = opts_.size - 1; pending > 0;) {
       int fd = AcceptOne(coord_listen_fd_, kTimeout, err);
       if (fd < 0) return false;
       uint32_t peer_rank;
-      if (!RecvAll(fd, &peer_rank, 4) || peer_rank >= (uint32_t)opts_.size) {
+      if (!RecvAll(fd, &peer_rank, 4)) {
         *err = "bad hello from worker";
+        CloseFd(fd);
+        return false;
+      }
+      if (opts_.elastic && peer_rank == kJoinHello) {
+        // A standby can register while init is still collecting worker
+        // hellos: hvdrun backfills toward --max-np from the first tick of
+        // the keep-alive loop, so a start-small launch (-np 2 --max-np 6)
+        // races its first standby against this loop.  Park it for the
+        // first reshape barrier instead of failing the whole job's init.
+        if (!RegisterJoiner(fd, 1.0)) CloseFd(fd);
+        continue;
+      }
+      if (peer_rank >= (uint32_t)opts_.size || coord_fds_[peer_rank] >= 0) {
+        *err = "bad hello from worker";
+        CloseFd(fd);
         return false;
       }
       coord_fds_[peer_rank] = fd;
+      --pending;
     }
   } else {
     if (!ParseEndpoint(opts_.coord_endpoint, &host, &port)) {
@@ -691,6 +786,16 @@ void Engine::TeardownSockets() {
   CloseFd(coord_fd_);
   for (int fd : coord_fds_) CloseFd(fd);
   coord_fds_.clear();
+  if (coord_) {
+    // Standbys parked for an admission that will never come (plus any
+    // half-done handshakes): their processes see EOF and exit instead of
+    // blocking on a closed coordinator.
+    for (int fd : coord_->pending_join_fds) CloseFd(fd);
+    coord_->pending_join_fds.clear();
+    coord_->pending_join_endpoints.clear();
+    for (const auto& hs : coord_->handshaking) CloseFd(hs.fd);
+    coord_->handshaking.clear();
+  }
   CloseFd(data_listen_fd_);
   CloseFd(left_fd_);
   CloseFd(right_fd_);
@@ -905,6 +1010,16 @@ int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
       status->code.store(code);
       return handle;
     }
+    if (reshape_ack_pending_.load()) {
+      // Elastic reshape not yet acknowledged: fail fast with the
+      // retryable status instead of letting this op stall a negotiation
+      // its peers are not running (they are resyncing state).  Checked
+      // under mu_ so it pairs exactly with ApplyReshape's drain.
+      std::lock_guard<std::mutex> mlk(membership_mu_);
+      status->error = reshape_message_;
+      status->code.store(ST_RESHAPE);
+      return handle;
+    }
     if (table_.count(name)) {
       // Same duplicate-name precondition as the reference enqueue
       // (operations.cc:1827-1833).
@@ -967,13 +1082,12 @@ bool Engine::RunLoopOnce() {
   }
 
   ResponseList responses;
-  if (opts_.size == 1) {
-    // Single-process: everything is immediately "negotiated".
-    coord_->shutdown_requested |= my_requests.shutdown;
-    CoordinatorHandle(my_requests, 0);
-    responses = CoordinatorTick();
-    AttachTunedParams(&responses);
-  } else if (opts_.rank == 0) {
+  if (opts_.rank == 0) {
+    // Coordinator (covers the single-process case too: the worker loop
+    // and broadcast below are empty at size 1, but joiner admission and
+    // reshape barriers must still run — a job shrunk to one rank keeps
+    // accepting standbys).
+    CoordinatorAcceptJoiners();
     coord_->shutdown_requested |= my_requests.shutdown;
     CoordinatorHandle(my_requests, 0);
     for (int r = 1; r < opts_.size; ++r) {
@@ -1012,8 +1126,19 @@ bool Engine::RunLoopOnce() {
     CheckCollectiveTimeout();
     responses = CoordinatorTick();
     AttachTunedParams(&responses);
-    std::vector<uint8_t> out = SerializeResponseList(responses);
-    for (int r = 1; r < opts_.size; ++r) SendFrame(coord_fds_[r], out);
+    CoordinatorMaybeReshape(&responses);
+    if (opts_.size > 1 || responses.reshape_present) {
+      std::vector<uint8_t> out = SerializeResponseList(responses);
+      for (int r = 1; r < opts_.size; ++r) {
+        if (coord_->rank_dead[r]) continue;
+        SendFrame(coord_fds_[r], out);
+      }
+      // Admitted standbys receive the same barrier frame over the control
+      // socket they registered on; ApplyReshape below then folds their
+      // fds into the coordinator star.
+      if (responses.reshape_present)
+        for (int fd : coord_->pending_join_fds) SendFrame(fd, out);
+    }
   } else {
     if (!SendFrame(coord_fd_, SerializeRequestList(my_requests))) {
       responses.abort_code = ST_RANKS_DOWN;
@@ -1045,6 +1170,10 @@ bool Engine::RunLoopOnce() {
     }
   }
 
+  // Elastic reshape barrier: the list carries no op payload (the
+  // coordinator cleared it), so adopting the membership IS this tick's
+  // work.  A rebuild failure latched a local abort — exit and drain.
+  if (responses.reshape_present && !ApplyReshape(responses)) return false;
   // Tuned parameters apply BEFORE this tick's cache-hit replay: the
   // replay re-fuses under opts_.fusion_threshold, and every rank
   // processes this same list at this same tick index, so fusion-plan
@@ -1597,6 +1726,30 @@ std::string DescribePending(const std::string& name,
 void Engine::MarkRankDead(int r, const std::string& reason) {
   if (coord_->rank_dead[r]) return;
   coord_->rank_dead[r] = true;
+  if (opts_.elastic && coord_->abort_code == 0) {
+    // Shrink-and-continue (docs/fault-tolerance.md#elastic-membership):
+    // with enough survivors, arm a reshape barrier at the next tick
+    // instead of the fatal abort cascade.  Rank 0 hosts the coordinator,
+    // so it is alive by construction here; more deaths observed in the
+    // same sweep accumulate into the same barrier, and dropping below
+    // min_size falls through to the abort (the checkpoint-restart
+    // fallback hvdrun --min-np relies on).
+    int alive = 0;
+    for (int i = 0; i < opts_.size; ++i)
+      if (!coord_->rank_dead[i]) ++alive;
+    if (alive >= std::max<int64_t>(opts_.min_size, 1)) {
+      coord_->reshape_pending = true;
+      fprintf(stderr,
+              "[horovod_tpu] WARNING: rank %d down (%s); elastic reshape "
+              "at the next tick (%d survivor(s), membership epoch %lld -> "
+              "%lld).\n",
+              r, reason.c_str(), alive,
+              static_cast<long long>(membership_epoch_.load()),
+              static_cast<long long>(membership_epoch_.load() + 1));
+      return;
+    }
+    coord_->reshape_pending = false;  // below min_size: abort instead
+  }
   if (coord_->abort_code != 0) return;  // first abort wins
   std::string down;
   for (int i = 0; i < opts_.size; ++i)
@@ -1629,12 +1782,25 @@ void Engine::MarkRankDead(int r, const std::string& reason) {
       "ranks down: " + down + " (" + reason + ")" +
       (pending.empty() ? std::string(".")
                        : "; pending collective(s): " + pending + ".") +
+      (opts_.elastic
+           ? " Survivors fell below the elastic minimum (--min-np " +
+                 std::to_string(static_cast<long long>(opts_.min_size)) +
+                 "), so the job cannot shrink further."
+           : std::string()) +
       " The job was aborted; restart it (e.g. hvdrun --max-restarts) to "
       "resume from the latest checkpoint.";
 }
 
 void Engine::CheckCollectiveTimeout() {
   if (opts_.collective_timeout_sec <= 0 || coord_->abort_code != 0) return;
+  // An armed reshape barrier poisons every in-flight collective with the
+  // retryable ST_RESHAPE at this very tick and clears the pending
+  // tables.  Entries here may already be past the timeout — the liveness
+  // WaitReadable that detected the dead rank blocked for the full
+  // timeout while they aged — so latching fatal ST_TIMEOUT now would
+  // preempt the shrink-and-continue the elastic path just armed (a
+  // frozen rank would kill the job where a crashed one would not).
+  if (coord_->reshape_pending) return;
   auto now = std::chrono::steady_clock::now();
   std::string stalled;
   double worst = 0.0;
@@ -1797,6 +1963,525 @@ int64_t Engine::FusionThresholdAt(int64_t tick) {
     value = e.second;
   }
   return value;
+}
+
+// ---------------------------------------------------------------------------
+// Elastic membership (docs/fault-tolerance.md#elastic-membership).
+//
+// The rank-0 coordinator already OWNS membership: liveness, negotiation
+// counts, and the broadcast response list all key off it.  A reshape is
+// therefore just another lockstep broadcast: the coordinator ships the new
+// membership (dense ranks + endpoints + the parameters the new job must
+// agree on) in the response list, and every rank adopts it at the same
+// tick boundary — cancelling in-flight collectives with the RETRYABLE
+// ST_RESHAPE status, clearing the response cache and autotune search (so
+// slot numbering and tuned params stay lockstep in the new membership),
+// and rebuilding the flat data ring over the still-open listen sockets.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+std::string RankCsv(const std::vector<int32_t>& ranks) {
+  std::string out;
+  for (int32_t r : ranks)
+    out += (out.empty() ? "" : ", ") + std::to_string(r);
+  return out;
+}
+
+}  // namespace
+
+namespace {
+
+// Endpoints are "host:port" strings; anything past this is a corrupt or
+// hostile frame length, not a real standby.
+const uint32_t kMaxJoinEndpointLen = 1024;
+
+// Incremental parse of a joiner's endpoint frame ([u32 LE length]
+// [payload]) out of the bytes assembled so far.  Returns 1 with *ep
+// filled when the frame is complete, 0 when more bytes are needed, and
+// -1 when the bytes can never become a valid frame (zero/oversize
+// length, or trailing junk after the payload).
+int ParseJoinEndpointFrame(const std::vector<uint8_t>& buf,
+                           std::string* ep) {
+  if (buf.size() < 4) return 0;
+  uint32_t len = static_cast<uint32_t>(buf[0]) |
+                 (static_cast<uint32_t>(buf[1]) << 8) |
+                 (static_cast<uint32_t>(buf[2]) << 16) |
+                 (static_cast<uint32_t>(buf[3]) << 24);
+  if (len == 0 || len > kMaxJoinEndpointLen) return -1;
+  if (buf.size() < 4 + static_cast<size_t>(len)) return 0;
+  if (buf.size() > 4 + static_cast<size_t>(len)) return -1;
+  ep->assign(buf.begin() + 4, buf.end());
+  return 1;
+}
+
+}  // namespace
+
+bool Engine::RegisterJoiner(int fd, double timeout_sec) {
+  // The joiner's hello word has been consumed; assemble its endpoint
+  // frame with bounded non-blocking reads (a trickled or truncated frame
+  // costs at most timeout_sec, never a blocked engine loop) and park it
+  // for the next reshape barrier.
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_sec);
+  std::vector<uint8_t> epbuf;
+  std::string ep;
+  while (true) {
+    if (!RecvAvailable(fd, &epbuf)) return false;
+    int rc = ParseJoinEndpointFrame(epbuf, &ep);
+    if (rc < 0) return false;
+    if (rc > 0) break;
+    double remaining = std::chrono::duration<double>(
+                           deadline - std::chrono::steady_clock::now())
+                           .count();
+    if (remaining <= 0 || !WaitReadable(fd, remaining)) return false;
+  }
+  return RegisterJoinerEndpoint(fd, ep);
+}
+
+bool Engine::RegisterJoinerEndpoint(int fd, const std::string& ep) {
+  // Duplicate endpoints (a standby retrying, or one colliding with a
+  // LIVE member) are refused.  A dead rank's endpoint is fair game: a
+  // fixed-endpoint deployment restarts the replacement on the same
+  // host:port, and refusing it would crash-loop the standby while the
+  // job shrinks instead of backfilling.
+  bool dup = false;
+  for (const auto& e : coord_->pending_join_endpoints) dup |= (e == ep);
+  // rank_dead is sized by the job size; an endpoint list longer than it
+  // (env-launched job with a stale HVD_TPU_DATA) counts the extras as
+  // live rather than reading past the vector.
+  for (size_t r = 0; r < opts_.data_endpoints.size(); ++r)
+    if (r >= coord_->rank_dead.size() || !coord_->rank_dead[r])
+      dup |= (opts_.data_endpoints[r] == ep);
+  if (dup) return false;
+  if (coord_->pending_join_fds.empty())
+    coord_->join_wait_since = std::chrono::steady_clock::now();
+  coord_->pending_join_fds.push_back(fd);
+  coord_->pending_join_endpoints.push_back(ep);
+  fprintf(stderr,
+          "[horovod_tpu] standby %s registered with the coordinator; "
+          "admitting at the next reshape barrier.\n",
+          ep.c_str());
+  return true;
+}
+
+void Engine::CoordinatorAcceptJoiners() {
+  if (!opts_.elastic || coord_listen_fd_ < 0) return;
+  // Drain the listen backlog without blocking (at most a few per tick).
+  // The handshake itself is deferred: a standby sends hello+endpoint
+  // immediately after connect, so its fd turns readable within a tick,
+  // while a non-joiner connect that never sends (port scanner, health
+  // check, load-balancer probe) parks in `handshaking` at zero cost to
+  // the tick and is dropped at its deadline — it must not be able to
+  // stall every worker's negotiation wait behind a blocking read.
+  for (int accepted = 0; accepted < 4 && WaitReadable(coord_listen_fd_, 0.0);
+       ++accepted) {
+    std::string err;
+    int fd = AcceptOne(coord_listen_fd_, 0.0, &err);
+    if (fd < 0) break;
+    coord_->handshaking.push_back(
+        {fd, std::chrono::steady_clock::now() + std::chrono::seconds(5)});
+  }
+  for (size_t i = coord_->handshaking.size(); i-- > 0;) {
+    auto& hs = coord_->handshaking[i];
+    // Assemble the hello + endpoint frame strictly from bytes already in
+    // the kernel buffer: a peer that trickles a partial handshake parks
+    // here until its deadline and can never block the tick mid-message.
+    bool settled = false;
+    if (!RecvAvailable(hs.fd, &hs.buf)) {
+      settled = true;  // EOF or socket error before a full handshake
+      CloseFd(hs.fd);
+    } else {
+      uint32_t hello = 0;
+      if (hs.buf.size() >= 4) memcpy(&hello, hs.buf.data(), 4);
+      if (hs.buf.size() >= 4 && hello != kJoinHello) {
+        settled = true;  // not a joiner (probe, scanner, stale connect)
+        CloseFd(hs.fd);
+      } else if (hs.buf.size() >= 4) {
+        std::string ep;
+        std::vector<uint8_t> frame(hs.buf.begin() + 4, hs.buf.end());
+        int rc = ParseJoinEndpointFrame(frame, &ep);
+        if (rc != 0) {
+          settled = true;
+          if (rc < 0 || !RegisterJoinerEndpoint(hs.fd, ep))
+            CloseFd(hs.fd);
+        }
+      }
+    }
+    if (!settled && std::chrono::steady_clock::now() >= hs.deadline) {
+      settled = true;
+      CloseFd(hs.fd);
+    }
+    if (settled)
+      coord_->handshaking.erase(coord_->handshaking.begin() + i);
+  }
+}
+
+bool Engine::CoordinatorMaybeReshape(ResponseList* out) {
+  if (!opts_.elastic || out->abort_code != 0 || out->shutdown) return false;
+  // Sweep joiners that died while waiting for admission: broadcasting a
+  // dead standby's endpoint would send every survivor's RebuildRing
+  // chasing a closed port and turn a healthy elastic job into a fatal
+  // abort.  (A joiner has nothing to send after registering, so any
+  // readable state here is EOF/error.)
+  for (size_t i = coord_->pending_join_fds.size(); i-- > 0;) {
+    if (!PeerClosed(coord_->pending_join_fds[i])) continue;
+    fprintf(stderr,
+            "[horovod_tpu] standby %s died before admission; dropped.\n",
+            coord_->pending_join_endpoints[i].c_str());
+    CloseFd(coord_->pending_join_fds[i]);
+    coord_->pending_join_fds.erase(coord_->pending_join_fds.begin() + i);
+    coord_->pending_join_endpoints.erase(
+        coord_->pending_join_endpoints.begin() + i);
+  }
+  bool shrink = coord_->reshape_pending;
+  // A grow-only barrier waits for a quiesced tick (nothing pending or
+  // broadcast this tick, and the previous reshape acknowledged) so the
+  // interruption is limited to the enqueue-poison handshake; a shrink
+  // barrier fires immediately — everything in flight is doomed anyway.
+  bool grow = !shrink && !coord_->pending_join_fds.empty() &&
+              coord_->message_table.empty() &&
+              coord_->cache_pending.empty() && out->responses.empty() &&
+              out->cache_hits.empty() && !reshape_ack_pending_.load();
+  if (!shrink && !grow && !coord_->pending_join_fds.empty() &&
+      !reshape_ack_pending_.load()) {
+    // A fully pipelined loop (async enqueues keeping every tick busy)
+    // may never present a quiesced tick; past a bounded wait, force the
+    // barrier so admission cannot starve — the standby's own admission
+    // timeout (120s in SetupRejoinSockets) is the backstop this must
+    // beat.  In-flight collectives get the retryable ST_RESHAPE exactly
+    // as in a shrink.
+    constexpr double kForcedGrowSec = 10.0;
+    double waited = std::chrono::duration<double>(
+                        std::chrono::steady_clock::now() -
+                        coord_->join_wait_since)
+                        .count();
+    if (waited >= kForcedGrowSec) {
+      fprintf(stderr,
+              "[horovod_tpu] standby waited %.1fs without a quiesced "
+              "tick; forcing the grow barrier (in-flight collectives "
+              "will retry in the new membership).\n",
+              waited);
+      grow = true;
+    }
+  }
+  if (!shrink && !grow) return false;
+  // The reshape replaces this tick's payload: op responses built against
+  // the old membership would execute over a ring a dead rank just broke,
+  // and cache hits would replay against caches the barrier is clearing.
+  out->responses.clear();
+  out->cache_hits.clear();
+  out->tuned_present = false;
+  out->reshape_present = true;
+  out->membership_epoch = membership_epoch_.load() + 1;
+  out->reshape_cache_capacity = opts_.cache_capacity;
+  out->reshape_fusion_threshold = cur_fusion_.load();
+  out->reshape_cycle_time_us = cur_cycle_us_.load();
+  for (int r = 0; r < opts_.size; ++r) {
+    if (coord_->rank_dead[r]) {
+      out->reshape_lost.push_back(r);
+      continue;
+    }
+    out->member_old_ranks.push_back(r);
+    out->member_endpoints.push_back(opts_.data_endpoints[r]);
+  }
+  for (const auto& ep : coord_->pending_join_endpoints) {
+    out->member_old_ranks.push_back(-1);
+    out->member_endpoints.push_back(ep);
+  }
+  return true;
+}
+
+bool Engine::ApplyReshape(const ResponseList& rl) {
+  int old_rank = opts_.rank;
+  int old_size = opts_.size;
+  int new_size = static_cast<int>(rl.member_old_ranks.size());
+  int new_rank = -1;
+  std::vector<int32_t> joined;
+  for (int i = 0; i < new_size; ++i) {
+    if (rl.member_old_ranks[i] == old_rank) new_rank = i;
+    if (rl.member_old_ranks[i] < 0) joined.push_back(i);
+  }
+  if (new_rank < 0) {
+    // Unreachable for a live rank (the coordinator only reshapes around
+    // survivors it is still talking to); fail closed rather than run
+    // with a wrong identity.
+    AbortLocal(ST_RANKS_DOWN,
+               "membership reshape did not include this rank; the job "
+               "cannot continue and should be restarted.");
+    return false;
+  }
+  std::string msg =
+      "membership changed (epoch " +
+      std::to_string(static_cast<long long>(rl.membership_epoch)) + "): " +
+      (rl.reshape_lost.empty()
+           ? std::string("rank(s) joined")
+           : "ranks down: " + RankCsv(rl.reshape_lost)) +
+      "; continuing with " + std::to_string(new_size) +
+      " rank(s).  In-flight collectives were cancelled; re-enter "
+      "agreement and resync state from the root (hvd.run_elastic does "
+      "both).";
+
+  // 1. Arm the enqueue poison BEFORE draining: an Enqueue that misses the
+  // flag must have entered the table before the drain below (both hold
+  // mu_), so every in-flight or racing collective gets the retryable
+  // status — none can slip through into the new membership's negotiation
+  // before Python acknowledges (hvd.membership_ack / run_elastic resync).
+  {
+    std::lock_guard<std::mutex> lk(membership_mu_);
+    reshape_message_ = msg;
+    for (int32_t r : rl.reshape_lost) ranks_lost_.push_back(r);
+    for (int32_t r : joined) ranks_joined_.push_back(r);
+  }
+  reshape_ack_pending_.store(true);
+  // 2. Cancel everything in flight with the retryable status.  Entries
+  // already failed by a broken ring carry their transport error instead;
+  // the elastic driver treats both as retryable once the epoch bumps.
+  std::vector<TableEntry> doomed;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : table_) doomed.push_back(std::move(kv.second));
+    table_.clear();
+    queue_.clear();
+  }
+  for (auto& e : doomed) CompleteEntry(e, ST_RESHAPE, msg);
+  // 3. Caches and the autotune search reset at the barrier, on every
+  // rank at the same tick: slot numbering and tuned parameters must mean
+  // the same thing everywhere in the new membership.
+  opts_.cache_capacity = rl.reshape_cache_capacity;
+  cache_.set_capacity(opts_.cache_capacity);
+  cache_.Clear();
+  cache_size_.store(0);
+  opts_.fusion_threshold = rl.reshape_fusion_threshold;
+  opts_.cycle_time_ms =
+      static_cast<double>(rl.reshape_cycle_time_us) / 1000.0;
+  cur_fusion_.store(rl.reshape_fusion_threshold);
+  cur_cycle_us_.store(rl.reshape_cycle_time_us);
+  autotune_frozen_.store(false);
+  applied_window_.store(0);
+  {
+    std::lock_guard<std::mutex> lk(autotune_mu_);
+    applied_log_.clear();
+    fusion_history_.clear();
+    fusion_history_.emplace_back(ticks_done_.load(),
+                                 rl.reshape_fusion_threshold);
+  }
+  // 4. Adopt the new identity.  Elastic jobs are single-host (the
+  // launcher rejects --hosts), so the local identity tracks the global
+  // one — a survivor and an admitted standby must never collide on
+  // local_rank() for per-host resources.
+  opts_.rank = new_rank;
+  opts_.size = new_size;
+  opts_.local_rank = new_rank;
+  opts_.local_size = new_size;
+  opts_.data_endpoints.assign(rl.member_endpoints.begin(),
+                              rl.member_endpoints.end());
+  cur_rank_.store(new_rank);
+  cur_size_.store(new_size);
+  cur_local_rank_.store(new_rank);
+  cur_local_size_.store(new_size);
+  membership_epoch_.store(rl.membership_epoch);
+  reshapes_total_.fetch_add(1);
+  // 5. Coordinator bookkeeping: compact the control star to the new
+  // membership (survivor fds keep their sockets, admitted standbys bring
+  // theirs) and restart the per-rank liveness/search state.
+  if (old_rank == 0 && coord_) {
+    std::vector<int> new_fds(new_size, -1);
+    int join_i = 0;
+    for (int i = 0; i < new_size; ++i) {
+      int prev = rl.member_old_ranks[i];
+      if (prev == 0) continue;  // self
+      if (prev > 0 && prev < static_cast<int>(coord_fds_.size())) {
+        new_fds[i] = coord_fds_[prev];
+        coord_fds_[prev] = -1;
+      } else if (prev < 0 &&
+                 join_i < static_cast<int>(coord_->pending_join_fds.size())) {
+        new_fds[i] = coord_->pending_join_fds[join_i++];
+      }
+    }
+    for (int fd : coord_fds_) CloseFd(fd);  // dead ranks' sockets
+    coord_fds_ = std::move(new_fds);
+    coord_->pending_join_fds.clear();
+    coord_->pending_join_endpoints.clear();
+    coord_->rank_dead.assign(new_size, false);
+    coord_->reshape_pending = false;
+    coord_->message_table.clear();
+    coord_->ready.clear();
+    coord_->cache_pending.clear();
+    coord_->cached_ready.clear();
+    tuner_.Configure(opts_.autotune, opts_.autotune_warmup,
+                     opts_.autotune_window, opts_.autotune_fix_fusion,
+                     opts_.autotune_fix_cycle_ms, opts_.fusion_threshold,
+                     opts_.cycle_time_ms);
+    std::lock_guard<std::mutex> lk(announce_mu_);
+    if (static_cast<int>(last_announce_counts_.size()) < new_size)
+      last_announce_counts_.resize(new_size, 0);
+  }
+  // 6. Rebuild the data plane for the new membership.  A clean rebuild
+  // also clears the broken-transport latch a mid-collective death set.
+  std::string err;
+  if (!RebuildRing(&err)) {
+    AbortLocal(ST_RANKS_DOWN,
+               "membership reshape failed while rebuilding the data ring "
+               "(" + err + "); this job cannot continue and should be "
+               "restarted.");
+    return false;
+  }
+  data_plane_failed_.store(false);
+  timeline_.Instant("membership", "MEMBERSHIP_RESHAPE");
+  std::string how = rl.reshape_lost.empty()
+                        ? std::string(" (grow)")
+                        : " (lost rank(s) " + RankCsv(rl.reshape_lost) + ")";
+  fprintf(stderr,
+          "[horovod_tpu] membership epoch %lld: rank %d/%d -> %d/%d%s.\n",
+          static_cast<long long>(rl.membership_epoch), old_rank, old_size,
+          new_rank, new_size, how.c_str());
+  return true;
+}
+
+bool Engine::RebuildRing(std::string* err) {
+  CloseFd(left_fd_);
+  CloseFd(right_fd_);
+  left_fd_ = right_fd_ = -1;
+  // Elastic jobs run the flat ring only; make sure no stale two-level
+  // topology outlives a reshape.
+  for (int fd : local_member_fds_) CloseFd(fd);
+  local_member_fds_.clear();
+  CloseFd(local_leader_fd_);
+  CloseFd(cross_left_fd_);
+  CloseFd(cross_right_fd_);
+  local_leader_fd_ = cross_left_fd_ = cross_right_fd_ = -1;
+  node_id_ = 0;
+  n_nodes_ = 1;
+  if (opts_.size == 1) return true;
+  const double kTimeout = 30.0;
+  // Epoch-tagged hellos: a stale connect from a previous membership (or
+  // a dying rank's last SYN in the backlog) parses as a mismatch and is
+  // dropped instead of being adopted as a neighbour.
+  const uint32_t epoch_tag =
+      static_cast<uint32_t>(membership_epoch_.load() & 0xff) << 16;
+  uint32_t hello = (3u << 24) | epoch_tag |
+                   (static_cast<uint32_t>(opts_.rank) & 0xffff);
+  int right = (opts_.rank + 1) % opts_.size;
+  std::string host;
+  int port;
+  if (!ParseEndpoint(opts_.data_endpoints[right], &host, &port)) {
+    *err = "bad data endpoint " + opts_.data_endpoints[right];
+    return false;
+  }
+  right_fd_ = ConnectRetry(host, port, kTimeout, err);
+  if (right_fd_ < 0) return false;
+  if (!SendAll(right_fd_, &hello, 4)) {
+    *err = "ring-rebuild hello send failed";
+    return false;
+  }
+  for (int attempts = 0; attempts < 16 && left_fd_ < 0; ++attempts) {
+    int fd = AcceptOne(data_listen_fd_, kTimeout, err);
+    if (fd < 0) return false;
+    uint32_t peer = 0;
+    if (!WaitReadable(fd, 2.0) || !RecvAll(fd, &peer, 4)) {
+      CloseFd(fd);
+      continue;
+    }
+    if ((peer & 0xff000000u) == (3u << 24) &&
+        (peer & 0x00ff0000u) == epoch_tag) {
+      left_fd_ = fd;
+    } else {
+      CloseFd(fd);  // stale pre-reshape connect
+    }
+  }
+  if (left_fd_ < 0) {
+    *err = "ring left neighbour never connected after the reshape";
+    return false;
+  }
+  return true;
+}
+
+bool Engine::SetupRejoinSockets(std::string* err) {
+  // Standby bring-up: listen on our own data endpoint, register with the
+  // coordinator, and block until the admitting reshape broadcast names
+  // our dense rank and the full membership.
+  const double kTimeout = 120.0;
+  if (opts_.data_endpoints.empty() || opts_.coord_endpoint.empty()) {
+    *err = "rejoin requires HVD_TPU_COORD and this rank's HVD_TPU_DATA";
+    return false;
+  }
+  std::string my_ep = opts_.data_endpoints[0];
+  std::string host;
+  int port;
+  if (!ParseEndpoint(my_ep, &host, &port)) {
+    *err = "bad data endpoint " + my_ep;
+    return false;
+  }
+  data_listen_fd_ = Listen("0.0.0.0", port, err);
+  if (data_listen_fd_ < 0) return false;
+  if (!ParseEndpoint(opts_.coord_endpoint, &host, &port)) {
+    *err = "bad coordinator endpoint " + opts_.coord_endpoint;
+    return false;
+  }
+  coord_fd_ = ConnectRetry(host, port, kTimeout, err);
+  if (coord_fd_ < 0) return false;
+  if (!SendAll(coord_fd_, &kJoinHello, 4) ||
+      !SendFrame(coord_fd_,
+                 std::vector<uint8_t>(my_ep.begin(), my_ep.end()))) {
+    *err = "rejoin registration send failed";
+    return false;
+  }
+  if (!WaitReadable(coord_fd_, kTimeout)) {
+    *err = "rejoin admission timed out (no reshape barrier within " +
+           std::to_string(static_cast<long long>(kTimeout)) + "s)";
+    return false;
+  }
+  std::vector<uint8_t> buf;
+  ResponseList rl;
+  if (!RecvFrame(coord_fd_, &buf) || !ParseResponseList(buf, &rl) ||
+      !rl.reshape_present) {
+    *err = "rejoin admission failed (coordinator closed or sent a "
+           "non-reshape frame)";
+    return false;
+  }
+  int new_rank = -1;
+  for (size_t i = 0; i < rl.member_endpoints.size(); ++i)
+    if (rl.member_old_ranks[i] < 0 && rl.member_endpoints[i] == my_ep)
+      new_rank = static_cast<int>(i);
+  if (new_rank < 0) {
+    *err = "rejoin admission did not include this standby's endpoint";
+    return false;
+  }
+  opts_.rank = new_rank;
+  opts_.size = static_cast<int>(rl.member_old_ranks.size());
+  // Single-host elastic: local identity tracks global (see ApplyReshape).
+  opts_.local_rank = new_rank;
+  opts_.local_size = opts_.size;
+  opts_.data_endpoints.assign(rl.member_endpoints.begin(),
+                              rl.member_endpoints.end());
+  opts_.cache_capacity = rl.reshape_cache_capacity;
+  opts_.fusion_threshold = rl.reshape_fusion_threshold;
+  opts_.cycle_time_ms =
+      static_cast<double>(rl.reshape_cycle_time_us) / 1000.0;
+  cur_rank_.store(new_rank);
+  cur_size_.store(opts_.size);
+  membership_epoch_.store(rl.membership_epoch);
+  {
+    std::lock_guard<std::mutex> lk(membership_mu_);
+    ranks_joined_.push_back(new_rank);
+    for (int32_t r : rl.reshape_lost) ranks_lost_.push_back(r);
+  }
+  fprintf(stderr,
+          "[horovod_tpu] standby admitted as rank %d/%d (membership epoch "
+          "%lld).\n",
+          new_rank, opts_.size,
+          static_cast<long long>(rl.membership_epoch));
+  // No clock sync for standbys: the admitting barrier cannot stall the
+  // live job on probe round-trips; this rank's timeline keeps offset 0.
+  return RebuildRing(err);
+}
+
+std::string Engine::MembershipInfo() {
+  std::lock_guard<std::mutex> lk(membership_mu_);
+  return std::to_string(static_cast<long long>(membership_epoch_.load())) +
+         "|" + std::to_string(cur_size_.load()) + "|" +
+         RankCsv(ranks_lost_) + "|" + RankCsv(ranks_joined_);
 }
 
 // ---------------------------------------------------------------------------

@@ -77,6 +77,23 @@ struct EngineOptions {
   // intra-host loopback + cross-host DCN.  Requires ranks grouped in
   // contiguous blocks of local_size (the hvdrun layout).
   bool hierarchical_allreduce = false;
+  // Elastic membership (docs/fault-tolerance.md#elastic-membership,
+  // HVD_TPU_ELASTIC): when a worker dies, the coordinator reshapes the
+  // job around the survivors (new dense ranks, rebuilt ring, membership
+  // epoch bump) instead of cascading a fatal abort, as long as at least
+  // `min_size` ranks survive — below that the abort path (and with it
+  // the hvdrun checkpoint-restart fallback) still fires.  Requires the
+  // rank-0 coordinator to survive; forces the flat ring (hierarchical
+  // topologies are not rebuilt).
+  bool elastic = false;
+  int64_t min_size = 1;
+  // This process is a standby REJOINING a live elastic job
+  // (HVD_TPU_REJOIN, spawned by hvdrun --min-np/--max-np): Init connects
+  // to the coordinator, announces its data endpoint, and blocks until
+  // admitted at the next reshape barrier, learning its dense rank and
+  // the membership from the reshape broadcast.  rank/size/data_endpoints
+  // in these options are placeholders until then.
+  bool rejoin = false;
 };
 
 struct HandleStatus {
@@ -188,10 +205,16 @@ class Engine {
   void Shutdown();
 
   bool Initialized() const { return initialized_.load(); }
-  int rank() const { return opts_.rank; }
-  int size() const { return opts_.size; }
-  int local_rank() const { return opts_.local_rank; }
-  int local_size() const { return opts_.local_size; }
+  // rank/size mirror opts_ through atomics: elastic reshapes mutate the
+  // membership on the engine thread mid-run, and Python API threads read
+  // hvd.rank()/hvd.size() live (they must re-resolve after a reshape).
+  int rank() const { return cur_rank_.load(); }
+  int size() const { return cur_size_.load(); }
+  // Elastic reshapes re-resolve the local identity too (elastic is
+  // single-host only, so post-reshape local == global); static jobs keep
+  // their launch-time values.
+  int local_rank() const { return cur_local_rank_.load(); }
+  int local_size() const { return cur_local_size_.load(); }
 
   // Returns a handle (>=0) or -1 if the engine is not initialized / shut
   // down.  For allgather, `out` may be null; the result is kept engine-side
@@ -295,6 +318,23 @@ class Engine {
   // append-only with increasing tick stamps.
   int64_t FusionThresholdAt(int64_t tick);
 
+  // Elastic-membership observability (docs/fault-tolerance.md).  The
+  // epoch counts reshapes survived by THIS engine lifetime (0 until the
+  // first); reshape/lost/joined totals are process-cumulative like
+  // StallEvents.  MembershipInfo serializes
+  // "epoch|size|lost_csv|joined_csv" (cumulative rank lists, each in the
+  // numbering of the epoch the change happened in).
+  bool ElasticEnabled() const { return opts_.elastic; }
+  int64_t MembershipEpoch() const { return membership_epoch_.load(); }
+  int64_t ReshapeEvents() const { return reshapes_total_.load(); }
+  std::string MembershipInfo();
+  // Python acknowledges a reshape after resyncing state (hvd.run_elastic):
+  // until then every fresh Enqueue fails fast with the retryable
+  // ST_RESHAPE status, so no rank can stall waiting for peers that are
+  // re-entering agreement.
+  void MembershipAck() { reshape_ack_pending_.store(false); }
+  bool ReshapeAckPending() const { return reshape_ack_pending_.load(); }
+
   // The engine-owned Chrome-tracing timeline.  Exposed so the XLA data
   // plane (Python, jax/eager_mesh.py) can emit its BUCKET_BUILD /
   // XLA_DISPATCH / DEVICE_WAIT activities into the SAME trace file as the
@@ -309,7 +349,39 @@ class Engine {
   void BackgroundLoop();
   bool RunLoopOnce();
   bool SetupSockets(std::string* err);
+  // Standby path (opts_.rejoin): connect to the coordinator, announce the
+  // data endpoint, block until the admitting reshape broadcast arrives,
+  // adopt the new membership, and build the ring.
+  bool SetupRejoinSockets(std::string* err);
   void TeardownSockets();
+  // Elastic membership (docs/fault-tolerance.md#elastic-membership).
+  // Rank 0: drain pending joiner connects off the control listen socket
+  // (non-blocking; a standby announces itself with a JOIN hello + an
+  // endpoint frame).
+  void CoordinatorAcceptJoiners();
+  // Rank 0: finish a joiner's registration by assembling the rest of its
+  // JOIN handshake (hello word already consumed) with bounded,
+  // non-blocking reads — a partial frame can stall this at most
+  // timeout_sec, never indefinitely.  False (fd NOT adopted; caller
+  // closes) on a short/duplicate handshake.
+  bool RegisterJoiner(int fd, double timeout_sec);
+  // Rank 0: park a fully-handshaken joiner (endpoint already parsed) for
+  // the next reshape barrier.  False (fd NOT adopted; caller closes) on
+  // a duplicate endpoint.
+  bool RegisterJoinerEndpoint(int fd, const std::string& ep);
+  // Rank 0: whether this tick can be the reshape barrier (a death is
+  // pending, or quiesced joiners await admission) and, if so, fill `out`
+  // with the reshape verdict + new membership.
+  bool CoordinatorMaybeReshape(ResponseList* out);
+  // Every rank: adopt the broadcast membership — fail in-flight
+  // collectives with the retryable ST_RESHAPE status, clear the response
+  // cache and autotune search, update rank/size/endpoints, and rebuild
+  // the data-plane ring.  On rebuild failure the engine falls back to a
+  // fatal local abort (the launcher's restart path takes over).
+  bool ApplyReshape(const ResponseList& rl);
+  // Tear down and reconnect the flat ring for the current membership,
+  // with epoch-tagged hellos so stale pre-reshape connects are rejected.
+  bool RebuildRing(std::string* err);
   // NTP-style clock sync over the coordinator star (end of SetupSockets):
   // rank 0 probes each worker K times; the minimum-RTT round trip gives
   // the best offset estimate (worker_ts - probe midpoint), which rank 0
@@ -477,6 +549,25 @@ class Engine {
   std::chrono::steady_clock::time_point epoch_{};
   std::atomic<int64_t> clock_offset_us_{0};
   std::atomic<int64_t> clock_rtt_us_{0};
+
+  // Elastic membership (docs/fault-tolerance.md#elastic-membership).
+  // cur_rank_/cur_size_ mirror opts_ for lock-free reads from Python API
+  // threads (rank()/size() must re-resolve after a reshape).  The epoch
+  // counts reshapes this engine lifetime; reshape/lost/joined totals are
+  // process-cumulative for metrics.  reshape_ack_pending_ poisons fresh
+  // enqueues with the retryable status until Python acknowledges the new
+  // membership (hvd.run_elastic's resync calls MembershipAck first).
+  std::atomic<int> cur_rank_{0};
+  std::atomic<int> cur_size_{1};
+  std::atomic<int> cur_local_rank_{0};
+  std::atomic<int> cur_local_size_{1};
+  std::atomic<int64_t> membership_epoch_{0};
+  std::atomic<int64_t> reshapes_total_{0};
+  std::atomic<bool> reshape_ack_pending_{false};
+  std::mutex membership_mu_;  // guards the lists + reshape_message_
+  std::vector<int32_t> ranks_lost_;    // cumulative, epoch-local numbering
+  std::vector<int32_t> ranks_joined_;  // cumulative, new dense ranks
+  std::string reshape_message_;    // the retryable status message
 
   // Online autotuning.  The tuner lives at the coordinator (rank 0 /
   // single-process); the applied-parameter state below is per-rank,

@@ -181,6 +181,17 @@ bool RecvAll(int fd, void* buf, size_t len) {
   return true;
 }
 
+bool PeerClosed(int fd) {
+  if (fd < 0) return true;
+  char probe;
+  ssize_t r = recv(fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
+  if (r == 0) return true;                                // orderly EOF
+  if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK ||
+                errno == EINTR))
+    return false;                                         // alive, just idle
+  return r < 0;                                           // hard error
+}
+
 bool WaitReadable(int fd, double timeout_sec) {
   double deadline = NowSec() + timeout_sec;
   while (true) {
@@ -212,6 +223,21 @@ bool RecvFrame(int fd, std::vector<uint8_t>* payload) {
                  (static_cast<uint32_t>(hdr[3]) << 24);
   payload->resize(len);
   return len == 0 || RecvAll(fd, payload->data(), len);
+}
+
+bool RecvAvailable(int fd, std::vector<uint8_t>* buf) {
+  uint8_t tmp[512];
+  while (true) {
+    ssize_t n = recv(fd, tmp, sizeof(tmp), MSG_DONTWAIT);
+    if (n > 0) {
+      buf->insert(buf->end(), tmp, tmp + n);
+      continue;
+    }
+    if (n == 0) return false;  // EOF
+    if (errno == EINTR) continue;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+    return false;
+  }
 }
 
 bool Exchange(int send_fd, const void* sbuf, size_t slen, int recv_fd,

@@ -38,9 +38,20 @@ bool RecvAll(int fd, void* buf, size_t len);
 // frozen or the network is partitioned, which socket EOF never reports.
 bool WaitReadable(int fd, double timeout_sec);
 
+// Non-blocking liveness probe: true when the peer has closed (EOF) or the
+// socket is in error — i.e. sending to it can no longer succeed.  Pending
+// unread data does NOT count as closed.
+bool PeerClosed(int fd);
+
 // Length-prefixed message framing ([u32 little-endian length][payload]).
 bool SendFrame(int fd, const std::vector<uint8_t>& payload);
 bool RecvFrame(int fd, std::vector<uint8_t>* payload);
+
+// Append whatever bytes fd has ready RIGHT NOW to *buf without ever
+// blocking (MSG_DONTWAIT), so a caller can assemble a message across
+// ticks from a peer that trickles it.  False on error or EOF; true
+// otherwise, including when zero new bytes were available.
+bool RecvAvailable(int fd, std::vector<uint8_t>* buf);
 
 // Full-duplex exchange: send `slen` bytes on send_fd while receiving `rlen`
 // bytes from recv_fd, multiplexed with poll(2) so neighbouring ranks can

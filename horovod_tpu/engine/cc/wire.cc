@@ -169,6 +169,20 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
     w.I64(rl.tuned_cycle_time_us);
     w.I64(rl.tuned_window);
   }
+  w.U8(rl.reshape_present ? 1 : 0);
+  if (rl.reshape_present) {
+    w.I64(rl.membership_epoch);
+    w.I64(rl.reshape_cache_capacity);
+    w.I64(rl.reshape_fusion_threshold);
+    w.I64(rl.reshape_cycle_time_us);
+    w.U32(static_cast<uint32_t>(rl.member_old_ranks.size()));
+    for (size_t i = 0; i < rl.member_old_ranks.size(); ++i) {
+      w.I32(rl.member_old_ranks[i]);
+      w.Str(rl.member_endpoints[i]);
+    }
+    w.U32(static_cast<uint32_t>(rl.reshape_lost.size()));
+    for (int32_t r : rl.reshape_lost) w.I32(r);
+  }
   return std::move(w.buf);
 }
 
@@ -201,6 +215,24 @@ bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
     rl->tuned_fusion_threshold = rd.I64();
     rl->tuned_cycle_time_us = rd.I64();
     rl->tuned_window = rd.I64();
+  }
+  rl->member_old_ranks.clear();
+  rl->member_endpoints.clear();
+  rl->reshape_lost.clear();
+  rl->reshape_present = rd.U8() != 0;
+  if (rl->reshape_present) {
+    rl->membership_epoch = rd.I64();
+    rl->reshape_cache_capacity = rd.I64();
+    rl->reshape_fusion_threshold = rd.I64();
+    rl->reshape_cycle_time_us = rd.I64();
+    uint32_t nm = rd.U32();
+    for (uint32_t i = 0; i < nm && rd.ok; ++i) {
+      rl->member_old_ranks.push_back(rd.I32());
+      rl->member_endpoints.push_back(rd.Str());
+    }
+    uint32_t nl = rd.U32();
+    for (uint32_t i = 0; i < nl && rd.ok; ++i)
+      rl->reshape_lost.push_back(rd.I32());
   }
   return rd.ok;
 }

@@ -51,6 +51,13 @@ enum StatusCode : int32_t {
   // RanksDownError / CollectiveTimeoutError.
   ST_RANKS_DOWN = 6,
   ST_TIMEOUT = 7,
+  // Elastic membership (docs/fault-tolerance.md#elastic-membership): the
+  // job reshaped (a rank died and the survivors continued, or a standby
+  // joined) and the collective carrying this status was cancelled at the
+  // reshape barrier.  RETRYABLE: Python maps it to MembershipChangedError;
+  // hvd.run_elastic re-enters agreement and resyncs state by root
+  // broadcast instead of killing the job.
+  ST_RESHAPE = 8,
 };
 
 size_t DataTypeSize(uint8_t dtype);
@@ -120,6 +127,25 @@ struct ResponseList {
   int64_t tuned_fusion_threshold = 0;
   int64_t tuned_cycle_time_us = 0;
   int64_t tuned_window = 0;
+  // Elastic membership reshape (docs/fault-tolerance.md): when present,
+  // this tick IS the reshape barrier.  The list carries the complete new
+  // membership — for each new dense rank its previous rank (-1 for a
+  // freshly admitted standby) and its data endpoint — so every receiver
+  // derives its own new rank by finding itself (survivors by old rank,
+  // joiners by endpoint), plus the engine parameters the new membership
+  // must agree on from tick one: the job-wide cache capacity and the
+  // currently applied tuned params (caches and the autotune search are
+  // reset at the barrier, so these are the fresh baseline everywhere,
+  // joiners included).  `reshape_lost` names the ranks (previous-epoch
+  // numbering) that died and triggered a shrink; empty on pure grows.
+  bool reshape_present = false;
+  int64_t membership_epoch = 0;
+  int64_t reshape_cache_capacity = 0;
+  int64_t reshape_fusion_threshold = 0;
+  int64_t reshape_cycle_time_us = 0;
+  std::vector<int32_t> member_old_ranks;      // index = new dense rank
+  std::vector<std::string> member_endpoints;  // index = new dense rank
+  std::vector<int32_t> reshape_lost;
 };
 
 std::vector<uint8_t> SerializeRequestList(const RequestList& rl);

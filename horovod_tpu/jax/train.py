@@ -5,7 +5,12 @@ Also home of the checkpoint-resume glue for job-level restart
 :func:`load_latest_checkpoint` give a ``hvdrun --max-restarts`` job a
 durable step counter + pytree snapshot, so a mid-run rank crash costs the
 steps since the last checkpoint instead of the whole run (the Elastic
-Horovod / TorchElastic contract, scoped to restart-in-place).
+Horovod / TorchElastic contract).  Under ``hvdrun --min-np`` even that
+cost disappears for in-budget failures: wrap the loop in
+``hvd.run_elastic`` with an ``hvd.ElasticState(params=..., opt_state=...,
+step=...)`` — pytree leaves broadcast fine — and survivors shrink and
+continue in place, with the checkpoint path as the below-``--min-np``
+fallback (docs/fault-tolerance.md#elastic-membership).
 
 The reference's usage recipe (/root/reference/README.md:80-105) — scale LR by
 size, wrap the optimizer, broadcast initial state — becomes one call here:

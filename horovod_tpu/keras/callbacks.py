@@ -68,8 +68,20 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
         self.checkpoint_dir = checkpoint_dir
         self.broadcast_done = False
         self.resumed_from: Optional[str] = None
+        self._membership_epoch = 0
 
     def on_train_begin(self, logs=None):  # noqa: D401
+        # Elastic re-entry (docs/fault-tolerance.md#elastic-membership):
+        # when fit() is called again after a reshape killed the previous
+        # one ("catch MembershipChangedError and call fit again"), the
+        # engine's enqueue poison is still armed — ack it BEFORE any
+        # broadcast below, and re-broadcast even if an earlier fit already
+        # did (the survivors' weights diverged from the cancelled batch).
+        epoch = _common.membership_epoch()
+        if epoch != self._membership_epoch:
+            self._membership_epoch = epoch
+            _common.membership_ack()
+            self.broadcast_done = False
         if self.broadcast_done:
             return
         from horovod_tpu.keras import broadcast_global_variables
@@ -88,6 +100,26 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
                       f"{latest}")
         broadcast_global_variables(self.root_rank, model=self.model)
         self.broadcast_done = True
+
+    def on_train_batch_begin(self, batch, logs=None):
+        # Elastic membership (docs/fault-tolerance.md#elastic-membership):
+        # after a reshape, re-broadcast from the root so every member
+        # trains on identical weights.  This fully covers GROW barriers
+        # (quiesced ticks, i.e. between batches — the admitted standby
+        # gets the live weights) and re-entry after a shrink; a shrink
+        # that cancels an in-flight batch still raises the retryable
+        # MembershipChangedError out of fit() — catch it and call fit
+        # again, or drive the loop with hvd.run_elastic.  One cheap
+        # engine call per batch when nothing changed.
+        if not _common.is_initialized():
+            return
+        epoch = _common.membership_epoch()
+        if epoch != self._membership_epoch:
+            self._membership_epoch = epoch
+            from horovod_tpu.keras import broadcast_global_variables
+
+            _common.membership_ack()
+            broadcast_global_variables(self.root_rank, model=self.model)
 
 
 class MetricAverageCallback(keras.callbacks.Callback):

@@ -15,8 +15,10 @@ from horovod_tpu.runner.launch import (  # noqa: F401
     failure_report,
     launch_fn,
     make_rank_env,
+    membership_succeeded,
     run_command,
     run_elastic,
     run_hosts,
+    run_membership,
     signal_name,
 )

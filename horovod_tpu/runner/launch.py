@@ -82,6 +82,18 @@ def allocate_endpoints(size: int, host: str = "127.0.0.1"):
     return coord, data
 
 
+def _kill_grace_sec() -> float:
+    """How long a finished/failed job waits for its remaining ranks to
+    exit on their own before SIGKILLing them (the engine cascades a
+    coordinated shutdown/abort, so healthy ranks exit well within this).
+    Tunable so fault-injection tests with deliberately wedged ranks stay
+    fast; shared by the static and elastic launchers."""
+    try:
+        return float(os.environ.get("HVD_TPU_KILL_GRACE_SEC") or 15.0)
+    except ValueError:
+        return 15.0
+
+
 class _StderrTee:
     """Echo one rank's stderr to the launcher's stderr line-by-line while
     retaining the last N lines.  Non-capture runs (the hvdrun CLI) keep
@@ -225,16 +237,33 @@ def _wait_all(cmd: Sequence[str], procs, timeout: float,
     # kill stragglers -- the fail-fast the reference left to mpirun.  The
     # grace is tunable (HVD_TPU_KILL_GRACE_SEC) so fault-injection tests
     # with deliberately wedged ranks stay fast.
+    grace_sec = _kill_grace_sec()
+    # A rank exiting rc 0 while its peers keep running for MINUTES means
+    # the job can never form or finish (synchronous SPMD completes in
+    # lockstep): a rank that dies cleanly before init() completes — e.g.
+    # during a --max-restarts relaunch window — would otherwise park the
+    # remaining ranks in their connect retries until the TOTAL --timeout
+    # budget (often unbounded) burned, with no failure report.  Kill the
+    # stragglers after a bounded completion grace instead, so the attempt
+    # fails fast, counts against --max-restarts, and carries the stderr
+    # tail.  The default is deliberately generous — legitimate post-
+    # barrier work (rank 0 writing a large final checkpoint after the
+    # workers exited) must fit inside it; <= 0 disables the deadline.
     try:
-        grace_sec = float(os.environ.get("HVD_TPU_KILL_GRACE_SEC") or 15.0)
+        straggler_sec = float(
+            os.environ.get("HVD_TPU_EXIT_STRAGGLER_SEC") or 300.0)
     except ValueError:
-        grace_sec = 15.0
+        straggler_sec = 300.0
     deadline = time.monotonic() + timeout
     grace_deadline = None
+    zero_exit_deadline = None
     first_failed = None  # rank index of the first observed nonzero exit
     timed_out = False
     try:
-        while any(p.poll() is None for p in procs):
+        # Poll EVERY rank each pass (a short-circuiting any(p.poll()...)
+        # would stop at the first live rank and never populate the
+        # returncodes the deadline scans below read).
+        while sum(1 for p in procs if p.poll() is None):
             now = time.monotonic()
             if grace_deadline is None:
                 failed = [i for i, p in enumerate(procs)
@@ -242,7 +271,11 @@ def _wait_all(cmd: Sequence[str], procs, timeout: float,
                 if failed:
                     first_failed = failed[0]
                     grace_deadline = now + grace_sec
-            if now >= deadline or (grace_deadline and now >= grace_deadline):
+            if (straggler_sec > 0 and zero_exit_deadline is None
+                    and any(p.returncode == 0 for p in procs)):
+                zero_exit_deadline = now + straggler_sec
+            if (now >= deadline or (grace_deadline and now >= grace_deadline)
+                    or (zero_exit_deadline and now >= zero_exit_deadline)):
                 timed_out = now >= deadline
                 for p in procs:
                     if p.poll() is None:
@@ -257,6 +290,19 @@ def _wait_all(cmd: Sequence[str], procs, timeout: float,
             if p.poll() is None:
                 _kill_rank(p)
         raise
+    results = _collect_results(procs, tees, first_failed=first_failed)
+    if timed_out:
+        raise subprocess.TimeoutExpired(cmd, timeout)
+    return results
+
+
+def _collect_results(procs, tees,
+                     first_failed: Optional[int] = None) -> List[RankResult]:
+    """Drain every launched process into a :class:`RankResult` after the
+    polling loop decided the job is over: bounded waits, group-kill of
+    anything (or any orphan sharing its pipes) that survives them, and
+    stdout/stderr salvage — shared by ``_wait_all`` and
+    ``run_membership``."""
     results = []
     for r, p in enumerate(procs):
         tee = tees[r] if tees else None
@@ -288,9 +334,39 @@ def _wait_all(cmd: Sequence[str], procs, timeout: float,
         rc = p.returncode if p.returncode is not None else -9
         results.append(RankResult(r, rc, out or "", errout or "",
                                   first_failure=(r == first_failed)))
-    if timed_out:
-        raise subprocess.TimeoutExpired(cmd, timeout)
     return results
+
+
+def _elastic_bounds(np: int, min_np: Optional[int],
+                    max_np: Optional[int]) -> Tuple[int, int]:
+    """Normalize and validate the elastic membership bounds — the ONE
+    place the rules live (run_elastic, run_membership, and the CLI all
+    route through it).  An unset --min-np means "all launched ranks must
+    finish", NOT "one survivor is enough"; an unset --max-np means no
+    planned growth."""
+    # `is not None`, not truthiness: an explicit --min-np 0 must reach the
+    # range check and be rejected, not silently read as "unset".
+    min_np = min_np if min_np is not None else np
+    max_np = max_np if max_np is not None else np
+    if not (1 <= min_np <= np <= max_np):
+        raise ValueError(
+            f"need 1 <= min-np ({min_np}) <= np ({np}) <= max-np ({max_np})")
+    return min_np, max_np
+
+
+def _check_elastic_support(hosts_spec: Optional[str],
+                           tpu_pin: bool) -> None:
+    """Reject launcher features elastic membership cannot compose with
+    yet, loudly, instead of silently dropping them."""
+    if hosts_spec:
+        raise ValueError(
+            "elastic membership (min_np/max_np) supports single-host "
+            "launches only")
+    if tpu_pin:
+        raise ValueError(
+            "elastic membership (min_np/max_np) does not support TPU "
+            "chip pinning yet: standby ranks have no stable local_rank "
+            "to pin to")
 
 
 def run_elastic(cmd: Sequence[str], np: int, max_restarts: int = 0,
@@ -302,6 +378,8 @@ def run_elastic(cmd: Sequence[str], np: int, max_restarts: int = 0,
                 port_base: Optional[int] = None,
                 tpu_pin: bool = False,
                 tpu_topology: Optional[str] = None,
+                min_np: Optional[int] = None,
+                max_np: Optional[int] = None,
                 report: Callable[[str], None] = None):
     """Job-level restart (docs/fault-tolerance.md): launch the job, and on
     failure — any rank exiting nonzero, or the job timing out — group-kill
@@ -312,12 +390,28 @@ def run_elastic(cmd: Sequence[str], np: int, max_restarts: int = 0,
     ``(results, restarts_used)``; the caller's training script is expected
     to resume from its latest checkpoint (see
     ``horovod_tpu.jax.train.load_latest_checkpoint`` / the keras
-    ``BroadcastGlobalVariablesCallback`` glue)."""
+    ``BroadcastGlobalVariablesCallback`` glue).
+
+    With ``min_np``/``max_np`` set (``hvdrun --min-np/--max-np``), each
+    attempt runs under the elastic membership launcher
+    (:func:`run_membership`): rank deaths shrink the job in place and
+    standbys rejoin, with NO relaunch as long as at least ``min_np``
+    members survive.  Only when elastic continuation fails — the
+    coordinator died, or survivors fell below ``min_np`` — does the
+    attempt count as a failure and the full relaunch + checkpoint-resume
+    fallback above kick in."""
     import time
 
     if report is None:
         def report(msg):
             print(msg, file=sys.stderr, flush=True)
+    elastic = min_np is not None or max_np is not None
+    if elastic:
+        # Normalize the bounds HERE so the success verdict below uses the
+        # same floor run_membership enforces (an unset --min-np means "all
+        # launched ranks must finish", NOT "one survivor is enough").
+        min_np, max_np = _elastic_bounds(np, min_np, max_np)
+        _check_elastic_support(hosts_spec, tpu_pin)
     base_env = dict(env if env is not None else os.environ)
     results: List[RankResult] = []
     # `timeout` is the TOTAL wall-clock budget across every attempt (the
@@ -331,7 +425,13 @@ def run_elastic(cmd: Sequence[str], np: int, max_restarts: int = 0,
         run_env = dict(base_env)
         run_env["HVD_TPU_RESTART_EPOCH"] = str(epoch)
         try:
-            if hosts_spec:
+            if elastic:
+                results = run_membership(cmd, np, min_np=min_np,
+                                         max_np=max_np, env=run_env,
+                                         timeout=remaining,
+                                         capture=capture, host=host,
+                                         report=report)
+            elif hosts_spec:
                 results = run_hosts(cmd, np, hosts_spec,
                                     port_base=port_base, env=run_env,
                                     timeout=remaining, capture=capture,
@@ -349,7 +449,9 @@ def run_elastic(cmd: Sequence[str], np: int, max_restarts: int = 0,
             report(f"hvdrun: job timed out (restart epoch {epoch}); "
                    f"restarting ({epoch + 1}/{max_restarts})")
             continue
-        if all(r.returncode == 0 for r in results):
+        ok = (membership_succeeded(results, min_np) if elastic
+              else all(r.returncode == 0 for r in results))
+        if ok:
             return results, epoch
         if epoch < max_restarts:
             rpt = failure_report(results)
@@ -357,6 +459,185 @@ def run_elastic(cmd: Sequence[str], np: int, max_restarts: int = 0,
                    + (f"\n{rpt}" if rpt else "")
                    + f"\nhvdrun: restarting ({epoch + 1}/{max_restarts})")
     return results, max_restarts
+
+
+def run_membership(cmd: Sequence[str], np: int,
+                   min_np: Optional[int] = None,
+                   max_np: Optional[int] = None,
+                   env: Optional[Dict[str, str]] = None,
+                   timeout: float = 300.0,
+                   capture: bool = False,
+                   host: str = "127.0.0.1",
+                   rejoin_delay: float = 1.0,
+                   max_rejoins: Optional[int] = None,
+                   report: Callable[[str], None] = None) -> List[RankResult]:
+    """Elastic membership launcher (``hvdrun --min-np/--max-np``,
+    docs/fault-tolerance.md#elastic-membership).
+
+    Launches ``np`` ranks with ``HVD_TPU_ELASTIC=1``.  Unlike
+    :func:`run_command`, a dying rank does NOT trigger the kill cascade:
+    the engine reshapes the job around the survivors, so the launcher
+    keeps the job alive while at least ``min_np`` ranks (the coordinator
+    included) are still running, and — while membership is below
+    ``max_np`` — spawns standby replacements (``HVD_TPU_REJOIN=1``, a
+    fresh data endpoint) that register with the live coordinator and are
+    admitted at the next reshape barrier.
+
+    Fatal cases kill everything and return failing results so an outer
+    ``run_elastic(..., max_restarts=N)`` can fall back to the
+    full-relaunch + checkpoint-resume path: the coordinator (launch rank
+    0) dying, or the running count dropping below ``min_np``.
+
+    Returns one :class:`RankResult` per process ever launched — the
+    initial ranks keep their launch indices, standbys are numbered from
+    ``np`` up.
+    """
+    import time
+
+    if report is None:
+        def report(msg):
+            print(msg, file=sys.stderr, flush=True)
+    min_np, max_np = _elastic_bounds(np, min_np, max_np)
+    if max_rejoins is None:
+        # Budget both the planned growth toward max_np (launching below
+        # it is legitimate: -np 2 --max-np 6 starts small and grows) and
+        # crash replacements, so initial backfill cannot exhaust the
+        # budget real failures need later.
+        max_rejoins = 2 * max_np
+    coord, data = allocate_endpoints(np, host)
+    base_env = dict(env if env is not None else os.environ)
+    base_env["HVD_TPU_ELASTIC"] = "1"
+    base_env["HVD_TPU_MIN_NP"] = str(min_np)
+
+    procs: List = []
+    tees: List = []
+
+    def spawn(rank_env):
+        p = subprocess.Popen(
+            list(cmd), env=rank_env,
+            stdout=subprocess.PIPE if capture else None,
+            stderr=subprocess.PIPE,
+            text=True, start_new_session=True)
+        procs.append(p)
+        tees.append(None if capture else _StderrTee(p.stderr))
+        return p
+
+    for r in range(np):
+        spawn(make_rank_env(r, np, coord, data, base_env))
+
+    grace_sec = _kill_grace_sec()
+    deadline = time.monotonic() + timeout
+    completion_deadline = None  # armed when the first rank finishes rc 0
+    rejoin_at = None            # next standby spawn time
+    rejoins_used = 0
+    fatal = False
+    reported_dead: set = set()
+    first_dead = None  # slot of the CHRONOLOGICALLY first death observed
+    try:
+        while any(p.poll() is None for p in procs):
+            now = time.monotonic()
+            running = sum(1 for p in procs if p.poll() is None)
+            completed = sum(1 for p in procs if p.returncode == 0)
+            for i, p in enumerate(procs):
+                if p.returncode not in (None, 0) and i not in reported_dead:
+                    if first_dead is None:
+                        first_dead = i
+                    reported_dead.add(i)
+                    # 1-based to match the "spawning standby N" line.
+                    label = (f"rank {i}" if i < np
+                             else f"standby {i - np + 1} (slot {i})")
+                    report(f"hvdrun: {label} exited with "
+                           f"{signal_name(p.returncode)}; "
+                           f"{running} member(s) still running "
+                           f"(elastic min-np {min_np})")
+            if procs[0].poll() is not None and procs[0].returncode != 0:
+                # The coordinator owns membership; without it nothing can
+                # reshape.  Fall back to the outer restart path.
+                report("hvdrun: coordinator (rank 0) died; elastic "
+                       "continuation impossible")
+                fatal = True
+                break
+            if completed:
+                # Synchronous SPMD finishes in lockstep: once one member
+                # completed, the rest (admitted standbys included) should
+                # follow within the grace.  Stragglers past it are wedged.
+                if completion_deadline is None:
+                    completion_deadline = now + max(grace_sec, 5.0)
+                if now >= completion_deadline:
+                    # Wedged stragglers — and standbys still waiting for
+                    # an admission that will never come — get killed, not
+                    # waited out.
+                    for p in procs:
+                        if p.poll() is None:
+                            _kill_rank(p)
+                    break
+            elif running < min_np:
+                report(f"hvdrun: only {running} member(s) running "
+                       f"(< min-np {min_np}); giving up on elastic "
+                       f"continuation")
+                fatal = True
+                break
+            elif running < max_np and rejoins_used < max_rejoins:
+                # Backfill toward max-np with standbys.  The delay keeps a
+                # crash-looping command from hot-spawning; each standby
+                # gets a fresh endpoint so a dead rank's lingering socket
+                # cannot poison the rejoin.
+                if rejoin_at is None:
+                    rejoin_at = now + rejoin_delay
+                elif now >= rejoin_at:
+                    rejoin_at = None
+                    rejoins_used += 1
+                    ep = f"{host}:{pick_free_port(host)}"
+                    standby_env = dict(base_env)
+                    standby_env.update({
+                        "HVD_TPU_REJOIN": "1",
+                        "HVD_TPU_RANK": "0", "HVD_TPU_SIZE": "1",
+                        "HVD_TPU_LOCAL_RANK": "0", "HVD_TPU_LOCAL_SIZE": "1",
+                        "HVD_TPU_COORD": coord, "HVD_TPU_DATA": ep,
+                    })
+                    report(f"hvdrun: spawning standby {rejoins_used} at {ep} "
+                           f"({running}/{max_np} members running)")
+                    spawn(standby_env)
+            else:
+                rejoin_at = None
+            if now >= deadline:
+                for p in procs:
+                    if p.poll() is None:
+                        _kill_rank(p)
+                raise subprocess.TimeoutExpired(cmd, timeout)
+            time.sleep(0.05)
+    except BaseException:
+        for p in procs:
+            if p.poll() is None:
+                _kill_rank(p)
+        raise
+    if fatal:
+        for p in procs:
+            if p.poll() is None:
+                _kill_rank(p)
+    results = _collect_results(procs, tees)
+    # Flag the CHRONOLOGICALLY first death for the failure report — the
+    # lowest-index nonzero exit is often the launcher's own fatal-path
+    # kill cascade, not the root cause.  (Success itself is judged by
+    # membership_succeeded: coordinator clean + >= min_np clean.)
+    if first_dead is not None:
+        results[first_dead].first_failure = True
+    else:
+        for r in results:
+            if r.returncode != 0:
+                r.first_failure = True
+                break
+    return results
+
+
+def membership_succeeded(results: List[RankResult],
+                         min_np: int) -> bool:
+    """Whether an elastic run (``run_membership``) counts as success:
+    the coordinator (slot 0) exited 0 and at least ``min_np`` members
+    completed cleanly (deaths the job reshaped around do not fail it)."""
+    if not results or results[0].returncode != 0:
+        return False
+    return sum(1 for r in results if r.returncode == 0) >= min_np
 
 
 _FN_RUNNER = """\
@@ -415,6 +696,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "HVD_TPU_TIMELINE=DIR).  Merge them with "
                              "tools/timeline_merge.py — see "
                              "docs/timeline.md")
+    parser.add_argument("--min-np", type=int, default=None,
+                        help="elastic membership "
+                             "(docs/fault-tolerance.md#elastic-membership): "
+                             "keep the job alive while at least this many "
+                             "ranks survive — a dying rank shrinks the job "
+                             "in place (survivors re-negotiate size/rank "
+                             "and resync by root broadcast, no relaunch or "
+                             "checkpoint reload); below min-np the "
+                             "--max-restarts checkpoint fallback fires")
+    parser.add_argument("--max-np", type=int, default=None,
+                        help="with --min-np: while membership is below "
+                             "this, spawn standby ranks that rejoin the "
+                             "live job at the next reshape barrier "
+                             "(default: -np)")
     parser.add_argument("--max-restarts", type=int, default=0,
                         help="on job failure (a rank died, or the engine "
                              "aborted on a dead/stalled rank), kill the "
@@ -453,18 +748,42 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # legacy single-file mode there; ranks mkdir the trailing-sep
         # form themselves.
         env["HVD_TPU_TIMELINE"] = args.timeline.rstrip(os.sep) + os.sep
+    elastic = args.min_np is not None or args.max_np is not None
+    if elastic:
+        try:
+            _elastic_bounds(args.num_proc, args.min_np, args.max_np)
+            _check_elastic_support(args.hosts, tpu_pin)
+        except ValueError as e:
+            parser.error(str(e))
     try:
         results, restarts = run_elastic(
             cmd, args.num_proc, max_restarts=args.max_restarts,
             env=env, timeout=args.timeout or 3e7, host=args.host,
             hosts_spec=args.hosts, port_base=args.port_base,
-            tpu_pin=tpu_pin, tpu_topology=args.tpu_topology)
+            tpu_pin=tpu_pin, tpu_topology=args.tpu_topology,
+            min_np=args.min_np, max_np=args.max_np)
     except subprocess.TimeoutExpired:
         print("hvdrun: job timed out", file=sys.stderr)
         return 124
-    if restarts and all(r.returncode == 0 for r in results):
+    # Unset --min-np with --max-np means "may grow, must not shrink": the
+    # success floor is the full launch size, not one survivor.
+    ok = (membership_succeeded(
+        results,
+        args.min_np if args.min_np is not None else args.num_proc)
+          if elastic else all(r.returncode == 0 for r in results))
+    if restarts and ok:
         print(f"hvdrun: job succeeded after {restarts} restart(s)",
               file=sys.stderr)
+    if elastic and ok:
+        # Initial ranks only: a standby the launcher itself reaped at the
+        # completion deadline (spawned but never admitted before the job
+        # finished) was never a member, so it is not "lost".
+        lost = sum(1 for r in results
+                   if r.returncode != 0 and r.rank < args.num_proc)
+        if lost:
+            print(f"hvdrun: job completed elastically ({lost} member(s) "
+                  f"lost and reshaped around)", file=sys.stderr)
+        return 0
     rc = 0
     report = failure_report(results)
     if report:

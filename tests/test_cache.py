@@ -486,3 +486,130 @@ def test_plane_meta_cache_update_semantics():
     plane._meta_cache.pop("a")  # per-name error eviction re-opens the slot
     plane._meta_update(op("c", h=5))
     assert plane._meta_cache == {"b": 7, "c": 5}
+
+
+# ---------------------------------------------------------------------------
+# Elastic membership interplay (docs/fault-tolerance.md#elastic-membership):
+# the reshape barrier clears the cache and autotune search on every rank so
+# slot numbering and tuned params stay lockstep in the new membership.
+# ---------------------------------------------------------------------------
+
+
+_RESHAPE_CACHE_SCRIPT = """\
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+state = hvd.ElasticState(step=0)
+marks = {}
+
+def train(state):
+    if hvd.membership_epoch() > 0 and "at_reshape" not in marks:
+        marks["at_reshape"] = hvd.metrics_snapshot()["cache"]["engine"]
+    while state.step < 30:
+        for k in range(3):
+            out = hvd.allreduce(np.full(16, 1.0, np.float32),
+                                average=False, name=f"steady.{k}")
+            assert np.allclose(out, float(hvd.size())), (out[0], hvd.size())
+        state.step += 1
+    return True
+
+hvd.run_elastic(train, state)
+m = hvd.metrics_snapshot()["membership"]
+assert m["epoch"] == 1 and m["ranks_lost"] == [2], m
+at = marks["at_reshape"]
+end = hvd.metrics_snapshot()["cache"]["engine"]
+# Counters are process-cumulative; contents were cleared at the barrier,
+# so the new membership re-negotiates the 3 names once (misses) and then
+# rides slot-bit hits again -- the cache re-warms instead of staying
+# poisoned with pre-reshape slot numbering.
+hits = end["hits"] - at["hits"]
+misses = end["misses"] - at["misses"]
+assert misses >= 3, (at, end)
+assert hits >= 30, (at, end)
+assert hits / max(hits + misses, 1) >= 0.7, (at, end)
+assert end["size"] >= 3, end
+print("CACHEOK", hvd.rank(), hits, misses, flush=True)
+"""
+
+
+def test_cache_rewarms_after_reshape(tmp_path):
+    """PR-4 interplay: a crash mid-cached-steady-state on an elastic job
+    reshapes instead of aborting; the response cache is cleared at the
+    barrier on every survivor and re-warms in the new membership (fresh
+    misses once, then steady hits)."""
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "train.py"
+    script.write_text(_RESHAPE_CACHE_SCRIPT)
+    # rank 2's ops: 1 entry-sync broadcast, then 3 per step -> op 31 is
+    # mid-steady-state (step 10 of 30), well after the cache warmed.
+    results = run_membership(
+        [sys.executable, str(script)], 3, min_np=2, max_np=3,
+        max_rejoins=0,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=2:crash@op=31",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20"),
+        timeout=90.0, capture=True, report=lambda msg: None)
+    assert membership_succeeded(results, 2), \
+        [(r.rank, r.returncode, r.stderr[-400:]) for r in results]
+    oks = [line for r in results if r.returncode == 0
+           for line in r.stdout.splitlines() if line.startswith("CACHEOK")]
+    assert len(oks) == 2, results
+
+
+_RESHAPE_AUTOTUNE_SCRIPT = """\
+import time
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+assert hvd.autotune_report()["enabled"]
+state = hvd.ElasticState(step=0)
+marks = {}
+
+def train(state):
+    if hvd.membership_epoch() > 0 and "applied" not in marks:
+        marks["applied"] = len(hvd.autotune_report()["applied"])
+    while state.step < 80:
+        for k in range(3):
+            hvd.allreduce(np.full(256, 1.0, np.float32),
+                          average=False, name=f"tune.{k}")
+        state.step += 1
+        time.sleep(0.005)
+    return True
+
+hvd.run_elastic(train, state)
+rep = hvd.autotune_report()
+# The tuner restarted at the barrier and re-broadcast parameters in the
+# new membership...
+assert len(rep["applied"]) > marks["applied"], (marks, rep["applied"])
+# ...and every survivor applied them in lockstep.
+mine = np.asarray([rep["fusion_threshold"],
+                   int(rep["cycle_time_ms"] * 1000)], np.int64)
+rows = hvd.allgather(mine.reshape(1, -1), name="tune.check")
+assert (rows == rows[0]).all(), rows
+print("TUNEOK", hvd.rank(), len(rep["applied"]), flush=True)
+"""
+
+
+def test_autotune_rebroadcasts_after_reshape(tmp_path):
+    """PR-5 interplay: after a reshape the autotune search resets and its
+    parameter broadcasts resume in the new membership -- autotune_report()
+    shows fresh applied entries, lockstep-identical across survivors."""
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "train.py"
+    script.write_text(_RESHAPE_AUTOTUNE_SCRIPT)
+    results = run_membership(
+        [sys.executable, str(script)], 3, min_np=2, max_np=3,
+        max_rejoins=0,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=1:crash@op=13",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20",
+                 HVD_TPU_AUTOTUNE="1", HVD_TPU_AUTOTUNE_WARMUP="1",
+                 HVD_TPU_AUTOTUNE_WINDOW="8"),
+        timeout=90.0, capture=True, report=lambda msg: None)
+    assert membership_succeeded(results, 2), \
+        [(r.rank, r.returncode, r.stderr[-400:]) for r in results]
+    oks = [line for r in results if r.returncode == 0
+           for line in r.stdout.splitlines() if line.startswith("TUNEOK")]
+    assert len(oks) == 2, results

@@ -1,0 +1,478 @@
+"""Elastic membership tests (docs/fault-tolerance.md#elastic-membership):
+shrink-and-continue without process relaunch or checkpoint reload.
+
+The ISSUE acceptance path: a 4-rank CPU job with an injected crash@op
+keeps training on the 3 survivors — they agree on the new ``size()==3``
+with dense ranks, parameters are allgather-identical after the
+root-broadcast resync, and ``metrics_snapshot()["membership"]`` reports
+epoch 1 naming the dead rank.  Plus the fast 2-rank shrink-to-1 tier-1
+smoke, the standby rejoin (grow) path, the ``hvdrun --min-np`` CLI, and
+the in-process units for ``ElasticState``/``run_elastic``/launcher
+accounting.  The below-``--min-np`` checkpoint fallback lives in
+test_faults.py next to the rest of the restart machinery; the PR-4
+cache / PR-5 autotune reshape interplay lives in test_cache.py.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env(**overrides):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.setdefault("HVD_TPU_KILL_GRACE_SEC", "3")
+    env.update({k: str(v) for k, v in overrides.items()})
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA", "HVD_TPU_FAULT_SPEC",
+                "HVD_TPU_RESTART_EPOCH", "HVD_TPU_ELASTIC",
+                "HVD_TPU_MIN_NP", "HVD_TPU_REJOIN"):
+        env.setdefault(var, "")
+        if not env[var]:
+            env.pop(var, None)
+    return env
+
+
+# One re-enterable training script for every elastic test: averaged
+# allreduce of ones adds exactly 1.0 per step REGARDLESS of the current
+# membership size, so the final weights prove the step count survived the
+# reshape; the trailing allgather proves the resync left every member
+# (admitted standbys included) bit-identical.
+_TRAIN = """\
+import os, sys, time
+import numpy as np
+import horovod_tpu as hvd
+
+TOTAL = int(sys.argv[1])
+PAUSE = float(os.environ.get("TEST_STEP_PAUSE") or 0)
+hvd.init()
+state = hvd.ElasticState(weights=np.zeros(8, np.float32), step=0)
+
+def train(state):
+    while state.step < TOTAL:
+        s = state.step
+        g = np.ones(8, np.float32)
+        state.weights = state.weights + hvd.allreduce(
+            g, average=True, name=f"grad.{s}")
+        state.step = s + 1
+        if PAUSE:
+            time.sleep(PAUSE)
+    return state.weights
+
+w = hvd.run_elastic(train, state)
+assert np.allclose(w, float(TOTAL)), (hvd.rank(), w)
+# Elastic is single-host: the local identity must track the global one
+# through reshapes (a survivor and an admitted standby must never
+# collide on local_rank for per-host resources).
+assert hvd.local_rank() == hvd.rank(), (hvd.local_rank(), hvd.rank())
+assert hvd.local_size() == hvd.size(), (hvd.local_size(), hvd.size())
+flat = hvd.allgather(w.reshape(1, -1), name="final.identity")
+assert np.allclose(flat, flat[0]), flat
+m = hvd.metrics_snapshot()["membership"]
+print("MEMBER", hvd.rank(), hvd.size(), m["epoch"], m["size"],
+      ",".join(map(str, m["ranks_lost"])) or "-",
+      ",".join(map(str, m["ranks_joined"])) or "-", int(w[0]), flush=True)
+"""
+
+
+def _members(results):
+    """Parse the MEMBER lines of every clean rank: [(rank, size, epoch,
+    size_in_snapshot, lost, joined, w0), ...]."""
+    out = []
+    for r in results:
+        if r.returncode != 0:
+            continue
+        for line in r.stdout.splitlines():
+            if line.startswith("MEMBER "):
+                tok = line.split()
+                lost = [] if tok[5] == "-" else [int(x) for x in
+                                                 tok[5].split(",")]
+                joined = [] if tok[6] == "-" else [int(x) for x in
+                                                   tok[6].split(",")]
+                out.append((int(tok[1]), int(tok[2]), int(tok[3]),
+                            int(tok[4]), lost, joined, int(tok[7])))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The acceptance path: 4 ranks shrink to 3 and train to completion.
+# ---------------------------------------------------------------------------
+
+
+def test_shrink_to_three_trains_to_completion(tmp_path):
+    """rank=2:crash@op=12 on a 4-rank job: the survivors re-negotiate
+    size()==3 with dense ranks at the reshape barrier, resync from rank 0
+    by root broadcast (no relaunch, no checkpoint), finish all 30 steps,
+    and report membership epoch 1 naming rank 2."""
+    from horovod_tpu.common.faults import CRASH_EXIT_CODE
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "train.py"
+    script.write_text(_TRAIN)
+    results = run_membership(
+        [sys.executable, str(script), "30"], 4, min_np=2, max_np=4,
+        max_rejoins=0,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=2:crash@op=12",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20"),
+        timeout=90.0, capture=True, report=lambda msg: None)
+    by_slot = {r.rank: r for r in results}
+    assert by_slot[2].returncode == CRASH_EXIT_CODE, by_slot[2]
+    for slot in (0, 1, 3):
+        assert by_slot[slot].returncode == 0, \
+            (slot, by_slot[slot].returncode, by_slot[slot].stderr[-800:])
+    assert membership_succeeded(results, 2)
+    members = _members(results)
+    assert len(members) == 3, members
+    # Dense re-assigned ranks in the new membership: {0, 1, 2}.
+    assert sorted(m[0] for m in members) == [0, 1, 2], members
+    for rank_now, size_now, epoch, msize, lost, joined, w0 in members:
+        assert size_now == 3 and msize == 3, members
+        assert epoch == 1, members
+        assert lost == [2] and joined == [], members
+        assert w0 == 30, members
+
+
+def test_shrink_to_one_smoke(tmp_path):
+    """The fast tier-1 smoke: a 2-rank job loses rank 1 and the
+    coordinator finishes the run alone (size()==1)."""
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "train.py"
+    script.write_text(_TRAIN)
+    t0 = time.monotonic()
+    results = run_membership(
+        [sys.executable, str(script), "12"], 2, min_np=1, max_np=2,
+        max_rejoins=0,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=1:crash@op=6",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20"),
+        timeout=60.0, capture=True, report=lambda msg: None)
+    assert time.monotonic() - t0 < 45.0
+    assert membership_succeeded(results, 1), \
+        [(r.rank, r.returncode, r.stderr[-400:]) for r in results]
+    members = _members(results)
+    assert len(members) == 1, members
+    rank_now, size_now, epoch, msize, lost, joined, w0 = members[0]
+    assert (rank_now, size_now, epoch, msize) == (0, 1, 1, 1), members
+    assert lost == [1] and w0 == 12, members
+
+
+def test_frozen_rank_shrinks_instead_of_fatal_timeout(tmp_path):
+    """A SIGSTOP'd rank is caught by the liveness probe AFTER the pending
+    collectives have aged past HVD_TPU_COLLECTIVE_TIMEOUT_SEC (the probe
+    itself blocked that long) — the armed reshape must win over the
+    fatal ST_TIMEOUT sweep in the same tick, or a frozen rank kills an
+    elastic job a crashed rank would not."""
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "train.py"
+    script.write_text(_TRAIN)
+    t0 = time.monotonic()
+    results = run_membership(
+        [sys.executable, str(script), "12"], 2, min_np=1, max_np=2,
+        max_rejoins=0,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=1:freeze@op=6",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="2"),
+        timeout=60.0, capture=True, report=lambda msg: None)
+    assert time.monotonic() - t0 < 45.0
+    assert membership_succeeded(results, 1), \
+        [(r.rank, r.returncode, r.stderr[-400:]) for r in results]
+    members = _members(results)
+    assert len(members) == 1, members
+    rank_now, size_now, epoch, msize, lost, joined, w0 = members[0]
+    assert (rank_now, size_now, epoch, msize) == (0, 1, 1, 1), members
+    assert lost == [1] and w0 == 12, members
+
+
+# ---------------------------------------------------------------------------
+# Grow: a standby registers with the live coordinator and is admitted.
+# ---------------------------------------------------------------------------
+
+
+def test_standby_rejoins_and_grows_back(tmp_path):
+    """2-rank job with --max-np 2: rank 1 crashes (shrink to 1), the
+    launcher spawns a standby (HVD_TPU_REJOIN=1) that registers with the
+    live coordinator and is admitted at the next reshape barrier; both
+    the survivor and the admitted standby finish with identical weights
+    and the survivor's membership shows the join."""
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "train.py"
+    script.write_text(_TRAIN)
+    results = run_membership(
+        [sys.executable, str(script), "60"], 2, min_np=1, max_np=2,
+        rejoin_delay=0.3,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=1:crash@op=10",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20",
+                 TEST_STEP_PAUSE="0.05"),
+        timeout=90.0, capture=True, report=lambda msg: None)
+    assert membership_succeeded(results, 1), \
+        [(r.rank, r.returncode, r.stderr[-400:]) for r in results]
+    # Slot 2 is the standby: it must have been admitted and finished.
+    by_slot = {r.rank: r for r in results}
+    assert 2 in by_slot, results
+    assert by_slot[2].returncode == 0, by_slot[2].stderr[-800:]
+    members = _members(results)
+    # Survivor + standby, dense ranks {0, 1} in the final membership.
+    assert sorted(m[0] for m in members) == [0, 1], members
+    survivor = next(m for m in members if m[0] == 0)
+    _, size_now, epoch, msize, lost, joined, w0 = survivor
+    assert size_now == 2 and msize == 2, members
+    assert epoch == 2, members          # shrink, then grow
+    assert lost == [1] and joined == [1], members
+    for m in members:
+        assert m[6] == 60, members      # every member trained to the end
+
+
+# ---------------------------------------------------------------------------
+# CLI: hvdrun --min-np/--max-np end to end.
+# ---------------------------------------------------------------------------
+
+
+def test_hvdrun_cli_min_np(tmp_path):
+    """`hvdrun -np 2 --min-np 1`: a crashed rank is reshaped around, the
+    job exits 0, and the elastic completion notice lands on stderr."""
+    script = tmp_path / "train.py"
+    script.write_text(_TRAIN)
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         "--min-np", "1", "--timeout", "60", "--",
+         sys.executable, str(script), "12"],
+        env=_env(HVD_TPU_FAULT_SPEC="rank=1:crash@op=6",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20"),
+        capture_output=True, text=True, timeout=90)
+    assert proc.returncode == 0, proc.stderr[-1200:]
+    assert "completed elastically" in proc.stderr, proc.stderr[-800:]
+    assert "1 member(s) lost" in proc.stderr, proc.stderr[-800:]
+
+
+def test_hvdrun_cli_rejects_bad_bounds():
+    from horovod_tpu.runner import run_membership
+
+    with pytest.raises(ValueError, match="min-np"):
+        run_membership(["true"], 2, min_np=3, max_np=4)
+    with pytest.raises(ValueError, match="min-np"):
+        run_membership(["true"], 2, min_np=1, max_np=1)
+    # An explicit 0 is invalid, not "unset": silently disabling elastic
+    # for the user who asked for maximal elasticity is the worst outcome.
+    with pytest.raises(ValueError, match="min-np"):
+        run_membership(["true"], 2, min_np=0)
+
+
+# ---------------------------------------------------------------------------
+# In-process units: state sync, driver error contract, accounting.
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_state_validation_and_keys():
+    import horovod_tpu as hvd
+
+    with pytest.raises(ValueError, match="at least one"):
+        hvd.ElasticState()
+    st = hvd.ElasticState(weights=np.ones(3), step=7, lr=0.1)
+    assert st.keys() == ["lr", "step", "weights"]
+    assert st.step == 7
+
+
+def test_elastic_state_sync_roundtrips_leaf_types(single_process_hvd):
+    """sync() replaces every leaf with the root's value and preserves the
+    Python type of scalar leaves (step counters stay ints)."""
+    hvd = single_process_hvd
+    st = hvd.ElasticState(weights=np.arange(4, dtype=np.float32),
+                          step=3, lr=0.5, done=False)
+    st.sync(root=0, key=0)
+    assert isinstance(st.step, int) and st.step == 3
+    assert isinstance(st.lr, float) and st.lr == 0.5
+    assert isinstance(st.done, bool) and st.done is False
+    assert isinstance(st.weights, np.ndarray)
+    assert np.allclose(st.weights, np.arange(4)), st.weights
+
+
+def test_run_elastic_returns_result_and_reraises_fatal(single_process_hvd):
+    """The driver returns train_fn's result; fatal engine errors
+    (RanksDownError — the below-min-np / dead-coordinator path) and
+    non-engine exceptions re-raise unchanged."""
+    hvd = single_process_hvd
+    from horovod_tpu.common import RanksDownError
+
+    st = hvd.ElasticState(step=0)
+    assert hvd.run_elastic(lambda s: "done", st) == "done"
+    assert st.step == 0
+
+    def fatal(_):
+        raise RanksDownError("ranks down: 1", ranks=[1])
+
+    with pytest.raises(RanksDownError):
+        hvd.run_elastic(fatal, st)
+
+    def user_bug(_):
+        raise KeyError("not an engine error")
+
+    with pytest.raises(KeyError):
+        hvd.run_elastic(user_bug, st)
+
+
+def test_membership_changed_error_is_retryable_internal_error():
+    from horovod_tpu.common import (HorovodInternalError,
+                                    MembershipChangedError, RanksDownError)
+
+    err = MembershipChangedError("membership changed", lost_ranks=[2, 3])
+    assert isinstance(err, HorovodInternalError)
+    assert not isinstance(err, RanksDownError)
+    assert err.lost_ranks == [2, 3]
+
+
+def test_membership_epoch_zero_before_init():
+    from horovod_tpu.common import membership_epoch
+
+    assert membership_epoch() == 0
+
+
+def test_membership_succeeded_accounting():
+    from horovod_tpu.runner import RankResult, membership_succeeded
+
+    ok = RankResult(0, 0, "", "")
+    dead = RankResult(1, 43, "", "")
+    assert membership_succeeded([ok, dead], 1)
+    assert not membership_succeeded([ok, dead], 2)          # too few clean
+    assert not membership_succeeded([dead, ok], 1)          # coordinator died
+    assert not membership_succeeded([], 1)
+    assert membership_succeeded([ok, dead, RankResult(2, 0, "", "")], 2)
+
+
+def test_membership_metrics_and_prometheus():
+    """The registry's ungated membership mirror and its Prometheus
+    families (hvd_tpu_membership_*)."""
+    from horovod_tpu.common.metrics import MetricsRegistry, prometheus_text
+
+    reg = MetricsRegistry()
+    snap = reg.snapshot()
+    assert snap["membership"] == {"epoch": 0, "size": 0, "reshapes": 0,
+                                  "ranks_lost": [], "ranks_joined": []}
+    reg.set_membership({"epoch": 2, "size": 3, "reshapes": 2,
+                        "ranks_lost": [1], "ranks_joined": [3]})
+    snap = reg.snapshot()
+    assert snap["membership"]["epoch"] == 2
+    assert snap["membership"]["ranks_lost"] == [1]
+    text = prometheus_text(snap)
+    assert "hvd_tpu_membership_epoch 2" in text
+    assert "hvd_tpu_membership_size 3" in text
+    assert "hvd_tpu_membership_reshapes_total 2" in text
+    assert "hvd_tpu_membership_ranks_lost_total 1" in text
+    assert "hvd_tpu_membership_ranks_joined_total 1" in text
+
+
+def test_elastic_state_sync_pytree_leaves(single_process_hvd):
+    """Nested dict/namedtuple state (the jax params/opt_state shape)
+    syncs leaf-by-leaf and rebuilds the structure."""
+    import collections
+
+    hvd = single_process_hvd
+    Opt = collections.namedtuple("Opt", ["mu", "nu"])
+    params = {"dense": {"w": np.ones((2, 2), np.float32),
+                        "b": np.zeros(2, np.float32)}}
+    opt = Opt(mu=[np.full(2, 3.0)], nu=[np.full(2, 4.0)])
+    st = hvd.ElasticState(params=params, opt=opt, step=5)
+    st.sync(root=0, key=1)
+    assert isinstance(st.params, dict)
+    assert np.allclose(st.params["dense"]["w"], 1.0)
+    assert np.allclose(st.params["dense"]["b"], 0.0)
+    assert isinstance(st.opt, Opt)
+    assert np.allclose(st.opt.mu[0], 3.0) and np.allclose(st.opt.nu[0], 4.0)
+    assert st.step == 5
+
+
+def test_tree_flatten_pure_python_fallback(monkeypatch):
+    """Without jax, _tree_flatten still walks dicts (sorted keys), lists,
+    tuples, and namedtuples deterministically."""
+    import collections
+    import sys as _sys
+
+    from horovod_tpu.common import elastic
+
+    monkeypatch.setitem(_sys.modules, "jax", None)  # force ImportError
+    Pt = collections.namedtuple("Pt", ["x", "y"])
+    tree = {"b": [1, 2], "a": (Pt(x=3, y=4), 5)}
+    leaves, rebuild = elastic._tree_flatten(tree)
+    # Sorted dict keys -> "a" first.
+    assert leaves == [3, 4, 5, 1, 2]
+    out = rebuild([v * 10 for v in leaves])
+    assert out == {"a": (Pt(x=30, y=40), 50), "b": [10, 20]}
+    assert isinstance(out["a"][0], Pt)
+
+
+def test_run_elastic_rejects_unsupported_combos():
+    """Elastic + --hosts / --tpu-pin fail loudly instead of silently
+    dropping the feature (chip pinning has no stable local_rank for
+    standbys; multi-host elastic is not built yet)."""
+    from horovod_tpu.runner import run_elastic
+
+    with pytest.raises(ValueError, match="single-host"):
+        run_elastic(["true"], 2, min_np=1, hosts_spec="h1:1,h2:1")
+    with pytest.raises(ValueError, match="pinning"):
+        run_elastic(["true"], 2, min_np=1, tpu_pin=True)
+
+
+def test_trickled_probe_cannot_stall_the_job(tmp_path, monkeypatch):
+    """A connect to the elastic control port that sends a PARTIAL join
+    hello and then goes idle (slow trickle, health check, port scanner
+    writing a banner byte) must park in the coordinator's handshake
+    buffer and be dropped at its deadline — never block the engine tick
+    in a full-message read, which would stall every worker's negotiation
+    until the collective timeout killed a healthy job."""
+    import socket
+    import threading
+
+    from horovod_tpu.runner import launch, membership_succeeded
+
+    captured = {}
+    real = launch.allocate_endpoints
+
+    def spy(size, host="127.0.0.1"):
+        coord, data = real(size, host)
+        captured["coord"] = coord
+        return coord, data
+
+    monkeypatch.setattr(launch, "allocate_endpoints", spy)
+
+    script = tmp_path / "train.py"
+    script.write_text(_TRAIN)
+    box = {}
+
+    def run():
+        box["results"] = launch.run_membership(
+            [sys.executable, str(script), "30"], 2, min_np=1, max_np=2,
+            max_rejoins=0,
+            env=_env(HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20",
+                     TEST_STEP_PAUSE="0.2"),
+            timeout=60.0, capture=True, report=lambda msg: None)
+
+    t = threading.Thread(target=run)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while "coord" not in captured and time.monotonic() < deadline:
+            time.sleep(0.05)
+        host, port = captured["coord"].rsplit(":", 1)
+        # Let init finish so the probe hits the elastic accept loop, not
+        # the init rendezvous; the job itself runs ~6s of paused steps.
+        time.sleep(2.5)
+        probe = socket.create_connection((host, int(port)), timeout=5.0)
+        probe.sendall(b"\xfe\xff")  # 2 of the 4 hello bytes, then silence
+        t.join(timeout=55.0)
+        probe.close()
+    finally:
+        t.join(timeout=60.0)
+    assert not t.is_alive()
+    results = box["results"]
+    assert membership_succeeded(results, 2), \
+        [(r.rank, r.returncode, r.stderr[-400:]) for r in results]
+    members = _members(results)
+    assert len(members) == 2 and all(m[6] == 30 for m in members), members

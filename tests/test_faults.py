@@ -405,3 +405,79 @@ def test_hvdrun_reports_signal_death(tmp_path):
         env=_env(), capture_output=True, text=True, timeout=90)
     assert proc.returncode != 0
     assert "SIGKILL (signal 9)" in proc.stderr, proc.stderr[-800:]
+
+
+# ---------------------------------------------------------------------------
+# Elastic membership interplay (docs/fault-tolerance.md#elastic-membership):
+# the checkpoint-restart path is the FALLBACK when shrinking cannot help.
+# ---------------------------------------------------------------------------
+
+
+def test_below_min_np_falls_back_to_checkpoint_restart(tmp_path):
+    """A 2-rank elastic job with --min-np 2: losing a rank leaves too few
+    survivors to shrink around, so the engine aborts fatally (naming the
+    elastic minimum), run_membership gives up on elastic continuation, and
+    the outer --max-restarts relaunch + checkpoint-resume fallback kicks
+    in exactly as in the non-elastic case."""
+    from horovod_tpu.runner import run_elastic
+
+    script = tmp_path / "train.py"
+    script.write_text(_RESTART_SCRIPT)
+    ckpt = tmp_path / "ckpt"
+    msgs = []
+    results, restarts = run_elastic(
+        [sys.executable, str(script), str(ckpt)], 2, max_restarts=1,
+        min_np=2, max_np=2,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=1:crash@op=4",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20"),
+        timeout=120.0, capture=True, report=msgs.append)
+    assert restarts == 1
+    assert all(r.returncode == 0 for r in results), \
+        [(r.rank, r.returncode, r.stderr[-400:]) for r in results]
+    # The relaunch resumed from the checkpoint, not step 0.
+    done = (ckpt / "done.txt").read_text()
+    assert "epoch=1" in done, done
+    assert int(done.split("start_step=")[1]) >= 1, done
+    # The launcher explained why elastic continuation was abandoned.
+    assert any("min-np" in m or "coordinator" in m for m in msgs), msgs
+
+
+def test_clean_early_exit_counts_against_restarts_fast(tmp_path, monkeypatch):
+    """Restart accounting (ISSUE 6 satellite): a rank that dies CLEANLY
+    (rc 0) during the relaunch window — before init() completes — used to
+    park its peers in their connect retries until the TOTAL --timeout
+    budget burned.  The zero-exit straggler deadline
+    (HVD_TPU_EXIT_STRAGGLER_SEC) kills the stragglers instead, so the
+    attempt fails fast, counts against --max-restarts, and carries the
+    failure_report stderr tail."""
+    import time
+
+    from horovod_tpu.runner import failure_report, run_elastic
+
+    script = tmp_path / "early_exit.py"
+    script.write_text(
+        "import os, sys\n"
+        "if os.environ.get('HVD_TPU_RANK') == '1':\n"
+        "    sys.exit(0)  # clean death before init\n"
+        "import horovod_tpu as hvd\n"
+        "hvd.init()  # rank 0 blocks here waiting for rank 1\n"
+    )
+    # The deadline is read by the LAUNCHER (like HVD_TPU_KILL_GRACE_SEC),
+    # not the ranks; 2s keeps the two attempts inside the test budget.
+    monkeypatch.setenv("HVD_TPU_EXIT_STRAGGLER_SEC", "2")
+    msgs = []
+    t0 = time.monotonic()
+    results, restarts = run_elastic(
+        [sys.executable, str(script)], 2, max_restarts=1,
+        env=_env(), timeout=300.0, capture=True, report=msgs.append)
+    elapsed = time.monotonic() - t0
+    # Two attempts at ~(straggler deadline + cleanup) each — nowhere near
+    # the 300s total budget the old behavior would have burned.
+    assert elapsed < 90.0, elapsed
+    assert restarts == 1  # the relaunch was attempted and counted
+    by_rank = {r.rank: r for r in results}
+    assert by_rank[1].returncode == 0           # the clean early exit
+    assert by_rank[0].returncode != 0           # straggler, killed
+    assert any("restarting (1/1)" in m for m in msgs), msgs
+    # The stderr tail reaches the report (rank 0 was killed waiting).
+    assert failure_report(results), results

@@ -39,6 +39,8 @@ def populated_registry():
     reg.record_cache("engine", "hits")
     reg.record_cache("xla", "misses")
     reg.set_cache_size("engine", 1)
+    reg.set_membership({"epoch": 1, "size": 3, "reshapes": 1,
+                        "ranks_lost": [1], "ranks_joined": [3]})
     reg.set_autotune({
         "enabled": True, "frozen": True, "windows": 3,
         "fusion_threshold": 1 << 20, "cycle_time_ms": 2.5,

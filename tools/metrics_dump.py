@@ -178,6 +178,21 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
         lines.append("(no cache traffic — disabled, single step, or a "
                      "pre-cache dump)")
 
+    # Elastic membership (docs/fault-tolerance.md#elastic-membership);
+    # only rendered once the job reshaped, so pre-elastic dumps stay
+    # unchanged.
+    member = snap.get("membership", {})
+    if member.get("epoch") or member.get("reshapes"):
+        lines.append("== membership ==")
+        lost = member.get("ranks_lost", [])
+        joined = member.get("ranks_joined", [])
+        lines.append(
+            f"epoch {member.get('epoch', 0)}, size {member.get('size', 0)}, "
+            f"reshapes {member.get('reshapes', 0)}; lost "
+            + (", ".join(f"rank{r}" for r in lost) or "none")
+            + "; joined "
+            + (", ".join(f"rank{r}" for r in joined) or "none"))
+
     # Online autotuning (docs/performance.md#autotuning); only rendered
     # when the job opted in, so pre-autotune dumps stay unchanged.
     tune = snap.get("autotune", {})
