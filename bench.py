@@ -17,12 +17,14 @@ Extra errors are clipped to one short line.  BENCH_EXTRA=0 disables,
 BENCH_EXTRA_CONFIGS="seq:batch,..." overrides the sweep.
 
 Env knobs: BENCH_MODEL (resnet101|resnet50|resnet18|vgg16|inception_v3|
-mnist|transformer|allreduce|small_allreduce|scaling), BENCH_BATCH,
-BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
+mnist|transformer|allreduce|small_allreduce|serve_decode|scaling),
+BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
 length); transformer adds BENCH_SEQ/BENCH_VOCAB/BENCH_D_MODEL/BENCH_LAYERS/
 BENCH_HEADS; allreduce adds BENCH_NP/BENCH_BYTES/BENCH_ITERS;
 small_allreduce (the negotiation-bound cache microbench) adds
-BENCH_NP/BENCH_TENSORS/BENCH_STEPS.
+BENCH_NP/BENCH_TENSORS/BENCH_STEPS; serve_decode (the serving-plane
+continuous-batching bench, docs/inference.md) adds
+BENCH_NP/BENCH_REQUESTS.
 """
 
 from __future__ import annotations
@@ -446,6 +448,107 @@ if hvd.rank() == 0:
     print(json.dumps(record))
 
 
+def bench_serve_decode() -> None:
+    """Serving-plane bench (docs/inference.md): a synthetic multi-tenant
+    request stream against the continuous-batching engine over BENCH_NP
+    ranks.  Headline is generated tokens/sec; extra_metrics carries p50/
+    p99 time-to-first-token and per-token latency (lower-is-better: the
+    ``_ms`` suffix tells tools/bench_compare.py to gate them in that
+    direction), mean batch occupancy, and the steady-state negotiation-
+    cache hit rate measured over the serve window only (init-time param
+    broadcasts are legitimate misses) — asserted >= 0.9, the number that
+    proves decode steps pay zero coordinator roundtrips."""
+    import subprocess
+    import sys
+
+    np_ = int(os.environ.get("BENCH_NP", "2"))
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "24"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = f"""
+import json, threading, time, numpy as np, horovod_tpu as hvd
+from tools.metrics_dump import quantile
+from horovod_tpu.serving.engine import (ModelSpec, ServingEngine,
+                                        broadcast_params, init_params)
+from horovod_tpu.serving.scheduler import Scheduler, ServeConfig
+hvd.init()
+spec = ModelSpec(vocab=211, d_model=64, n_layers=2, n_heads=2)
+cfg = ServeConfig(max_batch=8, prefill_chunk=8, block_tokens=8,
+                  num_blocks=192, max_blocks_per_seq=12)
+params = broadcast_params(init_params(spec))
+rank0 = hvd.rank() == 0
+sch = Scheduler(cfg) if rank0 else None
+engine = ServingEngine(spec, cfg, params, sch)
+if not rank0:
+    engine.run()
+    hvd.shutdown()
+    raise SystemExit(0)
+loop = threading.Thread(target=engine.run, daemon=True)
+loop.start()
+base = hvd.metrics_snapshot()["cache"]["engine"]
+rng = np.random.RandomState(0)
+reqs = []
+t0 = time.perf_counter()
+# Mixed tenants/lengths arriving while earlier requests decode: the
+# continuous-batching shape (joins and retirements at step boundaries).
+for i in range({n_requests}):
+    tenant = ("acme", "beta", "gamma")[i % 3]
+    prompt = rng.randint(0, 211, int(rng.randint(4, 40))).tolist()
+    reqs.append(sch.submit(tenant, prompt, int(rng.randint(8, 32))))
+    time.sleep(0.002)
+for r in reqs:
+    assert r.event.wait(300), f"request {{r.id}} hung"
+dt = time.perf_counter() - t0
+engine.request_stop()
+loop.join(60)
+snap = hvd.metrics_snapshot()
+cache = snap["cache"]["engine"]
+hits = cache["hits"] - base["hits"]
+misses = cache["misses"] - base["misses"]
+hit_rate = hits / max(hits + misses, 1)
+assert hit_rate >= 0.9, (
+    f"steady-state negotiation cache hit rate {{hit_rate:.3f}} < 0.9 "
+    f"({{hits}} hits / {{misses}} misses over the serve window)")
+serving = snap["serving"]
+hists = snap["histograms"]
+tokens = sum(len(r.generated) for r in reqs)
+print("SERVE_JSON " + json.dumps({{
+    "tokens_per_sec": tokens / dt,
+    "requests": len(reqs),
+    "ttft_p50_ms": round((quantile(hists["serving_ttft_sec"], 0.5)
+                          or 0.0) * 1e3, 2),
+    "ttft_p99_ms": round((quantile(hists["serving_ttft_sec"], 0.99)
+                          or 0.0) * 1e3, 2),
+    "token_p50_ms": round((quantile(hists["serving_token_sec"], 0.5)
+                           or 0.0) * 1e3, 2),
+    "token_p99_ms": round((quantile(hists["serving_token_sec"], 0.99)
+                           or 0.0) * 1e3, 2),
+    "occupancy": round(serving["occupancy"], 4),
+    "steps": serving["steps"],
+    "cache_hit_rate": round(hit_rate, 4),
+}}), flush=True)
+hvd.shutdown()
+"""
+    env = dict(os.environ,
+               PYTHONPATH=repo + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    env.setdefault("HVD_TPU_METRICS", "1")
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_), "--",
+         sys.executable, "-c", code],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    stats = next(json.loads(line[len("SERVE_JSON "):])
+                 for line in out.stdout.splitlines()
+                 if line.startswith("SERVE_JSON "))
+    print(json.dumps({
+        "metric": f"serve_decode_tokens_per_sec_np{np_}",
+        "value": round(stats.pop("tokens_per_sec"), 2),
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # the reference serves nothing
+        "extra_metrics": stats,
+    }))
+
+
 def main() -> None:
     import jax
 
@@ -468,6 +571,8 @@ def main() -> None:
         return bench_allreduce()
     if model_name == "small_allreduce":
         return bench_small_allreduce()
+    if model_name == "serve_decode":
+        return bench_serve_decode()
     if model_name == "scaling":
         return bench_scaling()
     batch = int(os.environ.get("BENCH_BATCH", "64"))

@@ -63,6 +63,12 @@ HISTOGRAMS = {
     "announce_skew_sec": (LATENCY_BUCKETS,
                           "first-to-last announce skew per negotiated "
                           "collective (rank-0 coordinator view)"),
+    "serving_ttft_sec": (LATENCY_BUCKETS,
+                         "serving plane: submit to first generated token "
+                         "(rank-0 scheduler view)"),
+    "serving_token_sec": (LATENCY_BUCKETS,
+                          "serving plane: mean per-token latency of "
+                          "retired requests (end-to-end / tokens)"),
 }
 
 # Cap on distinct stalled-tensor entries kept by name; beyond it new names
@@ -70,6 +76,16 @@ HISTOGRAMS = {
 # stalling forever) cannot grow the registry unboundedly.
 _MAX_STALL_TENSORS = 256
 _STALL_OVERFLOW_KEY = "<other>"
+# Same cap for per-tenant serving counters: tenant names arrive from the
+# network, so an adversarial client must not be able to grow the registry
+# (or the Prometheus exposition) without bound.
+_MAX_TENANTS = 256
+
+# Serving-plane event counters (requests lifecycle) — the keys of the
+# "serving" snapshot section and the `event` label values of
+# hvd_tpu_serving_requests_total.
+SERVING_EVENTS = ("requests", "admitted", "rejected", "retired", "failed",
+                  "preempted", "reformed")
 
 
 class Histogram:
@@ -151,6 +167,18 @@ class MetricsRegistry:
         # enabling full metrics.
         self._membership = {"epoch": 0, "size": 0, "reshapes": 0,
                             "ranks_lost": [], "ranks_joined": []}
+        # Serving plane (docs/inference.md): request-lifecycle counters,
+        # decode-step occupancy accounting, KV-pool gauges, and per-tenant
+        # request/token counters.  Ungated, like stalls: the serve smoke
+        # and acceptance tests assert on them without enabling full
+        # metrics.  Meaningful on rank 0 (the scheduler) only.
+        self._serving = {
+            **{e: 0 for e in SERVING_EVENTS},
+            "steps": 0, "slot_steps": 0,
+            "queue_depth": 0, "active": 0, "batch_slots": 0,
+            "kv_blocks_in_use": 0, "kv_blocks_total": 0,
+            "tenants": {},
+        }
         self._hists = {name: Histogram(bounds)
                        for name, (bounds, _) in HISTOGRAMS.items()}
 
@@ -246,6 +274,49 @@ class MetricsRegistry:
             self._skew["last_to_announce"][key] = (
                 self._skew["last_to_announce"].get(key, 0) + int(n))
 
+    def _tenant_locked(self, tenant: str) -> dict:
+        tenants = self._serving["tenants"]
+        if tenant not in tenants and len(tenants) >= _MAX_TENANTS:
+            tenant = _STALL_OVERFLOW_KEY
+        return tenants.setdefault(tenant, {
+            **{e: 0 for e in SERVING_EVENTS},
+            "prompt_tokens": 0, "generated_tokens": 0,
+        })
+
+    def record_serving(self, event: str, tenant: Optional[str] = None,
+                       n: int = 1) -> None:
+        """`n` serving request-lifecycle events (one of
+        :data:`SERVING_EVENTS`), optionally attributed to a tenant.
+        Ungated."""
+        with self._lock:
+            self._serving[event] += int(n)
+            if tenant is not None:
+                self._tenant_locked(tenant)[event] += int(n)
+
+    def record_serving_tokens(self, tenant: str, kind: str,
+                              n: int) -> None:
+        """`n` `kind` ("prompt" / "generated") tokens for a tenant."""
+        with self._lock:
+            self._tenant_locked(tenant)[f"{kind}_tokens"] += int(n)
+
+    def record_serving_step(self, active_slots: int,
+                            batch_slots: int) -> None:
+        """One decode step carrying `active_slots` live requests: the
+        running occupancy numerator/denominator."""
+        with self._lock:
+            self._serving["steps"] += 1
+            self._serving["slot_steps"] += int(active_slots)
+            self._serving["batch_slots"] = int(batch_slots)
+
+    def set_serving_gauges(self, **gauges) -> None:
+        """Overwrite serving gauges (queue_depth / active / batch_slots /
+        kv_blocks_in_use / kv_blocks_total)."""
+        with self._lock:
+            for key, value in gauges.items():
+                if key not in self._serving or key == "tenants":
+                    raise KeyError(f"unknown serving gauge {key!r}")
+                self._serving[key] = int(value)
+
     def record_stall(self, name: str, duration_sec: float) -> None:
         with self._lock:
             self._stall_count += 1
@@ -294,6 +365,18 @@ class MetricsRegistry:
                                 self._autotune.get("history", [])],
                     "applied": [dict(a) for a in
                                 self._autotune.get("applied", [])],
+                },
+                "serving": {
+                    **{k: v for k, v in self._serving.items()
+                       if k != "tenants"},
+                    "occupancy": (
+                        self._serving["slot_steps"]
+                        / (self._serving["steps"]
+                           * self._serving["batch_slots"])
+                        if self._serving["steps"]
+                        and self._serving["batch_slots"] else 0.0),
+                    "tenants": {t: dict(v) for t, v in
+                                self._serving["tenants"].items()},
                 },
                 "histograms": {name: h.to_dict()
                                for name, h in self._hists.items()},
@@ -449,6 +532,59 @@ def prometheus_text(snapshot: dict) -> str:
     out.append("# TYPE hvd_tpu_membership_ranks_joined_total counter")
     out.append("hvd_tpu_membership_ranks_joined_total "
                f"{len(member.get('ranks_joined', []))}")
+
+    serving = snapshot.get("serving", {})
+    out.append("# HELP hvd_tpu_serving_requests_total "
+               "serving request lifecycle events (docs/inference.md)")
+    out.append("# TYPE hvd_tpu_serving_requests_total counter")
+    for event in SERVING_EVENTS:
+        out.append(f'hvd_tpu_serving_requests_total{{event="{event}"}} '
+                   f'{serving.get(event, 0)}')
+    out.append("# HELP hvd_tpu_serving_steps_total "
+               "decode steps executed (rank-0 scheduler view)")
+    out.append("# TYPE hvd_tpu_serving_steps_total counter")
+    out.append(f"hvd_tpu_serving_steps_total {serving.get('steps', 0)}")
+    out.append("# HELP hvd_tpu_serving_queue_depth "
+               "requests waiting for a batch slot")
+    out.append("# TYPE hvd_tpu_serving_queue_depth gauge")
+    out.append("hvd_tpu_serving_queue_depth "
+               f"{serving.get('queue_depth', 0)}")
+    out.append("# HELP hvd_tpu_serving_active_requests "
+               "requests currently holding a decode-batch slot")
+    out.append("# TYPE hvd_tpu_serving_active_requests gauge")
+    out.append(f"hvd_tpu_serving_active_requests {serving.get('active', 0)}")
+    out.append("# HELP hvd_tpu_serving_batch_occupancy "
+               "mean fraction of decode-batch slots carrying a request")
+    out.append("# TYPE hvd_tpu_serving_batch_occupancy gauge")
+    out.append("hvd_tpu_serving_batch_occupancy "
+               f"{repr(float(serving.get('occupancy', 0.0)))}")
+    out.append("# HELP hvd_tpu_serving_kv_blocks_in_use "
+               "KV cache blocks currently allocated")
+    out.append("# TYPE hvd_tpu_serving_kv_blocks_in_use gauge")
+    out.append("hvd_tpu_serving_kv_blocks_in_use "
+               f"{serving.get('kv_blocks_in_use', 0)}")
+    out.append("# HELP hvd_tpu_serving_kv_blocks_total "
+               "KV cache block pool size")
+    out.append("# TYPE hvd_tpu_serving_kv_blocks_total gauge")
+    out.append("hvd_tpu_serving_kv_blocks_total "
+               f"{serving.get('kv_blocks_total', 0)}")
+    out.append("# HELP hvd_tpu_serving_tenant_requests_total "
+               "serving request events per tenant")
+    out.append("# TYPE hvd_tpu_serving_tenant_requests_total counter")
+    out.append("# HELP hvd_tpu_serving_tenant_tokens_total "
+               "prompt/generated tokens per tenant")
+    out.append("# TYPE hvd_tpu_serving_tenant_tokens_total counter")
+    for tenant, entry in serving.get("tenants", {}).items():
+        label = _label_escape(tenant)
+        for event in SERVING_EVENTS:
+            if entry.get(event):
+                out.append(
+                    f'hvd_tpu_serving_tenant_requests_total{{tenant='
+                    f'"{label}",event="{event}"}} {entry[event]}')
+        for kind in ("prompt", "generated"):
+            out.append(f'hvd_tpu_serving_tenant_tokens_total{{tenant='
+                       f'"{label}",kind="{kind}"}} '
+                       f'{entry.get(f"{kind}_tokens", 0)}')
 
     skew = snapshot.get("skew", {})
     out.append("# HELP hvd_tpu_announce_total "

@@ -21,6 +21,7 @@ from horovod_tpu.models.resnet import (  # noqa: F401
     ResNet152,
 )
 from horovod_tpu.models.transformer import (  # noqa: F401
+    DecodeContext,
     TransformerLM,
     next_token_loss,
 )

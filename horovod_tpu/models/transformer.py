@@ -11,7 +11,7 @@ scales linearly with the ring size.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 import flax.linen as nn
 import jax
@@ -83,13 +83,18 @@ def rope(x, positions, base: float = 10000.0, seq_dim: int = -2):
     trained under the old pairing exactly.
 
     ``positions``: (seq,) global token positions — global, so
-    sequence-sharded shards stay consistent.  ``seq_dim`` names the
-    sequence axis of ``x`` (-2 for (b, h, s, d), 1 for (b, s, h, d))."""
+    sequence-sharded shards stay consistent — or (batch, seq) when every
+    batch row sits at a different offset (the serving plane's continuous
+    decode batch, where slot b's next token lives at its own cache
+    length).  ``seq_dim`` names the sequence axis of ``x`` (-2 for
+    (b, h, s, d), 1 for (b, s, h, d))."""
     d = x.shape[-1]
     half = d // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs
     shape = [1] * x.ndim
+    if positions.ndim == 2:  # per-batch-row offsets (decode mode)
+        shape[0] = positions.shape[0]
     shape[seq_dim] = x.shape[seq_dim]
     shape[-1] = half
     cos = jnp.cos(angles).reshape(shape)[..., None]
@@ -120,6 +125,44 @@ def _rope_half_pairing(x, positions, base: float = 10000.0,
     return rotated.astype(x.dtype)
 
 
+class DecodeContext(NamedTuple):
+    """Per-step context for cached (KV) decode — the serving plane's
+    iteration-level hook (docs/inference.md).
+
+    ``k``/``v``: ``(n_layers, batch, heads, ctx_len, head_dim)`` — every
+    layer's cached keys/values (post-rope, as the layers wrote them),
+    gathered by the caller (the serving engine gathers its block-pool
+    pages; a simple driver can pass a contiguous cache).  ``mask``:
+    ``(batch, ctx_len)`` bool — which context positions are valid for
+    each batch row (rows at different lengths share one padded buffer).
+    ``positions``: ``(batch, new_len)`` int32 — the global positions of
+    the new tokens per row (= the row's cache length + arange).
+    """
+
+    k: Any
+    v: Any
+    mask: Any
+    positions: Any
+
+    def layer(self, i: int):
+        return self.k[i], self.v[i], self.mask, self.positions
+
+
+def _decode_attention(q, k, v, mask, sm_scale):
+    """Masked attention for the decode path: ``q`` (b, h, s, hd) against
+    ``k``/``v`` (b, h, S, hd) under ``mask`` (b, s, S).  Plain einsum —
+    decode steps are a handful of query rows, so a fused kernel would buy
+    nothing — with float32 softmax internals regardless of storage dtype.
+    Every query row attends at least to itself (the caller's mask always
+    admits the within-chunk diagonal), so the softmax is never empty."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale
+    logits = jnp.where(mask[:, None], logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
 class Attention(nn.Module):
     n_heads: int
     dtype: Any = jnp.bfloat16
@@ -129,9 +172,13 @@ class Attention(nn.Module):
     # "ppermute" (XLA collective permute), "rdma", or "fused" (rotation
     # DMA inside the flash kernel; ops/ring_flash.py).
     ring_impl: str = "ppermute"
+    # Sow each layer's (post-rope) K/V into the "intermediates"
+    # collection: the sharded ring-prefill path reads them back to fill
+    # the serving plane's KV cache (serving/prefill.py).
+    capture_kv: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode_ctx=None):
         b, s, d = x.shape
         head_dim = d // self.n_heads
         # One fused qkv projection whose einsum emits q/k/v *head-major*
@@ -150,22 +197,43 @@ class Attention(nn.Module):
         # (b, heads, seq, head_dim) each; custom VJP avoids the
         # activation-sized cotangent stack the sliced einsum would build.
 
-        if self.seq_axis is not None:
+        new_kv = None
+        if decode_ctx is not None:
+            k_ctx, v_ctx, ctx_mask, positions = decode_ctx
+            q, k = rope(q, positions), rope(k, positions)
+            ctx_len = k_ctx.shape[-2]
+            # Context keys all precede the new chunk; within the chunk
+            # positions are consecutive, so causality is a lower triangle.
+            mask = jnp.concatenate([
+                jnp.broadcast_to(ctx_mask[:, None, :], (b, s, ctx_len)),
+                jnp.broadcast_to(jnp.tril(jnp.ones((s, s), bool))[None],
+                                 (b, s, s)),
+            ], axis=-1)
+            keys = jnp.concatenate([k_ctx.astype(k.dtype), k], axis=-2)
+            vals = jnp.concatenate([v_ctx.astype(v.dtype), v], axis=-2)
+            out = _decode_attention(q, keys, vals, mask, head_dim ** -0.5)
+            new_kv = (k, v)
+        elif self.seq_axis is not None:
             offset = lax.axis_index(self.seq_axis) * s
             positions = offset + jnp.arange(s)
             q, k = rope(q, positions), rope(k, positions)
+            if self.capture_kv:
+                self.sow("intermediates", "kv", (k, v))
             out = ring_attention(q, k, v, axis_name=self.seq_axis,
                                  causal=True, rotate_impl=self.ring_impl)
         else:
             positions = jnp.arange(s)
             q, k = rope(q, positions), rope(k, positions)
+            if self.capture_kv:
+                self.sow("intermediates", "kv", (k, v))
             out = flash_attention(q, k, v, causal=True) if self.use_flash \
                 else blockwise_attention(q, k, v, causal=True)
         w_o = self.param(
             "o_kernel",
             nn.initializers.lecun_normal(in_axis=(0, 1), out_axis=2),
             (self.n_heads, head_dim, d), jnp.float32)
-        return jnp.einsum("bhse,hed->bsd", out, w_o.astype(self.dtype))
+        proj = jnp.einsum("bhse,hed->bsd", out, w_o.astype(self.dtype))
+        return proj if new_kv is None else (proj, new_kv)
 
 
 class Block(nn.Module):
@@ -175,19 +243,28 @@ class Block(nn.Module):
     seq_axis: Optional[str] = None
     use_flash: bool = True
     ring_impl: str = "ppermute"
+    capture_kv: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode_ctx=None):
         h = nn.RMSNorm(dtype=self.dtype, name="attn_norm")(x)
-        x = x + Attention(self.n_heads, self.dtype, self.seq_axis,
-                          self.use_flash, self.ring_impl, name="attn")(h)
+        attn = Attention(self.n_heads, self.dtype, self.seq_axis,
+                         self.use_flash, self.ring_impl, self.capture_kv,
+                         name="attn")
+        new_kv = None
+        if decode_ctx is None:
+            x = x + attn(h)
+        else:
+            a, new_kv = attn(h, decode_ctx)
+            x = x + a
         h = nn.RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
         h = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
                      name="up")(h)
         h = nn.gelu(h)
         h = nn.Dense(x.shape[-1], use_bias=False, dtype=self.dtype,
                      name="down")(h)
-        return x + h
+        x = x + h
+        return x if new_kv is None else (x, new_kv)
 
 
 class TransformerLM(nn.Module):
@@ -202,6 +279,7 @@ class TransformerLM(nn.Module):
     seq_axis: Optional[str] = None  # mapped mesh axis of sequence shards
     use_flash: bool = True
     ring_impl: str = "ppermute"  # K/V rotation under sequence parallelism
+    capture_kv: bool = False  # sow per-layer K/V (ring prefill capture)
     # Storage dtype of the returned logits.  The MXU accumulation is
     # always float32; bfloat16 STORAGE halves the dominant HBM stream of
     # the LM step (the (batch, seq, vocab) logits tensor and its
@@ -214,19 +292,33 @@ class TransformerLM(nn.Module):
     logits_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, tokens, targets=None):
+    def __call__(self, tokens, targets=None, decode_ctx=None):
         if targets is not None and self.seq_axis is not None:
             raise ValueError(
                 "targets= (fused head+loss) is unsupported under sequence "
                 "parallelism: it has no axis_name-aware normalization; "
                 "compute logits and use next_token_loss(..., axis_name=...) "
                 "instead.")
+        if decode_ctx is not None and (targets is not None
+                                       or self.seq_axis is not None):
+            raise ValueError(
+                "decode_ctx= (cached KV decode) composes with neither "
+                "targets= nor sequence parallelism: decode is an "
+                "inference-only, single-shard path (docs/inference.md).")
         d_ff = self.d_ff or 4 * self.d_model
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.dtype, name="embed")(tokens)
+        new_ks, new_vs = [], []
         for i in range(self.n_layers):
-            x = Block(self.n_heads, d_ff, self.dtype, self.seq_axis,
-                      self.use_flash, self.ring_impl, name=f"layer_{i}")(x)
+            block = Block(self.n_heads, d_ff, self.dtype, self.seq_axis,
+                          self.use_flash, self.ring_impl, self.capture_kv,
+                          name=f"layer_{i}")
+            if decode_ctx is None:
+                x = block(x)
+            else:
+                x, (k_new, v_new) = block(x, decode_ctx.layer(i))
+                new_ks.append(k_new)
+                new_vs.append(v_new)
         x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
         # Logits accumulate in float32 for a numerically stable softmax,
         # but the matmul runs in bfloat16 on the MXU: an f32xf32 matmul
@@ -240,10 +332,15 @@ class TransformerLM(nn.Module):
         if targets is not None:
             # Fused head+loss: see fused_next_token_loss.
             return fused_next_token_loss(x, w, targets, dtype=self.dtype)
-        return jnp.einsum("bsd,dv->bsv", x.astype(self.dtype),
-                          w.astype(self.dtype),
-                          preferred_element_type=jnp.float32).astype(
-                              self.logits_dtype)
+        logits = jnp.einsum("bsd,dv->bsv", x.astype(self.dtype),
+                            w.astype(self.dtype),
+                            preferred_element_type=jnp.float32).astype(
+                                self.logits_dtype)
+        if decode_ctx is not None:
+            # (n_layers, batch, heads, new_len, head_dim) each: the new
+            # chunk's K/V for the caller to persist into its cache.
+            return logits, (jnp.stack(new_ks), jnp.stack(new_vs))
+        return logits
 
 
 # Param-layout version stamped into checkpoint wrappers by the migrators

@@ -18,6 +18,8 @@ block's probability matrix (O(seq^2 / block)).
 from __future__ import annotations
 
 import functools
+import os
+import warnings
 from typing import Optional
 
 import jax
@@ -737,6 +739,83 @@ def _pick_block(seq_len: int, maximum: int = 512) -> int:
     return min(maximum, seq_len)  # ragged: the fallback path handles it
 
 
+def _vmem_budget_bytes() -> int:
+    """Scoped-VMEM planning budget, bytes.  Default 16 MiB — the v5e
+    scoped-allocation ceiling the r5 sweep calibrated against;
+    ``HVD_TPU_VMEM_LIMIT_MB`` overrides it for chips with different
+    scoped capacity (or to leave headroom under other scoped users)."""
+    return int(float(os.environ.get("HVD_TPU_VMEM_LIMIT_MB") or 16.0)
+               * (1 << 20))
+
+
+def _plan_vmem_bytes(mode: str, q_len: int, d: int, block_q: int,
+                     block_k: int) -> int:
+    """Conservative scoped-VMEM estimate for a backward plan, bytes.
+
+    Mosaic's real allocation is not a closed form (see _bwd_plan), so
+    this models the structural upper bound: every revolving block window
+    double-buffered at f32 width with head_dim padded to the 128-lane
+    tile, the combined kernel's whole-seq dq charged three ways (scratch
+    + a double-buffered output window — the term whose growth is exactly
+    the BENCH_r04 seq-8192 OOM).  Calibrated against the r5 sweep: every
+    measured-pass band lands under 16 MiB here and the measured 23.2 MiB
+    seq-8192/1024-block failure lands over, so clamping to this estimate
+    can only reject plans the frontier also rejects."""
+    lanes = max(d, 128)
+    w, db = 4, 2              # f32 worst case; double-buffered windows
+    lse = db * w * 8 * 2 * block_q          # lse8 + delta8 windows
+    if mode == "combined":
+        wins = db * w * lanes * (2 * block_q + 2 * block_k  # q,do,k,v in
+                                 + 2 * block_k)             # dk,dv out
+        dq = (db + 1) * w * lanes * q_len   # whole-seq out window + scratch
+        scratch = w * lanes * 2 * block_k   # dk/dv accumulators
+        return wins + lse + dq + scratch
+    # Split kernels run back to back; scoped peak is the larger one.
+    dkdv = (db * w * lanes * (2 * block_q + 4 * block_k) + lse
+            + w * lanes * 2 * block_k)
+    dqk = (db * w * lanes * (3 * block_q + 2 * block_k) + lse
+           + w * lanes * block_q)
+    return max(dkdv, dqk)
+
+
+def _fwd_vmem_bytes(q_len: int, d: int, block_q: int,
+                    block_k: int) -> int:
+    """Same structural estimate for the forward kernel (q in + out + k/v
+    windows, lse output, online-softmax scratch)."""
+    lanes = max(d, 128)
+    w, db = 4, 2
+    return (db * w * lanes * (2 * block_q + 2 * block_k)
+            + db * w * 8 * block_q                       # lse out
+            + w * block_q * (2 * 128 + lanes))           # m/l/acc scratch
+
+
+def _clamp_blocks(mode: str, q_len: int, d: int, block_q: int,
+                  block_k: int, estimate=_plan_vmem_bytes):
+    """Step a plan's blocks down until ``estimate`` fits the budget.
+    Returns the fitted (block_q, block_k), or None when even 128-blocks
+    cannot fit (combined's whole-seq dq term: the caller demotes to
+    split).  Warns when it changes the requested plan — a clamp means
+    the tuned choice would have been the r04 compile-time OOM."""
+    budget = _vmem_budget_bytes()
+    bq, bk = block_q, block_k
+    while estimate(mode, q_len, d, bq, bk) > budget:
+        if bq >= bk and bq > 128:
+            bq = _pick_block(q_len, bq // 2)
+        elif bk > 128:
+            bk = _pick_block(q_len, bk // 2)
+        elif mode == "combined":
+            return None
+        else:
+            break  # nothing below 128-blocks; the grid is as small as it gets
+    if (bq, bk) != (block_q, block_k):
+        warnings.warn(
+            f"attention {mode} blocks ({block_q}, {block_k}) at "
+            f"seq {q_len}/head_dim {d} exceed the scoped-VMEM budget "
+            f"({budget >> 20} MiB, HVD_TPU_VMEM_LIMIT_MB); clamped to "
+            f"({bq}, {bk})", stacklevel=3)
+    return bq, bk
+
+
 def _bwd_plan(q_len: int, d: int, block_q: int, block_k: int,
               bh: int = 1):
     """Choose the flash-backward execution mode and blocks against the
@@ -780,17 +859,33 @@ def _bwd_plan(q_len: int, d: int, block_q: int, block_k: int,
         # Each band is gated at its CALIBRATED bh bound (the table
         # above); anything beyond falls through to split, which
         # compiles everywhere — never extrapolate the combined kernel
-        # past a probed region (the r4 lesson).
+        # past a probed region (the r4 lesson).  The band choice is then
+        # backstopped against the COMPUTED budget (_plan_vmem_bytes):
+        # a shrunken HVD_TPU_VMEM_LIMIT_MB, or a band edge the sweep's
+        # granularity missed, clamps blocks down (warning) or demotes to
+        # split instead of handing Mosaic a plan that cannot compile.
+        choice = None
         if rows128 <= 2048 and bh <= 1024:
-            return "combined", block_q, block_k
-        if rows128 <= 4096 and bh <= 512:
-            return ("combined", _pick_block(q_len, min(block_q, 512)),
-                    _pick_block(q_len, min(block_k, 1024)))
-        if rows128 <= 8192 and bh <= 32:
-            return ("combined", _pick_block(q_len, min(block_q, 512)),
-                    _pick_block(q_len, min(block_k, 512)))
-    return ("split", _pick_block(q_len, block_q),
-            _pick_block(q_len, block_k))
+            choice = (block_q, block_k)
+        elif rows128 <= 4096 and bh <= 512:
+            choice = (_pick_block(q_len, min(block_q, 512)),
+                      _pick_block(q_len, min(block_k, 1024)))
+        elif rows128 <= 8192 and bh <= 32:
+            choice = (_pick_block(q_len, min(block_q, 512)),
+                      _pick_block(q_len, min(block_k, 512)))
+        if choice is not None:
+            fitted = _clamp_blocks("combined", q_len, d, *choice)
+            if fitted is not None:
+                return ("combined",) + fitted
+            warnings.warn(
+                f"combined attention backward at seq {q_len}/head_dim "
+                f"{d} cannot fit the scoped-VMEM budget "
+                f"({_vmem_budget_bytes() >> 20} MiB) at any block size "
+                "(whole-seq dq scratch); demoting to the split kernels",
+                stacklevel=2)
+    fitted = _clamp_blocks("split", q_len, d, _pick_block(q_len, block_q),
+                           _pick_block(q_len, block_k))
+    return ("split",) + fitted
 
 
 def _split_bwd_call(q, do, lse8, delta8, k, v, *, causal, block_q,
@@ -925,6 +1020,11 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         # aligned hot path).
         return _blockwise_fwd_impl(q, k, v, causal, sm_scale,
                                    max(block_k, 128), 0, 0)
+    # Backstop explicit oversized blocks against the scoped-VMEM budget
+    # (the default <=1024 blocks peak ~6 MiB and never clamp).
+    block_q, block_k = _clamp_blocks(
+        "forward", q_len, d, block_q, block_k,
+        estimate=lambda _m, s, dd, bq, bk: _fwd_vmem_bytes(s, dd, bq, bk))
     bh = batch * heads
     # Pre-scale q by the exact power-of-two part of sm_scale: one
     # (seq, d) multiply here replaces a (seq, seq) pass inside the

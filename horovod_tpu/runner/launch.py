@@ -380,6 +380,7 @@ def run_elastic(cmd: Sequence[str], np: int, max_restarts: int = 0,
                 tpu_topology: Optional[str] = None,
                 min_np: Optional[int] = None,
                 max_np: Optional[int] = None,
+                max_rejoins: Optional[int] = None,
                 report: Callable[[str], None] = None):
     """Job-level restart (docs/fault-tolerance.md): launch the job, and on
     failure — any rank exiting nonzero, or the job timing out — group-kill
@@ -430,6 +431,7 @@ def run_elastic(cmd: Sequence[str], np: int, max_restarts: int = 0,
                                          max_np=max_np, env=run_env,
                                          timeout=remaining,
                                          capture=capture, host=host,
+                                         max_rejoins=max_rejoins,
                                          report=report)
             elif hosts_spec:
                 results = run_hosts(cmd, np, hosts_spec,
@@ -710,6 +712,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "this, spawn standby ranks that rejoin the "
                              "live job at the next reshape barrier "
                              "(default: -np)")
+    parser.add_argument("--serve", action="store_true",
+                        help="serving mode (docs/inference.md): the "
+                             "command defaults to the serving entrypoint "
+                             "(python -m horovod_tpu.serving); rank 0 "
+                             "opens the HTTP front door on "
+                             "HVD_TPU_SERVE_PORT / --serve-port.  With "
+                             "--min-np the job shrinks around dead ranks "
+                             "and keeps serving (standby rejoin is "
+                             "disabled: a fresh rank cannot recover the "
+                             "in-flight KV state)")
+    parser.add_argument("--serve-port", type=int, default=None,
+                        help="with --serve: the front-door port (sets "
+                             "HVD_TPU_SERVE_PORT)")
     parser.add_argument("--max-restarts", type=int, default=0,
                         help="on job failure (a rank died, or the engine "
                              "aborted on a dead/stalled rank), kill the "
@@ -730,18 +745,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command, e.g. python train.py")
     args = parser.parse_args(argv)
-    if not args.command:
-        parser.error("no command given")
     cmd = args.command
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
+    if not cmd:
+        if not args.serve:
+            parser.error("no command given")
+        cmd = [sys.executable, "-m", "horovod_tpu.serving"]
+    if args.serve_port is not None and not args.serve:
+        parser.error("--serve-port requires --serve")
     from horovod_tpu.runner.tpu_pin import pinning_requested
 
     tpu_pin = pinning_requested(args.tpu_pin)
     env = None
+    if args.serve_port is not None:
+        env = dict(os.environ)
+        env["HVD_TPU_SERVE_PORT"] = str(args.serve_port)
     if args.timeline:
         os.makedirs(args.timeline, exist_ok=True)
-        env = dict(os.environ)
+        env = dict(env if env is not None else os.environ)
         # Trailing separator forces the directory form on EVERY rank —
         # remote (ssh) hosts don't share the launcher's filesystem, so a
         # bare path that only exists locally would fall back to the
@@ -761,7 +783,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             env=env, timeout=args.timeout or 3e7, host=args.host,
             hosts_spec=args.hosts, port_base=args.port_base,
             tpu_pin=tpu_pin, tpu_topology=args.tpu_topology,
-            min_np=args.min_np, max_np=args.max_np)
+            min_np=args.min_np, max_np=args.max_np,
+            # Serving is shrink-only: an admitted standby would join with
+            # empty KV pages and silently corrupt every sequence it
+            # touches, so elastic serve jobs never spawn standbys.
+            max_rejoins=0 if args.serve else None)
     except subprocess.TimeoutExpired:
         print("hvdrun: job timed out", file=sys.stderr)
         return 124

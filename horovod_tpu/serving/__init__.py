@@ -1,0 +1,38 @@
+"""Serving plane: multi-tenant continuous-batching inference over the
+collective engine (docs/inference.md).
+
+Three pieces on top of the subsystems PRs 1-6 built:
+
+* a rank-0 HTTP/JSON front door with per-tenant admission quotas and a
+  bounded queue that sheds load with typed 429s (serving/server.py);
+* an iteration-level continuous-batching scheduler over a block-granular
+  KV cache pool (serving/scheduler.py, serving/kv_cache.py), whose batch
+  plan is broadcast each step through the ordinary named-collective path
+  — the PR-4 negotiation response cache makes steady-state decode steps
+  pay zero coordinator roundtrips;
+* a per-rank decode engine driving models/transformer.py's cached-KV
+  decode mode, with ring-attention bulk prefill for long prompts and
+  elastic-reshape recovery (serving/engine.py, serving/prefill.py).
+
+``python -m horovod_tpu.serving`` (or ``hvdrun --serve``) is the server
+entrypoint.  The scheduler/pool core is importable without jax for pure
+unit testing.
+"""
+
+from horovod_tpu.serving.kv_cache import BlockPool  # noqa: F401
+from horovod_tpu.serving.scheduler import (  # noqa: F401
+    AdmissionError,
+    Plan,
+    Scheduler,
+    ServeConfig,
+    ServingUnavailableError,
+)
+
+__all__ = [
+    "AdmissionError",
+    "BlockPool",
+    "Plan",
+    "Scheduler",
+    "ServeConfig",
+    "ServingUnavailableError",
+]

@@ -1,0 +1,59 @@
+"""Serving-rank entrypoint: ``hvdrun --serve`` launches this module on
+every rank (``python -m horovod_tpu.serving``; docs/inference.md).
+
+Rank 0 opens the HTTP front door (``HVD_TPU_SERVE_PORT``) over the
+scheduler; every rank joins the decode loop.  The process exits 0 on an
+orderly ``POST /shutdown`` drain; fatal collective errors exit nonzero so
+the launcher's restart/elastic accounting sees them.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    import horovod_tpu as hvd
+    from horovod_tpu.serving import server as _server
+    from horovod_tpu.serving.engine import (ModelSpec, ServingEngine,
+                                            broadcast_params, init_params)
+    from horovod_tpu.serving.scheduler import Scheduler, ServeConfig
+
+    if hvd.restart_epoch() or __import__("os").environ.get(
+            "HVD_TPU_REJOIN"):
+        # A relaunched or standby serving rank has no way to recover the
+        # in-flight KV state; serving composes with --min-np (shrink and
+        # continue) but not with standby rejoin (docs/inference.md).
+        print("horovod_tpu.serving: standby/restarted serve ranks are "
+              "not supported; launch fresh", file=sys.stderr)
+        return 3
+    hvd.init()
+    cfg = ServeConfig.from_env()
+    spec = ModelSpec.from_env()
+    params = broadcast_params(init_params(spec))
+    rank0 = hvd.rank() == 0
+    scheduler = Scheduler(cfg) if rank0 else None
+    engine = ServingEngine(spec, cfg, params, scheduler)
+    port = None
+    if rank0:
+        port = _server.start_server(scheduler, cfg, engine=engine)
+        print(f"horovod_tpu.serving: listening on port {port} "
+              f"(size {hvd.size()}, model {spec.n_layers}L/"
+              f"{spec.d_model}d/vocab {spec.vocab})", flush=True)
+    try:
+        engine.run()
+    finally:
+        if rank0:
+            if scheduler.failed is None:
+                from horovod_tpu.serving.scheduler import \
+                    ServingUnavailableError
+
+                scheduler.fail_all(
+                    ServingUnavailableError("server shut down"))
+            _server.stop_server()
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

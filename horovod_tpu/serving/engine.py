@@ -1,0 +1,336 @@
+"""Per-rank serving engine: the decode loop under the batch-plan broadcast.
+
+Every rank runs the same loop: receive rank 0's packed batch plan through
+the ordinary named-collective path (``hvd.broadcast`` of a FIXED-shape
+int32 array, name ``serve.plan`` — so after the first step the PR-4
+negotiation response cache replays the agreement and steady-state decode
+steps pay zero coordinator roundtrips), execute the jitted decode step
+against the local page buffer, and loop.  Rank 0 additionally owns the
+scheduler and samples the next token from its own logits; the sample
+travels to the workers inside the NEXT plan (they never sample), so every
+rank's KV pages stay bit-identical by construction.
+
+Robustness: a :class:`~horovod_tpu.MembershipChangedError` on the plan
+broadcast means the elastic job reshaped mid-decode.  Survivor pages and
+scheduler state are both intact and the cancelled step never executed
+anywhere (the reshape barrier poisons in-flight collectives on every rank
+consistently), so each rank simply acks the reshape and re-enters the
+loop; rank 0 re-plans the identical step and in-flight requests resume.
+Fatal errors (``RanksDownError`` below min-np, timeouts) fail every
+in-flight request typed — never hang (docs/inference.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import metrics
+from horovod_tpu.serving import kv_cache, scheduler as sched
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """The served TransformerLM's shape (env: ``HVD_TPU_SERVE_*``).
+    Defaults are a test-scale model; production points ``ckpt`` at a
+    checkpoint whose tree matches the spec (docs/inference.md)."""
+
+    vocab: int = 512
+    d_model: int = 64
+    n_layers: int = 2
+    n_heads: int = 2
+    dtype: str = "float32"
+    seed: int = 0
+    ckpt: str = ""
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def from_env() -> "ModelSpec":
+        d = ModelSpec()
+        return ModelSpec(
+            vocab=int(os.environ.get("HVD_TPU_SERVE_VOCAB") or d.vocab),
+            d_model=int(os.environ.get("HVD_TPU_SERVE_D_MODEL")
+                        or d.d_model),
+            n_layers=int(os.environ.get("HVD_TPU_SERVE_LAYERS")
+                         or d.n_layers),
+            n_heads=int(os.environ.get("HVD_TPU_SERVE_HEADS")
+                        or d.n_heads),
+            dtype=os.environ.get("HVD_TPU_SERVE_DTYPE") or d.dtype,
+            seed=int(os.environ.get("HVD_TPU_SERVE_SEED") or d.seed),
+            ckpt=os.environ.get("HVD_TPU_SERVE_CKPT") or d.ckpt,
+        )
+
+
+def build_model(spec: ModelSpec, seq_axis: Optional[str] = None,
+                capture_kv: bool = False):
+    """The served model (and its sequence-parallel prefill twin — the
+    parameter tree is identical, only the attention communication pattern
+    differs).  ``use_flash=False``: serving never runs the training-path
+    Pallas kernel — decode uses the cached-KV path, prefill the blockwise
+    or ring path — so interpret-mode kernel compiles are never paid."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import TransformerLM
+
+    return TransformerLM(
+        vocab_size=spec.vocab, d_model=spec.d_model,
+        n_layers=spec.n_layers, n_heads=spec.n_heads,
+        dtype=jnp.dtype(spec.dtype), logits_dtype=jnp.float32,
+        use_flash=False, seq_axis=seq_axis, capture_kv=capture_kv)
+
+
+def init_params(spec: ModelSpec):
+    """Deterministic parameters: from ``spec.ckpt`` when set (the
+    ``jax.train.save_checkpoint`` pickle format), else seeded random init
+    — identical on every rank, and root-broadcast after init anyway so a
+    rank-locally-loaded checkpoint cannot diverge the job."""
+    import jax
+
+    if spec.ckpt:
+        from horovod_tpu.jax.train import load_latest_checkpoint
+
+        loaded = (load_latest_checkpoint(spec.ckpt)
+                  if os.path.isdir(spec.ckpt) else None)
+        if loaded is None:
+            import pickle
+
+            with open(spec.ckpt, "rb") as f:
+                loaded = pickle.load(f)
+        tree = loaded[1] if isinstance(loaded, tuple) else loaded
+        return tree.get("params", tree) if isinstance(tree, dict) else tree
+    model = build_model(spec)
+    tokens = np.zeros((1, 4), np.int32)
+    return model.init(jax.random.PRNGKey(spec.seed), tokens)["params"]
+
+
+def broadcast_params(params):
+    """Root-broadcast every parameter leaf from rank 0 (numbered names:
+    the signatures are stable, so even these warm the response cache)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    synced = []
+    for i, leaf in enumerate(leaves):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        out = hvd.broadcast(arr, 0, name=f"serve.param.{i}")
+        synced.append(out.reshape(arr.shape).astype(arr.dtype))
+    return jax.tree_util.tree_unflatten(treedef, synced)
+
+
+def make_step_fn(model, spec: ModelSpec, cfg: sched.ServeConfig) -> Callable:
+    """The jitted decode step: gather each slot's paged KV context,
+    run the model's cached-decode path over the (fixed-shape) token
+    chunk, scatter the fresh K/V back into the pages, and return greedy
+    next-token candidates per slot.  All shapes are static, so this
+    compiles exactly once per server lifetime."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from horovod_tpu.models import DecodeContext
+
+    ctx_len = cfg.max_blocks_per_seq * cfg.block_tokens
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(pages, params, tokens, n_new, lengths, tables):
+        k_ctx, v_ctx = kv_cache.gather_context(pages, tables)
+        ctx_mask = jnp.arange(ctx_len)[None, :] < lengths[:, None]
+        positions = lengths[:, None] + jnp.arange(tokens.shape[1])[None, :]
+        logits, (k_new, v_new) = model.apply(
+            {"params": params}, tokens,
+            decode_ctx=DecodeContext(k_ctx, v_ctx, ctx_mask, positions))
+        pages = kv_cache.scatter_new(pages, k_new, v_new, tables,
+                                     lengths, n_new)
+        idx = jnp.clip(n_new - 1, 0, tokens.shape[1] - 1)
+        sampled = jnp.take_along_axis(jnp.argmax(logits, axis=-1),
+                                      idx[:, None], axis=1)[:, 0]
+        return pages, sampled
+
+    return step
+
+
+def reference_decode(model, params, prompt_ids, max_new_tokens: int,
+                     eos_id: int = -1) -> List[int]:
+    """Greedy decode by repeated FULL-context forward — the semantic
+    ground truth the cached/paged path must reproduce (tests, and the
+    bench's correctness spot-check).  The buffer is padded to the final
+    length once (causal attention makes trailing padding invisible to
+    earlier positions), so the whole decode compiles a single forward
+    instead of one per length."""
+    import jax
+    import jax.numpy as jnp
+
+    total = len(prompt_ids) + max_new_tokens
+    apply = jax.jit(lambda t: model.apply({"params": params}, t))
+    tokens = list(prompt_ids)
+    out = []
+    for _ in range(max_new_tokens):
+        buf = jnp.asarray([tokens + [0] * (total - len(tokens))],
+                          jnp.int32)
+        logits = apply(buf)
+        tok = int(jnp.argmax(logits[0, len(tokens) - 1]))
+        out.append(tok)
+        if eos_id >= 0 and tok == eos_id:
+            break
+        tokens.append(tok)
+    return out
+
+
+class ServingEngine:
+    """One rank's serving loop.  Rank 0 owns ``scheduler`` (and the HTTP
+    front door sits on top of it); workers pass ``scheduler=None``."""
+
+    def __init__(self, spec: ModelSpec, cfg: sched.ServeConfig, params,
+                 scheduler: Optional[sched.Scheduler] = None):
+        self.spec = spec
+        self.cfg = cfg
+        self.model = build_model(spec)
+        self.params = params
+        self.scheduler = scheduler
+        self._step_fn = make_step_fn(self.model, spec, cfg)
+        self._stop = threading.Event()
+        self._trash = cfg.num_blocks  # page index masked writes land in
+        import jax.numpy as jnp
+
+        self.pages = kv_cache.init_pages(
+            spec.n_layers, spec.n_heads, spec.head_dim, cfg.num_blocks,
+            cfg.block_tokens, jnp.dtype(spec.dtype))
+        self._prefill = None  # lazy ring-prefill helper (serving/prefill.py)
+
+    def request_stop(self) -> None:
+        """Ask the loop to broadcast OP_STOP at the next tick (rank 0;
+        on workers it only exits the local loop — the plan broadcast is
+        what actually releases them)."""
+        self._stop.set()
+
+    # -- plan execution ---------------------------------------------------
+
+    def _tables_array(self, plan: sched.Plan) -> np.ndarray:
+        tables = np.full((self.cfg.max_batch, self.cfg.max_blocks_per_seq),
+                         self._trash, np.int32)
+        for sp in plan.slots:
+            for i, b in enumerate(sp.table):
+                if b >= 0:
+                    tables[sp.slot, i] = b
+        return tables
+
+    def _execute(self, plan: sched.Plan) -> np.ndarray:
+        """Run one planned step; returns per-slot sampled tokens."""
+        cfg = self.cfg
+        tokens = np.zeros((cfg.max_batch, cfg.prefill_chunk), np.int32)
+        n_new = np.zeros(cfg.max_batch, np.int32)
+        lengths = np.zeros(cfg.max_batch, np.int32)
+        tables = self._tables_array(plan)
+        for sp in plan.slots:
+            lengths[sp.slot] = sp.length
+            if sp.bulk_len:
+                continue  # handled by the bulk-prefill path below
+            tokens[sp.slot, :sp.n_new] = sp.tokens
+            n_new[sp.slot] = sp.n_new
+        self.pages, sampled = self._step_fn(
+            self.pages, self.params, tokens, n_new, lengths, tables)
+        sampled = np.array(sampled)  # writable: bulk slots overwrite below
+        for sp in plan.slots:
+            if sp.bulk_len:
+                sampled[sp.slot] = self._bulk_prefill(sp, tables[sp.slot])
+        return sampled
+
+    def _bulk_prefill(self, sp: sched.SlotPlan, table: np.ndarray) -> int:
+        """Whole-prompt prefill for one slot in a single sharded forward
+        (ops/ring_attention over the local device mesh), instead of
+        chunk-by-chunk: the prompt travels in a side broadcast (bucketed
+        length, so only a handful of extra cache signatures exist), every
+        rank writes the captured K/V into its pages, and the last real
+        position's logit is the first sampled token."""
+        from horovod_tpu.serving import prefill
+
+        if self._prefill is None:
+            self._prefill = prefill.RingPrefill(self.spec, self.cfg,
+                                                self.params)
+        if self.scheduler is not None:
+            feed = self.scheduler.bulk_tokens(sp.request_id)
+        else:
+            feed = []
+        buf, real_len = prefill.broadcast_prompt(feed, sp.bulk_len)
+        k_all, v_all, sampled = self._prefill(buf, real_len)
+        self.pages = prefill.scatter_bulk(self.pages, k_all, v_all,
+                                          table, real_len, self._trash)
+        return sampled
+
+    # -- the loop ---------------------------------------------------------
+
+    def run(self) -> None:
+        """One rank's serve loop.  ANY exception that kills it fails
+        in-flight requests typed first (never hang) — the per-iteration
+        handlers below cover the collective paths; this net covers the
+        rest (planning, packing, a bad checkpoint's first apply)."""
+        try:
+            self._loop()
+        except Exception as exc:
+            if self.scheduler is not None:
+                self.scheduler.fail_all(exc)
+            raise
+
+    def _loop(self) -> None:
+        cfg = self.cfg
+        rank0 = hvd.rank() == 0
+        plan_shape = sched.plan_size(cfg)
+        registry = metrics.registry
+        while True:
+            if rank0:
+                if self._stop.is_set():
+                    buf = sched.pack_control(cfg, sched.OP_STOP)
+                    plan = None
+                else:
+                    plan = (self.scheduler.step_plan()
+                            if self.scheduler else None)
+                    buf = (sched.pack_plan(cfg, plan) if plan
+                           else sched.pack_control(cfg, sched.OP_IDLE))
+            else:
+                buf = np.zeros(plan_shape, np.int32)
+                plan = None
+            try:
+                wire = hvd.broadcast(buf, 0, name="serve.plan")
+            except hvd.MembershipChangedError:
+                # Reshape mid-decode: the step never ran anywhere; ack
+                # and re-plan (docs/inference.md#reshape-semantics).
+                hvd.membership_ack()
+                if rank0 and self.scheduler:
+                    self.scheduler.reform([])
+                continue
+            opcode = int(wire[0])
+            if opcode == sched.OP_STOP:
+                return
+            if opcode == sched.OP_IDLE:
+                if rank0:
+                    time.sleep(cfg.idle_sleep_sec)
+                continue
+            if not rank0:
+                plan = sched.unpack_plan(cfg, wire)
+            t0 = time.perf_counter()
+            try:
+                sampled = self._execute(plan)
+            except hvd.MembershipChangedError:
+                # The bulk-prefill side broadcast got cancelled by a
+                # reshape.  The page writes a partially-executed step
+                # already made are idempotent (same values to the same
+                # positions) and scheduler state only advances in
+                # complete_step, so re-planning re-runs the identical
+                # step safely.
+                hvd.membership_ack()
+                if rank0 and self.scheduler:
+                    self.scheduler.reform([])
+                continue
+            if registry.enabled:
+                registry.observe("step_sec", time.perf_counter() - t0)
+            if rank0 and self.scheduler:
+                self.scheduler.complete_step(plan, sampled)

@@ -44,6 +44,10 @@ def pytest_configure(config):
         "quick: in-process tests (no rank subprocesses); `-m quick` is the "
         "fast PR-iteration tier (<3 min), `-m 'not quick'` the distributed "
         "tier.")
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-rank system tests excluded from the tier-1 budget "
+        "(`-m 'not slow'`); run them explicitly with `-m slow`.")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -370,3 +370,22 @@ def test_bench_compare(tmp_path):
     assert main([str(old), str(other)]) == 0
     missing = tmp_path / "missing.json"
     assert main([str(old), str(missing)]) == 2
+    # Latency extras (unit suffix) gate in the OPPOSITE direction: growth
+    # is the regression (the serving bench's TTFT/per-token metrics),
+    # shrinkage is an improvement.
+    lat_old = tmp_path / "lat_old.json"
+    lat_new = tmp_path / "lat_new.json"
+    lat_old.write_text(json.dumps({"metric": "m", "value": 100.0,
+                                   "extra_metrics": {"ttft_p99_ms": 10.0}}))
+    lat_new.write_text(json.dumps({"metric": "m", "value": 100.0,
+                                   "extra_metrics": {"ttft_p99_ms": 20.0}}))
+    assert main([str(lat_old), str(lat_new), "--extras"]) == 1
+    assert main([str(lat_new), str(lat_old), "--extras"]) == 0
+    # The unit token must not catch rates ("per" prefix) and must catch
+    # mid-name units (the cache bench's negotiation_p50_us_cached).
+    from tools.bench_compare import lower_is_better
+    assert not lower_is_better("cache_off_ops_per_sec")
+    assert not lower_is_better("tokens_per_sec")
+    assert lower_is_better("negotiation_p50_us_cached")
+    assert lower_is_better("token_p50_ms")
+    assert not lower_is_better("cache_hit_rate")

@@ -6,6 +6,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -82,6 +84,8 @@ def test_keras_mnist_advanced_example():
     assert "Test accuracy" in out
 
 
+@pytest.mark.slow  # ~65s: multi-epoch resnet50 + checkpoint resume; the
+# keras integration itself is covered by test_keras + the mnist examples
 def test_keras_imagenet_resnet50_example_with_resume(tmp_path):
     """BASELINE.json acceptance config 4, both legs: a fresh run that
     checkpoints on rank 0, then a resumed run that must find the epoch-1
@@ -102,6 +106,7 @@ def test_keras_imagenet_resnet50_example_with_resume(tmp_path):
     assert os.path.exists(fmt.format(epoch=2))
 
 
+@pytest.mark.slow  # ~23s: see the keras resnet50 note above
 def test_pytorch_imagenet_resnet50_example_with_resume(tmp_path):
     """BASELINE.json acceptance config 5, both legs: fresh run (rank-0
     checkpoint + parameter/optimizer-state broadcast), then a resumed run

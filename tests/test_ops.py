@@ -140,6 +140,49 @@ def test_bwd_plan_matches_vmem_calibration():
     assert 11520 % bq == 0 and 11520 % bk == 0
 
 
+def test_bwd_plan_fits_vmem_budget(monkeypatch):
+    """Every plan the block selection emits must fit the COMPUTED
+    scoped-VMEM estimate — the backstop behind the calibrated bands
+    (the BENCH_r04 seq-8192 OOM was a tuned block choice whose scoped
+    footprint nobody computed).  Long-context shapes 8192/16384 are the
+    regression region."""
+    import horovod_tpu.ops.attention as attn
+
+    for seq in (8192, 16384):
+        for d in (64, 128, 256):
+            for bh in (8, 16, 32, 64, 256):
+                mode, bq, bk = attn._bwd_plan(seq, d, 1024, 1024, bh)
+                assert seq % bq == 0 and seq % bk == 0
+                assert (attn._plan_vmem_bytes(mode, seq, d, bq, bk)
+                        <= attn._vmem_budget_bytes()), (seq, d, bh, mode)
+    # The measured r04 failure (combined 1024-blocks at seq 8192:
+    # 23.2 MiB) must score over the default 16 MiB budget — the estimate
+    # is only a guard if it rejects the shape that actually OOMed.
+    assert (attn._plan_vmem_bytes("combined", 8192, 64, 1024, 1024)
+            > attn._vmem_budget_bytes())
+    # A shrunken budget clamps (with a warning) instead of handing
+    # Mosaic a plan that cannot compile; 8 MiB cannot hold seq-8192
+    # combined's whole-seq dq at ANY block size, so it demotes to split.
+    monkeypatch.setenv("HVD_TPU_VMEM_LIMIT_MB", "8")
+    with pytest.warns(UserWarning, match="scoped-VMEM"):
+        mode, bq, bk = attn._bwd_plan(8192, 64, 1024, 1024, 16)
+    assert mode == "split"
+    assert (attn._plan_vmem_bytes(mode, 8192, 64, bq, bk)
+            <= attn._vmem_budget_bytes())
+    monkeypatch.delenv("HVD_TPU_VMEM_LIMIT_MB")
+    # The forward guard: explicit oversized blocks clamp to fitting ones
+    # instead of compiling a >budget kernel.
+    assert (attn._fwd_vmem_bytes(8192, 64, 8192, 1024)
+            > attn._vmem_budget_bytes())
+    with pytest.warns(UserWarning, match="clamped"):
+        fitted = attn._clamp_blocks(
+            "forward", 8192, 64, 8192, 1024,
+            estimate=lambda _m, s, dd, a, b:
+                attn._fwd_vmem_bytes(s, dd, a, b))
+    assert fitted is not None
+    assert attn._fwd_vmem_bytes(8192, 64, *fitted) <= attn._vmem_budget_bytes()
+
+
 @pytest.mark.parametrize("d", [64, 128])
 @pytest.mark.parametrize("seq", [1024, 4096, 8192, 16384])
 def test_flash_bwd_seq_sweep_compiles(seq, d):

@@ -1,0 +1,625 @@
+"""Serving plane (horovod_tpu/serving/, docs/inference.md).
+
+Three tiers, mirroring the subsystem's layering:
+
+* pure units — the continuous-batching scheduler core with NO jax or
+  engine (join/retire at step boundaries, KV-block pool exhaustion ->
+  queued-not-crashed, per-tenant quotas, priority order + preemption,
+  reshape-driven re-planning, plan wire pack/unpack);
+* the tier-1 single-process smoke — real model + HTTP front door at
+  size 1: two tenants POST overlapping requests, completions match the
+  full-context reference decode, snapshot counters match the workload;
+* multi-rank system tests — the 4-rank two-tenant acceptance (greedy
+  determinism, continuous batching observable, steady-state negotiation
+  cache hit rate >= 0.9) in tier-1, plus two `slow`-marked failure-path
+  tests (`-m slow`): a mid-decode crash failing requests TYPED (never
+  hung), and the elastic reshape resume (requests survive a membership
+  shrink).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tests.distributed import distributed_test  # noqa: E402
+
+from horovod_tpu.common import metrics  # noqa: E402
+from horovod_tpu.serving import kv_cache  # noqa: E402
+from horovod_tpu.serving import scheduler as sched  # noqa: E402
+from horovod_tpu.serving.scheduler import (  # noqa: E402
+    AdmissionError,
+    REJECT_QUEUE_FULL,
+    REJECT_TENANT_QUOTA,
+    REJECT_TOO_LONG,
+    Scheduler,
+    ServeConfig,
+    ServingUnavailableError,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Pure scheduler units (no jax, no engine).
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(max_batch=2, prefill_chunk=4, block_tokens=4, num_blocks=16,
+                max_blocks_per_seq=4, queue_limit=8, tenant_max_inflight=4)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _token(sp):
+    """Deterministic fake decode: a slot's sampled token is a function of
+    its request and how far it has generated."""
+    return (sp.request_id * 17 + sp.length) % 101
+
+
+def _drive(sch, max_steps=500):
+    """Run the scheduler against the fake decoder until drained.  Returns
+    the retired requests in retirement order."""
+    retired = []
+    for _ in range(max_steps):
+        plan = sch.step_plan()
+        if plan is None:
+            if sch.idle():
+                return retired
+            continue
+        sampled = [0] * sch.cfg.max_batch
+        for sp in plan.slots:
+            if sp.samples:
+                sampled[sp.slot] = _token(sp)
+        retired.extend(sch.complete_step(plan, sampled))
+    raise AssertionError(f"scheduler did not drain in {max_steps} steps")
+
+
+def test_block_pool_alloc_free():
+    pool = kv_cache.BlockPool(4, 8)
+    assert pool.blocks_for_tokens(0) == 0
+    assert pool.blocks_for_tokens(1) == 1
+    assert pool.blocks_for_tokens(8) == 1
+    assert pool.blocks_for_tokens(9) == 2
+    a = pool.alloc(3)
+    assert len(a) == 3 and pool.blocks_in_use == 3
+    # All-or-nothing: 2 > 1 free -> None, nothing leaks.
+    assert pool.alloc(2) is None
+    assert pool.blocks_in_use == 3 and pool.blocks_free == 1
+    pool.free(a)
+    assert pool.blocks_in_use == 0 and pool.blocks_free == 4
+    assert pool.peak_in_use == 3
+    with pytest.raises(ValueError):
+        pool.alloc(-1)
+    with pytest.raises(ValueError):
+        pool.free([99])
+    with pytest.raises(ValueError):
+        kv_cache.BlockPool(0, 8)
+
+
+def test_admission_typed_rejections():
+    metrics.registry.reset()
+    sch = Scheduler(_cfg(queue_limit=3, tenant_max_inflight=2))
+    with pytest.raises(AdmissionError) as e:
+        sch.submit("t", [], 4)
+    assert e.value.reason == REJECT_TOO_LONG
+    with pytest.raises(AdmissionError) as e:
+        sch.submit("t", [1, 2], 0)
+    assert e.value.reason == REJECT_TOO_LONG
+    # prompt + max_new past the context cap (max_seq = 16 here).
+    with pytest.raises(AdmissionError) as e:
+        sch.submit("t", [1] * 10, 10)
+    assert e.value.reason == REJECT_TOO_LONG
+    # Per-tenant in-flight cap.
+    sch.submit("t", [1, 2], 2)
+    sch.submit("t", [1, 2], 2)
+    with pytest.raises(AdmissionError) as e:
+        sch.submit("t", [1, 2], 2)
+    assert e.value.reason == REJECT_TENANT_QUOTA and e.value.tenant == "t"
+    # Global queue bound (distinct tenants dodge the per-tenant cap).
+    sch.submit("u", [1, 2], 2)
+    with pytest.raises(AdmissionError) as e:
+        sch.submit("v", [1, 2], 2)
+    assert e.value.reason == REJECT_QUEUE_FULL
+    snap = metrics.registry.snapshot()["serving"]
+    assert snap["requests"] == 8
+    assert snap["admitted"] == 3
+    assert snap["rejected"] == 5
+    assert snap["tenants"]["t"]["rejected"] == 4
+    assert snap["tenants"]["v"]["rejected"] == 1
+    assert snap["queue_depth"] == 3
+
+
+def test_join_and_retire_at_step_boundaries():
+    """The continuous-batching core: a short request retires (and frees
+    its slot + blocks) while a long one keeps decoding, and a request
+    submitted mid-flight joins at the next step boundary — no
+    head-of-line blocking in either direction."""
+    metrics.registry.reset()
+    sch = Scheduler(_cfg())
+    short = sch.submit("acme", [1, 2, 3], 2)
+    long = sch.submit("beta", [4, 5, 6], 10)
+    # Drive until the short one retires.
+    retired = []
+    joined_late = None
+    for step in range(100):
+        plan = sch.step_plan()
+        assert plan is not None
+        sampled = [0] * sch.cfg.max_batch
+        for sp in plan.slots:
+            if sp.samples:
+                sampled[sp.slot] = _token(sp)
+        retired.extend(sch.complete_step(plan, sampled))
+        if retired and joined_late is None:
+            # Short retired, long still active: its freed slot must be
+            # re-usable at the very next boundary.
+            assert retired == [short]
+            assert long.state == sched.ACTIVE
+            joined_late = sch.submit("acme", [7, 8], 3)
+        if len(retired) == 3:
+            break
+    assert [r.id for r in retired] == [short.id, joined_late.id, long.id] \
+        or [r.id for r in retired[:1]] == [short.id]
+    assert short.finish_seq < long.finish_seq
+    assert joined_late.finish_seq < long.finish_seq  # joined AND beat it out
+    assert len(short.generated) == 2
+    assert len(long.generated) == 10
+    assert len(joined_late.generated) == 3
+    # Everything drained: pool fully free, slots empty.
+    assert sch.pool.blocks_in_use == 0
+    assert sch.idle()
+    snap = metrics.registry.snapshot()["serving"]
+    assert snap["retired"] == 3
+    assert snap["tenants"]["acme"]["generated_tokens"] == 5
+    assert snap["tenants"]["beta"]["generated_tokens"] == 10
+    assert 0.0 < snap["occupancy"] <= 1.0
+
+
+def test_pool_exhaustion_queues_not_crashes():
+    """A request the pool cannot currently hold stays QUEUED (or gets
+    preempted back to the queue) and completes once blocks free up —
+    never an exception, never a lost request."""
+    metrics.registry.reset()
+    # Pool of 4 blocks, each request needs 3 (8 prompt + 4 gen = 12
+    # tokens / 4 per block): two cannot be resident at full length.
+    sch = Scheduler(_cfg(num_blocks=4, queue_limit=8))
+    a = sch.submit("t", [1] * 8, 4)
+    b = sch.submit("t", [2] * 8, 4)
+    retired = _drive(sch)
+    assert {r.id for r in retired} == {a.id, b.id}
+    assert len(a.generated) == 4 and len(b.generated) == 4
+    assert sch.pool.blocks_in_use == 0
+    snap = metrics.registry.snapshot()["serving"]
+    assert snap["retired"] == 2 and snap["failed"] == 0
+    # The squeeze was real: someone was preempted or the join was
+    # deferred (peak usage can never exceed the pool).
+    assert sch.pool.peak_in_use <= 4
+
+
+def test_priority_ordering():
+    """Higher-priority requests join free slots first; submission order
+    (FIFO) breaks ties — joining happens at the step boundary, so a
+    later high-priority submission beats every earlier lower one."""
+    sch = Scheduler(_cfg(max_batch=1, queue_limit=8))
+    first = sch.submit("t", [1, 2], 2)
+    low = sch.submit("t", [3, 4], 2, priority=0)
+    mid = sch.submit("t", [5, 6], 2, priority=1)
+    high = sch.submit("u", [7, 8], 2, priority=5)
+    retired = _drive(sch)
+    assert [r.id for r in retired] == [high.id, mid.id, first.id, low.id]
+
+
+def test_priority_preemption_resumes():
+    """When the pool runs dry, the lowest-priority youngest active
+    request is preempted (blocks freed, back to the queue) and later
+    resumes from a re-prefill — its generated tokens are kept."""
+    metrics.registry.reset()
+    sch = Scheduler(_cfg(num_blocks=4, queue_limit=8))
+    victim = sch.submit("t", [1] * 8, 4, priority=0)
+    # Let the victim join and decode a couple of steps alone.
+    for _ in range(3):
+        plan = sch.step_plan()
+        sampled = [0] * sch.cfg.max_batch
+        for sp in plan.slots:
+            if sp.samples:
+                sampled[sp.slot] = _token(sp)
+        sch.complete_step(plan, sampled)
+    tokens_before = list(victim.generated)
+    assert victim.state == sched.ACTIVE
+    vip = sch.submit("u", [2] * 8, 4, priority=9)
+    retired = _drive(sch)
+    assert [r.id for r in retired] == [vip.id, victim.id]
+    # The preemption actually happened and the early tokens survived it.
+    assert metrics.registry.snapshot()["serving"]["preempted"] >= 1
+    assert victim.generated[:len(tokens_before)] == tokens_before
+    assert len(victim.generated) == 4
+
+
+def test_replan_after_reshape_is_identical():
+    """Reshape semantics (docs/inference.md): a cancelled step is
+    re-planned bit-identically — same slots, tokens, tables, lengths —
+    because scheduler state only advances in complete_step and block
+    allocation only ever covers the shortfall."""
+    sch = Scheduler(_cfg())
+    sch.submit("t", [1, 2, 3, 4, 5, 6], 4)
+    sch.submit("u", [7, 8], 2)
+    p1 = sch.step_plan()
+    in_use = sch.pool.blocks_in_use
+    sch.reform([1])                      # the broadcast never completed
+    p2 = sch.step_plan()
+    assert sch.pool.blocks_in_use == in_use  # no double allocation
+    assert len(p1.slots) == len(p2.slots)
+    for a, b in zip(p1.slots, p2.slots):
+        assert (a.slot, a.request_id, a.tokens, a.n_new, a.length,
+                a.table, a.bulk_len, a.samples) == \
+               (b.slot, b.request_id, b.tokens, b.n_new, b.length,
+                b.table, b.bulk_len, b.samples)
+    assert metrics.registry.snapshot()["serving"]["reformed"] == 1
+    # And the job still drains to completion afterwards.
+    sampled = [0] * sch.cfg.max_batch
+    for sp in p2.slots:
+        if sp.samples:
+            sampled[sp.slot] = _token(sp)
+    sch.complete_step(p2, sampled)
+    _drive(sch)
+    assert sch.idle()
+
+
+def test_plan_pack_roundtrip():
+    cfg = _cfg()
+    sch = Scheduler(cfg)
+    sch.submit("t", [1, 2, 3, 4, 5], 3)
+    sch.submit("u", [9], 2)
+    plan = sch.step_plan()
+    wire = sched.pack_plan(cfg, plan)
+    assert wire.shape == (sched.plan_size(cfg),)
+    back = sched.unpack_plan(cfg, wire)
+    assert back.opcode == sched.OP_STEP and back.step == plan.step
+    assert len(back.slots) == len(plan.slots)
+    for a, b in zip(plan.slots, back.slots):
+        assert (a.slot, a.tokens, a.n_new, a.length, a.bulk_len,
+                a.samples) == (b.slot, b.tokens, b.n_new, b.length,
+                               b.bulk_len, b.samples)
+        # Tables travel padded with -1.
+        assert b.table[:len(a.table)] == a.table
+    ctl = sched.pack_control(cfg, sched.OP_STOP)
+    assert sched.unpack_plan(cfg, ctl).opcode == sched.OP_STOP
+
+
+def test_fail_all_is_typed_never_hung():
+    metrics.registry.reset()
+    sch = Scheduler(_cfg())
+    a = sch.submit("t", [1, 2], 4)
+    b = sch.submit("t", [3, 4], 4)
+    sch.step_plan()                      # a and b take slots + blocks
+    sch.fail_all(RuntimeError("ranks died"))
+    for req in (a, b):
+        assert req.event.is_set(), "request hung after plane failure"
+        assert isinstance(req.error, ServingUnavailableError)
+        assert "ranks died" in str(req.error)
+    assert sch.pool.blocks_in_use == 0
+    with pytest.raises(ServingUnavailableError):
+        sch.submit("t", [5], 1)
+    snap = metrics.registry.snapshot()["serving"]
+    assert snap["failed"] == 2
+
+
+def test_serve_config_from_env(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_SERVE_MAX_BATCH", "3")
+    monkeypatch.setenv("HVD_TPU_SERVE_KV_BLOCKS", "99")
+    monkeypatch.setenv("HVD_TPU_SERVE_QUEUE", "7")
+    monkeypatch.setenv("HVD_TPU_SERVE_PORT", "18780")
+    cfg = ServeConfig.from_env()
+    assert cfg.max_batch == 3
+    assert cfg.num_blocks == 99
+    assert cfg.queue_limit == 7
+    assert cfg.port == 18780
+    assert cfg.prefill_chunk == ServeConfig().prefill_chunk  # default kept
+    assert cfg.max_seq == cfg.block_tokens * cfg.max_blocks_per_seq
+
+
+def test_tenant_cardinality_is_bounded():
+    """Tenant names arrive from the network: past the cap they fold into
+    the overflow bucket instead of growing the registry unboundedly."""
+    metrics.registry.reset()
+    for i in range(metrics._MAX_TENANTS + 10):
+        metrics.registry.record_serving("requests", f"tenant-{i}")
+    tenants = metrics.registry.snapshot()["serving"]["tenants"]
+    assert len(tenants) == metrics._MAX_TENANTS + 1  # cap + overflow key
+    assert tenants[metrics._STALL_OVERFLOW_KEY]["requests"] == 10
+    metrics.registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 single-process smoke: real model + HTTP front door at size 1.
+# ---------------------------------------------------------------------------
+
+
+def _post(port, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_serve_smoke_single_process(single_process_hvd):
+    """The serve smoke (ISSUE 7 satellite): start the server, POST two
+    tenants' overlapping requests, assert greedy-deterministic
+    completions and snapshot counters matching the workload."""
+    from horovod_tpu.serving import server as _server
+    from horovod_tpu.serving.engine import (ModelSpec, ServingEngine,
+                                            init_params, reference_decode)
+
+    hvd = single_process_hvd
+    metrics.registry.reset()
+    spec = ModelSpec(vocab=97, d_model=32, n_layers=2, n_heads=2)
+    cfg = ServeConfig(max_batch=4, prefill_chunk=4, block_tokens=4,
+                      num_blocks=64, max_blocks_per_seq=8, port=0,
+                      request_timeout_sec=120.0)
+    params = init_params(spec)
+    sch = Scheduler(cfg)
+    engine = ServingEngine(spec, cfg, params, sch)
+    loop = threading.Thread(target=engine.run, daemon=True)
+    loop.start()
+    port = _server.start_server(sch, cfg, engine=engine)
+    try:
+        assert _get(port, "/healthz")["ok"]
+        jobs = {"acme": ([3, 1, 4, 1, 5], 6), "beta": ([2, 7, 1], 3)}
+        results = {}
+
+        def client(tenant):
+            prompt, max_new = jobs[tenant]
+            results[tenant] = _post(port, {
+                "tenant": tenant, "prompt_ids": prompt,
+                "max_new_tokens": max_new})
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in jobs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        for tenant, (prompt, max_new) in jobs.items():
+            status, body = results[tenant]
+            assert status == 200, (tenant, body)
+            want = reference_decode(engine.model, params, prompt, max_new)
+            assert body["tokens"] == want, (tenant, body["tokens"], want)
+            assert body["ttft_ms"] is not None
+        # Typed 400 for a request no retry can fix.
+        status, body = _post(port, {"tenant": "acme", "prompt_ids": [1] * 30,
+                                    "max_new_tokens": 30})
+        assert status == 400 and body["error"]["reason"] == REJECT_TOO_LONG
+        # Malformed body.
+        status, body = _post(port, {"prompt_ids": [1]})
+        assert status == 400 and body["error"]["type"] == "bad_request"
+        stats = _get(port, "/v1/stats")
+        serving = stats["serving"]
+        assert serving["admitted"] == 2 and serving["retired"] == 2
+        assert serving["rejected"] == 1
+        assert serving["tenants"]["acme"]["generated_tokens"] == 6
+        assert serving["tenants"]["beta"]["generated_tokens"] == 3
+        assert serving["tenants"]["acme"]["prompt_tokens"] == 5
+        snap = hvd.metrics_snapshot()["serving"]
+        assert snap["steps"] == serving["steps"] >= 6
+        assert snap["kv_blocks_in_use"] == 0     # everything freed
+        # Orderly drain.
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/shutdown",
+                                     data=b"")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert json.loads(resp.read())["stopping"]
+        loop.join(60)
+        assert not loop.is_alive()
+    finally:
+        engine.request_stop()
+        _server.stop_server()
+        metrics.registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Multi-rank system tests.
+# ---------------------------------------------------------------------------
+
+
+@distributed_test(np_=4, timeout=300)
+def test_four_rank_two_tenant_acceptance():
+    """The ISSUE acceptance core on 4 ranks: two tenants' overlapping
+    requests of different lengths all complete with greedy-deterministic
+    tokens, the short request retires first (continuous batching), and
+    the steady-state decode negotiation-cache hit rate is >= 0.9 (decode
+    steps pay zero coordinator roundtrips)."""
+    import horovod_tpu as hvd
+    from horovod_tpu.serving.engine import (ModelSpec, ServingEngine,
+                                            broadcast_params, init_params,
+                                            reference_decode)
+
+    hvd.init()
+    spec = ModelSpec(vocab=101, d_model=32, n_layers=2, n_heads=2)
+    cfg = ServeConfig(max_batch=4, prefill_chunk=4, block_tokens=4,
+                      num_blocks=64, max_blocks_per_seq=8)
+    params = broadcast_params(init_params(spec))
+    rank0 = hvd.rank() == 0
+    sch = Scheduler(cfg) if rank0 else None
+    engine = ServingEngine(spec, cfg, params, sch)
+    if not rank0:
+        engine.run()
+        hvd.shutdown()
+        return
+    base = hvd.metrics_snapshot()["cache"]["engine"]
+    loop = threading.Thread(target=engine.run, daemon=True)
+    loop.start()
+    short = sch.submit("acme", [5, 4, 3], 4)
+    long = sch.submit("beta", list(range(1, 9)), 16)
+    assert short.event.wait(180) and long.event.wait(180), "request hung"
+    assert short.error is None and long.error is None
+    # Continuous batching observable: the short request retired while
+    # the long one was still decoding.
+    assert short.finish_seq < long.finish_seq
+    assert short.generated == reference_decode(engine.model, params,
+                                               [5, 4, 3], 4)
+    assert long.generated == reference_decode(engine.model, params,
+                                              list(range(1, 9)), 16)
+    cache = hvd.metrics_snapshot()["cache"]["engine"]
+    hits = cache["hits"] - base["hits"]
+    misses = cache["misses"] - base["misses"]
+    rate = hits / max(hits + misses, 1)
+    assert rate >= 0.9, (hits, misses)
+    serving = hvd.metrics_snapshot()["serving"]
+    assert serving["admitted"] == 2 and serving["retired"] == 2
+    assert serving["tenants"]["acme"]["generated_tokens"] == 4
+    assert serving["tenants"]["beta"]["generated_tokens"] == 16
+    engine.request_stop()
+    loop.join(60)
+    hvd.shutdown()
+
+
+# One serve-rank script for the failure-path tests: every rank runs the
+# engine; rank 0 submits one long request BEFORE entering the loop, so
+# the injected mid-decode crash always lands with a request in flight.
+_SERVE_CRASH = """\
+import sys, threading
+import horovod_tpu as hvd
+from horovod_tpu.serving.engine import (ModelSpec, ServingEngine,
+                                        broadcast_params, init_params,
+                                        reference_decode)
+from horovod_tpu.serving.scheduler import (Scheduler, ServeConfig,
+                                           ServingUnavailableError)
+
+ELASTIC = sys.argv[1] == "elastic"
+hvd.init()
+spec = ModelSpec(vocab=101, d_model=32, n_layers=2, n_heads=2)
+cfg = ServeConfig(max_batch=4, prefill_chunk=4, block_tokens=4,
+                  num_blocks=64, max_blocks_per_seq=16)
+params = broadcast_params(init_params(spec))
+rank0 = hvd.rank() == 0
+sch = Scheduler(cfg) if rank0 else None
+engine = ServingEngine(spec, cfg, params, sch)
+if not rank0:
+    try:
+        engine.run()
+    except hvd.RanksDownError:
+        if ELASTIC:
+            raise
+        print("TYPED worker", flush=True)
+        sys.exit(0)
+    hvd.shutdown()
+    sys.exit(0)
+
+short = sch.submit("acme", [5, 4, 3], 4)
+long = sch.submit("beta", list(range(1, 9)), 24 if ELASTIC else 48)
+if ELASTIC:
+    # Verify while the loop still idle-ticks: the slow reference decode
+    # (one compile per length) must not trip the launcher's clean-exit
+    # straggler deadline on the other ranks' account.
+    loop = threading.Thread(target=engine.run, daemon=True)
+    loop.start()
+    assert short.event.wait(180) and long.event.wait(180), "request hung"
+    assert short.error is None and long.error is None, (short.error,
+                                                        long.error)
+    assert short.generated == reference_decode(
+        engine.model, params, [5, 4, 3], 4)
+    assert long.generated == reference_decode(
+        engine.model, params, list(range(1, 9)), 24)
+    m = hvd.metrics_snapshot()["membership"]
+    assert m["epoch"] == 1 and m["ranks_lost"] == [2], m
+    assert hvd.metrics_snapshot()["serving"]["reformed"] >= 1
+    print("SERVED", hvd.size(), len(long.generated), flush=True)
+    engine.request_stop()
+    loop.join(60)
+    hvd.shutdown()
+else:
+    try:
+        engine.run()
+        sys.exit(1)  # the crash must surface
+    except hvd.RanksDownError:
+        pass
+    assert long.event.is_set(), "request hung after rank death"
+    assert isinstance(long.error, ServingUnavailableError), long.error
+    print("TYPED rank0", flush=True)
+"""
+
+
+def _serve_env(**overrides):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    env.setdefault("HVD_TPU_KILL_GRACE_SEC", "3")
+    env.update({k: str(v) for k, v in overrides.items()})
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA", "HVD_TPU_FAULT_SPEC", "HVD_TPU_ELASTIC",
+                "HVD_TPU_RESTART_EPOCH", "HVD_TPU_MIN_NP",
+                "HVD_TPU_REJOIN"):
+        if not env.get(var):
+            env.pop(var, None)
+    return env
+
+
+@pytest.mark.slow
+def test_rank_death_mid_decode_fails_typed(tmp_path):
+    """Without elastic membership, killing a rank mid-decode aborts the
+    collectives: the in-flight request fails TYPED
+    (ServingUnavailableError) — never hangs — and every survivor exits
+    through RanksDownError."""
+    from horovod_tpu.common.faults import CRASH_EXIT_CODE
+    from horovod_tpu.runner import run_command
+
+    script = tmp_path / "serve.py"
+    script.write_text(_SERVE_CRASH)
+    results = run_command(
+        [sys.executable, str(script), "plain"], 3,
+        env=_serve_env(HVD_TPU_FAULT_SPEC="rank=1:crash@op=30",
+                       HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20"),
+        timeout=120.0, capture=True)
+    by_rank = {r.rank: r for r in results}
+    assert by_rank[1].returncode == CRASH_EXIT_CODE, by_rank[1]
+    assert by_rank[0].returncode == 0, by_rank[0].stderr[-800:]
+    assert by_rank[2].returncode == 0, by_rank[2].stderr[-800:]
+    assert "TYPED rank0" in by_rank[0].stdout
+    assert "TYPED worker" in by_rank[2].stdout
+
+
+@pytest.mark.slow
+def test_reshape_mid_decode_resumes(tmp_path):
+    """The elastic path: a 4-rank serve job loses rank 2 mid-decode and
+    the survivors reshape (epoch 1) and KEEP SERVING — both in-flight
+    requests complete with the same greedy-deterministic tokens, nothing
+    hangs, and the scheduler records the ridden reshape."""
+    from horovod_tpu.common.faults import CRASH_EXIT_CODE
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "serve.py"
+    script.write_text(_SERVE_CRASH)
+    results = run_membership(
+        [sys.executable, str(script), "elastic"], 4, min_np=2, max_np=4,
+        max_rejoins=0,
+        env=_serve_env(HVD_TPU_FAULT_SPEC="rank=2:crash@op=35",
+                       HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20"),
+        timeout=180.0, capture=True, report=lambda msg: None)
+    by_slot = {r.rank: r for r in results}
+    assert by_slot[2].returncode == CRASH_EXIT_CODE, by_slot[2]
+    for slot in (0, 1, 3):
+        assert by_slot[slot].returncode == 0, \
+            (slot, by_slot[slot].returncode, by_slot[slot].stderr[-1200:])
+    assert membership_succeeded(results, 2)
+    served = [line for line in by_slot[0].stdout.splitlines()
+              if line.startswith("SERVED ")]
+    assert served and served[0].split() == ["SERVED", "3", "24"], served

@@ -17,9 +17,10 @@ Accepted file shapes (all produced in this repo):
 * a driver round record (``BENCH_r*.json``) — a JSON object whose
   ``parsed`` field holds the bench record.
 
-Every metric in this repo is a throughput (higher is better); lower-is-
-better metrics would need a sign convention this tool deliberately does
-not grow until one exists.
+Headline metrics are throughputs (higher is better).  Extras ending in a
+latency unit suffix (``_ms``/``_us``/``_sec`` — the serving bench's TTFT
+and per-token latencies) are gated in the opposite direction: growth past
+the threshold is the regression.
 """
 
 from __future__ import annotations
@@ -66,6 +67,20 @@ def _numeric(value) -> Optional[float]:
     return None
 
 
+# Sign convention for extras: every headline metric in this repo is a
+# throughput (higher is better), but latency extras are the opposite —
+# a time-unit token marks them (`ttft_p99_ms`, `negotiation_p50_us_cached`),
+# so growth past the threshold is the regression, not shrinkage.  A unit
+# preceded by "per" is a rate (`ops_per_sec`), which stays higher-is-better.
+LATENCY_UNITS = frozenset(("ms", "us", "sec", "seconds"))
+
+
+def lower_is_better(name: str) -> bool:
+    tokens = name.split("_")
+    return any(t in LATENCY_UNITS and (i == 0 or tokens[i - 1] != "per")
+               for i, t in enumerate(tokens))
+
+
 def compare(old: dict, new: dict, threshold_pct: float,
             extras: bool) -> Tuple[list, list]:
     """(regressions, report_lines) between two bench records.  Only pairs
@@ -80,8 +95,9 @@ def compare(old: dict, new: dict, threshold_pct: float,
             lines.append(f"  {name}: old={ov:g} (not comparable)")
             return
         delta_pct = (nv - ov) / ov * 100.0
+        worse_pct = -delta_pct if lower_is_better(name) else delta_pct
         flag = ""
-        if delta_pct < -threshold_pct:
+        if worse_pct < -threshold_pct:
             regressions.append((name, ov, nv, delta_pct))
             flag = "  << REGRESSION"
         lines.append(f"  {name}: {ov:g} -> {nv:g} "

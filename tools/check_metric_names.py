@@ -41,6 +41,15 @@ def populated_registry():
     reg.set_cache_size("engine", 1)
     reg.set_membership({"epoch": 1, "size": 3, "reshapes": 1,
                         "ranks_lost": [1], "ranks_joined": [3]})
+    reg.record_serving("requests", "lint-tenant")
+    reg.record_serving("admitted", "lint-tenant")
+    reg.record_serving("rejected", "lint-tenant")
+    reg.record_serving("retired", "lint-tenant")
+    reg.record_serving_tokens("lint-tenant", "prompt", 8)
+    reg.record_serving_tokens("lint-tenant", "generated", 4)
+    reg.record_serving_step(2, 4)
+    reg.set_serving_gauges(queue_depth=1, active=2, kv_blocks_in_use=3,
+                           kv_blocks_total=8)
     reg.set_autotune({
         "enabled": True, "frozen": True, "windows": 3,
         "fusion_threshold": 1 << 20, "cycle_time_ms": 2.5,

@@ -8,6 +8,7 @@ hvd.metrics_snapshot() returns.
     python tools/metrics_dump.py run.json.0            # one dump
     python tools/metrics_dump.py before.json.0 after.json.0   # diff (B - A)
     python tools/metrics_dump.py --stragglers run.json.0      # skew view
+    python tools/metrics_dump.py --tenants run.json.0  # serving tenants
 
 Prints the per-op table (ops and bytes per data plane), fusion-batch
 counters, stall events, response-cache hit rates (docs/performance.md),
@@ -193,6 +194,34 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
             + "; joined "
             + (", ".join(f"rank{r}" for r in joined) or "none"))
 
+    # Serving plane (docs/inference.md); only rendered when the rank
+    # served traffic, so training dumps stay unchanged.  Per-tenant
+    # detail lives behind --tenants.  Counters diff in two-file mode
+    # like every other section; gauges (queue, kv blocks, occupancy)
+    # stay absolute — the B dump's live state.
+    serving = dict(snap.get("serving", {}))
+    if base:
+        base_serving = base.get("serving", {})
+        for k in ("requests", "admitted", "rejected", "retired", "failed",
+                  "preempted", "reformed", "steps"):
+            serving[k] = serving.get(k, 0) - base_serving.get(k, 0)
+    if serving.get("requests") or serving.get("steps"):
+        lines.append("== serving ==")
+        lines.append(
+            f"requests {serving.get('requests', 0)} "
+            f"(admitted {serving.get('admitted', 0)}, "
+            f"rejected {serving.get('rejected', 0)}, "
+            f"retired {serving.get('retired', 0)}, "
+            f"failed {serving.get('failed', 0)}, "
+            f"preempted {serving.get('preempted', 0)})")
+        lines.append(
+            f"steps {serving.get('steps', 0)}, occupancy "
+            f"{100.0 * serving.get('occupancy', 0.0):.1f}%, queue "
+            f"{serving.get('queue_depth', 0)}, kv blocks "
+            f"{serving.get('kv_blocks_in_use', 0)}/"
+            f"{serving.get('kv_blocks_total', 0)}, reshapes ridden "
+            f"{serving.get('reformed', 0)}")
+
     # Online autotuning (docs/performance.md#autotuning); only rendered
     # when the job opted in, so pre-autotune dumps stay unchanged.
     tune = snap.get("autotune", {})
@@ -222,6 +251,33 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
         lines.append(f"{name:<18}{hist['count']:>8}{fmt(mean):>10}"
                      f"{fmt(quantile(hist, 0.5)):>10}"
                      f"{fmt(quantile(hist, 0.99)):>10}")
+    return "\n".join(lines)
+
+
+def render_tenants(snap: dict) -> str:
+    """The --tenants view: per-tenant request/token/reject breakdown from
+    the serving section (docs/inference.md; use rank 0's dump — the
+    scheduler lives there)."""
+    lines = ["== tenants (serving plane, rank-0 scheduler view) =="]
+    tenants = snap.get("serving", {}).get("tenants", {})
+    if not tenants:
+        lines.append("(no serving traffic recorded — not a serving rank, "
+                     "or not the scheduler's dump; use rank 0's file)")
+        return "\n".join(lines)
+    lines.append(f"{'tenant':<16}{'admitted':>9}{'rejected':>9}"
+                 f"{'retired':>8}{'failed':>7}{'prompt':>8}{'gen':>8}")
+    for name in sorted(tenants,
+                       key=lambda t: -tenants[t].get("admitted", 0)):
+        e = tenants[name]
+        lines.append(f"{name[:15]:<16}{e.get('admitted', 0):>9}"
+                     f"{e.get('rejected', 0):>9}{e.get('retired', 0):>8}"
+                     f"{e.get('failed', 0):>7}"
+                     f"{e.get('prompt_tokens', 0):>8}"
+                     f"{e.get('generated_tokens', 0):>8}")
+    total_rej = sum(e.get("rejected", 0) for e in tenants.values())
+    total_req = sum(e.get("requests", 0) for e in tenants.values())
+    lines.append(f"shed rate: {total_rej}/{total_req} requests rejected "
+                 f"({100.0 * total_rej / max(total_req, 1):.1f}%)")
     return "\n".join(lines)
 
 
@@ -258,17 +314,23 @@ def main(argv) -> int:
     stragglers = "--stragglers" in argv
     if stragglers:
         argv.remove("--stragglers")
+    tenants = "--tenants" in argv
+    if tenants:
+        argv.remove("--tenants")
     if len(argv) not in (2, 3) or argv[1] in ("-h", "--help"):
         print(__doc__)
         return 2
-    if stragglers and len(argv) != 2:
-        print("--stragglers takes a single dump (the coordinator's, "
-              "rank 0)", file=sys.stderr)
+    if (stragglers or tenants) and len(argv) != 2:
+        print("--stragglers/--tenants take a single dump (the "
+              "coordinator's, rank 0)", file=sys.stderr)
         return 2
     with open(argv[1]) as f:
         a = json.load(f)
     if stragglers:
         print(render_stragglers(a))
+        return 0
+    if tenants:
+        print(render_tenants(a))
         return 0
     if len(argv) == 3:
         with open(argv[2]) as f:
