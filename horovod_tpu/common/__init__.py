@@ -246,6 +246,16 @@ def _load_lib():
         lib.hvd_tpu_timeline_instant.argtypes = [ctypes.c_char_p,
                                                  ctypes.c_char_p]
         lib.hvd_tpu_timeline_flush.argtypes = []
+        lib.hvd_tpu_flight_count.restype = ctypes.c_longlong
+        lib.hvd_tpu_flight_count.argtypes = []
+        lib.hvd_tpu_flight_dump.restype = ctypes.c_char_p
+        lib.hvd_tpu_flight_dump.argtypes = []
+        lib.hvd_tpu_pending_info.restype = ctypes.c_char_p
+        lib.hvd_tpu_pending_info.argtypes = []
+        lib.hvd_tpu_coord_pending_info.restype = ctypes.c_char_p
+        lib.hvd_tpu_coord_pending_info.argtypes = []
+        lib.hvd_tpu_diagnosis.restype = ctypes.c_char_p
+        lib.hvd_tpu_diagnosis.argtypes = []
         _lib = lib
         return lib
 
@@ -332,10 +342,24 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
         metrics.registry.enable()
     _metrics_file = (f"{cfg.metrics_file}.{ps.rank}"
                      if cfg.metrics_file else None)
+    # Postmortem plane (docs/troubleshooting.md#reading-a-postmortem):
+    # with a dump dir set, fatal uncaught exceptions leave a rank dump
+    # too (typed aborts and injected crashes hook their own paths).
+    if cfg.postmortem_dir:
+        from horovod_tpu.common import postmortem as _postmortem
+
+        _postmortem.install_excepthook()
     if cfg.monitor_port is not None:
         port = cfg.monitor_port + ps.local_rank if cfg.monitor_port else 0
         try:
             metrics.start_monitor(port, snapshot_fn=metrics_snapshot)
+            # Job-level aggregation (docs/metrics.md#cluster): rank 0's
+            # monitor additionally serves /cluster, one merged health view
+            # scraped from every rank's /health.  Needs a fixed base port
+            # (port 0 binds randomly — peers become unscrapable).
+            if ps.rank == 0 and cfg.monitor_port:
+                metrics.configure_cluster(
+                    _cluster_targets(ps, cfg.monitor_port))
         except OSError as exc:
             import warnings
 
@@ -409,6 +433,25 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
     atexit.register(shutdown)
 
 
+def _cluster_targets(ps: ProcessSet, base_port: int) -> list:
+    """(rank, host, port) scrape targets for the /cluster aggregation:
+    every rank's monitor binds ``base_port + local_rank``, and hvdrun
+    places ranks in contiguous per-host blocks, so a rank's local index is
+    the count of same-host ranks before it.  Falls back to localhost when
+    the launcher provided no data endpoints (single-process init)."""
+    targets = []
+    seen: dict = {}
+    for r in range(ps.size):
+        if ps.data_endpoints and r < len(ps.data_endpoints):
+            host = ps.data_endpoints[r].rsplit(":", 1)[0]
+        else:
+            host = "127.0.0.1"
+        local_idx = seen.get(host, 0)
+        seen[host] = local_idx + 1
+        targets.append((r, host, base_port + local_idx))
+    return targets
+
+
 def _tpu_visible() -> bool:
     """True when jax is importable and reports at least one TPU device —
     the auto-enable predicate for the XLA data plane.  Conservative: any
@@ -421,21 +464,43 @@ def _tpu_visible() -> bool:
         return False
 
 
+def _flush_metrics_file(clear: bool = True) -> None:
+    """Write the per-rank ``HVD_TPU_METRICS_FILE`` dump now.  The clean
+    ``shutdown()`` path clears the pending path afterwards; the abort /
+    postmortem paths flush WITHOUT clearing (crashed ranks must leave
+    metrics too, and a later clean shutdown simply overwrites the dump
+    with fresher totals)."""
+    global _metrics_file
+    if _metrics_file is None:
+        return
+    path = _metrics_file
+    if clear:
+        _metrics_file = None
+    try:
+        with open(path, "w") as f:
+            json.dump(metrics_snapshot(), f, indent=2)
+            f.write("\n")
+    except OSError as exc:
+        import warnings
+
+        warnings.warn(f"could not write metrics file {path}: {exc}")
+
+
 def shutdown() -> None:
     """Shut the engine down.  Idempotent: safe to call twice, or without a
     prior ``init()`` (both are no-ops beyond flushing metrics plumbing)."""
-    global _process_set, _xla_plane, _metrics_file, _fault_injector
+    global _process_set, _xla_plane, _fault_injector
     _fault_injector = None
-    if _metrics_file is not None:
-        path, _metrics_file = _metrics_file, None
-        try:
-            with open(path, "w") as f:
-                json.dump(metrics_snapshot(), f, indent=2)
-                f.write("\n")
-        except OSError as exc:
-            import warnings
+    if _lib is not None and int(_lib.hvd_tpu_abort_code()) != 0:
+        # A typed abort the process never consumed through a Handle.wait
+        # (e.g. the driver was between collectives when the coordinator
+        # aborted, and atexit is the first code to look): leave the
+        # postmortem artifact before the engine state goes away.
+        from horovod_tpu.common import postmortem as _postmortem
 
-            warnings.warn(f"could not write metrics file {path}: {exc}")
+        _postmortem.write_postmortem(
+            _postmortem.reason_for_code(int(_lib.hvd_tpu_abort_code())))
+    _flush_metrics_file(clear=True)
     metrics.stop_monitor()
     if _lib is not None and _lib.hvd_tpu_initialized():
         _lib.hvd_tpu_shutdown()
@@ -660,6 +725,22 @@ def _sync_engine_membership() -> None:
         })
 
 
+def _sync_engine_flight() -> None:
+    """Mirror the flight recorders' cumulative event counts (engine C++
+    ring + XLA-plane Python ring) into the registry's ungated ``"flight"``
+    section.  A state copy, like the membership sync."""
+    from horovod_tpu.common import postmortem as _postmortem
+
+    with _stall_sync_lock:
+        engine_events = (int(_lib.hvd_tpu_flight_count())
+                         if _lib is not None else 0)
+        metrics.registry.set_flight({
+            "events": {"engine": engine_events,
+                       "xla": _postmortem.plane_ring.total},
+            "capacity": _postmortem.ring_capacity(),
+        })
+
+
 def _sync_engine_autotune() -> None:
     """Mirror the engine's autotuning state into the registry's ungated
     ``"autotune"`` section (docs/performance.md#autotuning).  Unlike the
@@ -690,6 +771,7 @@ def metrics_snapshot() -> dict:
     _sync_engine_cache()
     _sync_engine_autotune()
     _sync_engine_membership()
+    _sync_engine_flight()
     return metrics.registry.snapshot()
 
 
@@ -907,6 +989,14 @@ def _status_error(code: int, msg: str, name: str) -> Exception:
     prefix = f"collective '{name}' failed: "
     if code == ST_PRECONDITION:
         return ValueError(prefix + msg)
+    if code in (ST_RANKS_DOWN, ST_TIMEOUT):
+        # Typed abort: leave the postmortem artifact NOW, while the
+        # engine's flight ring and pending tables still describe the
+        # moment of death (both planes route their abort statuses through
+        # here).  Write-once and best-effort inside.
+        from horovod_tpu.common import postmortem as _postmortem
+
+        _postmortem.write_postmortem(_postmortem.reason_for_code(code))
     if code == ST_RANKS_DOWN:
         return RanksDownError(prefix + msg, ranks=_parse_down_ranks(msg))
     if code == ST_TIMEOUT:

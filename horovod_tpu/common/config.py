@@ -98,6 +98,15 @@ class Config:
     elastic: bool = False
     min_np: int = 1
     rejoin: bool = False
+    # Postmortem plane (docs/troubleshooting.md#reading-a-postmortem).
+    # HVD_TPU_POSTMORTEM_DIR: directory each rank writes its
+    # rank-<N>.json crash/hang dump into on typed aborts, injected
+    # crashes, and fatal uncaught exceptions (hvdrun --postmortem-dir
+    # sets it job-wide); empty disables.  HVD_TPU_FLIGHT_EVENTS sizes the
+    # always-on flight-recorder rings (engine C++ ring and the XLA
+    # plane's Python ring alike); 0 disables recording.
+    postmortem_dir: str = ""
+    flight_events: int = 512
 
     @property
     def effective_cache_capacity(self) -> int:
@@ -154,4 +163,7 @@ class Config:
             elastic=_flag(os.environ.get("HVD_TPU_ELASTIC")),
             min_np=int(os.environ.get("HVD_TPU_MIN_NP") or 1),
             rejoin=_flag(os.environ.get("HVD_TPU_REJOIN")),
+            postmortem_dir=os.environ.get("HVD_TPU_POSTMORTEM_DIR", ""),
+            flight_events=int(os.environ.get(
+                "HVD_TPU_FLIGHT_EVENTS") or 512),
         )

@@ -143,6 +143,24 @@ class FaultInjector:
                     _common._lib.hvd_tpu_timeline_flush()
             except Exception:
                 pass
+            # And the postmortem dump (docs/troubleshooting.md#reading-a-
+            # postmortem): the crashing rank leaves its own flight ring
+            # and pending table, not just the survivors' view of it.
+            try:
+                from horovod_tpu.common import postmortem as _postmortem
+
+                _postmortem.write_postmortem("fault_crash")
+            except Exception:
+                pass
+            # os._exit skips atexit, so the HVD_TPU_METRICS_FILE dump
+            # must flush here too (write_postmortem only covers it when a
+            # postmortem dir is set) — crashed ranks leave metrics.
+            try:
+                from horovod_tpu import common as _common
+
+                _common._flush_metrics_file(clear=False)
+            except Exception:
+                pass
             # Hard death: no shutdown handshake, sockets drop — the
             # coordinator sees EOF, exactly like a SIGKILL'd rank.
             os._exit(CRASH_EXIT_CODE)

@@ -179,6 +179,12 @@ class MetricsRegistry:
             "kv_blocks_in_use": 0, "kv_blocks_total": 0,
             "tenants": {},
         }
+        # Flight recorder (docs/troubleshooting.md#reading-a-postmortem):
+        # cumulative event counts per plane plus the configured ring
+        # capacity, mirrored from the recorders on every snapshot.
+        # Ungated, like stalls: postmortem tests assert on it without
+        # enabling full metrics.
+        self._flight = {"events": {p: 0 for p in PLANES}, "capacity": 0}
         self._hists = {name: Histogram(bounds)
                        for name, (bounds, _) in HISTOGRAMS.items()}
 
@@ -257,6 +263,13 @@ class MetricsRegistry:
         idempotent overwrite, like the autotune mirror).  Ungated."""
         with self._lock:
             self._membership = dict(state)
+
+    def set_flight(self, state: dict) -> None:
+        """Mirror the flight recorders' state (a state copy — idempotent
+        overwrite, like the membership mirror).  Ungated."""
+        with self._lock:
+            self._flight = {"events": dict(state.get("events", {})),
+                            "capacity": int(state.get("capacity", 0))}
 
     def set_autotune(self, report: dict) -> None:
         """Mirror the engine's autotuning report (a state copy — the
@@ -377,6 +390,10 @@ class MetricsRegistry:
                         and self._serving["batch_slots"] else 0.0),
                     "tenants": {t: dict(v) for t, v in
                                 self._serving["tenants"].items()},
+                },
+                "flight": {
+                    "events": dict(self._flight["events"]),
+                    "capacity": self._flight["capacity"],
                 },
                 "histograms": {name: h.to_dict()
                                for name, h in self._hists.items()},
@@ -586,6 +603,20 @@ def prometheus_text(snapshot: dict) -> str:
                        f'"{label}",kind="{kind}"}} '
                        f'{entry.get(f"{kind}_tokens", 0)}')
 
+    flight = snapshot.get("flight", {})
+    out.append("# HELP hvd_tpu_flight_events_total "
+               "flight-recorder events recorded "
+               "(docs/troubleshooting.md#reading-a-postmortem)")
+    out.append("# TYPE hvd_tpu_flight_events_total counter")
+    for plane in PLANES:
+        out.append(f'hvd_tpu_flight_events_total{{plane="{plane}"}} '
+                   f'{flight.get("events", {}).get(plane, 0)}')
+    out.append("# HELP hvd_tpu_flight_ring_capacity "
+               "configured flight-recorder ring size "
+               "(HVD_TPU_FLIGHT_EVENTS; 0 = disabled)")
+    out.append("# TYPE hvd_tpu_flight_ring_capacity gauge")
+    out.append(f"hvd_tpu_flight_ring_capacity {flight.get('capacity', 0)}")
+
     skew = snapshot.get("skew", {})
     out.append("# HELP hvd_tpu_announce_total "
                "negotiations reaching full count (coordinator view)")
@@ -615,11 +646,133 @@ def prometheus_text(snapshot: dict) -> str:
 
 
 # ---------------------------------------------------------------------------
-# HTTP monitor (opt-in: HVD_TPU_MONITOR_PORT, or start_monitor() directly).
+# Job-level aggregation (docs/metrics.md#cluster): rank 0's monitor serves
+# /cluster — one merged health view of every live rank — so a single scrape
+# target covers the fleet.  Each rank's monitor serves the compact /health
+# summary the aggregation is built from.
 # ---------------------------------------------------------------------------
 
 _monitor_lock = threading.Lock()
 _monitor = None  # (server, bound_port)
+# /cluster scrape targets [(rank, host, port)], set on rank 0 by
+# configure_cluster at init.  Torn down by stop_monitor (and thus re-init
+# and hvdrun relaunches) so elastic reshapes / --max-restarts cannot serve
+# stale per-rank entries (the PR-6 cache-clear discipline).
+_cluster_cfg = None
+
+
+def configure_cluster(targets) -> None:
+    """Arm rank 0's /cluster aggregation with the per-rank monitor
+    endpoints ([(rank, host, port)]; rank 0's own entry included)."""
+    global _cluster_cfg
+    with _monitor_lock:
+        _cluster_cfg = list(targets)
+
+
+def cluster_configured() -> bool:
+    with _monitor_lock:
+        return _cluster_cfg is not None
+
+
+def health_summary(snap: dict) -> dict:
+    """The compact per-rank health record /cluster merges: liveness,
+    membership epoch, cache hit rate, stall/abort counts, serving
+    occupancy, flight-recorder activity."""
+    member = snap.get("membership", {})
+    # Both planes' negotiation caches count (an XLA-plane job records its
+    # hits under "xla"; engine-only would read 0.0 there).
+    hits = sum(c.get("hits", 0) for c in snap.get("cache", {}).values())
+    misses = sum(c.get("misses", 0)
+                 for c in snap.get("cache", {}).values())
+    serving = snap.get("serving", {})
+    return {
+        "live": True,
+        "membership_epoch": member.get("epoch", 0),
+        "size": member.get("size", 0),
+        "restart_epoch": snap.get("faults", {}).get("restart_epoch", 0),
+        "stalls": snap.get("stalls", {}).get("count", 0),
+        "aborts": sum(snap.get("faults", {}).get("aborts", {}).values()),
+        "cache_hit_rate": (hits / (hits + misses)
+                           if hits + misses else 0.0),
+        "serving_occupancy": serving.get("occupancy", 0.0),
+        "serving_active": serving.get("active", 0),
+        "flight_events": sum(
+            snap.get("flight", {}).get("events", {}).values()),
+    }
+
+
+def _scrape_health(host: str, port: int, timeout: float = 1.0) -> dict:
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/health", timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except Exception as exc:
+        return {"live": False, "error": f"{type(exc).__name__}: {exc}"}
+
+
+def cluster_document(snapshot_fn: Callable[[], dict]) -> dict:
+    """Scrape every rank's /health (rank 0's own summary is computed
+    locally — no loopback HTTP round trip) and merge one job view."""
+    with _monitor_lock:
+        targets = list(_cluster_cfg or [])
+    ranks: Dict[str, dict] = {}
+    threads = []
+
+    def scrape(rank, host, port):
+        ranks[str(rank)] = _scrape_health(host, port)
+
+    own_rank = targets[0][0] if targets else 0
+    for rank, host, port in targets:
+        if rank == own_rank:
+            ranks[str(rank)] = health_summary(snapshot_fn())
+            continue
+        # Pre-claim the entry as dead: a scrape thread that outlives the
+        # join below (e.g. DNS resolution blocking past urllib's timeout)
+        # must leave the rank visible as live:false, not silently missing
+        # — liveness is the point, a dead rank must not hide.
+        ranks[str(rank)] = {"live": False,
+                            "error": "scrape did not respond in time"}
+        t = threading.Thread(target=scrape, args=(rank, host, port),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=2.0)
+    live = [r for r in ranks.values() if r.get("live")]
+    epochs = {r.get("membership_epoch") for r in live}
+    return {
+        "ranks": ranks,
+        "launched": len(targets),
+        "live": len(live),
+        "membership_epochs_agree": len(epochs) <= 1,
+    }
+
+
+def cluster_prometheus_text(doc: dict) -> str:
+    """Prometheus form of the merged /cluster document, so one scrape
+    target covers the fleet's liveness and epoch agreement."""
+    out: List[str] = []
+    out.append("# HELP hvd_tpu_cluster_rank_up rank responded to the "
+               "cluster health scrape")
+    out.append("# TYPE hvd_tpu_cluster_rank_up gauge")
+    for rank, entry in sorted(doc["ranks"].items(), key=lambda kv: kv[0]):
+        out.append(f'hvd_tpu_cluster_rank_up{{rank="{rank}"}} '
+                   f'{1 if entry.get("live") else 0}')
+    out.append("# HELP hvd_tpu_cluster_rank_membership_epoch per-rank "
+               "elastic membership epoch")
+    out.append("# TYPE hvd_tpu_cluster_rank_membership_epoch gauge")
+    for rank, entry in sorted(doc["ranks"].items(), key=lambda kv: kv[0]):
+        if entry.get("live"):
+            out.append(
+                f'hvd_tpu_cluster_rank_membership_epoch{{rank="{rank}"}} '
+                f'{entry.get("membership_epoch", 0)}')
+    out.append("# HELP hvd_tpu_cluster_ranks_live ranks responding to the "
+               "cluster health scrape")
+    out.append("# TYPE hvd_tpu_cluster_ranks_live gauge")
+    out.append(f"hvd_tpu_cluster_ranks_live {doc['live']}")
+    return "\n".join(out) + "\n"
 
 
 def start_monitor(port: int,
@@ -640,12 +793,26 @@ def start_monitor(port: int,
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                if self.path.split("?")[0] == "/metrics":
+                path = self.path.split("?")[0]
+                if path == "/metrics":
                     body = prometheus_text(fn()).encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif self.path.split("?")[0] == "/metrics.json":
+                elif path == "/metrics.json":
                     body = json.dumps(fn()).encode()
                     ctype = "application/json"
+                elif path == "/health":
+                    # Compact per-rank summary, the /cluster scrape unit.
+                    body = json.dumps(health_summary(fn())).encode()
+                    ctype = "application/json"
+                elif path in ("/cluster", "/cluster.prom") \
+                        and cluster_configured():
+                    doc = cluster_document(fn)
+                    if path == "/cluster":
+                        body = json.dumps(doc).encode()
+                        ctype = "application/json"
+                    else:
+                        body = cluster_prometheus_text(doc).encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
                 else:
                     self.send_error(404)
                     return
@@ -669,8 +836,13 @@ def start_monitor(port: int,
 
 
 def stop_monitor() -> None:
-    global _monitor
+    global _monitor, _cluster_cfg
     with _monitor_lock:
+        # The /cluster aggregation dies with the monitor: a re-init (or
+        # an hvdrun --max-restarts relaunch) reconfigures fresh targets,
+        # so stale per-rank entries from a previous membership cannot be
+        # served (the PR-6 cache-clear discipline).
+        _cluster_cfg = None
         if _monitor is None:
             return
         server, _ = _monitor

@@ -208,6 +208,44 @@ long long hvd_tpu_cache_eviction_count() {
 
 long long hvd_tpu_cache_size() { return GlobalEngine()->CacheSize(); }
 
+// Postmortem plane (docs/troubleshooting.md#reading-a-postmortem).
+// Flight recorder: process-cumulative event count for the metrics
+// registry, and a non-destructive ring snapshot
+// ("seq|ts_us|event|name|arg;...", oldest first) for the dump writer.
+long long hvd_tpu_flight_count() {
+  return GlobalEngine()->flight().Events();
+}
+
+const char* hvd_tpu_flight_dump() {
+  static thread_local std::string tl_flight_dump;
+  tl_flight_dump = GlobalEngine()->flight().Dump();
+  return tl_flight_dump.c_str();
+}
+
+// Pending-tensor tables: this rank's in-flight collectives
+// ("name|op|age_us;...") and — on rank 0 — the coordinator's waiting-on
+// snapshot ("name|age_us|missing_rank missing_rank;...").
+const char* hvd_tpu_pending_info() {
+  static thread_local std::string tl_pending_info;
+  tl_pending_info = GlobalEngine()->PendingInfo();
+  return tl_pending_info.c_str();
+}
+
+const char* hvd_tpu_coord_pending_info() {
+  static thread_local std::string tl_coord_pending;
+  tl_coord_pending = GlobalEngine()->CoordPendingInfo();
+  return tl_coord_pending.c_str();
+}
+
+// The cross-rank diagnosis paragraph the coordinator folded into the
+// broadcast abort message (empty before an abort, or when the abort
+// carried none).
+const char* hvd_tpu_diagnosis() {
+  static thread_local std::string tl_diagnosis;
+  tl_diagnosis = GlobalEngine()->Diagnosis();
+  return tl_diagnosis.c_str();
+}
+
 // Cross-rank clock alignment (docs/timeline.md): this rank's estimated
 // clock offset against rank 0 (µs) and the RTT error bound of the winning
 // NTP-style probe.  0 on rank 0 / single-process jobs.

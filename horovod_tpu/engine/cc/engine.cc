@@ -4,6 +4,7 @@
 
 #include <algorithm>
 #include <cstdio>
+#include <cstdlib>
 
 #include "net.h"
 
@@ -387,6 +388,13 @@ struct Engine::Coordinator {
   // first death arms the coordinated abort below; later deaths are noted
   // but the first abort wins.
   std::vector<bool> rank_dead;
+  // Postmortem accounting (per rank, engine thread only): the tick each
+  // rank's last control frame arrived at, and the tick/name of its last
+  // collective announce — the raw material of the cross-rank diagnosis
+  // ("rank 2 stopped announcing after tick 1841").  -1 = never.
+  std::vector<int64_t> last_frame_tick;
+  std::vector<int64_t> last_announce_tick;
+  std::vector<std::string> last_announce_name;
   // Armed abort, broadcast in the next response list: ST_RANKS_DOWN or
   // ST_TIMEOUT plus a structured message naming missing ranks / stalled
   // tensors.  0 = not aborting.
@@ -462,6 +470,7 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
     std::lock_guard<std::mutex> lk(abort_mu_);
     abort_code_.store(0);
     abort_message_.clear();
+    abort_pending_info_.clear();
   }
   epoch_ = std::chrono::steady_clock::now();
   clock_offset_us_.store(0);
@@ -475,6 +484,24 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   }
   coord_.reset(new Coordinator());
   coord_->rank_dead.assign(opts_.size, false);
+  coord_->last_frame_tick.assign(opts_.size, -1);
+  coord_->last_announce_tick.assign(opts_.size, -1);
+  coord_->last_announce_name.assign(opts_.size, "");
+  {
+    std::lock_guard<std::mutex> lk(coord_info_mu_);
+    coord_pending_info_.clear();
+  }
+  // Flight recorder (postmortem plane): always-on unless sized to 0.
+  // Env-read here rather than plumbed through the init signature — the
+  // ring is pure observability and every rank reads the same launcher
+  // environment.
+  {
+    const char* cap_env = getenv("HVD_TPU_FLIGHT_EVENTS");
+    int64_t cap = 512;
+    if (cap_env && *cap_env) cap = atoll(cap_env);
+    if (cap < 0) cap = 0;
+    flight_.Initialize(cap, epoch_);
+  }
   fast_ticks_ = 0;
   last_fusion_use_ = epoch_;
   // Every rank writes its own trace; the Python side resolves
@@ -1030,6 +1057,7 @@ int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
       return handle;
     }
     table_.emplace(name, std::move(e));
+    if (flight_.Enabled()) flight_.Record(FL_ENQUEUE, name, handle);
     Request req;
     req.rank = opts_.rank;
     req.op = op;
@@ -1074,8 +1102,10 @@ bool Engine::RunLoopOnce() {
       if (slot >= 0) {
         my_requests.cache_bits.push_back(static_cast<uint32_t>(slot));
         cache_hits_.fetch_add(1);
+        if (flight_.Enabled()) flight_.Record(FL_CACHE_HIT, req.name, slot);
       } else {
         if (cache_.enabled()) cache_misses_.fetch_add(1);
+        if (flight_.Enabled()) flight_.Record(FL_ANNOUNCE, req.name, 0);
         my_requests.requests.push_back(std::move(req));
       }
     }
@@ -1119,6 +1149,7 @@ bool Engine::RunLoopOnce() {
       }
       RequestList rl;
       if (ParseRequestList(buf, &rl)) {
+        coord_->last_frame_tick[r] = ticks_done_.load();
         coord_->shutdown_requested |= rl.shutdown;
         CoordinatorHandle(rl, r);
       }
@@ -1127,6 +1158,7 @@ bool Engine::RunLoopOnce() {
     responses = CoordinatorTick();
     AttachTunedParams(&responses);
     CoordinatorMaybeReshape(&responses);
+    UpdateCoordPendingInfo();
     if (opts_.size > 1 || responses.reshape_present) {
       std::vector<uint8_t> out = SerializeResponseList(responses);
       for (int r = 1; r < opts_.size; ++r) {
@@ -1219,6 +1251,11 @@ bool Engine::RunLoopOnce() {
   bool flowed = !my_requests.requests.empty() ||
                 !my_requests.cache_bits.empty() ||
                 !responses.responses.empty() || !responses.cache_hits.empty();
+  // Flight: stamp ticks that moved work (an idle fleet must not roll the
+  // ring with thousands of empty ticks — the interesting final seconds
+  // would be overwritten by silence).
+  if (flowed && flight_.Enabled())
+    flight_.Record(FL_TICK, "", ticks_done_.load());
   bool outstanding;
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -1356,6 +1393,11 @@ void Engine::CoordinatorHandleBits(const std::vector<uint32_t>& bits,
       pb.ranks[from_rank] = true;
       ++pb.count;
       timeline_.NegotiateRankReady(s->name, from_rank);
+      if (from_rank <
+          static_cast<int>(coord_->last_announce_tick.size())) {
+        coord_->last_announce_tick[from_rank] = ticks_done_.load();
+        coord_->last_announce_name[from_rank] = s->name;
+      }
     }
     if (pb.count == opts_.size) {
       // Agreement by pure bit intersection: no strings were parsed, no
@@ -1383,6 +1425,11 @@ void Engine::CoordinatorHandleBits(const std::vector<uint32_t>& bits,
 }
 
 void Engine::HandleOneRequest(const Request& req, int from_rank) {
+  if (from_rank >= 0 &&
+      from_rank < static_cast<int>(coord_->last_announce_tick.size())) {
+    coord_->last_announce_tick[from_rank] = ticks_done_.load();
+    coord_->last_announce_name[from_rank] = req.name;
+  }
   {
     auto& pt = coord_->message_table[req.name];
     if (pt.requests.empty()) {
@@ -1653,6 +1700,11 @@ void Engine::CheckForStalledTensors() {
       stall_log_.emplace_back(name, stalled_sec);
       while (stall_log_.size() > 64) stall_log_.pop_front();
     }
+    if (flight_.Enabled())
+      flight_.Record(
+          FL_STALL, name,
+          static_cast<int64_t>(
+              std::chrono::duration<double>(now - first_seen).count()));
     if (!preamble) {
       fprintf(stderr,
               "[horovod_tpu] WARNING: One or more tensors were submitted to "
@@ -1752,9 +1804,12 @@ void Engine::MarkRankDead(int r, const std::string& reason) {
   }
   if (coord_->abort_code != 0) return;  // first abort wins
   std::string down;
+  std::vector<int> dead_ranks;
   for (int i = 0; i < opts_.size; ++i)
-    if (coord_->rank_dead[i])
+    if (coord_->rank_dead[i]) {
       down += (down.empty() ? "" : ", ") + std::to_string(i);
+      dead_ranks.push_back(i);
+    }
   std::string pending;
   int listed = 0;
   for (const auto& kv : coord_->message_table) {
@@ -1788,7 +1843,8 @@ void Engine::MarkRankDead(int r, const std::string& reason) {
                  "), so the job cannot shrink further."
            : std::string()) +
       " The job was aborted; restart it (e.g. hvdrun --max-restarts) to "
-      "resume from the latest checkpoint.";
+      "resume from the latest checkpoint." +
+      " cross-rank diagnosis: " + BuildDiagnosis(dead_ranks);
 }
 
 void Engine::CheckCollectiveTimeout() {
@@ -1805,6 +1861,12 @@ void Engine::CheckCollectiveTimeout() {
   std::string stalled;
   double worst = 0.0;
   int n_stalled = 0;
+  std::vector<bool> missing_any(opts_.size, false);
+  auto note_missing = [&](const std::vector<bool>& present) {
+    for (int r = 0; r < opts_.size && r < static_cast<int>(present.size());
+         ++r)
+      if (!present[r]) missing_any[r] = true;
+  };
   for (const auto& kv : coord_->message_table) {
     if (kv.second.requests.empty() || !kv.second.forced_error.empty())
       continue;
@@ -1813,6 +1875,9 @@ void Engine::CheckCollectiveTimeout() {
     if (age < opts_.collective_timeout_sec) continue;
     worst = std::max(worst, age);
     ++n_stalled;
+    std::vector<bool> present(opts_.size, false);
+    for (const auto& r : kv.second.requests) present[r.rank] = true;
+    note_missing(present);
     if (n_stalled <= 8)
       stalled += (stalled.empty() ? "" : "; ") +
                  DescribePending(kv.first, kv.second.requests, opts_.size);
@@ -1823,6 +1888,7 @@ void Engine::CheckCollectiveTimeout() {
     if (age < opts_.collective_timeout_sec) continue;
     worst = std::max(worst, age);
     ++n_stalled;
+    note_missing(kv.second.ranks);
     if (n_stalled <= 8) {
       const CacheSlot* s = cache_.Get(static_cast<int>(kv.first));
       stalled += (stalled.empty() ? "" : "; ") + std::string("'") +
@@ -1831,6 +1897,9 @@ void Engine::CheckCollectiveTimeout() {
     }
   }
   if (n_stalled == 0) return;
+  std::vector<int> missing_ranks;
+  for (int r = 0; r < opts_.size; ++r)
+    if (missing_any[r]) missing_ranks.push_back(r);
   if (n_stalled > 8)
     stalled += "; ... (" + std::to_string(n_stalled - 8) + " more)";
   char worst_buf[32];
@@ -1842,17 +1911,24 @@ void Engine::CheckCollectiveTimeout() {
       std::to_string(static_cast<long long>(opts_.collective_timeout_sec)) +
       "): " + stalled +
       ". One or more ranks never submitted the matching collective; the "
-      "job was aborted instead of hanging.";
+      "job was aborted instead of hanging." +
+      " cross-rank diagnosis: " + BuildDiagnosis(missing_ranks);
 }
 
 void Engine::AbortLocal(int32_t code, const std::string& message) {
+  // Freeze the in-flight table BEFORE the latch: the BackgroundLoop
+  // drain clears table_ moments later, and the postmortem dump must
+  // still know what was pending at the moment of death.
+  std::string pending = LivePendingInfo();
   {
     std::lock_guard<std::mutex> lk(abort_mu_);
     if (abort_code_.load() != 0) return;  // first abort wins
     abort_message_ = message;
+    abort_pending_info_ = std::move(pending);
     abort_code_.store(code);
   }
   abort_events_.fetch_add(1);
+  if (flight_.Enabled()) flight_.Record(FL_ABORT, "", code);
   // A broken job must fail every subsequent collective uniformly.
   data_plane_failed_.store(true);
   // Invalidate the response cache: the peers' caches die with the job,
@@ -1871,6 +1947,136 @@ void Engine::AbortLocal(int32_t code, const std::string& message) {
 std::string Engine::AbortMessage() {
   std::lock_guard<std::mutex> lk(abort_mu_);
   return abort_message_;
+}
+
+// ---------------------------------------------------------------------------
+// Postmortem plane (flight recorder drains, pending tables, diagnosis).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// The marker Python and Diagnosis() split the broadcast abort message on.
+const char kDiagnosisMarker[] = "cross-rank diagnosis: ";
+
+std::string SanitizeInfo(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) out += (c == ';' || c == '|') ? '_' : c;
+  return out;
+}
+
+}  // namespace
+
+std::string Engine::BuildDiagnosis(const std::vector<int>& missing) {
+  // Engine thread only (coordinator tables).  One paragraph: where the
+  // job is, and — for each rank a stalled collective waits on — the last
+  // thing the coordinator ever heard from it.  This is the "rank 2
+  // stopped announcing after tick 1841" story the postmortem renders.
+  if (!coord_) return "no coordinator state on this rank.";
+  int64_t cur = ticks_done_.load();
+  std::string out = "the coordinator is at tick " + std::to_string(cur);
+  if (missing.empty()) return out + "; no rank is missing.";
+  for (int r : missing) {
+    out += "; rank " + std::to_string(r);
+    bool announced =
+        r >= 0 && r < static_cast<int>(coord_->last_announce_tick.size()) &&
+        coord_->last_announce_tick[r] >= 0;
+    if (announced) {
+      out += " last announced '" + coord_->last_announce_name[r] +
+             "' at tick " + std::to_string(coord_->last_announce_tick[r]) +
+             " and stopped announcing after that";
+    } else {
+      out += " never announced any collective";
+    }
+    if (r > 0 && r < static_cast<int>(coord_->last_frame_tick.size())) {
+      out += coord_->last_frame_tick[r] >= 0
+                 ? " (last control-plane frame at tick " +
+                       std::to_string(coord_->last_frame_tick[r]) + ")"
+                 : " (no control-plane frame ever received)";
+    }
+  }
+  return out + ".";
+}
+
+std::string Engine::Diagnosis() {
+  std::lock_guard<std::mutex> lk(abort_mu_);
+  size_t pos = abort_message_.find(kDiagnosisMarker);
+  if (pos == std::string::npos) return "";
+  return abort_message_.substr(pos + sizeof(kDiagnosisMarker) - 1);
+}
+
+std::string Engine::LivePendingInfo() {
+  auto now = std::chrono::steady_clock::now();
+  std::string out;
+  std::lock_guard<std::mutex> lk(mu_);
+  int listed = 0;
+  for (const auto& kv : table_) {
+    if (listed++ == 64) break;
+    int64_t age_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                         now - kv.second.enqueued_at)
+                         .count();
+    out += (out.empty() ? "" : ";") + SanitizeInfo(kv.first) + "|" +
+           OpName(kv.second.op) + "|" + std::to_string(age_us);
+  }
+  return out;
+}
+
+std::string Engine::PendingInfo() {
+  std::string live = LivePendingInfo();
+  if (!live.empty()) return live;
+  // Post-abort the drain has emptied the table; serve the snapshot the
+  // abort froze instead.
+  std::lock_guard<std::mutex> lk(abort_mu_);
+  return abort_code_.load() != 0 ? abort_pending_info_ : live;
+}
+
+void Engine::UpdateCoordPendingInfo() {
+  // Engine thread, rank 0 (and size-1), once per tick.  Negotiations
+  // normally resolve within a tick or two, so the tables are almost
+  // always empty and this is a lock + an empty-compare.
+  if (!coord_) return;
+  std::string info;
+  auto now = std::chrono::steady_clock::now();
+  int listed = 0;
+  for (const auto& kv : coord_->message_table) {
+    if (listed++ == 64) break;
+    if (kv.second.requests.empty()) continue;
+    std::vector<bool> present(opts_.size, false);
+    for (const auto& r : kv.second.requests)
+      if (r.rank >= 0 && r.rank < opts_.size) present[r.rank] = true;
+    int64_t age_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                         now - kv.second.first_seen)
+                         .count();
+    std::string missing;
+    for (int r = 0; r < opts_.size; ++r)
+      if (!present[r])
+        missing += (missing.empty() ? "" : " ") + std::to_string(r);
+    info += (info.empty() ? "" : ";") + SanitizeInfo(kv.first) + "|" +
+            std::to_string(age_us) + "|" + missing;
+  }
+  for (const auto& kv : coord_->cache_pending) {
+    if (listed++ == 64) break;
+    const CacheSlot* s = cache_.Get(static_cast<int>(kv.first));
+    int64_t age_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                         now - kv.second.first_seen)
+                         .count();
+    std::string missing;
+    for (int r = 0;
+         r < opts_.size && r < static_cast<int>(kv.second.ranks.size()); ++r)
+      if (!kv.second.ranks[r])
+        missing += (missing.empty() ? "" : " ") + std::to_string(r);
+    info += (info.empty() ? "" : ";") +
+            SanitizeInfo(s ? s->name
+                           : "<cache slot " + std::to_string(kv.first) + ">") +
+            "|" + std::to_string(age_us) + "|" + missing;
+  }
+  std::lock_guard<std::mutex> lk(coord_info_mu_);
+  if (coord_pending_info_ != info) coord_pending_info_ = std::move(info);
+}
+
+std::string Engine::CoordPendingInfo() {
+  std::lock_guard<std::mutex> lk(coord_info_mu_);
+  return coord_pending_info_;
 }
 
 // ---------------------------------------------------------------------------
@@ -1928,6 +2134,8 @@ void Engine::ApplyTunedParams(const ResponseList& rl) {
   }
   timeline_.Instant("autotune",
                     rl.tuned_frozen ? "AUTOTUNE_FREEZE" : "AUTOTUNE_APPLY");
+  if (flight_.Enabled())
+    flight_.Record(FL_TUNE, "", rl.tuned_fusion_threshold);
 }
 
 int64_t Engine::AutotuneWindows() {
@@ -2304,6 +2512,11 @@ bool Engine::ApplyReshape(const ResponseList& rl) {
     coord_->pending_join_fds.clear();
     coord_->pending_join_endpoints.clear();
     coord_->rank_dead.assign(new_size, false);
+    // Post-reshape postmortem accounting restarts: old entries carry the
+    // previous membership's rank numbering.
+    coord_->last_frame_tick.assign(new_size, -1);
+    coord_->last_announce_tick.assign(new_size, -1);
+    coord_->last_announce_name.assign(new_size, "");
     coord_->reshape_pending = false;
     coord_->message_table.clear();
     coord_->ready.clear();
@@ -2328,6 +2541,8 @@ bool Engine::ApplyReshape(const ResponseList& rl) {
     return false;
   }
   data_plane_failed_.store(false);
+  if (flight_.Enabled())
+    flight_.Record(FL_RESHAPE, "", rl.membership_epoch);
   timeline_.Instant("membership", "MEMBERSHIP_RESHAPE");
   std::string how = rl.reshape_lost.empty()
                         ? std::string(" (grow)")
@@ -2548,6 +2763,9 @@ void Engine::PerformOperation(const Response& resp, bool from_cache) {
                            arrived - e.enqueued_at)
                            .count();
 
+  if (flight_.Enabled() && !resp.names.empty())
+    flight_.Record(resp.type == RESP_ERROR ? FL_ERROR : FL_EXECUTE,
+                   resp.names[0], static_cast<int64_t>(resp.names.size()));
   if (resp.type == RESP_ERROR) {
     for (auto& e : entries) CompleteEntry(e, ST_PRECONDITION, resp.error_message);
     return;

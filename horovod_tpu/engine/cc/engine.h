@@ -31,6 +31,7 @@
 #include <vector>
 
 #include "autotune.h"
+#include "flight.h"
 #include "timeline.h"
 #include "wire.h"
 
@@ -335,6 +336,30 @@ class Engine {
   void MembershipAck() { reshape_ack_pending_.store(false); }
   bool ReshapeAckPending() const { return reshape_ack_pending_.load(); }
 
+  // Flight recorder (postmortem plane, flight.h): the always-on bounded
+  // ring of recent control-plane events this rank recorded.  Exposed so
+  // c_api can serve the ring snapshot and cumulative event count to the
+  // Python postmortem writer and the metrics registry.
+  FlightRecorder& flight() { return flight_; }
+
+  // Pending-tensor observability (postmortem dumps).  PendingInfo: THIS
+  // rank's in-flight collectives as "name|op|age_us;..." (what was
+  // enqueued but not completed when the dump was taken).  CoordPendingInfo
+  // (rank 0): the coordinator's waiting-on view as
+  // "name|age_us|missing_rank missing_rank ...;..." — which ranks each
+  // stalled negotiation is still waiting for.  Both bounded and
+  // separator-sanitized; CoordPendingInfo is a snapshot the engine thread
+  // refreshes each tick (the coordinator tables are engine-thread-only).
+  std::string PendingInfo();
+  std::string CoordPendingInfo();
+
+  // Cross-rank stall diagnosis: on the ST_TIMEOUT / ST_RANKS_DOWN abort
+  // paths the coordinator aggregates its per-rank waiting-on knowledge
+  // (last announce, last control frame) into a one-paragraph story that
+  // rides the broadcast abort message — Diagnosis() returns that
+  // paragraph (empty when no abort, or the abort carried none).
+  std::string Diagnosis();
+
   // The engine-owned Chrome-tracing timeline.  Exposed so the XLA data
   // plane (Python, jax/eager_mesh.py) can emit its BUCKET_BUILD /
   // XLA_DISPATCH / DEVICE_WAIT activities into the SAME trace file as the
@@ -508,7 +533,22 @@ class Engine {
   std::unique_ptr<Coordinator> coord_;
   uint8_t last_fused_dtype_ = 255;  // dtype of the current fusion group
   Timeline timeline_;
+  FlightRecorder flight_;
   std::chrono::steady_clock::time_point last_stall_check_;
+
+  // Coordinator waiting-on snapshot for CoordPendingInfo: rebuilt by the
+  // engine thread each tick the coordinator tables are non-empty (the
+  // tables themselves are engine-thread-only), read by API threads.
+  std::mutex coord_info_mu_;
+  std::string coord_pending_info_;
+  // Rank 0: refresh coord_pending_info_ from message_table/cache_pending
+  // (engine thread only; cheap — negotiations normally resolve within a
+  // tick, so the tables are almost always empty).
+  void UpdateCoordPendingInfo();
+  // Rank 0, engine thread: the cross-rank diagnosis paragraph for the
+  // stalled/dead ranks in `missing`, built from the coordinator's
+  // per-rank last-announce / last-frame accounting.
+  std::string BuildDiagnosis(const std::vector<int>& missing);
 
   // Negotiation response cache.  Engine-thread only: mutated while
   // processing response lists, read at queue drain; contents reset at
@@ -541,8 +581,14 @@ class Engine {
   // (first abort wins); events_ is process-cumulative for metrics.
   std::atomic<int32_t> abort_code_{0};
   std::atomic<int64_t> abort_events_{0};
-  std::mutex abort_mu_;  // guards abort_message_
+  std::mutex abort_mu_;  // guards abort_message_, abort_pending_info_
   std::string abort_message_;
+  // Pending table frozen at the abort (the BackgroundLoop drain clears
+  // table_ right after, but the postmortem dump must still say which
+  // collectives were in flight when the job died).
+  std::string abort_pending_info_;
+  // The live table_ serialization PendingInfo() falls back from.
+  std::string LivePendingInfo();
 
   // Clock alignment: the engine's ts epoch (set at Init, shared with the
   // timeline) and this rank's measured offset/RTT against rank 0.

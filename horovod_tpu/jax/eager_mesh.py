@@ -76,6 +76,7 @@ from typing import List, Optional
 import numpy as np
 
 from horovod_tpu.common import metrics as _metrics
+from horovod_tpu.common import postmortem as _postmortem
 
 _lock = threading.Lock()
 _plane = None  # initialized XlaDataPlane, or False if init failed/disabled
@@ -379,6 +380,8 @@ class XlaDataPlane:
                 op.cached = True
                 op.neg_raw = raw
                 _metrics.registry.record_cache("xla", "hits")
+                if _postmortem.plane_ring.enabled:
+                    _postmortem.plane_ring.record("cache_hit", op.name)
                 return
             _metrics.registry.record_cache("xla", "misses")
         vec = np.zeros(2 * self._size, np.int64)
@@ -411,6 +414,8 @@ class XlaDataPlane:
             if code != common.ST_OK:
                 msg = lib.hvd_tpu_error(op.neg_raw).decode()
                 op.handle._fail(common._status_error(code, msg, op.name))
+                if _postmortem.plane_ring.enabled:
+                    _postmortem.plane_ring.record("error", op.name, code)
                 op.seq = -1  # consumed; never dispatched
                 # A name that negotiated to an error (e.g. the cached-vs-
                 # changed-metadata mismatch) must renegotiate from
@@ -569,6 +574,9 @@ class XlaDataPlane:
                 # operators read metrics_snapshot()["stalls"] without
                 # opting into full metrics collection.
                 _metrics.registry.record_stall(handle._name, now - start)
+                if _postmortem.plane_ring.enabled:
+                    _postmortem.plane_ring.record(
+                        "stall", handle._name, int(now - start))
                 import sys
 
                 print(
@@ -603,6 +611,12 @@ class XlaDataPlane:
         _metrics.registry.record_stall(handle._name, waited_sec)
         if record_abort:
             _metrics.registry.record_abort("timeout")
+        if _postmortem.plane_ring.enabled:
+            _postmortem.plane_ring.record("abort", handle._name,
+                                          int(waited_sec))
+        # The plane-side deadline is a typed abort too: leave the dump
+        # (write-once; the engine path may already have claimed it).
+        _postmortem.write_postmortem("timeout")
         handle._fail(common.CollectiveTimeoutError(
             f"collective '{handle._name}' failed: XLA-plane dispatch wait "
             f"exceeded HVD_TPU_COLLECTIVE_TIMEOUT_SEC "
@@ -749,6 +763,9 @@ class XlaDataPlane:
                 op.handle._set_result(batch, o, n, op.tick, op.seq)
         self.stats["dispatches"] += 1
         self.stats["fused_tensors"] += len(bucket)
+        if _postmortem.plane_ring.enabled:
+            _postmortem.plane_ring.record("execute", bucket[0].name,
+                                          len(bucket))
 
     def _tl_phase(self, tl_lib, bucket: List[_PlaneOp],
                   start: Optional[bytes]) -> None:
@@ -779,6 +796,10 @@ class XlaDataPlane:
     def _enqueue(self, kind: str, payload: np.ndarray, root: int,
                  handle: XlaHandle, name: str) -> XlaHandle:
         op = _PlaneOp(name, kind, payload, root, handle)
+        # Flight recorder (postmortem plane): the XLA plane mirrors the
+        # engine's ring so both data planes record their final seconds.
+        if _postmortem.plane_ring.enabled:
+            _postmortem.plane_ring.record("enqueue", name)
         if _metrics.registry.enabled:
             op.t_enq = time.perf_counter()
             # Caller-visible payload bytes (pre-widening: bf16/f16 count

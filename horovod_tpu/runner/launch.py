@@ -35,10 +35,14 @@ def signal_name(returncode: int) -> str:
     return f"{name} (signal {-returncode})"
 
 
-def failure_report(results, tail_lines: int = 30) -> str:
+def failure_report(results, tail_lines: int = 30,
+                   postmortem_dir: Optional[str] = None) -> str:
     """One-stop failure summary: every failing rank labeled (signal names
     included), then the FIRST-failing rank's stderr tail — the root cause,
-    ahead of the kill cascade's -9 noise."""
+    ahead of the kill cascade's -9 noise.  With a postmortem dir set
+    (``--postmortem-dir`` / ``HVD_TPU_POSTMORTEM_DIR``), points at the
+    first-failing rank's dump and repeats the coordinator's cross-rank
+    diagnosis next to the tail."""
     lines = []
     first = None
     for r in results:
@@ -56,7 +60,46 @@ def failure_report(results, tail_lines: int = 30) -> str:
         lines.append(f"--- rank {first.rank} stderr (last {len(tail)} "
                      f"lines) ---")
         lines.extend(tail)
+    directory = (postmortem_dir
+                 or os.environ.get("HVD_TPU_POSTMORTEM_DIR") or "")
+    if first is not None and directory:
+        lines.extend(_postmortem_lines(directory, first.rank))
     return "\n".join(lines)
+
+
+def _postmortem_lines(directory: str, first_rank: int) -> List[str]:
+    """Postmortem pointers for the failure report: the first-failing
+    rank's dump path (a crashed-before-init rank may have none — fall
+    back to any rank's) and the cross-rank diagnosis, read from whichever
+    dump carries it (the coordinator broadcast it to every survivor)."""
+    import glob
+    import json
+
+    from horovod_tpu.common import postmortem as _postmortem
+
+    lines: List[str] = []
+    path = _postmortem.dump_path_for(directory, first_rank)
+    all_dumps = sorted(glob.glob(os.path.join(directory, "rank-*.json")))
+    if path is None and all_dumps:
+        path = all_dumps[0]
+    if path is None:
+        return lines
+    lines.append(f"postmortem: {path}"
+                 + (f" (+{len(all_dumps) - 1} more rank dump(s); render "
+                    f"with tools/postmortem_dump.py {directory})"
+                    if len(all_dumps) > 1 else ""))
+    diagnosis = None
+    for candidate in ([path] + [p for p in all_dumps if p != path]):
+        try:
+            with open(candidate) as f:
+                diagnosis = json.load(f).get("diagnosis")
+        except (OSError, ValueError):
+            continue
+        if diagnosis:
+            break
+    if diagnosis:
+        lines.append(f"cross-rank diagnosis: {diagnosis}")
+    return lines
 
 
 def make_rank_env(rank: int, size: int, coord: str, data: Sequence[str],
@@ -698,6 +741,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "HVD_TPU_TIMELINE=DIR).  Merge them with "
                              "tools/timeline_merge.py — see "
                              "docs/timeline.md")
+    parser.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                        help="postmortem plane (docs/troubleshooting.md"
+                             "#reading-a-postmortem): every rank writes a "
+                             "rank-<N>.json crash/hang dump under DIR on "
+                             "typed aborts, injected crashes, and fatal "
+                             "exceptions (sets HVD_TPU_POSTMORTEM_DIR); "
+                             "the failure report points at the first-"
+                             "failing rank's dump.  Render with "
+                             "tools/postmortem_dump.py DIR")
     parser.add_argument("--min-np", type=int, default=None,
                         help="elastic membership "
                              "(docs/fault-tolerance.md#elastic-membership): "
@@ -761,6 +813,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.serve_port is not None:
         env = dict(os.environ)
         env["HVD_TPU_SERVE_PORT"] = str(args.serve_port)
+    if args.postmortem_dir:
+        os.makedirs(args.postmortem_dir, exist_ok=True)
+        env = dict(env if env is not None else os.environ)
+        env["HVD_TPU_POSTMORTEM_DIR"] = args.postmortem_dir
+        # The launcher's own failure_report reads the env default too.
+        os.environ["HVD_TPU_POSTMORTEM_DIR"] = args.postmortem_dir
     if args.timeline:
         os.makedirs(args.timeline, exist_ok=True)
         env = dict(env if env is not None else os.environ)

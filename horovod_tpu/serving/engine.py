@@ -333,4 +333,14 @@ class ServingEngine:
             if registry.enabled:
                 registry.observe("step_sec", time.perf_counter() - t0)
             if rank0 and self.scheduler:
-                self.scheduler.complete_step(plan, sampled)
+                finished = self.scheduler.complete_step(plan, sampled)
+                # Request traces feed the PR-3 timeline too: one instant
+                # per retirement on the "serving" row (no-op when the
+                # timeline is off), so a merged trace shows request
+                # completions against the collective rows.
+                if finished and hvd.timeline_enabled():
+                    for req in finished:
+                        hvd.trace_marker(
+                            f"req.{req.id}.retired"
+                            f"[{len(req.generated)}tok]",
+                            row="serving")

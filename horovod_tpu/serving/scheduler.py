@@ -20,6 +20,7 @@ it into a 429) instead of growing queues unboundedly.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import heapq
 import itertools
@@ -42,6 +43,14 @@ OP_STOP = 2   # orderly shutdown: every rank leaves the serve loop
 REJECT_QUEUE_FULL = "queue_full"
 REJECT_TENANT_QUOTA = "tenant_quota"
 REJECT_TOO_LONG = "too_long"
+
+# Request-trace bounds (postmortem plane, docs/inference.md#request-traces):
+# per-request span cap (a 100k-token decode must not grow a span list
+# unboundedly — overflow is counted, terminal events always land) and the
+# completed-trace store size served by GET /v1/trace?id=.
+_MAX_SPANS = 512
+_MAX_TRACES = 256
+_TERMINAL_SPANS = ("retired", "preempted", "failed")
 
 
 class AdmissionError(Exception):
@@ -144,6 +153,23 @@ class Request:
         self.t_submit = time.monotonic()
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
+        # Request trace (docs/inference.md#request-traces): ordered span
+        # records through the lifecycle, served by GET /v1/trace?id= and
+        # landed on the PR-3 timeline at retirement.  Bounded; terminal
+        # events always record.
+        self.spans: List[dict] = [{"event": "submitted", "t_ms": 0.0}]
+        self.dropped_spans = 0
+
+    def span(self, event: str, now: Optional[float] = None,
+             **fields) -> None:
+        if len(self.spans) >= _MAX_SPANS and event not in _TERMINAL_SPANS:
+            self.dropped_spans += 1
+            return
+        rec = {"event": event,
+               "t_ms": round(((now if now is not None else time.monotonic())
+                              - self.t_submit) * 1e3, 3)}
+        rec.update(fields)
+        self.spans.append(rec)
 
     @property
     def feed(self) -> List[int]:
@@ -255,6 +281,11 @@ class Scheduler:
         self._finish_seq = itertools.count()
         self._failed: Optional[Exception] = None
         self._reg = metrics.registry
+        # Completed-request traces (retired/failed), bounded FIFO — the
+        # /v1/trace route serves live requests from _by_id and finished
+        # ones from here.
+        self._traces: "collections.OrderedDict[int, dict]" = \
+            collections.OrderedDict()
 
     # -- admission --------------------------------------------------------
 
@@ -309,6 +340,7 @@ class Scheduler:
             self._by_id[req.id] = req
             heapq.heappush(self._queue,
                            (-req.priority, next(self._submit_seq), req))
+            req.span("admitted")
             self._reg.record_serving("admitted", tenant)
             self._reg.record_serving_tokens(tenant, "prompt",
                                             len(req.prompt_ids))
@@ -381,6 +413,7 @@ class Scheduler:
             req.state = ACTIVE
             req.slot = self._slots.index(None)
             self._slots[req.slot] = req
+            req.span("activated", slot=req.slot)
             self._update_gauges()
 
     def _ensure_blocks_locked(self, req: Request, want_tokens: int) -> bool:
@@ -424,6 +457,7 @@ class Scheduler:
         req.state = QUEUED
         heapq.heappush(self._queue,
                        (-req.priority, next(self._submit_seq), req))
+        req.span("preempted")
         self._reg.record_serving("preempted", req.tenant)
         self._update_gauges()
 
@@ -447,7 +481,11 @@ class Scheduler:
                 req = self._slots[sp.slot]
                 if req is None or req.id != sp.request_id:
                     continue  # retired/preempted under a replan
+                was_prefill = req.filled < len(req.prompt_ids)
                 req.filled += sp.n_new or sp.bulk_len
+                req.span("prefill_chunk" if was_prefill else "decode_step",
+                         now, step=plan.step,
+                         tokens=sp.n_new or sp.bulk_len)
                 if not sp.samples:
                     continue
                 tok = int(sampled[sp.slot])
@@ -473,12 +511,40 @@ class Scheduler:
         req.state = DONE
         req.t_done = now
         req.finish_seq = next(self._finish_seq)
+        req.span("retired", now, generated=len(req.generated))
+        self._store_trace_locked(req)
         self._reg.record_serving("retired", req.tenant)
         self._reg.observe("serving_token_sec",
                           (now - req.t_submit)
                           / max(len(req.generated), 1))
         del self._by_id[req.id]
         req.event.set()
+
+    def _store_trace_locked(self, req: Request) -> None:
+        self._traces[req.id] = {
+            "id": req.id, "tenant": req.tenant, "state": req.state,
+            "finish_seq": req.finish_seq,
+            "spans": [dict(s) for s in req.spans],
+            "dropped_spans": req.dropped_spans,
+        }
+        while len(self._traces) > _MAX_TRACES:
+            self._traces.popitem(last=False)
+
+    def trace(self, request_id: int) -> Optional[dict]:
+        """Ordered span records for one request — live (still queued or
+        decoding) or finished (bounded store).  None when unknown (never
+        admitted, or evicted from the store)."""
+        with self._lock:
+            req = self._by_id.get(request_id)
+            if req is not None:
+                return {"id": req.id, "tenant": req.tenant,
+                        "state": req.state, "finish_seq": req.finish_seq,
+                        "spans": [dict(s) for s in req.spans],
+                        "dropped_spans": req.dropped_spans}
+            entry = self._traces.get(request_id)
+            if entry is None:
+                return None
+            return dict(entry, spans=[dict(s) for s in entry["spans"]])
 
     # -- robustness -------------------------------------------------------
 
@@ -490,6 +556,9 @@ class Scheduler:
         """
         with self._lock:
             self._reg.record_serving("reformed")
+            for req in self._slots:
+                if req is not None:
+                    req.span("reformed")
 
     def fail_all(self, exc: Exception) -> None:
         """The plane is down (fatal collective error or shutdown): fail
@@ -506,6 +575,8 @@ class Scheduler:
                 if req.slot is not None:
                     self._slots[req.slot] = None
                     req.slot = None
+                req.span("failed", error=str(exc)[:200])
+                self._store_trace_locked(req)
                 self._reg.record_serving("failed", req.tenant)
                 req.event.set()
             self._by_id.clear()

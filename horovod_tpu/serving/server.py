@@ -75,6 +75,28 @@ def start_server(scheduler: Scheduler, cfg: ServeConfig,
                     snap = metrics_snapshot()
                     self._reply(200, {k: snap[k] for k in
                                       ("serving", "cache", "membership")})
+                elif path == "/v1/trace":
+                    # Request trace (docs/inference.md#request-traces):
+                    # ordered spans for one request, live or retired.
+                    from urllib.parse import parse_qs, urlparse
+
+                    query = parse_qs(urlparse(self.path).query)
+                    try:
+                        request_id = int(query.get("id", [""])[0])
+                    except ValueError:
+                        self._reply(400, {"error": {
+                            "type": "bad_request",
+                            "detail": "trace needs a numeric ?id="}})
+                        return
+                    trace = scheduler.trace(request_id)
+                    if trace is None:
+                        self._reply(404, {"error": {
+                            "type": "not_found", "id": request_id,
+                            "detail": "unknown request id (never admitted,"
+                                      " or evicted from the bounded trace"
+                                      " store)"}})
+                        return
+                    self._reply(200, trace)
                 else:
                     self.send_error(404)
 

@@ -14,12 +14,41 @@ standalone:
 
 from __future__ import annotations
 
+import os
 import re
 import sys
 from collections import Counter
 
 NAME_RE = re.compile(r"^hvd_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
 HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+# Section-coverage contract: every metrics_snapshot() top-level section
+# must export at least one Prometheus family AND be documented in
+# docs/metrics.md — a new section missing from this map, a mapped family
+# missing from the exposition, or an undocumented section all fail the
+# lint (this drifted silently in past PRs).  "enabled" is the gate flag,
+# not a section; "histograms" is special-cased (one family per histogram).
+SECTION_FAMILIES = {
+    "ops": ("hvd_tpu_ops_total",),
+    "bytes": ("hvd_tpu_bytes_total",),
+    "batches": ("hvd_tpu_batches_dispatched_total",
+                "hvd_tpu_fused_tensors_total"),
+    "stalls": ("hvd_tpu_stall_events_total", "hvd_tpu_stalled_tensor_total"),
+    "faults": ("hvd_tpu_faults_injected_total", "hvd_tpu_aborts_total",
+               "hvd_tpu_restart_epoch"),
+    "skew": ("hvd_tpu_announce_total", "hvd_tpu_last_to_announce_total"),
+    "cache": ("hvd_tpu_response_cache_events_total",
+              "hvd_tpu_response_cache_size"),
+    "membership": ("hvd_tpu_membership_epoch", "hvd_tpu_membership_size",
+                   "hvd_tpu_membership_reshapes_total"),
+    "autotune": ("hvd_tpu_autotune_enabled",
+                 "hvd_tpu_autotune_windows_total"),
+    "serving": ("hvd_tpu_serving_requests_total",
+                "hvd_tpu_serving_steps_total"),
+    "flight": ("hvd_tpu_flight_events_total",
+               "hvd_tpu_flight_ring_capacity"),
+    "histograms": (),
+}
 
 
 def populated_registry():
@@ -50,6 +79,7 @@ def populated_registry():
     reg.record_serving_step(2, 4)
     reg.set_serving_gauges(queue_depth=1, active=2, kv_blocks_in_use=3,
                            kv_blocks_total=8)
+    reg.set_flight({"events": {"engine": 5, "xla": 2}, "capacity": 512})
     reg.set_autotune({
         "enabled": True, "frozen": True, "windows": 3,
         "fusion_threshold": 1 << 20, "cycle_time_ms": 2.5,
@@ -109,16 +139,67 @@ def lint(text: str) -> list:
     return errors
 
 
+def _metrics_doc_text() -> str:
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "docs", "metrics.md")
+    try:
+        with open(path) as f:
+            return f.read().lower()
+    except OSError:
+        return ""
+
+
+def lint_sections(snapshot: dict, text: str, doc_text: str) -> list:
+    """Section-coverage violations: every snapshot top-level section must
+    map to at least one rendered Prometheus family (SECTION_FAMILIES) and
+    appear in docs/metrics.md."""
+    errors = []
+    families = {line.split()[2] for line in text.splitlines()
+                if line.startswith("# TYPE ")}
+    for section, value in snapshot.items():
+        if section == "enabled":
+            continue  # the collection gate, not a metrics section
+        if section not in SECTION_FAMILIES:
+            errors.append(
+                f"snapshot section '{section}' has no SECTION_FAMILIES "
+                f"entry (tools/check_metric_names.py): declare its "
+                f"Prometheus families so the exposition cannot silently "
+                f"drop it")
+            continue
+        expected = SECTION_FAMILIES[section]
+        if section == "histograms":
+            from horovod_tpu.common.metrics import _prom_hist_name
+
+            expected = tuple(_prom_hist_name(name) for name in value)
+        if not expected:
+            errors.append(
+                f"snapshot section '{section}' declares no Prometheus "
+                f"family at all")
+        for family in expected:
+            if family not in families:
+                errors.append(
+                    f"snapshot section '{section}': declared family "
+                    f"'{family}' is missing from the exposition")
+        if section.lower() not in doc_text:
+            errors.append(
+                f"snapshot section '{section}' is not documented in "
+                f"docs/metrics.md")
+    return errors
+
+
 def main() -> int:
     from horovod_tpu.common import metrics
 
-    text = metrics.prometheus_text(populated_registry().snapshot())
+    snapshot = populated_registry().snapshot()
+    text = metrics.prometheus_text(snapshot)
     errors = lint(text)
+    errors += lint_sections(snapshot, text, _metrics_doc_text())
     for err in errors:
         print(f"check_metric_names: {err}", file=sys.stderr)
     if not errors:
         n = len([l for l in text.splitlines() if l.startswith("# TYPE ")])
-        print(f"check_metric_names: OK ({n} metric families)")
+        print(f"check_metric_names: OK ({n} metric families, "
+              f"{len(snapshot) - 1} snapshot sections covered)")
     return 1 if errors else 0
 
 

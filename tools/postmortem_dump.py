@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Render a postmortem dump directory into the human story.
+
+``hvdrun --postmortem-dir DIR`` (or ``HVD_TPU_POSTMORTEM_DIR=DIR``) makes
+every rank write ``rank-<N>.json`` when it dies a typed death
+(docs/troubleshooting.md#reading-a-postmortem).  This tool reads the
+directory and tells the story an operator needs at 3am:
+
+    $ python tools/postmortem_dump.py /tmp/pm
+    postmortem: 3 dump(s) in /tmp/pm (job size 4)
+    rank 0: timeout  rank 2: timeout  rank 3: timeout
+    membership epoch 0 on every dumped rank (consistent)
+    cross-rank diagnosis: the coordinator is at tick 1841; rank 1 last
+      announced 'step.11' at tick 1803 and stopped announcing after that
+    waiting-on (rank 0 coordinator view):
+      'step.12' stalled 2.1s, waiting on ranks [1]
+    rank 0 — last flight-recorder events (engine):
+      ... enqueue step.12 / announce step.12 / tick 1803 ...
+
+Options: ``--rank N`` focuses one rank, ``--events K`` sets the ring tail
+length (default 12), ``--json`` re-emits the merged view as JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+
+def load_dumps(directory: str) -> Dict[int, dict]:
+    """rank -> dump doc; restart-epoch-suffixed files win over older
+    plain ones when both exist (newest mtime per rank)."""
+    by_rank: Dict[int, str] = {}
+    for path in glob.glob(os.path.join(directory, "rank-*.json")):
+        base = os.path.basename(path)[len("rank-"):-len(".json")]
+        rank_s = base.split(".e")[0]
+        try:
+            rank = int(rank_s)
+        except ValueError:
+            continue
+        if (rank not in by_rank
+                or os.path.getmtime(path) > os.path.getmtime(by_rank[rank])):
+            by_rank[rank] = path
+    dumps = {}
+    for rank, path in by_rank.items():
+        try:
+            with open(path) as f:
+                dumps[rank] = json.load(f)
+            dumps[rank]["_path"] = path
+        except (OSError, ValueError) as exc:
+            print(f"postmortem_dump: skipping unreadable {path}: {exc}",
+                  file=sys.stderr)
+    return dumps
+
+
+def _fmt_event(e: dict) -> str:
+    name = f" {e['name']}" if e.get("name") else ""
+    arg = f" ({e['arg']})" if e.get("arg") else ""
+    return f"      t+{e['ts_us'] / 1e6:9.3f}s  {e['event']}{name}{arg}"
+
+
+def render(dumps: Dict[int, dict], events: int = 12,
+           only_rank: Optional[int] = None) -> List[str]:
+    lines: List[str] = []
+    ranks = sorted(dumps)
+    size = max((d.get("size", 0) for d in dumps.values()), default=0)
+    lines.append(f"postmortem: {len(dumps)} dump(s) for rank(s) "
+                 f"{ranks} (job size {size})")
+    reasons = {r: dumps[r].get("reason", "?") for r in ranks}
+    lines.append("  " + "  ".join(f"rank {r}: {reasons[r]}" for r in ranks))
+    epochs = {dumps[r].get("membership_epoch", 0) for r in ranks}
+    if len(epochs) <= 1:
+        lines.append(f"  membership epoch {epochs.pop() if epochs else 0} "
+                     f"on every dumped rank (consistent)")
+    else:
+        per_rank = {r: dumps[r].get("membership_epoch") for r in ranks}
+        lines.append(
+            f"  MEMBERSHIP EPOCH DISAGREEMENT across dumps: {per_rank}")
+    diagnosis = next((dumps[r].get("diagnosis") for r in ranks
+                      if dumps[r].get("diagnosis")), None)
+    if diagnosis:
+        lines.append(f"  cross-rank diagnosis: {diagnosis}")
+    missing = [r for r in range(size) if r not in dumps]
+    if missing:
+        lines.append(f"  no dump from rank(s) {missing} — these are "
+                     f"usually the ranks that died hard (SIGKILL/crash "
+                     f"before the writer ran); the survivors' diagnosis "
+                     f"and pending tables above name them")
+    coord = next((dumps[r] for r in ranks
+                  if dumps[r].get("pending", {}).get("coordinator")), None)
+    if coord:
+        lines.append("  waiting-on (rank 0 coordinator view):")
+        for entry in coord["pending"]["coordinator"]:
+            lines.append(f"    '{entry['name']}' stalled "
+                         f"{entry['age_sec']:.1f}s, waiting on ranks "
+                         f"{entry['missing_ranks']}")
+    for r in ranks:
+        if only_rank is not None and r != only_rank:
+            continue
+        d = dumps[r]
+        lines.append(f"rank {r} ({d.get('_path', '?')}):")
+        abort = d.get("abort", {})
+        if abort.get("message"):
+            head = abort["message"].split(" cross-rank diagnosis: ")[0]
+            lines.append(f"    abort[{abort.get('code')}]: {head[:300]}")
+        if d.get("exception"):
+            lines.append(f"    exception: {d['exception']['type']}: "
+                         f"{d['exception']['message'][:200]}")
+        pending = d.get("pending", {}).get("local", [])
+        if pending:
+            lines.append("    in-flight collectives at death:")
+            for entry in pending[:8]:
+                lines.append(f"      '{entry['name']}' ({entry['op']}) "
+                             f"pending {entry['age_sec']:.1f}s")
+        for plane in ("engine", "xla"):
+            ring = d.get("ring", {}).get(plane, [])
+            if not ring:
+                continue
+            lines.append(f"    last flight-recorder events ({plane}, "
+                         f"{len(ring)} in ring):")
+            lines.extend(_fmt_event(e) for e in ring[-events:])
+    return lines
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Render HVD_TPU_POSTMORTEM_DIR rank dumps into the "
+                    "human story (docs/troubleshooting.md).")
+    parser.add_argument("directory", help="postmortem dump directory")
+    parser.add_argument("--rank", type=int, default=None,
+                        help="show only this rank's detail section")
+    parser.add_argument("--events", type=int, default=12,
+                        help="flight-ring tail length per rank "
+                             "(default 12)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the merged dumps as one JSON document")
+    args = parser.parse_args(argv)
+    dumps = load_dumps(args.directory)
+    if not dumps:
+        print(f"postmortem_dump: no rank-*.json dumps in "
+              f"{args.directory}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({str(r): d for r, d in dumps.items()}, indent=2))
+        return 0
+    for line in render(dumps, events=args.events, only_rank=args.rank):
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
