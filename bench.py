@@ -17,12 +17,14 @@ Extra errors are clipped to one short line.  BENCH_EXTRA=0 disables,
 BENCH_EXTRA_CONFIGS="seq:batch,..." overrides the sweep.
 
 Env knobs: BENCH_MODEL (resnet101|resnet50|resnet18|vgg16|inception_v3|
-mnist|transformer|allreduce|small_allreduce|serve_decode|scaling),
-BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
+mnist|transformer|allreduce|small_allreduce|big_allreduce|serve_decode|
+scaling), BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
 length); transformer adds BENCH_SEQ/BENCH_VOCAB/BENCH_D_MODEL/BENCH_LAYERS/
 BENCH_HEADS; allreduce adds BENCH_NP/BENCH_BYTES/BENCH_ITERS;
 small_allreduce (the negotiation-bound cache microbench) adds
-BENCH_NP/BENCH_TENSORS/BENCH_STEPS; serve_decode (the serving-plane
+BENCH_NP/BENCH_TENSORS/BENCH_STEPS; big_allreduce (the bandwidth-bound
+wire-compression sweep, docs/performance.md#wire-compression) adds
+BENCH_NP/BENCH_BYTES/BENCH_ITERS; serve_decode (the serving-plane
 continuous-batching bench, docs/inference.md) adds
 BENCH_NP/BENCH_REQUESTS.
 """
@@ -448,6 +450,108 @@ if hvd.rank() == 0:
     print(json.dumps(record))
 
 
+def bench_big_allreduce() -> None:
+    """Bandwidth-bound large-tensor allreduce with the wire-compression
+    sweep (docs/performance.md#wire-compression): BENCH_BYTES of fp32
+    repeated steady-state over BENCH_NP local ranks, once per
+    HVD_TPU_COMPRESSION mode (off, bf16, fp8).  Headline is the bf16-mode
+    ops/sec; extra_metrics carries each mode's ops/sec and wire bytes
+    (`_bytes` extras gate lower-is-better in tools/bench_compare.py), the
+    off/compressed byte ratios (>= 1.8x for bf16 is the acceptance bar),
+    each mode's max relative error vs the fp32 result, and the bf16
+    -payload wire inflation (1.0 = native width; 2.0 was the old f32
+    staging)."""
+    import subprocess
+    import sys
+
+    np_ = int(os.environ.get("BENCH_NP", "4"))
+    nbytes = int(os.environ.get("BENCH_BYTES", str(32 * 1024 * 1024)))
+    iters = int(os.environ.get("BENCH_ITERS", "8"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = f"""
+import json, time, numpy as np, ml_dtypes, horovod_tpu as hvd
+hvd.init()
+n = {nbytes} // 4
+x = np.random.RandomState(hvd.rank()).rand(n).astype(np.float32) - 0.5
+want = np.zeros(n, np.float32)
+for i in range(hvd.size()):
+    want += np.random.RandomState(i).rand(n).astype(np.float32) - 0.5
+want /= hvd.size()
+out = hvd.allreduce(x, average=True, name="big.steady")  # warm: negotiate
+mark = hvd.compression_report()["engine"]
+t0 = time.perf_counter()
+for i in range({iters}):
+    out = hvd.allreduce(x, average=True, name="big.steady")
+dt = time.perf_counter() - t0
+rep = hvd.compression_report()["engine"]
+err = float(np.max(np.abs(out - want)) / max(float(np.max(np.abs(want))),
+                                             1e-9))
+# bf16-payload inflation probe: native-width wire means delta wire ==
+# delta payload (the old f32 staging paid 2x).
+xb = (np.random.RandomState(7).rand(1 << 18).astype(np.float32)
+      / 4).astype(ml_dtypes.bfloat16)
+b0 = hvd.compression_report()["engine"]
+hvd.allreduce(xb, average=False, name="big.half")
+b1 = hvd.compression_report()["engine"]
+if hvd.rank() == 0:
+    print("BIG_JSON " + json.dumps({{
+        "ops_per_sec": {iters} / dt,
+        "gbps": 2 * (hvd.size() - 1) / hvd.size() * {nbytes} * {iters}
+                / dt / 1e9,
+        "wire_bytes": rep["wire_bytes"] - mark["wire_bytes"],
+        "payload_bytes": rep["payload_bytes"] - mark["payload_bytes"],
+        "max_rel_err": err,
+        "half_wire_inflation": (b1["wire_bytes"] - b0["wire_bytes"])
+                               / max(b1["payload_bytes"]
+                                     - b0["payload_bytes"], 1),
+    }}), flush=True)
+"""
+
+    def run(mode: str) -> dict:
+        env = dict(os.environ,
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""),
+                   HVD_TPU_COMPRESSION=mode)
+        out = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
+             "--", sys.executable, "-c", code],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert out.returncode == 0, (mode, out.stderr[-2000:])
+        return next(json.loads(line[len("BIG_JSON "):])
+                    for line in out.stdout.splitlines()
+                    if line.startswith("BIG_JSON "))
+
+    off = run("off")
+    b16 = run("bf16")
+    f8 = run("fp8")
+    ratio16 = off["wire_bytes"] / max(b16["wire_bytes"], 1)
+    ratio8 = off["wire_bytes"] / max(f8["wire_bytes"], 1)
+    floor = float(os.environ.get("BENCH_BIG_ALLREDUCE_MIN_RATIO", "1.8"))
+    assert ratio16 >= floor, (
+        f"bf16 wire mode moved only {ratio16:.2f}x fewer bytes than the "
+        f"fp32 baseline (want >= {floor:.1f}x): "
+        f"{b16['wire_bytes']} vs {off['wire_bytes']}")
+    print(json.dumps({
+        "metric": f"big_allreduce_ops_per_sec_np{np_}",
+        "value": round(b16["ops_per_sec"], 2),
+        "unit": "ops/sec",
+        "vs_baseline": None,  # the reference published no such number
+        "extra_metrics": {
+            "off_ops_per_sec": round(off["ops_per_sec"], 2),
+            "fp8_ops_per_sec": round(f8["ops_per_sec"], 2),
+            "bf16_gbps_effective": round(b16["gbps"], 3),
+            "off_wire_bytes": off["wire_bytes"],
+            "bf16_wire_bytes": b16["wire_bytes"],
+            "fp8_wire_bytes": f8["wire_bytes"],
+            "bf16_compression_ratio": round(ratio16, 3),
+            "fp8_compression_ratio": round(ratio8, 3),
+            "bf16_max_rel_err": round(b16["max_rel_err"], 6),
+            "fp8_max_rel_err": round(f8["max_rel_err"], 6),
+            "half_wire_inflation": round(off["half_wire_inflation"], 3),
+        },
+    }))
+
+
 def bench_serve_decode() -> None:
     """Serving-plane bench (docs/inference.md): a synthetic multi-tenant
     request stream against the continuous-batching engine over BENCH_NP
@@ -571,6 +675,8 @@ def main() -> None:
         return bench_allreduce()
     if model_name == "small_allreduce":
         return bench_small_allreduce()
+    if model_name == "big_allreduce":
+        return bench_big_allreduce()
     if model_name == "serve_decode":
         return bench_serve_decode()
     if model_name == "scaling":
@@ -726,6 +832,11 @@ def main() -> None:
         # record can never outgrow the driver's output tail (the r4
         # failure mode: a 20 KB Mosaic error inside the JSON).
         extras = {}
+        # Round records track which wire-compression mode the run was
+        # configured with (a config row, not a measurement: the
+        # single-chip transformer sweep moves no collective bytes).
+        extras["wire_compression"] = os.environ.get(
+            "HVD_TPU_COMPRESSION", "off")
         # seq:batch pairs, token-constant (16k tokens/step — the
         # long-context protocol of docs/benchmarks.md); the full
         # documented sweep so each round's driver record carries it.

@@ -34,6 +34,7 @@ from horovod_tpu.common import (  # noqa: F401
     autotune_set,
     broadcast,
     broadcast_async,
+    compression_report,
     init,
     is_initialized,
     local_rank,

@@ -142,7 +142,8 @@ def _load_lib():
             ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
             ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong,
             ctypes.c_longlong, ctypes.c_double, ctypes.c_int,
-            ctypes.c_longlong, ctypes.c_int]
+            ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
+            ctypes.c_longlong, ctypes.c_longlong]
         lib.hvd_tpu_init_error.restype = ctypes.c_char_p
         lib.hvd_tpu_enqueue.restype = ctypes.c_longlong
         lib.hvd_tpu_enqueue.argtypes = [
@@ -221,9 +222,18 @@ def _load_lib():
         lib.hvd_tpu_autotune_applied.argtypes = []
         lib.hvd_tpu_autotune_set.restype = ctypes.c_int
         lib.hvd_tpu_autotune_set.argtypes = [ctypes.c_longlong,
-                                             ctypes.c_double]
+                                             ctypes.c_double,
+                                             ctypes.c_longlong]
         lib.hvd_tpu_fusion_threshold_at.restype = ctypes.c_longlong
         lib.hvd_tpu_fusion_threshold_at.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_compression_mode.restype = ctypes.c_int
+        lib.hvd_tpu_compression_mode.argtypes = []
+        lib.hvd_tpu_compression_mode_at.restype = ctypes.c_longlong
+        lib.hvd_tpu_compression_mode_at.argtypes = [ctypes.c_longlong]
+        lib.hvd_tpu_compression_info.restype = ctypes.c_char_p
+        lib.hvd_tpu_compression_info.argtypes = []
+        lib.hvd_tpu_compression_log.restype = ctypes.c_char_p
+        lib.hvd_tpu_compression_log.argtypes = []
         lib.hvd_tpu_elastic_enabled.restype = ctypes.c_int
         lib.hvd_tpu_elastic_enabled.argtypes = []
         lib.hvd_tpu_membership_epoch.restype = ctypes.c_longlong
@@ -312,9 +322,27 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
     data = ",".join(ps.data_endpoints) if ps.data_endpoints else ""
     from horovod_tpu.common import autotune as _autotune
 
-    # Pin-spec errors must surface at init, not be silently dropped into
-    # a knob the user asked to hold (common/autotune.py).
-    fix_fusion, fix_cycle = _autotune.parse_fix(cfg.autotune_fix)
+    # Pin-spec and compression-spec errors must surface at init, not be
+    # silently dropped into a knob the user asked to hold
+    # (common/autotune.py, common/config.py).
+    fix_fusion, fix_cycle, fix_comp = _autotune.parse_fix(cfg.autotune_fix)
+    compression_code = cfg.compression_code  # ValueError on a bad mode
+    if fix_comp > 0 and compression_code == 0:
+        # The engine pins the autotune axis at "none" whenever the job
+        # did not opt into compression (a tuner must never make an exact
+        # job lossy) — so a lossy pin here would be silently dropped,
+        # the exact failure mode parse_fix exists to reject.
+        raise ValueError(
+            "HVD_TPU_AUTOTUNE_FIX pins a lossy wire-compression mode but "
+            "HVD_TPU_COMPRESSION is off; set HVD_TPU_COMPRESSION=bf16|fp8 "
+            "(or drop the compression pin).")
+    if fix_comp > 0 and cfg.hierarchical_allreduce:
+        # Same contract for the two-level topology: its star phases keep
+        # the full-width wire, so the pinned knob would be dead.
+        raise ValueError(
+            "HVD_TPU_AUTOTUNE_FIX pins a lossy wire-compression mode but "
+            "HOROVOD_HIERARCHICAL_ALLREDUCE keeps the full-width wire; "
+            "use the flat ring (or drop the compression pin).")
     rc = lib.hvd_tpu_init(
         ps.rank, ps.size, ps.local_rank, ps.local_size,
         (ps.coord_endpoint or "").encode(), data.encode(),
@@ -323,7 +351,8 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
         cfg.collective_timeout_sec, cfg.effective_cache_capacity,
         int(cfg.autotune), cfg.autotune_warmup, cfg.autotune_window,
         fix_fusion, fix_cycle, int(cfg.elastic or cfg.rejoin),
-        cfg.min_np, int(cfg.rejoin))
+        cfg.min_np, int(cfg.rejoin), compression_code,
+        cfg.compression_min_bytes, fix_comp)
     if rc != 0:
         raise HorovodInternalError(
             "engine initialization failed: "
@@ -741,6 +770,52 @@ def _sync_engine_flight() -> None:
         })
 
 
+def _sync_engine_compression() -> None:
+    """Mirror the wire-compression state of both data planes into the
+    registry's ungated ``"compression"`` section (docs/performance.md
+    #wire-compression): the applied mode and min-bytes floor, per-plane
+    wire-vs-payload byte totals and per-mode bucket counts, and the
+    error-feedback residual gauges.  A state copy like the membership
+    sync — the C counters are cumulative, so overwriting is idempotent."""
+    if _lib is None:
+        return
+    from horovod_tpu.common.config import COMPRESSION_NAMES
+
+    with _stall_sync_lock:
+        parts = _lib.hvd_tpu_compression_info().decode().split("|")
+        try:
+            (wire, payload, n_none, n_bf16, n_fp8, res_bytes, res_tensors,
+             min_bytes) = (int(p) for p in parts[:8])
+        except ValueError:
+            return
+        planes = {
+            "engine": {"wire_bytes": wire, "payload_bytes": payload,
+                       "ops": {"none": n_none, "bf16": n_bf16,
+                               "fp8": n_fp8}},
+            "xla": {"wire_bytes": 0, "payload_bytes": 0,
+                    "ops": {"none": 0, "bf16": 0, "fp8": 0}},
+        }
+        plane_stats = getattr(_xla_plane, "comp_stats", None)
+        plane_res = 0
+        if plane_stats is not None:
+            planes["xla"] = {
+                "wire_bytes": int(plane_stats["wire_bytes"]),
+                "payload_bytes": int(plane_stats["payload_bytes"]),
+                "ops": dict(plane_stats["ops"]),
+            }
+            plane_res = sum(r.nbytes for r in
+                            getattr(_xla_plane, "_residuals", {}).values())
+        metrics.registry.set_compression({
+            "mode": COMPRESSION_NAMES.get(
+                int(_lib.hvd_tpu_compression_mode()), "off"),
+            "min_bytes": min_bytes,
+            "planes": planes,
+            "residual_bytes": res_bytes + plane_res,
+            "residual_tensors": res_tensors + len(
+                getattr(_xla_plane, "_residuals", {}) or {}),
+        })
+
+
 def _sync_engine_autotune() -> None:
     """Mirror the engine's autotuning state into the registry's ungated
     ``"autotune"`` section (docs/performance.md#autotuning).  Unlike the
@@ -772,6 +847,7 @@ def metrics_snapshot() -> dict:
     _sync_engine_autotune()
     _sync_engine_membership()
     _sync_engine_flight()
+    _sync_engine_compression()
     return metrics.registry.snapshot()
 
 
@@ -806,19 +882,76 @@ def autotune_report() -> dict:
 
 
 def autotune_set(fusion_threshold: Optional[int] = None,
-                 cycle_time_ms: Optional[float] = None) -> None:
+                 cycle_time_ms: Optional[float] = None,
+                 compression: Optional[str] = None) -> None:
     """Inject engine parameters for lockstep broadcast at the next
     negotiation tick — the pluggable-policy seam: a custom tuning policy
     runs on rank 0, reads ``metrics_snapshot()``, and drives the same
     broadcast machinery the built-in search uses, so every rank applies
     the change at the same tick boundary.  Works with the built-in tuner
     disabled or frozen; while a search is live it resumes from the
-    nearest grid point.  Rank 0 only (``ValueError`` elsewhere)."""
+    nearest grid point.  ``compression`` takes a wire mode name
+    ("off"/"bf16"/"fp8").  Rank 0 only (``ValueError`` elsewhere)."""
     lib = _load_lib()
     _check_initialized(lib)
     from horovod_tpu.common import autotune as _autotune
 
-    _autotune.set_params(lib, fusion_threshold, cycle_time_ms)
+    _autotune.set_params(lib, fusion_threshold, cycle_time_ms, compression)
+
+
+def compression_report() -> dict:
+    """The wire-compression report (docs/performance.md#wire-compression):
+    the applied mode and min-bytes floor (lockstep state — identical on
+    every rank of a healthy job), per-plane wire-vs-payload byte totals
+    and per-mode bucket counts, the error-feedback residual gauges, and
+    the engine's bounded per-bucket decision ``log`` ([{"name", "mode"},
+    ...] in execution order — identical across ranks; tests allgather and
+    compare it).  Returns the empty shape before ``init()``."""
+    from horovod_tpu.common.config import COMPRESSION_NAMES
+
+    empty_ops = {"none": 0, "bf16": 0, "fp8": 0}
+    rep = {
+        "mode": "off", "min_bytes": 0,
+        "engine": {"wire_bytes": 0, "payload_bytes": 0,
+                   "ops": dict(empty_ops)},
+        "xla": {"wire_bytes": 0, "payload_bytes": 0, "ops": dict(empty_ops)},
+        "residual_bytes": 0, "residual_tensors": 0,
+        "log": [],
+    }
+    if _lib is None:
+        return rep
+    parts = _lib.hvd_tpu_compression_info().decode().split("|")
+    try:
+        (wire, payload, n_none, n_bf16, n_fp8, res_bytes, res_tensors,
+         min_bytes) = (int(p) for p in parts[:8])
+    except ValueError:
+        return rep
+    rep.update({
+        "mode": COMPRESSION_NAMES.get(
+            int(_lib.hvd_tpu_compression_mode()), "off"),
+        "min_bytes": min_bytes,
+        "engine": {"wire_bytes": wire, "payload_bytes": payload,
+                   "ops": {"none": n_none, "bf16": n_bf16, "fp8": n_fp8}},
+        "residual_bytes": res_bytes,
+        "residual_tensors": res_tensors,
+    })
+    plane_stats = getattr(_xla_plane, "comp_stats", None)
+    if plane_stats is not None:
+        rep["xla"] = {"wire_bytes": int(plane_stats["wire_bytes"]),
+                      "payload_bytes": int(plane_stats["payload_bytes"]),
+                      "ops": dict(plane_stats["ops"])}
+        # Residual gauges cover BOTH planes, exactly like
+        # metrics_snapshot()["compression"] — the two public surfaces
+        # must agree on the same field names.
+        plane_res = getattr(_xla_plane, "_residuals", {}) or {}
+        rep["residual_bytes"] += sum(r.nbytes for r in plane_res.values())
+        rep["residual_tensors"] += len(plane_res)
+    for entry in _lib.hvd_tpu_compression_log().decode().split(";"):
+        if not entry:
+            continue
+        name, _, mode = entry.rpartition("|")
+        rep["log"].append({"name": name, "mode": mode})
+    return rep
 
 
 # ---------------------------------------------------------------------------

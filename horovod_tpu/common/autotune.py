@@ -28,22 +28,28 @@ from typing import List, Optional, Tuple
 
 # Candidate grids, mirrored from engine/cc/autotune.cc (keep in sync):
 # log-spaced, spanning the negotiation-bound 32 B-allreduce regime to
-# 100 MB CNN gradient buckets.
+# 100 MB CNN gradient buckets.  The compression axis is the CompressionMode
+# codes by wire aggressiveness; it is searchable only when the job opted
+# into compression (HVD_TPU_COMPRESSION != off) — the tuner must never
+# turn a lossy wire format on for a job that asked for exact fp32.
 FUSION_GRID: Tuple[int, ...] = tuple(
     v << 10 for v in (64, 256, 1024, 4096, 16384, 65536, 262144))
 CYCLE_GRID_MS: Tuple[float, ...] = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0)
+COMPRESSION_GRID: Tuple[str, ...] = ("off", "bf16", "fp8")
 
 # Knob names accepted by HVD_TPU_AUTOTUNE_FIX (and their report keys).
-KNOBS = ("fusion_threshold", "cycle_time_ms")
+KNOBS = ("fusion_threshold", "cycle_time_ms", "compression")
 
 
-def parse_fix(spec: str) -> Tuple[int, float]:
+def parse_fix(spec: str) -> Tuple[int, float, int]:
     """Parse ``HVD_TPU_AUTOTUNE_FIX`` ("k=v,..." with knobs from
     :data:`KNOBS`) into the engine's pin values ``(fix_fusion_bytes,
-    fix_cycle_ms)``; -1 means "tune this knob".  Raises ``ValueError`` on
-    unknown knobs or unparsable/negative values — a silently dropped pin
-    would tune a knob the user asked to hold."""
-    fix_fusion, fix_cycle = -1, -1.0
+    fix_cycle_ms, fix_compression_code)``; -1 means "tune this knob".
+    Raises ``ValueError`` on unknown knobs or unparsable/negative values
+    — a silently dropped pin would tune a knob the user asked to hold."""
+    from horovod_tpu.common.config import parse_compression
+
+    fix_fusion, fix_cycle, fix_comp = -1, -1.0, -1
     for clause in (spec or "").split(","):
         clause = clause.strip()
         if not clause:
@@ -54,6 +60,14 @@ def parse_fix(spec: str) -> Tuple[int, float]:
             raise ValueError(
                 f"HVD_TPU_AUTOTUNE_FIX: bad clause {clause!r} (want "
                 f"k=v with k in {KNOBS})")
+        if key == "compression":
+            try:
+                fix_comp = parse_compression(value)
+            except ValueError:
+                raise ValueError(
+                    f"HVD_TPU_AUTOTUNE_FIX: bad value in {clause!r} "
+                    f"(want compression=off|bf16|fp8)") from None
+            continue
         try:
             num = float(value)
         except ValueError:
@@ -66,7 +80,7 @@ def parse_fix(spec: str) -> Tuple[int, float]:
             fix_fusion = int(num)
         else:
             fix_cycle = num
-    return fix_fusion, fix_cycle
+    return fix_fusion, fix_cycle, fix_comp
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,10 +116,18 @@ def _cycle_ms(us: str) -> float:
     return int(us) / 1000.0
 
 
+def _comp_name(code: str) -> str:
+    from horovod_tpu.common.config import COMPRESSION_NAMES
+
+    return COMPRESSION_NAMES.get(int(code), code)
+
+
 _HISTORY_FIELDS = (("window", int), ("fusion_threshold", int),
-                   ("cycle_time_ms", _cycle_ms), ("score", float))
+                   ("cycle_time_ms", _cycle_ms),
+                   ("compression", _comp_name), ("score", float))
 _APPLIED_FIELDS = (("tick", int), ("fusion_threshold", int),
                    ("cycle_time_ms", _cycle_ms),
+                   ("compression", _comp_name),
                    ("frozen", lambda v: v == "1"))
 
 
@@ -115,12 +137,16 @@ def report(lib) -> dict:
     healthy job), freeze state, and the coordinator's per-window search
     history.  Workers see an empty ``history`` (the search runs at rank
     0) but a full ``applied`` log."""
+    from horovod_tpu.common.config import COMPRESSION_NAMES
+
     return {
         "enabled": bool(lib.hvd_tpu_autotune_enabled()),
         "frozen": bool(lib.hvd_tpu_autotune_frozen()),
         "windows": int(lib.hvd_tpu_autotune_windows()),
         "fusion_threshold": int(lib.hvd_tpu_autotune_fusion_threshold()),
         "cycle_time_ms": int(lib.hvd_tpu_autotune_cycle_time_us()) / 1000.0,
+        "compression": COMPRESSION_NAMES.get(
+            int(lib.hvd_tpu_compression_mode()), "off"),
         "best_score": float(lib.hvd_tpu_autotune_best_score()),
         "history": _parse_log(
             lib.hvd_tpu_autotune_history().decode(), _HISTORY_FIELDS),
@@ -134,25 +160,40 @@ def empty_report() -> dict:
     ``metrics_snapshot()["autotune"]`` structurally stable (ungated)."""
     return {"enabled": False, "frozen": False, "windows": 0,
             "fusion_threshold": 0, "cycle_time_ms": 0.0,
-            "best_score": 0.0, "history": [], "applied": []}
+            "compression": "off", "best_score": 0.0,
+            "history": [], "applied": []}
 
 
 def set_params(lib, fusion_threshold: Optional[int] = None,
-               cycle_time_ms: Optional[float] = None) -> None:
+               cycle_time_ms: Optional[float] = None,
+               compression: Optional[str] = None) -> None:
     """Inject parameters for lockstep broadcast at the next tick (rank 0
     only — the coordinator owns the broadcast).  The engine applies them
     on every rank at the same tick boundary, exactly like search
     candidates; a live search resumes from the nearest grid point."""
-    if fusion_threshold is None and cycle_time_ms is None:
+    from horovod_tpu.common.config import parse_compression
+
+    if (fusion_threshold is None and cycle_time_ms is None
+            and compression is None):
         raise ValueError(
-            "autotune_set: provide fusion_threshold and/or cycle_time_ms")
+            "autotune_set: provide fusion_threshold, cycle_time_ms, "
+            "and/or compression")
     if fusion_threshold is not None and int(fusion_threshold) < 0:
         raise ValueError("autotune_set: fusion_threshold must be >= 0")
     if cycle_time_ms is not None and float(cycle_time_ms) < 0:
         raise ValueError("autotune_set: cycle_time_ms must be >= 0")
+    comp_code = -1
+    if compression is not None:
+        try:
+            comp_code = parse_compression(compression)
+        except ValueError:
+            raise ValueError(
+                f"autotune_set: unknown compression mode {compression!r} "
+                f"(want off, bf16, or fp8)") from None
     rc = lib.hvd_tpu_autotune_set(
         -1 if fusion_threshold is None else int(fusion_threshold),
-        -1.0 if cycle_time_ms is None else float(cycle_time_ms))
+        -1.0 if cycle_time_ms is None else float(cycle_time_ms),
+        comp_code)
     if rc == 1:
         raise ValueError(
             "autotune_set: only rank 0 (the coordinator) can inject "

@@ -29,6 +29,25 @@ def _flag(value: Optional[str]) -> bool:
     return value is not None and value.strip().lower() not in _FALSY
 
 
+# Wire-compression mode spellings -> engine CompressionMode codes
+# (engine/cc/wire.h; mirrored by the XLA plane's jnp casts).
+COMPRESSION_CODES = {"off": 0, "none": 0, "0": 0, "": 0,
+                     "bf16": 1, "bfloat16": 1,
+                     "fp8": 2, "fp8_e4m3": 2, "float8_e4m3fn": 2}
+COMPRESSION_NAMES = {0: "off", 1: "bf16", 2: "fp8"}
+
+
+def parse_compression(value: Optional[str]) -> int:
+    """``HVD_TPU_COMPRESSION`` spelling -> CompressionMode code; raises
+    ``ValueError`` on an unknown mode."""
+    key = (value or "off").strip().lower()
+    if key not in COMPRESSION_CODES:
+        raise ValueError(
+            f"HVD_TPU_COMPRESSION: unknown wire-compression mode {value!r} "
+            f"(want off, bf16, or fp8)")
+    return COMPRESSION_CODES[key]
+
+
 @dataclasses.dataclass(frozen=True)
 class Config:
     fusion_threshold: int = DEFAULT_FUSION_THRESHOLD
@@ -98,6 +117,18 @@ class Config:
     elastic: bool = False
     min_np: int = 1
     rejoin: bool = False
+    # Wire-level gradient compression (docs/performance.md
+    # #wire-compression).  HVD_TPU_COMPRESSION=off|bf16|fp8: fp32
+    # allreduce buckets of at least `compression_min_bytes` transfer as
+    # bf16 (2x fewer wire bytes) or fp8-e4m3 (4x) with fp32 master copies
+    # and per-tensor error-feedback residuals; reduction still
+    # accumulates in f32 at every ring hop.  Agreed job-wide at init (a
+    # mixed-env launch is a typed error), kill-switched by "off" (the
+    # default — the fp32 wire stays bit-identical), and exposed to the
+    # autotuner as a third axis (HVD_TPU_AUTOTUNE_FIX=compression=...
+    # pins it).  f16/bf16 payloads ship at native width regardless.
+    compression: str = "off"
+    compression_min_bytes: int = 1024
     # Postmortem plane (docs/troubleshooting.md#reading-a-postmortem).
     # HVD_TPU_POSTMORTEM_DIR: directory each rank writes its
     # rank-<N>.json crash/hang dump into on typed aborts, injected
@@ -107,6 +138,13 @@ class Config:
     # plane's Python ring alike); 0 disables recording.
     postmortem_dir: str = ""
     flight_events: int = 512
+
+    @property
+    def compression_code(self) -> int:
+        """The engine's CompressionMode code for ``compression``
+        (engine/cc/wire.h).  Raises ``ValueError`` on an unknown
+        spelling — a typo must not silently run uncompressed."""
+        return parse_compression(self.compression)
 
     @property
     def effective_cache_capacity(self) -> int:
@@ -160,6 +198,9 @@ class Config:
             autotune_window=int(os.environ.get(
                 "HVD_TPU_AUTOTUNE_WINDOW") or 32),
             autotune_fix=os.environ.get("HVD_TPU_AUTOTUNE_FIX", ""),
+            compression=os.environ.get("HVD_TPU_COMPRESSION", "off"),
+            compression_min_bytes=int(os.environ.get(
+                "HVD_TPU_COMPRESSION_MIN_BYTES") or 1024),
             elastic=_flag(os.environ.get("HVD_TPU_ELASTIC")),
             min_np=int(os.environ.get("HVD_TPU_MIN_NP") or 1),
             rejoin=_flag(os.environ.get("HVD_TPU_REJOIN")),

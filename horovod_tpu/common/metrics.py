@@ -185,6 +185,21 @@ class MetricsRegistry:
         # Ungated, like stalls: postmortem tests assert on it without
         # enabling full metrics.
         self._flight = {"events": {p: 0 for p in PLANES}, "capacity": 0}
+        # Wire compression (docs/performance.md#wire-compression): the
+        # applied mode, per-plane wire-vs-payload byte totals with
+        # per-mode bucket counts, and the error-feedback residual gauges,
+        # mirrored from both data planes on every snapshot.  Ungated,
+        # like stalls: compression tests assert bytes ratios without
+        # enabling full metrics.  Wire bytes count each allreduce bucket
+        # at its on-wire width, payload bytes at the caller dtype's
+        # width — the pair is what "2x fewer bytes" claims are made of.
+        self._compression = {
+            "mode": "off", "min_bytes": 0,
+            "planes": {p: {"wire_bytes": 0, "payload_bytes": 0,
+                           "ops": {"none": 0, "bf16": 0, "fp8": 0}}
+                       for p in PLANES},
+            "residual_bytes": 0, "residual_tensors": 0,
+        }
         self._hists = {name: Histogram(bounds)
                        for name, (bounds, _) in HISTOGRAMS.items()}
 
@@ -270,6 +285,28 @@ class MetricsRegistry:
         with self._lock:
             self._flight = {"events": dict(state.get("events", {})),
                             "capacity": int(state.get("capacity", 0))}
+
+    def set_compression(self, state: dict) -> None:
+        """Mirror the wire-compression state of both data planes (a state
+        copy — the underlying counters are cumulative, so overwriting is
+        idempotent, like the membership mirror).  Ungated."""
+        with self._lock:
+            planes = {}
+            for plane in PLANES:
+                entry = dict(state.get("planes", {}).get(plane, {}))
+                planes[plane] = {
+                    "wire_bytes": int(entry.get("wire_bytes", 0)),
+                    "payload_bytes": int(entry.get("payload_bytes", 0)),
+                    "ops": {m: int(entry.get("ops", {}).get(m, 0))
+                            for m in ("none", "bf16", "fp8")},
+                }
+            self._compression = {
+                "mode": str(state.get("mode", "off")),
+                "min_bytes": int(state.get("min_bytes", 0)),
+                "planes": planes,
+                "residual_bytes": int(state.get("residual_bytes", 0)),
+                "residual_tensors": int(state.get("residual_tensors", 0)),
+            }
 
     def set_autotune(self, report: dict) -> None:
         """Mirror the engine's autotuning report (a state copy — the
@@ -394,6 +431,18 @@ class MetricsRegistry:
                 "flight": {
                     "events": dict(self._flight["events"]),
                     "capacity": self._flight["capacity"],
+                },
+                "compression": {
+                    "mode": self._compression["mode"],
+                    "min_bytes": self._compression["min_bytes"],
+                    "planes": {p: {"wire_bytes": v["wire_bytes"],
+                                   "payload_bytes": v["payload_bytes"],
+                                   "ops": dict(v["ops"])}
+                               for p, v in
+                               self._compression["planes"].items()},
+                    "residual_bytes": self._compression["residual_bytes"],
+                    "residual_tensors":
+                        self._compression["residual_tensors"],
                 },
                 "histograms": {name: h.to_dict()
                                for name, h in self._hists.items()},
@@ -616,6 +665,38 @@ def prometheus_text(snapshot: dict) -> str:
                "(HVD_TPU_FLIGHT_EVENTS; 0 = disabled)")
     out.append("# TYPE hvd_tpu_flight_ring_capacity gauge")
     out.append(f"hvd_tpu_flight_ring_capacity {flight.get('capacity', 0)}")
+
+    comp = snapshot.get("compression", {})
+    out.append("# HELP hvd_tpu_compression_mode "
+               "applied wire-compression mode (0=off 1=bf16 2=fp8; "
+               "docs/performance.md#wire-compression)")
+    out.append("# TYPE hvd_tpu_compression_mode gauge")
+    out.append("hvd_tpu_compression_mode "
+               f"{ {'off': 0, 'bf16': 1, 'fp8': 2}.get(comp.get('mode'), 0) }")
+    out.append("# HELP hvd_tpu_compression_wire_bytes_total "
+               "allreduce bucket bytes at on-wire width")
+    out.append("# TYPE hvd_tpu_compression_wire_bytes_total counter")
+    for plane, entry in comp.get("planes", {}).items():
+        out.append(f'hvd_tpu_compression_wire_bytes_total{{plane='
+                   f'"{plane}"}} {entry.get("wire_bytes", 0)}')
+    out.append("# HELP hvd_tpu_compression_payload_bytes_total "
+               "allreduce bucket bytes at caller-dtype width")
+    out.append("# TYPE hvd_tpu_compression_payload_bytes_total counter")
+    for plane, entry in comp.get("planes", {}).items():
+        out.append(f'hvd_tpu_compression_payload_bytes_total{{plane='
+                   f'"{plane}"}} {entry.get("payload_bytes", 0)}')
+    out.append("# HELP hvd_tpu_compression_ops_total "
+               "allreduce buckets executed per wire mode")
+    out.append("# TYPE hvd_tpu_compression_ops_total counter")
+    for plane, entry in comp.get("planes", {}).items():
+        for mode, n in entry.get("ops", {}).items():
+            out.append(f'hvd_tpu_compression_ops_total{{plane="{plane}",'
+                       f'mode="{mode}"}} {n}')
+    out.append("# HELP hvd_tpu_compression_residual_bytes "
+               "error-feedback residual buffer bytes held")
+    out.append("# TYPE hvd_tpu_compression_residual_bytes gauge")
+    out.append("hvd_tpu_compression_residual_bytes "
+               f"{comp.get('residual_bytes', 0)}")
 
     skew = snapshot.get("skew", {})
     out.append("# HELP hvd_tpu_announce_total "

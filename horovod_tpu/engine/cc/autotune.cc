@@ -15,6 +15,9 @@ const std::vector<int64_t> kFusionGrid = {
     64 << 10, 256 << 10, 1 << 20, 4 << 20, 16 << 20, 64 << 20, 256 << 20};
 const std::vector<double> kCycleGridMs = {0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
                                           50.0};
+// CompressionMode codes ordered by wire aggressiveness (none 0, bf16 1,
+// fp8 2): climbing +1 moves fewer bytes per bucket.
+const std::vector<int64_t> kCompressionGrid = {0, 1, 2};
 
 namespace {
 
@@ -54,8 +57,10 @@ int SnapLog(const std::vector<T>& grid, double value) {
 
 void ParameterManager::Configure(bool enabled, int64_t warmup_windows,
                                  int64_t window_ops, int64_t fix_fusion,
-                                 double fix_cycle_ms, int64_t init_fusion,
-                                 double init_cycle_ms) {
+                                 double fix_cycle_ms,
+                                 int64_t fix_compression,
+                                 int64_t init_fusion, double init_cycle_ms,
+                                 int64_t init_compression) {
   std::lock_guard<std::mutex> lk(mu_);
   enabled_ = enabled;
   done_ = !enabled;
@@ -65,14 +70,23 @@ void ParameterManager::Configure(bool enabled, int64_t warmup_windows,
                                  : kFusionGrid;
   axes_cycle_ = fix_cycle_ms >= 0 ? std::vector<double>{fix_cycle_ms}
                                   : kCycleGridMs;
+  axes_comp_ = fix_compression >= 0
+                   ? std::vector<int64_t>{fix_compression}
+                   : kCompressionGrid;
   init_fusion_ = init_fusion;
   init_cycle_ms_ = init_cycle_ms;
+  init_comp_ = init_compression;
   idx_[0] = SnapLog(axes_fusion_, static_cast<double>(init_fusion));
   idx_[1] = SnapLog(axes_cycle_, init_cycle_ms);
+  idx_[2] = 0;
+  for (size_t i = 0; i < axes_comp_.size(); ++i)
+    if (axes_comp_[i] == init_compression) idx_[2] = static_cast<int>(i);
   // Cycle first, climbing down: the idle-cadence co-arrival sleep is the
   // dominant knob for the negotiation-bound steady state (docs/
   // performance.md), and a too-high cycle drowns any fusion signal.
-  axis_ = axes_cycle_.size() > 1 ? 1 : 0;
+  axis_ = axes_cycle_.size() > 1 ? 1
+          : axes_fusion_.size() > 1 ? 0
+                                    : 2;
   dir_ = axis_ == 1 ? -1 : +1;
   tried_flip_ = false;
   have_anchor_ = false;
@@ -107,37 +121,44 @@ ParameterManager::Proposal ParameterManager::MakeProposal(bool frozen) {
   p.frozen = frozen;
   p.fusion_threshold = GridFusion();
   p.cycle_time_us = static_cast<int64_t>(GridCycleMs() * 1000.0);
+  p.compression = GridCompression();
   std::lock_guard<std::mutex> lk(mu_);
   p.window = windows_;
   return p;
 }
 
-void ParameterManager::Inject(int64_t fusion, double cycle_ms) {
+void ParameterManager::Inject(int64_t fusion, double cycle_ms,
+                              int64_t compression) {
   std::lock_guard<std::mutex> lk(mu_);
   inject_pending_ = true;
   inject_fusion_ = fusion;
   inject_cycle_ms_ = cycle_ms;
+  inject_comp_ = compression;
 }
 
 void ParameterManager::Tick(std::chrono::steady_clock::time_point now,
                             int64_t cur_fusion, double cur_cycle_ms,
-                            Proposal* out) {
+                            int64_t cur_compression, Proposal* out) {
   {
     // Manual injection (hvd.autotune_set) broadcasts exactly the caller's
     // values this tick — works with the tuner disabled or frozen (the
     // pluggable-policy seam).  The search, if live, resumes from the
     // nearest grid point with a fresh window.  An unset knob keeps the
     // engine's applied value, NOT a grid snap — injecting one knob must
-    // not silently move the other.
+    // not silently move the others.
     std::lock_guard<std::mutex> lk(mu_);
     if (inject_pending_) {
       inject_pending_ = false;
       int64_t fusion = inject_fusion_ >= 0 ? inject_fusion_ : cur_fusion;
       double cycle = inject_cycle_ms_ >= 0 ? inject_cycle_ms_
                                            : cur_cycle_ms;
+      int64_t comp = inject_comp_ >= 0 ? inject_comp_ : cur_compression;
       if (inject_fusion_ >= 0)
         idx_[0] = SnapLog(axes_fusion_, static_cast<double>(fusion));
       if (inject_cycle_ms_ >= 0) idx_[1] = SnapLog(axes_cycle_, cycle);
+      if (inject_comp_ >= 0)
+        for (size_t i = 0; i < axes_comp_.size(); ++i)
+          if (axes_comp_[i] == comp) idx_[2] = static_cast<int>(i);
       have_anchor_ = false;
       tried_flip_ = false;
       // De-anchor: the next window runs under the EXACT injected values,
@@ -153,6 +174,7 @@ void ParameterManager::Tick(std::chrono::steady_clock::time_point now,
       out->frozen = enabled_ && done_;
       out->fusion_threshold = fusion;
       out->cycle_time_us = static_cast<int64_t>(cycle * 1000.0);
+      out->compression = comp;
       out->window = windows_;
       return;
     }
@@ -180,11 +202,13 @@ void ParameterManager::CloseWindow(double score, Proposal* out) {
     // values, not the grid point they snap to.
     int64_t fus = anchored_ ? GridFusion() : init_fusion_;
     double cyc = anchored_ ? GridCycleMs() : init_cycle_ms_;
-    char buf[96];
-    snprintf(buf, sizeof(buf), "%lld|%lld|%lld|%.1f",
+    int64_t cmp = anchored_ ? GridCompression() : init_comp_;
+    char buf[112];
+    snprintf(buf, sizeof(buf), "%lld|%lld|%lld|%lld|%.1f",
              static_cast<long long>(windows_),
              static_cast<long long>(fus),
-             static_cast<long long>(cyc * 1000.0), score);
+             static_cast<long long>(cyc * 1000.0),
+             static_cast<long long>(cmp), score);
     history_.emplace_back(buf);
     while (history_.size() > kHistoryCap) history_.pop_front();
   }
@@ -209,8 +233,9 @@ void ParameterManager::CloseWindow(double score, Proposal* out) {
 
 void ParameterManager::BroadcastAnchor(Proposal* out) {
   anchored_ = true;
-  if (axes_fusion_.size() == 1 && axes_cycle_.size() == 1) {
-    // Both knobs pinned: nothing to search.  Broadcast the pinned point
+  if (axes_fusion_.size() == 1 && axes_cycle_.size() == 1 &&
+      axes_comp_.size() == 1) {
+    // Every knob pinned: nothing to search.  Broadcast the pinned point
     // once, frozen.
     FreezeAtBest(out);
   } else {
@@ -219,7 +244,7 @@ void ParameterManager::BroadcastAnchor(Proposal* out) {
 }
 
 void ParameterManager::Step(double score, Proposal* out) {
-  std::pair<int, int> point{idx_[0], idx_[1]};
+  std::array<int, 3> point{{idx_[0], idx_[1], idx_[2]}};
   auto& mem = memory_[point];
   mem.first += score;
   mem.second += 1;
@@ -286,8 +311,9 @@ void ParameterManager::Step(double score, Proposal* out) {
 }
 
 bool ParameterManager::MoveOn(int axis, int dir) {
-  int n = axis == 0 ? static_cast<int>(axes_fusion_.size())
-                    : static_cast<int>(axes_cycle_.size());
+  int n = axis == 0   ? static_cast<int>(axes_fusion_.size())
+          : axis == 1 ? static_cast<int>(axes_cycle_.size())
+                      : static_cast<int>(axes_comp_.size());
   int next = idx_[axis] + dir;
   if (next < 0 || next >= n) return false;
   idx_[axis] = next;
@@ -295,12 +321,13 @@ bool ParameterManager::MoveOn(int axis, int dir) {
 }
 
 void ParameterManager::SwitchAxis(double last_score) {
-  // Hand the climb to the other knob; the measurement of the CURRENT
+  // Hand the climb to the next knob; the measurement of the CURRENT
   // point becomes its anchor, so no window is wasted re-measuring.
-  for (int attempt = 0; attempt < 2; ++attempt) {
-    axis_ = 1 - axis_;
-    // Heuristic first direction: bigger fusion buckets, tighter cycle.
-    dir_ = axis_ == 0 ? +1 : -1;
+  for (int attempt = 0; attempt < 3; ++attempt) {
+    axis_ = (axis_ + 1) % 3;
+    // Heuristic first direction: bigger fusion buckets, tighter cycle,
+    // more aggressive wire compression.
+    dir_ = axis_ == 1 ? -1 : +1;
     have_anchor_ = true;
     anchor_score_ = last_score;
     anchor_idx_ = idx_[axis_];
@@ -310,9 +337,9 @@ void ParameterManager::SwitchAxis(double last_score) {
       dir_ = -dir_;
       return;
     }
-    // This axis is pinned (single-point grid); try the other one.
+    // This axis is pinned (single-point grid); try the next one.
   }
-  // Neither knob can move: the search space is exhausted.
+  // No knob can move: the search space is exhausted.
   done_ = true;
 }
 
@@ -322,7 +349,7 @@ void ParameterManager::FreezeAtBest(Proposal* out) {
   // view), so a run of small accepted moves can leave the real best only
   // in memory_; means, not maxes, keep one lucky window from deciding
   // the job's permanent parameters.
-  const std::pair<int, int>* argmax = nullptr;
+  const std::array<int, 3>* argmax = nullptr;
   double argmax_score = 0.0;
   for (const auto& kv : memory_) {
     double mean = kv.second.first / kv.second.second;
@@ -332,16 +359,18 @@ void ParameterManager::FreezeAtBest(Proposal* out) {
     }
   }
   if (argmax != nullptr) {
-    idx_[0] = argmax->first;
-    idx_[1] = argmax->second;
+    idx_[0] = (*argmax)[0];
+    idx_[1] = (*argmax)[1];
+    idx_[2] = (*argmax)[2];
     // The reported best score must describe the FROZEN point: assign the
     // argmax mean outright — best_score_ may hold a lucky spike from a
     // point the mean ranking rejected.
     std::lock_guard<std::mutex> lk(mu_);
     best_score_ = argmax_score;
   } else if (have_best_) {
-    idx_[0] = best_point_.first;
-    idx_[1] = best_point_.second;
+    idx_[0] = best_point_[0];
+    idx_[1] = best_point_[1];
+    idx_[2] = best_point_[2];
   }
   done_ = true;
   *out = MakeProposal(true);

@@ -26,6 +26,7 @@
 // threads and are guarded by an internal mutex.
 #pragma once
 
+#include <array>
 #include <chrono>
 #include <cstdint>
 #include <deque>
@@ -41,6 +42,11 @@ namespace hvdtpu {
 // (horovod_tpu/common/autotune.py) for docs and tests — keep in sync.
 extern const std::vector<int64_t> kFusionGrid;   // bytes
 extern const std::vector<double> kCycleGridMs;   // milliseconds
+// Wire-compression axis (CompressionMode codes, none -> bf16 -> fp8):
+// searched only when the job opted into compression at init — the tuner
+// must never turn a lossy wire format on for a job that asked for exact
+// fp32 (engine.cc pins the axis at the env value in that case).
+extern const std::vector<int64_t> kCompressionGrid;
 
 class ParameterManager {
  public:
@@ -49,15 +55,17 @@ class ParameterManager {
     bool frozen = false;
     int64_t fusion_threshold = 0;
     int64_t cycle_time_us = 0;
+    int64_t compression = 0;  // CompressionMode code
     int64_t window = 0;  // completed-window count when proposed
   };
 
-  // `fix_fusion` / `fix_cycle_ms` pin a knob (< 0 = tune it); the initial
-  // values seed the search (snapped to the nearest grid point in log
-  // space at the first post-warmup broadcast).
+  // `fix_fusion` / `fix_cycle_ms` / `fix_compression` pin a knob (< 0 =
+  // tune it); the initial values seed the search (snapped to the nearest
+  // grid point in log space at the first post-warmup broadcast).
   void Configure(bool enabled, int64_t warmup_windows, int64_t window_ops,
                  int64_t fix_fusion, double fix_cycle_ms,
-                 int64_t init_fusion, double init_cycle_ms);
+                 int64_t fix_compression, int64_t init_fusion,
+                 double init_cycle_ms, int64_t init_compression);
 
   bool enabled() const { return enabled_; }
   // Still searching: windows are being scored and candidates proposed.
@@ -72,17 +80,17 @@ class ParameterManager {
   // Rank 0, once per engine tick: closes the window when due and fills
   // `out` with the next candidate (or the freeze verdict).  `out->present`
   // stays false on ticks with nothing to broadcast.  `cur_fusion` /
-  // `cur_cycle_ms` are the engine's currently APPLIED values — a manual
-  // injection that sets only one knob keeps the other at its applied
-  // value (which need not be a grid point).
+  // `cur_cycle_ms` / `cur_compression` are the engine's currently APPLIED
+  // values — a manual injection that sets only some knobs keeps the
+  // others at their applied values (which need not be grid points).
   void Tick(std::chrono::steady_clock::time_point now, int64_t cur_fusion,
-            double cur_cycle_ms, Proposal* out);
+            double cur_cycle_ms, int64_t cur_compression, Proposal* out);
 
   // Manual injection (hvd.autotune_set, the pluggable-policy seam): the
   // injected values are broadcast on the next tick and the search state
   // snaps to the nearest grid point so a resumed search continues from
   // there.  Values < 0 keep the current value for that knob.
-  void Inject(int64_t fusion, double cycle_ms);
+  void Inject(int64_t fusion, double cycle_ms, int64_t compression);
 
   // Observability (any thread).
   int64_t windows() const;
@@ -94,6 +102,7 @@ class ParameterManager {
  private:
   int64_t GridFusion() const { return axes_fusion_[idx_[0]]; }
   double GridCycleMs() const { return axes_cycle_[idx_[1]]; }
+  int64_t GridCompression() const { return axes_comp_[idx_[2]]; }
   Proposal MakeProposal(bool frozen);
   // Broadcast the snapped anchor point (or the freeze verdict when both
   // knobs are pinned); the measured score of the window that triggered
@@ -115,11 +124,13 @@ class ParameterManager {
 
   std::vector<int64_t> axes_fusion_;
   std::vector<double> axes_cycle_;
+  std::vector<int64_t> axes_comp_;
   // Raw initial env values — what warmup windows actually run under
   // (the applied params change only at the first broadcast).
   int64_t init_fusion_ = 0;
   double init_cycle_ms_ = 0.0;
-  int idx_[2] = {0, 0};        // current grid point (fusion, cycle)
+  int64_t init_comp_ = 0;
+  int idx_[3] = {0, 0, 0};     // current grid point (fusion, cycle, comp)
   int axis_ = 1;               // knob being climbed (cycle first: the
                                // idle-cadence win is the common case)
   int dir_ = -1;               // climb direction on axis_
@@ -138,8 +149,8 @@ class ParameterManager {
   // The freeze verdict takes the argmax of per-point MEANS — repeated
   // visits (anchors are re-measured on every axis switch) average out
   // window noise instead of keeping a lucky spike.
-  std::map<std::pair<int, int>, std::pair<double, int>> memory_;
-  std::pair<int, int> best_point_{0, 0};
+  std::map<std::array<int, 3>, std::pair<double, int>> memory_;
+  std::array<int, 3> best_point_{{0, 0, 0}};
   bool have_best_ = false;
   int stall_windows_ = 0;
 
@@ -148,6 +159,7 @@ class ParameterManager {
   bool inject_pending_ = false;
   int64_t inject_fusion_ = -1;
   double inject_cycle_ms_ = -1.0;
+  int64_t inject_comp_ = -1;
 
   int64_t windows_ = 0;
   double best_score_ = 0.0;

@@ -47,7 +47,9 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
                  long long autotune_warmup, long long autotune_window,
                  long long autotune_fix_fusion,
                  double autotune_fix_cycle_ms, int elastic,
-                 long long min_size, int rejoin) {
+                 long long min_size, int rejoin, int compression_mode,
+                 long long compression_min_bytes,
+                 long long autotune_fix_compression) {
   EngineOptions opts;
   opts.rank = rank;
   opts.size = size;
@@ -70,6 +72,10 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
   opts.elastic = elastic != 0;
   opts.min_size = min_size > 0 ? min_size : 1;
   opts.rejoin = rejoin != 0;
+  opts.compression_mode = static_cast<uint8_t>(compression_mode);
+  opts.compression_min_bytes =
+      compression_min_bytes >= 0 ? compression_min_bytes : 0;
+  opts.autotune_fix_compression = autotune_fix_compression;
   std::string err;
   int rc = GlobalEngine()->Init(opts, &err);
   if (rc != 0) {
@@ -318,10 +324,12 @@ const char* hvd_tpu_autotune_applied() {
 }
 
 // Manual parameter injection (hvd.autotune_set; the pluggable-policy
-// seam): broadcast fusion/cycle (< 0 keeps the current value) at the next
-// tick.  0 ok, 1 not-the-coordinator, 2 uninitialized.
-int hvd_tpu_autotune_set(long long fusion_threshold, double cycle_time_ms) {
-  return GlobalEngine()->AutotuneInject(fusion_threshold, cycle_time_ms);
+// seam): broadcast fusion/cycle/compression (< 0 keeps the current
+// value) at the next tick.  0 ok, 1 not-the-coordinator, 2 uninitialized.
+int hvd_tpu_autotune_set(long long fusion_threshold, double cycle_time_ms,
+                         long long compression) {
+  return GlobalEngine()->AutotuneInject(fusion_threshold, cycle_time_ms,
+                                        compression);
 }
 
 // Fusion threshold in force at engine tick `tick` (the XLA plane keys its
@@ -329,6 +337,36 @@ int hvd_tpu_autotune_set(long long fusion_threshold, double cycle_time_ms) {
 // lockstep across ranks).
 long long hvd_tpu_fusion_threshold_at(long long tick) {
   return GlobalEngine()->FusionThresholdAt(tick);
+}
+
+// Wire compression (docs/performance.md#wire-compression).  The applied
+// mode is lockstep-broadcast state, identical on every rank of a healthy
+// job; the _at(tick) form serves the XLA plane's per-tick lookup the way
+// hvd_tpu_fusion_threshold_at does for bucket boundaries.
+int hvd_tpu_compression_mode() {
+  return GlobalEngine()->CompressionModeNow();
+}
+
+long long hvd_tpu_compression_mode_at(long long tick) {
+  return GlobalEngine()->CompressionModeAt(tick);
+}
+
+// "wire|payload|ops_none|ops_bf16|ops_fp8|residual_bytes|
+//  residual_tensors|min_bytes" — process-cumulative byte/op counters for
+// the Python metrics sync, plus the residual-buffer gauges.
+const char* hvd_tpu_compression_info() {
+  static thread_local std::string tl_compression_info;
+  tl_compression_info = GlobalEngine()->CompressionInfo();
+  return tl_compression_info.c_str();
+}
+
+// Bounded per-bucket decision log, "first_name|mode;..." in execution
+// order — identical across the ranks of a healthy job (the lockstep
+// contract tests allgather-compare).
+const char* hvd_tpu_compression_log() {
+  static thread_local std::string tl_compression_log;
+  tl_compression_log = GlobalEngine()->CompressionLog();
+  return tl_compression_log.c_str();
 }
 
 // Elastic-membership observability and control

@@ -3,8 +3,10 @@
 #include <string.h>
 
 #include <algorithm>
+#include <cmath>
 #include <cstdio>
 #include <cstdlib>
+#include <limits>
 
 #include "net.h"
 
@@ -174,6 +176,132 @@ uint16_t FloatToBf16(float f) {
     return static_cast<uint16_t>((bits >> 16) | 0x40);  // quiet nan
   uint32_t rounded = bits + 0x7fffu + ((bits >> 16) & 1);
   return static_cast<uint16_t>(rounded >> 16);
+}
+
+// ---------------------------------------------------------------------------
+// fp8-e4m3fn (4 exponent bits, bias 7, 3 mantissa bits; no inf, 0x7f/0xff
+// = nan) — the ml_dtypes.float8_e4m3fn layout the XLA plane mirrors with
+// jnp casts.  Encoding SATURATES at ±448 instead of producing nan (the
+// gradient-compression convention: one clipped outlier must not poison a
+// whole fused bucket); the Python plane clips before casting for the same
+// reason, so both planes quantize identically.
+// ---------------------------------------------------------------------------
+
+constexpr float kFp8Max = 448.0f;
+
+uint8_t FloatToFp8(float f) {
+  // Branchy bit-twiddled round-to-nearest-even (the hot loop of the fp8
+  // wire path runs this per element; the frexp/nearbyint formulation it
+  // replaced was 5x slower end to end).
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  uint8_t sign = static_cast<uint8_t>((bits >> 24) & 0x80u);
+  uint32_t a = bits & 0x7fffffffu;
+  if (a > 0x7f800000u) return sign | 0x7f;   // nan
+  if (a >= 0x43e00000u) return sign | 0x7e;  // >= 448: saturate (no inf)
+  if (a < 0x3c800000u) {
+    // < 2^-6: subnormal grid, quantum 2^-9.  lrintf under the default
+    // FE_TONEAREST mode is round-to-nearest-even, matching ml_dtypes;
+    // a result of 8 lands exactly on the smallest normal (0x08).
+    float av;
+    memcpy(&av, &a, 4);
+    return sign | static_cast<uint8_t>(lrintf(av * 512.0f));
+  }
+  // Normal: RNE the 23-bit mantissa down to 3 bits in the integer
+  // domain, then rebias the exponent (127 -> 7).  Mantissa carry-out
+  // propagates into the exponent arithmetically; a carry past 448
+  // saturates.
+  uint32_t rounded = a + 0x7ffffu + ((a >> 20) & 1u);
+  if (rounded >= 0x43e00000u) return sign | 0x7e;
+  uint32_t exp8 = ((rounded >> 23) & 0xffu) - 120u;
+  return sign | static_cast<uint8_t>((exp8 << 3) | ((rounded >> 20) & 7u));
+}
+
+float Fp8ToFloat(uint8_t b) {
+  static const std::vector<float> table = [] {
+    std::vector<float> t(256);
+    for (int i = 0; i < 256; ++i) {
+      int exp = (i >> 3) & 0xf;
+      int man = i & 7;
+      float v;
+      if (exp == 15 && man == 7)
+        v = std::numeric_limits<float>::quiet_NaN();
+      else if (exp == 0)
+        v = std::ldexp(static_cast<float>(man), -9);
+      else
+        v = std::ldexp(1.0f + man / 8.0f, exp - 7);
+      t[i] = (i & 0x80) ? -v : v;
+    }
+    return t;
+  }();
+  return table[b];
+}
+
+// ---------------------------------------------------------------------------
+// Wire formats for the compressed ring (docs/performance.md
+// #wire-compression): the reduction buffer stays f32 end to end, these
+// helpers narrow segments at the send boundary and widen them back at the
+// receive boundary.  COMP_* codes double as wire codes for f32 payloads;
+// WIRE_F16 serves native-width f16 payload shipping.
+// ---------------------------------------------------------------------------
+
+constexpr uint8_t WIRE_BF16 = COMP_BF16;
+constexpr uint8_t WIRE_FP8 = COMP_FP8;
+constexpr uint8_t WIRE_F16 = 3;
+
+size_t WireFormatSize(uint8_t wire) { return wire == WIRE_FP8 ? 1 : 2; }
+
+void CompressBuf(const float* src, void* dst, int64_t n, uint8_t wire) {
+  if (wire == WIRE_FP8) {
+    uint8_t* d = static_cast<uint8_t*>(dst);
+    for (int64_t i = 0; i < n; ++i) d[i] = FloatToFp8(src[i]);
+  } else {
+    uint16_t* d = static_cast<uint16_t*>(dst);
+    if (wire == WIRE_F16)
+      for (int64_t i = 0; i < n; ++i) d[i] = FloatToHalf(src[i]);
+    else
+      for (int64_t i = 0; i < n; ++i) d[i] = FloatToBf16(src[i]);
+  }
+}
+
+void DecompressBuf(const void* src, float* dst, int64_t n, uint8_t wire) {
+  if (wire == WIRE_FP8) {
+    const uint8_t* s = static_cast<const uint8_t*>(src);
+    for (int64_t i = 0; i < n; ++i) dst[i] = Fp8ToFloat(s[i]);
+  } else {
+    const uint16_t* s = static_cast<const uint16_t*>(src);
+    if (wire == WIRE_F16)
+      for (int64_t i = 0; i < n; ++i) dst[i] = HalfToFloat(s[i]);
+    else
+      for (int64_t i = 0; i < n; ++i) dst[i] = Bf16ToFloat(s[i]);
+  }
+}
+
+void DecompressAccumulate(const void* src, float* dst, int64_t n,
+                          uint8_t wire) {
+  if (wire == WIRE_FP8) {
+    const uint8_t* s = static_cast<const uint8_t*>(src);
+    for (int64_t i = 0; i < n; ++i) dst[i] += Fp8ToFloat(s[i]);
+  } else {
+    const uint16_t* s = static_cast<const uint16_t*>(src);
+    if (wire == WIRE_F16)
+      for (int64_t i = 0; i < n; ++i) dst[i] += HalfToFloat(s[i]);
+    else
+      for (int64_t i = 0; i < n; ++i) dst[i] += Bf16ToFloat(s[i]);
+  }
+}
+
+// One value's quantize -> dequantize round trip: what the wire will
+// deliver, and therefore what the error-feedback residual is measured
+// against.
+float QuantDequant(float v, uint8_t wire) {
+  if (wire == WIRE_FP8) {
+    if (v > kFp8Max) v = kFp8Max;
+    if (v < -kFp8Max) v = -kFp8Max;
+    return Fp8ToFloat(FloatToFp8(v));
+  }
+  if (wire == WIRE_F16) return HalfToFloat(FloatToHalf(v));
+  return Bf16ToFloat(FloatToBf16(v));
 }
 
 void HalfBufToFloat(const void* src, float* dst, int64_t n, uint8_t dtype) {
@@ -544,14 +672,41 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   cache_.set_capacity(opts_.cache_capacity);
   cache_.Clear();
   cache_size_.store(0);
+  // Wire compression (docs/performance.md#wire-compression): per-engine-
+  // lifetime state.  SetupSockets just validated the mode/min-bytes
+  // agreement job-wide; residuals start empty (a restart epoch must not
+  // replay stale error feedback), and the decision log restarts so the
+  // lockstep-identical contract is testable per lifetime.
+  cur_compression_.store(opts_.compression_mode);
+  cur_comp_min_bytes_.store(opts_.compression_min_bytes);
+  residuals_.clear();
+  residual_bytes_.store(0);
+  residual_tensors_.store(0);
+  {
+    std::lock_guard<std::mutex> lk(comp_mu_);
+    comp_log_.clear();
+  }
+  if (opts_.compression_mode != COMP_NONE && flight_.Enabled())
+    flight_.Record(FL_COMPRESS, "", opts_.compression_mode);
   // Online autotuning (docs/performance.md#autotuning): the search runs
   // at the coordinator only; every rank tracks the applied parameters.
   // State is per-engine-lifetime — a restart epoch re-tunes from its env
   // (the winning params are in the previous run's report for pinning).
+  // The compression axis is searchable only when the job opted into a
+  // lossy wire format: with HVD_TPU_COMPRESSION off the axis pins at
+  // "none" so the tuner can never silently make an exact job lossy.
+  // The two-level topology pins it too — ChooseCompression returns
+  // "none" for every bucket there, so the knob is dead and searching it
+  // would burn windows scoring three identical points.
   tuner_.Configure(opts_.autotune && (opts_.rank == 0 || opts_.size == 1),
                    opts_.autotune_warmup, opts_.autotune_window,
                    opts_.autotune_fix_fusion, opts_.autotune_fix_cycle_ms,
-                   opts_.fusion_threshold, opts_.cycle_time_ms);
+                   opts_.compression_mode == COMP_NONE ||
+                           opts_.hierarchical_allreduce
+                       ? COMP_NONE
+                       : opts_.autotune_fix_compression,
+                   opts_.fusion_threshold, opts_.cycle_time_ms,
+                   opts_.compression_mode);
   cur_fusion_.store(opts_.fusion_threshold);
   cur_cycle_us_.store(static_cast<int64_t>(opts_.cycle_time_ms * 1000.0));
   autotune_frozen_.store(false);
@@ -561,6 +716,8 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
     applied_log_.clear();
     fusion_history_.clear();
     fusion_history_.emplace_back(0, opts_.fusion_threshold);
+    compression_history_.clear();
+    compression_history_.emplace_back(0, opts_.compression_mode);
   }
   last_stall_check_ = std::chrono::steady_clock::now();
   initialized_.store(true);
@@ -663,24 +820,44 @@ bool Engine::SetupSockets(std::string* err) {
     // The 4th slot agrees on the response-cache capacity job-wide (the
     // minimum across ranks — a thrown kill switch anywhere disables it
     // everywhere): per-rank divergence would make a cache-slot index
-    // mean different collectives on different ranks.
+    // mean different collectives on different ranks.  Slots 5/6 carry
+    // the wire-compression config, which must be IDENTICAL on every rank
+    // — a min-reduce would silently weaken a rank's explicit choice, and
+    // a split would make ranks pack the same bucket in different wire
+    // formats; a mismatch is therefore a typed init error, not a vote.
     uint32_t cap32 = static_cast<uint32_t>(std::min<int64_t>(
         std::max<int64_t>(opts_.cache_capacity, 0), 0x7fffffff));
-    uint32_t mine[4] = {(uint32_t)opts_.local_rank, (uint32_t)opts_.local_size,
-                        opts_.hierarchical_allreduce ? 1u : 0u, cap32};
-    uint32_t reply[2] = {0, cap32};  // {hierarchical decision, capacity}
+    uint32_t cmin32 = static_cast<uint32_t>(std::min<int64_t>(
+        std::max<int64_t>(opts_.compression_min_bytes, 0), 0x7fffffff));
+    uint32_t mine[6] = {(uint32_t)opts_.local_rank, (uint32_t)opts_.local_size,
+                        opts_.hierarchical_allreduce ? 1u : 0u, cap32,
+                        (uint32_t)opts_.compression_mode, cmin32};
+    // {hierarchical decision, capacity, compression mismatch flag, pad}
+    uint32_t reply[4] = {0, cap32, 0, 0};
     if (opts_.rank == 0) {
       std::vector<uint32_t> lr(opts_.size), ls(opts_.size), hr(opts_.size);
       lr[0] = mine[0]; ls[0] = mine[1]; hr[0] = mine[2];
       uint32_t agreed_cap = cap32;
+      std::string comp_mismatch;
       for (int r = 1; r < opts_.size; ++r) {
-        uint32_t peer[4];
+        uint32_t peer[6];
         if (!RecvAll(coord_fds_[r], peer, sizeof peer)) {
           *err = "topology agreement recv failed";
           return false;
         }
         lr[r] = peer[0]; ls[r] = peer[1]; hr[r] = peer[2];
         agreed_cap = std::min(agreed_cap, peer[3]);
+        if (comp_mismatch.empty() &&
+            (peer[4] != mine[4] || peer[5] != mine[5]))
+          comp_mismatch =
+              "HVD_TPU_COMPRESSION mismatch: rank 0 configured mode " +
+              std::string(CompressionName(opts_.compression_mode)) +
+              " (min bytes " + std::to_string(cmin32) + ") but rank " +
+              std::to_string(r) + " configured mode " +
+              CompressionName(static_cast<uint8_t>(peer[4])) +
+              " (min bytes " + std::to_string(peer[5]) +
+              "); wire compression must be configured identically on "
+              "every rank.";
       }
       bool want = true, valid = true;
       for (int r = 0; r < opts_.size; ++r) want = want && hr[r] != 0;
@@ -698,16 +875,30 @@ bool Engine::SetupSockets(std::string* err) {
                 "ring.\n");
       reply[0] = (want && valid) ? 1 : 0;
       reply[1] = agreed_cap;
+      reply[2] = comp_mismatch.empty() ? 0 : 1;
       for (int r = 1; r < opts_.size; ++r) {
         if (!SendAll(coord_fds_[r], reply, sizeof reply)) {
           *err = "topology agreement send failed";
           return false;
         }
       }
+      if (!comp_mismatch.empty()) {
+        // The verdict was sent (workers fail with the same typed error);
+        // fail init on the coordinator with the full who-said-what story.
+        *err = comp_mismatch;
+        return false;
+      }
     } else {
       if (!SendAll(coord_fd_, mine, sizeof mine) ||
           !RecvAll(coord_fd_, reply, sizeof reply)) {
         *err = "topology agreement exchange failed";
+        return false;
+      }
+      if (reply[2] != 0) {
+        *err = "HVD_TPU_COMPRESSION mismatch: the ranks disagree on the "
+               "wire-compression configuration (mode or min-bytes floor); "
+               "set HVD_TPU_COMPRESSION and HVD_TPU_COMPRESSION_MIN_BYTES "
+               "identically on every rank.";
         return false;
       }
     }
@@ -1649,7 +1840,8 @@ ResponseList Engine::CoordinatorTick() {
   std::vector<std::string> ready;
   ready.swap(coord_->ready);
   std::vector<Response> responses;
-  std::vector<int64_t> nbytes;  // per response, for fusion accounting
+  std::vector<int64_t> nbytes;   // per response, for fusion accounting
+  std::vector<uint8_t> ndtypes;  // per response, for the compression verdict
   for (const auto& name : ready) {
     // Byte size must be computed before BuildResponse erases the table entry.
     auto& pt = coord_->message_table[name];
@@ -1672,8 +1864,23 @@ ResponseList Engine::CoordinatorTick() {
     } else {
       responses.push_back(std::move(r));
       nbytes.push_back(bytes);
+      ndtypes.push_back(dtype);
       last_fused_dtype_ = dtype;
     }
+  }
+  // Wire-compression verdict, per FINAL bucket (the fusion loop above may
+  // have grown a bucket past the min-bytes floor, so the decision runs
+  // after fusion settles): stamped on the broadcast response so every
+  // rank packs/unpacks the same format.  The COMPRESS attr also lands on
+  // each tensor's NEGOTIATE timeline row at the coordinator.
+  for (size_t i = 0; i < responses.size(); ++i) {
+    Response& r = responses[i];
+    if (r.type != RESP_ALLREDUCE) continue;
+    r.compression = ChooseCompression(ndtypes[i], nbytes[i]);
+    if (r.compression != COMP_NONE && timeline_.Enabled())
+      for (const auto& name : r.names)
+        timeline_.Instant(
+            name, std::string("COMPRESS_") + CompressionName(r.compression));
   }
   out.responses = std::move(responses);
   return out;
@@ -2089,12 +2296,14 @@ void Engine::AttachTunedParams(ResponseList* out) {
   if (out->abort_code != 0 || out->shutdown) return;
   ParameterManager::Proposal p;
   tuner_.Tick(std::chrono::steady_clock::now(), cur_fusion_.load(),
-              static_cast<double>(cur_cycle_us_.load()) / 1000.0, &p);
+              static_cast<double>(cur_cycle_us_.load()) / 1000.0,
+              cur_compression_.load(), &p);
   if (!p.present) return;
   out->tuned_present = true;
   out->tuned_frozen = p.frozen;
   out->tuned_fusion_threshold = p.fusion_threshold;
   out->tuned_cycle_time_us = p.cycle_time_us;
+  out->tuned_compression = static_cast<uint8_t>(p.compression);
   out->tuned_window = p.window;
 }
 
@@ -2105,37 +2314,55 @@ void Engine::ApplyTunedParams(const ResponseList& rl) {
   // applied log comparable across ranks and the fusion history a
   // deterministic function of the tick.
   int64_t tick = ticks_done_.load();
+  bool comp_changed =
+      cur_compression_.load() != static_cast<int64_t>(rl.tuned_compression);
   opts_.fusion_threshold = rl.tuned_fusion_threshold;
   opts_.cycle_time_ms =
       static_cast<double>(rl.tuned_cycle_time_us) / 1000.0;
+  opts_.compression_mode = rl.tuned_compression;
   cur_fusion_.store(rl.tuned_fusion_threshold);
   cur_cycle_us_.store(rl.tuned_cycle_time_us);
+  cur_compression_.store(rl.tuned_compression);
   if (rl.tuned_frozen) autotune_frozen_.store(true);
   applied_window_.store(rl.tuned_window);
   {
     std::lock_guard<std::mutex> lk(autotune_mu_);
-    char buf[96];
-    snprintf(buf, sizeof(buf), "%lld|%lld|%lld|%d",
+    char buf[112];
+    snprintf(buf, sizeof(buf), "%lld|%lld|%lld|%d|%d",
              static_cast<long long>(tick),
              static_cast<long long>(rl.tuned_fusion_threshold),
              static_cast<long long>(rl.tuned_cycle_time_us),
+             static_cast<int>(rl.tuned_compression),
              rl.tuned_frozen ? 1 : 0);
     applied_log_.emplace_back(buf);
     while (applied_log_.size() > 256) applied_log_.pop_front();
     if (fusion_history_.empty() ||
         fusion_history_.back().second != rl.tuned_fusion_threshold)
       fusion_history_.emplace_back(tick, rl.tuned_fusion_threshold);
+    if (compression_history_.empty() ||
+        compression_history_.back().second !=
+            static_cast<int64_t>(rl.tuned_compression))
+      compression_history_.emplace_back(
+          tick, static_cast<int64_t>(rl.tuned_compression));
     // Bounded: a pathological external policy (hvd.autotune_set per
     // phase, for hours) must not grow this without limit.  Dropping the
     // oldest change point makes the second-oldest the floor for all
     // earlier ticks — safe, because the plane only queries ticks that
     // closed recently.
     while (fusion_history_.size() > 1024) fusion_history_.pop_front();
+    while (compression_history_.size() > 1024)
+      compression_history_.pop_front();
   }
   timeline_.Instant("autotune",
                     rl.tuned_frozen ? "AUTOTUNE_FREEZE" : "AUTOTUNE_APPLY");
-  if (flight_.Enabled())
+  if (flight_.Enabled()) {
     flight_.Record(FL_TUNE, "", rl.tuned_fusion_threshold);
+    // Tune-style compression event (postmortem plane): straggler reports
+    // must show WHICH wire format a stalled bucket was using, so mode
+    // changes land in the ring next to the tick they applied at.
+    if (comp_changed)
+      flight_.Record(FL_COMPRESS, "", rl.tuned_compression);
+  }
 }
 
 int64_t Engine::AutotuneWindows() {
@@ -2153,10 +2380,11 @@ std::string Engine::AutotuneApplied() {
   return out;
 }
 
-int Engine::AutotuneInject(int64_t fusion, double cycle_ms) {
+int Engine::AutotuneInject(int64_t fusion, double cycle_ms,
+                           int64_t compression) {
   if (!initialized_.load()) return 2;
   if (opts_.rank != 0 && opts_.size > 1) return 1;
-  tuner_.Inject(fusion, cycle_ms);
+  tuner_.Inject(fusion, cycle_ms, compression);
   return 0;
 }
 
@@ -2167,6 +2395,17 @@ int64_t Engine::FusionThresholdAt(int64_t tick) {
   // entry per applied threshold change).
   int64_t value = fusion_history_.front().second;
   for (const auto& e : fusion_history_) {
+    if (e.first > tick) break;
+    value = e.second;
+  }
+  return value;
+}
+
+int64_t Engine::CompressionModeAt(int64_t tick) {
+  std::lock_guard<std::mutex> lk(autotune_mu_);
+  if (compression_history_.empty()) return cur_compression_.load();
+  int64_t value = compression_history_.front().second;
+  for (const auto& e : compression_history_) {
     if (e.first > tick) break;
     value = e.second;
   }
@@ -2385,6 +2624,8 @@ bool Engine::CoordinatorMaybeReshape(ResponseList* out) {
   out->reshape_cache_capacity = opts_.cache_capacity;
   out->reshape_fusion_threshold = cur_fusion_.load();
   out->reshape_cycle_time_us = cur_cycle_us_.load();
+  out->reshape_compression = static_cast<uint8_t>(cur_compression_.load());
+  out->reshape_compression_min_bytes = opts_.compression_min_bytes;
   for (int r = 0; r < opts_.size; ++r) {
     if (coord_->rank_dead[r]) {
       out->reshape_lost.push_back(r);
@@ -2465,6 +2706,18 @@ bool Engine::ApplyReshape(const ResponseList& rl) {
       static_cast<double>(rl.reshape_cycle_time_us) / 1000.0;
   cur_fusion_.store(rl.reshape_fusion_threshold);
   cur_cycle_us_.store(rl.reshape_cycle_time_us);
+  // Wire compression re-agrees across the barrier: every member — the
+  // admitted standbys included, whose own env never went through the
+  // init-time equality check — adopts the broadcast mode and floor, and
+  // the error-feedback residuals reset (the membership, and with it
+  // every sum a residual was correcting toward, just changed).
+  opts_.compression_mode = rl.reshape_compression;
+  opts_.compression_min_bytes = rl.reshape_compression_min_bytes;
+  cur_compression_.store(rl.reshape_compression);
+  cur_comp_min_bytes_.store(rl.reshape_compression_min_bytes);
+  residuals_.clear();
+  residual_bytes_.store(0);
+  residual_tensors_.store(0);
   autotune_frozen_.store(false);
   applied_window_.store(0);
   {
@@ -2473,6 +2726,9 @@ bool Engine::ApplyReshape(const ResponseList& rl) {
     fusion_history_.clear();
     fusion_history_.emplace_back(ticks_done_.load(),
                                  rl.reshape_fusion_threshold);
+    compression_history_.clear();
+    compression_history_.emplace_back(
+        ticks_done_.load(), static_cast<int64_t>(rl.reshape_compression));
   }
   // 4. Adopt the new identity.  Elastic jobs are single-host (the
   // launcher rejects --hosts), so the local identity tracks the global
@@ -2524,8 +2780,13 @@ bool Engine::ApplyReshape(const ResponseList& rl) {
     coord_->cached_ready.clear();
     tuner_.Configure(opts_.autotune, opts_.autotune_warmup,
                      opts_.autotune_window, opts_.autotune_fix_fusion,
-                     opts_.autotune_fix_cycle_ms, opts_.fusion_threshold,
-                     opts_.cycle_time_ms);
+                     opts_.autotune_fix_cycle_ms,
+                     opts_.compression_mode == COMP_NONE ||
+                             opts_.hierarchical_allreduce
+                         ? COMP_NONE
+                         : opts_.autotune_fix_compression,
+                     opts_.fusion_threshold, opts_.cycle_time_ms,
+                     opts_.compression_mode);
     std::lock_guard<std::mutex> lk(announce_mu_);
     if (static_cast<int>(last_announce_counts_.size()) < new_size)
       last_announce_counts_.resize(new_size, 0);
@@ -2674,6 +2935,12 @@ bool Engine::SetupRejoinSockets(std::string* err) {
   opts_.fusion_threshold = rl.reshape_fusion_threshold;
   opts_.cycle_time_ms =
       static_cast<double>(rl.reshape_cycle_time_us) / 1000.0;
+  // Wire compression comes from the admitting broadcast, not this
+  // standby's env: the live job's agreement wins.
+  opts_.compression_mode = rl.reshape_compression;
+  opts_.compression_min_bytes = rl.reshape_compression_min_bytes;
+  cur_compression_.store(rl.reshape_compression);
+  cur_comp_min_bytes_.store(rl.reshape_compression_min_bytes);
   cur_rank_.store(new_rank);
   cur_size_.store(opts_.size);
   membership_epoch_.store(rl.membership_epoch);
@@ -2711,6 +2978,7 @@ void Engine::ProcessCacheHits(const std::vector<uint32_t>& hits) {
   // their one-ring-pass-per-bucket behavior.
   std::vector<Response> merged;
   std::vector<int64_t> merged_bytes;
+  std::vector<uint8_t> merged_dtypes;
   uint8_t fused_dtype = 255;
   for (uint32_t hit : hits) {
     const CacheSlot* s = cache_.Get(static_cast<int>(hit));
@@ -2728,9 +2996,20 @@ void Engine::ProcessCacheHits(const std::vector<uint32_t>& hits) {
     } else {
       merged.push_back(s->response);
       merged_bytes.push_back(bytes);
+      merged_dtypes.push_back(s->dtype);
       fused_dtype = s->dtype;
     }
   }
+  // Replayed buckets recompute the wire-compression verdict locally from
+  // the same inputs the coordinator would use — bucket dtype/bytes (from
+  // the broadcast hit order) and the lockstep-mutated (mode, min-bytes)
+  // state — so a replayed bucket compresses exactly like its fresh
+  // negotiation would, on every rank, without putting the verdict back on
+  // the wire.
+  for (size_t i = 0; i < merged.size(); ++i)
+    if (merged[i].type == RESP_ALLREDUCE)
+      merged[i].compression =
+          ChooseCompression(merged_dtypes[i], merged_bytes[i]);
   for (const auto& resp : merged) PerformOperation(resp, /*from_cache=*/true);
 }
 
@@ -2780,6 +3059,10 @@ void Engine::PerformOperation(const Response& resp, bool from_cache) {
       single.type = resp.type;
       single.names.push_back(e.name);
       single.rank_dim0 = resp.rank_dim0;
+      // Deliberately NOT the bucket's compression verdict: replays
+      // re-fuse and recompute it from the replayed bucket's size
+      // (ProcessCacheHits), so a stale per-name copy would only mislead.
+      single.compression = COMP_NONE;
       CacheSlot evicted;
       int slot = cache_.Put(e.name, e.op, e.dtype, e.dims, e.root_rank,
                             single, &evicted);
@@ -2826,24 +3109,135 @@ void Engine::ExecuteAllreduce(const Response& resp,
                               std::vector<TableEntry>& entries) {
   uint8_t dtype = entries[0].dtype;
   bool half = (dtype == HVD_FLOAT16 || dtype == HVD_BFLOAT16);
-  uint8_t wire_dtype = half ? HVD_FLOAT32 : dtype;
+  bool hier = opts_.hierarchical_allreduce && opts_.size > 1;
+  // Negotiated wire compression (the Response's per-bucket verdict)
+  // applies to fp32 payloads on the flat ring; the two-level topology's
+  // node-local star keeps the legacy full-width path.
+  uint8_t comp =
+      (dtype == HVD_FLOAT32 && !hier) ? resp.compression : COMP_NONE;
+  // Wire format for the f32-master ring: a lossy compressed format for
+  // fp32 buckets, or the payload's OWN width for f16/bf16 (fixing the
+  // old 2x staging inflation: halves used to widen to f32 before they
+  // ever reached the wire) — 255 = plain ring in the payload dtype.
+  // Reduction accumulates in f32 at each ring hop in all wire modes.
+  uint8_t wire = 255;
+  if (comp == COMP_BF16)
+    wire = WIRE_BF16;
+  else if (comp == COMP_FP8)
+    wire = WIRE_FP8;
+  else if (half && !hier)
+    wire = dtype == HVD_FLOAT16 ? WIRE_F16 : WIRE_BF16;
+  uint8_t legacy_wire_dtype = half ? HVD_FLOAT32 : dtype;
   size_t esize = DataTypeSize(dtype);
-  size_t wsize = DataTypeSize(wire_dtype);
+  size_t wsize = DataTypeSize(legacy_wire_dtype);
 
   int64_t total_elems = 0;
   for (auto& e : entries) total_elems += NumElements(e.dims);
   for (auto& e : entries) timeline_.Start(e.name, "ALLREDUCE");
+  // Compression metrics: every executed bucket records its payload width
+  // and its wire width, so wire_bytes/payload_bytes exposes both the
+  // compression win and any residual staging inflation.
+  int64_t wire_unit = wire != 255 ? static_cast<int64_t>(WireFormatSize(wire))
+                                  : static_cast<int64_t>(wsize);
+  RecordCompressedOp(entries[0].name, comp,
+                     total_elems * static_cast<int64_t>(esize),
+                     total_elems * wire_unit);
 
   std::string err;
   bool ok = true;
-  bool hier = opts_.hierarchical_allreduce && opts_.size > 1;
   const char* reduce_activity =
       hier ? "HIERARCHICAL_ALLREDUCE" : "RING_ALLREDUCE";
   auto do_allreduce = [&](void* buf, int64_t n, std::string* e) {
-    return hier ? HierarchicalAllreduce(buf, n, wire_dtype, e)
-                : RingAllreduce(buf, n, wire_dtype, e);
+    return hier ? HierarchicalAllreduce(buf, n, legacy_wire_dtype, e)
+                : RingAllreduce(buf, n, legacy_wire_dtype, e);
   };
-  if (entries.size() == 1 && !half) {
+  if (wire != 255) {
+    // Compressed / native-width wire path: fp32 master copies live in the
+    // fusion buffer, segments cross the wire narrowed.  For lossy fp32
+    // compression each tensor carries an error-feedback residual: the
+    // quantization error of THIS step's (input + residual) is saved and
+    // added back in before the next step's compression (1-bit-SGD-style
+    // error feedback), so the wire rounding never compounds into drift.
+    last_fusion_use_ = std::chrono::steady_clock::now();
+    if (fusion_buffer_.size() < static_cast<size_t>(total_elems) * 4)
+      fusion_buffer_.resize(static_cast<size_t>(total_elems) * 4);
+    float* fb = reinterpret_cast<float*>(fusion_buffer_.data());
+    bool ef = comp != COMP_NONE;  // native half payloads are already
+                                  // wire-exact; no residual needed
+    if (ef) {
+      // Residual-map bound: a stream of never-repeating auto-named
+      // tensors gains nothing from error feedback but would grow this
+      // forever.  Checked ONCE, before this bucket touches the map — a
+      // mid-bucket clear would discard residuals just stored for the
+      // bucket's earlier tensors in this very step.
+      size_t fresh = 0;
+      for (auto& e : entries)
+        if (!residuals_.count(e.name)) ++fresh;
+      if (fresh > 0 && residuals_.size() + fresh > 4096) {
+        residuals_.clear();
+        residual_bytes_.store(0);
+      }
+    }
+    int64_t off = 0;
+    for (auto& e : entries) {
+      timeline_.ActivityStart(e.name, "MEMCPY_IN_FUSION_BUFFER");
+      int64_t n = NumElements(e.dims);
+      float* seg = fb + off;
+      if (half)
+        HalfBufToFloat(e.in, seg, n, dtype);
+      else
+        memcpy(seg, e.in, static_cast<size_t>(n) * 4);
+      if (ef) {
+        auto it = residuals_.find(e.name);
+        if (it == residuals_.end())
+          it = residuals_.emplace(e.name, std::vector<float>()).first;
+        std::vector<float>& r = it->second;
+        if (static_cast<int64_t>(r.size()) != n) {
+          residual_bytes_.fetch_add(
+              (n - static_cast<int64_t>(r.size())) * 4);
+          r.assign(static_cast<size_t>(n), 0.0f);
+        }
+        // Quantize the local contribution NOW: the residual is measured
+        // against exactly what the wire will deliver, and the first
+        // reduce-scatter hop then sends these values losslessly.
+        for (int64_t i = 0; i < n; ++i) {
+          float v = seg[i] + r[i];
+          float q = QuantDequant(v, wire);
+          r[i] = v - q;
+          seg[i] = q;
+        }
+      }
+      off += n;
+      timeline_.ActivityEnd(e.name);
+    }
+    if (ef) residual_tensors_.store(
+        static_cast<int64_t>(residuals_.size()));
+    if (comp != COMP_NONE && timeline_.Enabled())
+      for (auto& e : entries)
+        timeline_.Instant(
+            e.name, std::string("COMPRESS_") + CompressionName(comp));
+    timeline_.ActivityStart(entries[0].name, reduce_activity);
+    ok = RingAllreduceWire(fb, total_elems, wire, opts_.size, opts_.rank,
+                           left_fd_, right_fd_, &err);
+    timeline_.ActivityEnd(entries[0].name);
+    if (ok) {
+      off = 0;
+      for (auto& e : entries) {
+        timeline_.ActivityStart(e.name, "MEMCPY_OUT_FUSION_BUFFER");
+        int64_t n = NumElements(e.dims);
+        float* seg = fb + off;
+        // `average` is a per-tensor attribute, so divide per segment:
+        // fused neighbours may mix averaged and summed reductions.
+        if (e.average) DivideBuffer(seg, n, HVD_FLOAT32, opts_.size);
+        if (half)
+          FloatBufToHalf(seg, e.out, n, dtype);
+        else
+          memcpy(e.out, seg, static_cast<size_t>(n) * 4);
+        off += n;
+        timeline_.ActivityEnd(e.name);
+      }
+    }
+  } else if (entries.size() == 1 && !half) {
     // Single unfused tensor: skip the fusion buffer, reduce in place on the
     // output (the reference's single-entry in-place path,
     // operations.cc:1186).
@@ -2856,8 +3250,10 @@ void Engine::ExecuteAllreduce(const Response& resp,
     if (ok && e.average) DivideBuffer(e.out, total_elems, dtype, opts_.size);
   } else {
     // Fuse into one contiguous buffer, one ring pass, scatter back out --
-    // the reference's fusion-buffer dance (operations.cc:1109-1186) with
-    // half types widened to f32 for the reduction.
+    // the reference's fusion-buffer dance (operations.cc:1109-1186).
+    // Half dtypes only reach here under the two-level topology, where
+    // they still stage through f32 (the node-local star reduce has no
+    // compressed path).
     last_fusion_use_ = std::chrono::steady_clock::now();
     if (fusion_buffer_.size() < static_cast<size_t>(total_elems) * wsize)
       fusion_buffer_.resize(static_cast<size_t>(total_elems) * wsize);
@@ -3101,6 +3497,136 @@ bool Engine::RingAllreduceOn(void* buf, int64_t count, uint8_t dtype, int N,
     }
   }
   return true;
+}
+
+bool Engine::RingAllreduceWire(float* buf, int64_t count, uint8_t wire,
+                               int N, int index, int left_fd, int right_fd,
+                               std::string* err) {
+  // The bidirectional ring of RingAllreduceOn with the wire narrowed:
+  // the local buffer stays f32 (every hop accumulates in f32), segments
+  // are compressed at the send boundary and decompressed at the receive
+  // boundary.  The allgather phase recompresses the owner's reduced f32
+  // segment on every forward hop — exact, because dequantized values are
+  // representable in the wire format by construction — so forwarding
+  // needs no wire-byte staging.
+  if (N == 1 || count == 0) return true;
+  const size_t wsz = WireFormatSize(wire);
+  int64_t cB = count / 2, cA = count - cB;
+  HalfRing A{reinterpret_cast<char*>(buf), cA, sizeof(float), N, index};
+  HalfRing B{reinterpret_cast<char*>(buf + cA), cB, sizeof(float), N,
+             (N - index) % N};
+  int64_t max_a = cA / N + (cA % N ? 1 : 0);
+  int64_t max_b = cB / N + (cB % N ? 1 : 0);
+  std::vector<uint8_t> send_a(static_cast<size_t>(max_a) * wsz);
+  std::vector<uint8_t> send_b(static_cast<size_t>(max_b) * wsz);
+  std::vector<uint8_t> recv_a(static_cast<size_t>(max_a) * wsz);
+  std::vector<uint8_t> recv_b(static_cast<size_t>(max_b) * wsz);
+  float* bufB = buf + cA;
+
+  for (int gather = 0; gather < 2; ++gather) {
+    bool g = gather != 0;
+    for (int step = 0; step < N - 1; ++step) {
+      int64_t sa = A.seg_count(A.send_seg(step, g));
+      int64_t sb = B.seg_count(B.send_seg(step, g));
+      int64_t ra = A.seg_count(A.recv_seg(step, g));
+      int64_t rb = B.seg_count(B.recv_seg(step, g));
+      CompressBuf(buf + A.seg_start(A.send_seg(step, g)), send_a.data(), sa,
+                  wire);
+      CompressBuf(bufB + B.seg_start(B.send_seg(step, g)), send_b.data(), sb,
+                  wire);
+      if (!ExchangeBi(right_fd, send_a.data(), static_cast<size_t>(sa) * wsz,
+                      recv_b.data(), static_cast<size_t>(rb) * wsz, left_fd,
+                      send_b.data(), static_cast<size_t>(sb) * wsz,
+                      recv_a.data(), static_cast<size_t>(ra) * wsz)) {
+        *err = std::string("neighbour exchange failed (compressed ") +
+               (g ? "allgather" : "reduce-scatter") + " step " +
+               std::to_string(step) + ")";
+        return false;
+      }
+      if (g) {
+        // Allgather: adopt the fully reduced segment as broadcast.
+        DecompressBuf(recv_a.data(), buf + A.seg_start(A.recv_seg(step, g)),
+                      ra, wire);
+        DecompressBuf(recv_b.data(), bufB + B.seg_start(B.recv_seg(step, g)),
+                      rb, wire);
+      } else {
+        // Reduce-scatter: accumulate in f32.
+        DecompressAccumulate(recv_a.data(),
+                             buf + A.seg_start(A.recv_seg(step, g)), ra,
+                             wire);
+        DecompressAccumulate(recv_b.data(),
+                             bufB + B.seg_start(B.recv_seg(step, g)), rb,
+                             wire);
+      }
+    }
+    // The owned, fully reduced segments are forwarded quantized during
+    // the allgather phase; quantize the local copy too, so every rank
+    // ends with IDENTICAL values (the owner must not keep a higher-
+    // precision copy than it broadcast).
+    if (!g) {
+      int64_t oa = A.seg_count(A.send_seg(0, true));
+      float* pa = buf + A.seg_start(A.send_seg(0, true));
+      for (int64_t i = 0; i < oa; ++i) pa[i] = QuantDequant(pa[i], wire);
+      int64_t ob = B.seg_count(B.send_seg(0, true));
+      float* pb = bufB + B.seg_start(B.send_seg(0, true));
+      for (int64_t i = 0; i < ob; ++i) pb[i] = QuantDequant(pb[i], wire);
+    }
+  }
+  return true;
+}
+
+uint8_t Engine::ChooseCompression(uint8_t dtype, int64_t bytes) const {
+  uint8_t mode = static_cast<uint8_t>(cur_compression_.load());
+  if (mode == COMP_NONE) return COMP_NONE;
+  // Lossy wire formats apply to fp32 payloads on the flat multi-rank
+  // ring only: f16/bf16 already ship at native width, integer sums must
+  // stay exact, the two-level topology keeps its legacy star paths, and
+  // a single-rank job moves no wire bytes at all.
+  if (dtype != HVD_FLOAT32) return COMP_NONE;
+  if (opts_.hierarchical_allreduce || opts_.size <= 1) return COMP_NONE;
+  // The min-bytes floor keeps latency-bound small buckets uncompressed:
+  // their cost is negotiation + syscalls, not bandwidth, and the
+  // quantize/dequantize passes would be pure overhead.
+  if (bytes < opts_.compression_min_bytes) return COMP_NONE;
+  return mode;
+}
+
+void Engine::RecordCompressedOp(const std::string& name, uint8_t mode,
+                                int64_t payload_bytes, int64_t wire_bytes) {
+  comp_payload_bytes_.fetch_add(payload_bytes);
+  comp_wire_bytes_.fetch_add(wire_bytes);
+  switch (mode) {
+    case COMP_BF16: comp_ops_bf16_.fetch_add(1); break;
+    case COMP_FP8: comp_ops_fp8_.fetch_add(1); break;
+    default: comp_ops_none_.fetch_add(1); break;
+  }
+  std::lock_guard<std::mutex> lk(comp_mu_);
+  std::string entry;
+  for (char c : name) entry += (c == ';' || c == '|') ? '_' : c;
+  entry += std::string("|") + CompressionName(mode);
+  comp_log_.push_back(std::move(entry));
+  while (comp_log_.size() > 256) comp_log_.pop_front();
+}
+
+std::string Engine::CompressionInfo() {
+  return std::to_string(comp_wire_bytes_.load()) + "|" +
+         std::to_string(comp_payload_bytes_.load()) + "|" +
+         std::to_string(comp_ops_none_.load()) + "|" +
+         std::to_string(comp_ops_bf16_.load()) + "|" +
+         std::to_string(comp_ops_fp8_.load()) + "|" +
+         std::to_string(residual_bytes_.load()) + "|" +
+         std::to_string(residual_tensors_.load()) + "|" +
+         std::to_string(cur_comp_min_bytes_.load());
+}
+
+std::string Engine::CompressionLog() {
+  std::lock_guard<std::mutex> lk(comp_mu_);
+  std::string out;
+  for (const auto& e : comp_log_) {
+    if (!out.empty()) out += ';';
+    out += e;
+  }
+  return out;
 }
 
 bool Engine::HierarchicalAllreduce(void* buf, int64_t count, uint8_t dtype,

@@ -72,6 +72,17 @@ struct EngineOptions {
   int64_t autotune_window = 32;
   int64_t autotune_fix_fusion = -1;
   double autotune_fix_cycle_ms = -1.0;
+  int64_t autotune_fix_compression = -1;
+  // Wire-level gradient compression (docs/performance.md#wire-compression,
+  // HVD_TPU_COMPRESSION off|bf16|fp8): fp32 allreduce buckets at least
+  // `compression_min_bytes` big transfer as bf16 / fp8-e4m3 with fp32
+  // master copies and per-tensor error-feedback residuals; reduction
+  // still accumulates in f32 at every ring hop.  Agreed JOB-WIDE at init
+  // (a mixed-env launch is a typed init error, not a silent split into
+  // ranks that pack buckets differently), mutated only by the lockstep
+  // tuned-parameter broadcasts, and re-agreed across elastic reshapes.
+  uint8_t compression_mode = COMP_NONE;
+  int64_t compression_min_bytes = 1024;
   // Two-level allreduce: reduce to the node-local leader, ring-allreduce
   // across leaders, broadcast back within the node — the reference's
   // HOROVOD_HIERARCHICAL_ALLREDUCE (operations.cc:1003-1048) mapped to
@@ -310,14 +321,35 @@ class Engine {
   // allgather and compare it).
   std::string AutotuneApplied();
   // Manual parameter injection (hvd.autotune_set, rank 0 only): broadcast
-  // `fusion` / `cycle_ms` (< 0 keeps the current value) next tick.
-  // Returns 0 ok, 1 when called off the coordinator, 2 uninitialized.
-  int AutotuneInject(int64_t fusion, double cycle_ms);
+  // `fusion` / `cycle_ms` / `compression` (< 0 keeps the current value)
+  // next tick.  Returns 0 ok, 1 off the coordinator, 2 uninitialized.
+  int AutotuneInject(int64_t fusion, double cycle_ms, int64_t compression);
   // Fusion threshold in force at engine tick `tick` (the XLA plane's
   // bucket boundaries must follow autotuned thresholds in lockstep;
   // jax/eager_mesh.py).  Past ticks are stable: the history is
   // append-only with increasing tick stamps.
   int64_t FusionThresholdAt(int64_t tick);
+
+  // Wire-compression observability (docs/performance.md#wire-compression).
+  // The applied mode mirrors opts_ through an atomic (lockstep broadcasts
+  // mutate it on the engine thread; Python API threads read it live);
+  // CompressionModeAt serves the XLA plane's per-tick lockstep lookup the
+  // way FusionThresholdAt does for bucket boundaries.  The byte/op
+  // counters are process-cumulative (survive re-init, like StallEvents);
+  // wire bytes count every allreduce bucket at its wire width and payload
+  // bytes at the caller dtype's width, so the pair exposes both the
+  // compression win and the legacy half-staging inflation.
+  uint8_t CompressionModeNow() const {
+    return static_cast<uint8_t>(cur_compression_.load());
+  }
+  int64_t CompressionModeAt(int64_t tick);
+  // "wire|payload|ops_none|ops_bf16|ops_fp8|residual_bytes|
+  //  residual_tensors|min_bytes" for the Python metrics sync.
+  std::string CompressionInfo();
+  // Bounded per-bucket decision log, "first_name|mode;..." in execution
+  // order — identical on every rank of a healthy job (tests allgather
+  // and compare it across cache replay and reshapes).
+  std::string CompressionLog();
 
   // Elastic-membership observability (docs/fault-tolerance.md).  The
   // epoch counts reshapes survived by THIS engine lifetime (0 until the
@@ -475,9 +507,28 @@ class Engine {
   void CompleteEntry(const TableEntry& e, int32_t code,
                      const std::string& error);
 
+  // Wire compression (docs/performance.md#wire-compression).  The
+  // coordinator (and the lockstep cache replay) choose a bucket's wire
+  // format from the applied mode, the payload dtype, and the bucket's
+  // payload byte size; engine thread only.
+  uint8_t ChooseCompression(uint8_t dtype, int64_t bytes) const;
+  // Record one executed allreduce bucket for the compression metrics and
+  // the per-bucket decision log.
+  void RecordCompressedOp(const std::string& name, uint8_t mode,
+                          int64_t payload_bytes, int64_t wire_bytes);
+
   // Data plane primitives (ring over TCP).
   bool RingAllreduce(void* buf, int64_t count, uint8_t dtype,
                      std::string* err);
+  // Compressed ring allreduce: the local buffer stays f32 (reduction
+  // accumulates in f32 at every hop) while segments cross the wire in
+  // `wire` format (f16/bf16/fp8) — compress on send, decompress on
+  // receive.  Recompression of already-quantized values is exact, so the
+  // allgather phase loses nothing beyond the per-hop quantization the
+  // format implies.
+  bool RingAllreduceWire(float* buf, int64_t count, uint8_t wire,
+                         int N, int index, int left_fd, int right_fd,
+                         std::string* err);
   // Ring allreduce over an arbitrary participant ring (used for both the
   // global ring and the cross-node leader ring).
   bool RingAllreduceOn(void* buf, int64_t count, uint8_t dtype, int n,
@@ -625,13 +676,41 @@ class Engine {
   std::atomic<int64_t> cur_cycle_us_{0};
   std::atomic<bool> autotune_frozen_{false};
   std::atomic<int64_t> applied_window_{0};
-  std::mutex autotune_mu_;  // guards applied_log_, fusion_history_
-  std::deque<std::string> applied_log_;  // "tick|fusion|cycle_us|frozen"
+  std::mutex autotune_mu_;  // guards applied_log_, *_history_
+  std::deque<std::string> applied_log_;  // "tick|fusion|cycle_us|comp|frozen"
   // (first_effective_tick, fusion_threshold) change points, appended in
   // tick order and BOUNDED (oldest change points collapse into the
   // floor entry — the plane only ever queries recently closed ticks);
   // FusionThresholdAt walks this short log linearly.
   std::deque<std::pair<int64_t, int64_t>> fusion_history_;
+  // Same change-point log for the wire-compression mode, serving the XLA
+  // plane's per-tick lockstep lookup (CompressionModeAt).
+  std::deque<std::pair<int64_t, int64_t>> compression_history_;
+
+  // Wire compression (docs/performance.md#wire-compression).
+  // cur_compression_ mirrors opts_.compression_mode for lock-free reads
+  // from Python API threads; residuals_ holds the per-tensor fp32
+  // error-feedback buffers (engine thread only; the quantization error of
+  // each step feeds the next step's pre-compression add).  Cleared at
+  // Init, on reshape (the membership — and with it every sum — changed),
+  // and bounded so a stream of never-repeating auto-named tensors cannot
+  // grow it forever.  Byte/op counters are process-cumulative; the
+  // residual gauges mirror the map for the metrics registry.
+  std::atomic<int64_t> cur_compression_{COMP_NONE};
+  // Mirrors opts_.compression_min_bytes for lock-free reads from Python
+  // API threads (CompressionInfo): reshape/rejoin mutate opts_ on the
+  // engine thread mid-run.
+  std::atomic<int64_t> cur_comp_min_bytes_{0};
+  std::unordered_map<std::string, std::vector<float>> residuals_;
+  std::atomic<int64_t> comp_wire_bytes_{0};
+  std::atomic<int64_t> comp_payload_bytes_{0};
+  std::atomic<int64_t> comp_ops_none_{0};
+  std::atomic<int64_t> comp_ops_bf16_{0};
+  std::atomic<int64_t> comp_ops_fp8_{0};
+  std::atomic<int64_t> residual_bytes_{0};
+  std::atomic<int64_t> residual_tensors_{0};
+  std::mutex comp_mu_;  // guards comp_log_
+  std::deque<std::string> comp_log_;  // "first_name|mode", bounded
 
   // Announce-order accounting (rank 0).  Counts are process-cumulative;
   // the log is bounded so an unconsumed Python side cannot grow it.

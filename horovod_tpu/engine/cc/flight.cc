@@ -23,6 +23,7 @@ const char* FlightEventName(uint8_t event) {
     case FL_ABORT:     return "abort";
     case FL_RESHAPE:   return "reshape";
     case FL_TUNE:      return "tune";
+    case FL_COMPRESS:  return "compress";
     default:           return "unknown";
   }
 }

@@ -37,6 +37,7 @@ enum FlightEventType : uint8_t {
   FL_ABORT = 7,      // coordinated abort latched (arg: status code)
   FL_RESHAPE = 8,    // elastic membership adopted (arg: new epoch)
   FL_TUNE = 9,       // lockstep parameter broadcast applied (arg: fusion)
+  FL_COMPRESS = 10,  // wire-compression mode armed / changed (arg: mode)
 };
 
 const char* FlightEventName(uint8_t event);

@@ -49,6 +49,15 @@ const char* OpName(uint8_t op) {
   }
 }
 
+const char* CompressionName(uint8_t mode) {
+  switch (mode) {
+    case COMP_NONE: return "none";
+    case COMP_BF16: return "bf16";
+    case COMP_FP8: return "fp8";
+    default: return "<unknown compression>";
+  }
+}
+
 namespace {
 
 class Writer {
@@ -160,6 +169,7 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
     w.Str(r.error_message);
     w.U32(static_cast<uint32_t>(r.rank_dim0.size()));
     for (int64_t d : r.rank_dim0) w.I64(d);
+    w.U8(r.compression);
   }
   w.U32(static_cast<uint32_t>(rl.cache_hits.size()));
   for (uint32_t h : rl.cache_hits) w.U32(h);
@@ -168,6 +178,7 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
     w.I64(rl.tuned_fusion_threshold);
     w.I64(rl.tuned_cycle_time_us);
     w.I64(rl.tuned_window);
+    w.U8(rl.tuned_compression);
   }
   w.U8(rl.reshape_present ? 1 : 0);
   if (rl.reshape_present) {
@@ -175,6 +186,8 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
     w.I64(rl.reshape_cache_capacity);
     w.I64(rl.reshape_fusion_threshold);
     w.I64(rl.reshape_cycle_time_us);
+    w.U8(rl.reshape_compression);
+    w.I64(rl.reshape_compression_min_bytes);
     w.U32(static_cast<uint32_t>(rl.member_old_ranks.size()));
     for (size_t i = 0; i < rl.member_old_ranks.size(); ++i) {
       w.I32(rl.member_old_ranks[i]);
@@ -202,6 +215,7 @@ bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
     r.error_message = rd.Str();
     uint32_t ns = rd.U32();
     for (uint32_t j = 0; j < ns; ++j) r.rank_dim0.push_back(rd.I64());
+    r.compression = rd.U8();
     rl->responses.push_back(std::move(r));
   }
   rl->cache_hits.clear();
@@ -215,6 +229,7 @@ bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
     rl->tuned_fusion_threshold = rd.I64();
     rl->tuned_cycle_time_us = rd.I64();
     rl->tuned_window = rd.I64();
+    rl->tuned_compression = rd.U8();
   }
   rl->member_old_ranks.clear();
   rl->member_endpoints.clear();
@@ -225,6 +240,8 @@ bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
     rl->reshape_cache_capacity = rd.I64();
     rl->reshape_fusion_threshold = rd.I64();
     rl->reshape_cycle_time_us = rd.I64();
+    rl->reshape_compression = rd.U8();
+    rl->reshape_compression_min_bytes = rd.I64();
     uint32_t nm = rd.U32();
     for (uint32_t i = 0; i < nm && rd.ok; ++i) {
       rl->member_old_ranks.push_back(rd.I32());

@@ -60,9 +60,22 @@ enum StatusCode : int32_t {
   ST_RESHAPE = 8,
 };
 
+// Wire-compression modes (docs/performance.md#wire-compression): what an
+// fp32 allreduce bucket's payload is narrowed to on the wire.  Negotiated
+// per bucket by the rank-0 coordinator (a `compression` field on the
+// Response) from the job-wide HVD_TPU_COMPRESSION agreement, so every
+// rank compresses/decompresses the same buckets the same way.  Shared
+// with Python (horovod_tpu/common/config.py).
+enum CompressionMode : uint8_t {
+  COMP_NONE = 0,
+  COMP_BF16 = 1,      // fp32 -> bfloat16 on the wire (2x fewer bytes)
+  COMP_FP8 = 2,       // fp32 -> fp8-e4m3fn, saturating (4x fewer bytes)
+};
+
 size_t DataTypeSize(uint8_t dtype);
 const char* DataTypeName(uint8_t dtype);
 const char* OpName(uint8_t op);
+const char* CompressionName(uint8_t mode);
 
 // One rank's readiness announcement for one named tensor.
 struct Request {
@@ -101,6 +114,13 @@ struct Response {
   std::string error_message;
   // Allgather only: dim-0 size contributed by each rank, indexed by rank.
   std::vector<int64_t> rank_dim0;
+  // Allreduce only: the wire-compression verdict for this bucket
+  // (CompressionMode), chosen by the rank-0 coordinator per bucket-size
+  // class (bucket payload bytes >= HVD_TPU_COMPRESSION_MIN_BYTES) and
+  // broadcast so every rank packs/unpacks the same wire format.  Cache
+  // replays recompute it locally from the same lockstep-mutated state
+  // (engine.cc ProcessCacheHits), so fresh and replayed buckets agree.
+  uint8_t compression = COMP_NONE;
 };
 
 struct ResponseList {
@@ -127,6 +147,10 @@ struct ResponseList {
   int64_t tuned_fusion_threshold = 0;
   int64_t tuned_cycle_time_us = 0;
   int64_t tuned_window = 0;
+  // Wire-compression mode proposed with the tuned params (the third
+  // autotune axis): applied in the same lockstep as fusion/cycle, so the
+  // compression decision function mutates at one tick boundary everywhere.
+  uint8_t tuned_compression = COMP_NONE;
   // Elastic membership reshape (docs/fault-tolerance.md): when present,
   // this tick IS the reshape barrier.  The list carries the complete new
   // membership — for each new dense rank its previous rank (-1 for a
@@ -143,6 +167,12 @@ struct ResponseList {
   int64_t reshape_cache_capacity = 0;
   int64_t reshape_fusion_threshold = 0;
   int64_t reshape_cycle_time_us = 0;
+  // Wire-compression re-agreement across the barrier: the new membership
+  // (admitted standbys included) adopts the currently applied mode and
+  // min-bytes floor, the same way it adopts cache capacity — a joiner's
+  // own env must not make it pack buckets differently from survivors.
+  uint8_t reshape_compression = COMP_NONE;
+  int64_t reshape_compression_min_bytes = 0;
   std::vector<int32_t> member_old_ranks;      // index = new dense rank
   std::vector<std::string> member_endpoints;  // index = new dense rank
   std::vector<int32_t> reshape_lost;

@@ -87,6 +87,44 @@ _plane = None  # initialized XlaDataPlane, or False if init failed/disabled
 # jax's compilation cache behind it — without bound.  LRU past this.
 _JIT_CACHE_CAPACITY = 128
 
+# Wire compression (docs/performance.md#wire-compression): the plane
+# mirrors the engine's negotiated scheme with jnp casts — f32 allreduce
+# buckets past the min-bytes floor dispatch in the wire dtype and the
+# compiled program widens back to f32 before summing (f32 accumulation,
+# like the engine's per-hop f32 accumulate).  Mode codes are the engine's
+# CompressionMode values, read per closed tick over the same lockstep
+# seam the fusion threshold rides, so every rank compresses the same
+# buckets the same way.  fp8 saturates at ±448 before the cast (ml_dtypes
+# overflows to nan; one clipped outlier must not poison a fused bucket —
+# the engine's encoder saturates identically).
+_FP8_MAX = 448.0
+_WIRE_DTYPES = {}
+_WIRE_MODE_NAMES = {1: "bf16", 2: "fp8"}
+try:
+    import ml_dtypes as _ml_dtypes
+
+    _WIRE_DTYPES = {1: np.dtype(_ml_dtypes.bfloat16),
+                    2: np.dtype(_ml_dtypes.float8_e4m3fn)}
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    pass
+
+
+def quantize_error_feedback(values: np.ndarray, mode: int):
+    """Quantize f32 ``values`` to the wire dtype for ``mode`` (1=bf16,
+    2=fp8-e4m3fn, saturating) and return ``(wire, residual)``.  The
+    residual EXACTLY carries the rounding error in f32 arithmetic
+    (``values == wire.astype(f32) + residual`` element-wise, saturation
+    clipping excepted): the quantized value is within a fraction of the
+    input's magnitude, so the subtraction is exact by Sterbenz's lemma.
+    Feeding the residual into the next step's pre-compression add is the
+    1-bit-SGD-style error feedback that keeps lossy wire formats
+    converging like fp32."""
+    wire_dtype = _WIRE_DTYPES[mode]
+    v = np.clip(values, -_FP8_MAX, _FP8_MAX) if mode == 2 else values
+    wire = v.astype(wire_dtype)
+    residual = values - wire.astype(np.float32)
+    return wire, residual
+
 
 def _meta_hash(kind: str, dtype, shape, root: int) -> int:
     payload = repr((kind, np.dtype(dtype).str, tuple(shape), root)).encode()
@@ -320,6 +358,19 @@ class XlaDataPlane:
         # threshold is the live engine value, read ONCE per flush — not
         # per op, the bucketing loop is the dispatch hot path.
         self._live_threshold: Optional[int] = None
+        # Wire compression (docs/performance.md#wire-compression): the
+        # mode is the engine's lockstep-broadcast state, looked up per
+        # closed tick exactly like the fusion threshold so autotuned mode
+        # changes move every rank's dispatch format at the same tick
+        # boundary.  Residuals are the per-tensor f32 error-feedback
+        # buffers; comp_stats mirrors the engine's wire/payload byte and
+        # per-mode bucket accounting for metrics_snapshot()["compression"].
+        self._comp_min_bytes = int(cfg.compression_min_bytes)
+        self._tick_comp: dict = {}
+        self._live_comp: Optional[int] = None
+        self._residuals: dict = {}
+        self.comp_stats = {"wire_bytes": 0, "payload_bytes": 0,
+                           "ops": {"none": 0, "bf16": 0, "fp8": 0}}
         self._mu = threading.RLock()  # guards _fns, _pending, _local_seq
         self._pending: List[_PlaneOp] = []
         # Ops withdrawn by a timed-out wait, pinned so the engine's raw
@@ -481,6 +532,7 @@ class XlaDataPlane:
             else:
                 ticks_done = int(common._lib.hvd_tpu_ticks_done())
             self._live_threshold = None  # re-read at most once per flush
+            self._live_comp = None
             self._poll_negotiations()
             ready = [op for op in self._pending
                      if op.seq is not None and op.seq >= 0
@@ -545,6 +597,29 @@ class XlaDataPlane:
                 self._tick_thresholds.clear()
             self._tick_thresholds[tick] = thr
         return thr
+
+    def _compression_for(self, tick: int) -> int:
+        """Wire-compression mode in force at engine tick `tick`, memoized
+        like :meth:`_threshold_for`: the mode mutates only in lockstep at
+        tick boundaries, so keying the dispatch format off the op's
+        completion tick keeps every rank compiling and launching the same
+        program for the same bucket even while the autotuner moves the
+        knob.  Size-1 jobs move no wire bytes — always uncompressed."""
+        from horovod_tpu import common
+
+        if common._lib is None or self._size == 1:
+            return 0
+        if tick < 0:
+            if self._live_comp is None:
+                self._live_comp = int(common._lib.hvd_tpu_compression_mode())
+            return self._live_comp
+        mode = self._tick_comp.get(tick)
+        if mode is None:
+            mode = int(common._lib.hvd_tpu_compression_mode_at(tick))
+            if len(self._tick_comp) > 4096:
+                self._tick_comp.clear()
+            self._tick_comp[tick] = mode
+        return mode
 
     def _wait_dispatch(self, handle: XlaHandle) -> None:
         """Block until `handle`'s op is dispatched (or failed).  Bounded by
@@ -657,6 +732,15 @@ class XlaDataPlane:
             if kind == "ar":
                 fn = jax.jit(lambda a: a.sum(axis=0),
                              out_shardings=self._out_sharding)
+            elif kind == "arc":
+                # Compressed allreduce: the buffer arrives in the wire
+                # dtype (bf16/fp8) and widens back to f32 BEFORE the sum
+                # — f32 accumulation, mirroring the engine's per-hop f32
+                # accumulate (docs/performance.md#wire-compression).
+                import jax.numpy as jnp
+
+                fn = jax.jit(lambda a: a.astype(jnp.float32).sum(axis=0),
+                             out_shardings=self._out_sharding)
             elif kind == "bc":
                 fn = jax.jit(lambda a: a[root],
                              out_shardings=self._out_sharding)
@@ -748,11 +832,61 @@ class XlaDataPlane:
                 flat[off:off + n] = op.payload.reshape(-1)
                 offs.append(off)
                 off += n
-            fn = self._jit_for(kind, length, dtype, bucket[0].root)
+            # Wire compression: negotiated mode at this bucket's tick, on
+            # f32 allreduce buckets past the min-bytes floor (the same
+            # per-bucket-size-class decision the engine's coordinator
+            # makes, from the same lockstep state — so the decision is
+            # identical on every rank even though it is computed locally).
+            bucket_bytes = sum(op.payload.nbytes for op in bucket)
+            comp = 0
+            if kind == "ar" and dtype == np.float32:
+                comp = self._compression_for(bucket[0].tick)
+                if (comp not in _WIRE_DTYPES
+                        or bucket_bytes < self._comp_min_bytes):
+                    comp = 0
+            if comp:
+                # Residual-map bound, checked ONCE before this bucket
+                # touches the map (a mid-bucket clear would discard
+                # residuals just stored for the bucket's earlier
+                # tensors): never-repeating auto-named tensors gain
+                # nothing from error feedback and must not grow this
+                # forever.
+                fresh = sum(1 for op in bucket
+                            if op.name not in self._residuals)
+                if fresh and len(self._residuals) + fresh > 4096:
+                    self._residuals.clear()
+                # Error feedback: fold each tensor's residual into its
+                # segment, quantize the whole flat buffer once, and save
+                # each segment's new rounding error for the next step.
+                for op, o, n in zip(bucket, offs, lens):
+                    r = self._residuals.get(op.name)
+                    if r is not None and r.size == n:
+                        flat[o:o + n] += r
+                wire_flat, residual = quantize_error_feedback(flat, comp)
+                for op, o, n in zip(bucket, offs, lens):
+                    self._residuals[op.name] = residual[o:o + n].copy()
+                flat = wire_flat
+                fn = self._jit_for("arc", length, flat.dtype)
+                mode_name = _WIRE_MODE_NAMES[comp]
+            else:
+                fn = self._jit_for(kind, length, dtype, bucket[0].root)
+                mode_name = "none"
+            if kind == "ar":
+                # Ungated (like stalls): the wire-vs-payload ratio is the
+                # compression acceptance number, assertable without full
+                # metrics.  Payload counts at the CALLER-visible width,
+                # wire at the dispatched buffer's dtype width (padding
+                # excluded) — same semantics as the engine's counters.
+                caller_bytes = sum(
+                    int(np.prod(op.handle._shape))
+                    * np.dtype(op.handle._dtype).itemsize for op in bucket)
+                self.comp_stats["payload_bytes"] += caller_bytes
+                self.comp_stats["wire_bytes"] += total * flat.dtype.itemsize
+                self.comp_stats["ops"][mode_name] += 1
             if mx:
                 _metrics.registry.observe(
                     "bucket_fill",
-                    min(1.0, sum(op.payload.nbytes for op in bucket)
+                    min(1.0, bucket_bytes
                         / max(self._threshold_for(bucket[0].tick), 1)))
             self._tl_phase(tl_lib, bucket, b"XLA_DISPATCH")
             batch = _Batch(self._traced_dispatch(fn, flat, kind,
@@ -802,8 +936,11 @@ class XlaDataPlane:
             _postmortem.plane_ring.record("enqueue", name)
         if _metrics.registry.enabled:
             op.t_enq = time.perf_counter()
-            # Caller-visible payload bytes (pre-widening: bf16/f16 count
-            # at their own width, not the f32 compute copy's).
+            # bytes.in/out are PAYLOAD bytes on both planes: the
+            # caller-visible tensor at its own dtype's width (bf16/f16
+            # pre-widening, f32 pre-compression).  On-wire bytes are
+            # reported separately, in metrics_snapshot()["compression"]
+            # (wire_bytes vs payload_bytes), so the two never mix.
             _metrics.registry.record_enqueue(
                 "xla", self._OP_NAMES[kind],
                 int(np.prod(handle._shape))
@@ -862,10 +999,13 @@ def initialize(ps) -> Optional[XlaDataPlane]:
             if _plane:
                 # Re-init in the same process: the engine's tick counter
                 # and applied-parameter history restarted, so tick-keyed
-                # fusion thresholds memoized in the previous lifetime are
-                # stale (and, being per-rank wall-time artifacts, would
-                # split ranks into different bucket plans).
+                # fusion thresholds / compression modes memoized in the
+                # previous lifetime are stale (and, being per-rank
+                # wall-time artifacts, would split ranks into different
+                # bucket plans).  Residuals reset with the engine's.
                 _plane._tick_thresholds.clear()
+                _plane._tick_comp.clear()
+                _plane._residuals.clear()
             return _plane or None
         try:
             import jax

@@ -277,14 +277,22 @@ def test_autotune_set_is_rank0_only():
 def test_parse_fix():
     from horovod_tpu.common.autotune import parse_fix
 
-    assert parse_fix("") == (-1, -1.0)
-    assert parse_fix("fusion_threshold=1024") == (1024, -1.0)
-    assert parse_fix("cycle_time_ms=2.5") == (-1, 2.5)
-    assert parse_fix("fusion_threshold=8192, cycle_time_ms=5") == (8192, 5.0)
+    assert parse_fix("") == (-1, -1.0, -1)
+    assert parse_fix("fusion_threshold=1024") == (1024, -1.0, -1)
+    assert parse_fix("cycle_time_ms=2.5") == (-1, 2.5, -1)
+    assert parse_fix("fusion_threshold=8192, cycle_time_ms=5") == \
+        (8192, 5.0, -1)
+    # The wire-compression axis (docs/performance.md#wire-compression)
+    # pins by mode name; "off" pins it disabled rather than tuning it.
+    assert parse_fix("compression=bf16") == (-1, -1.0, 1)
+    assert parse_fix("compression=fp8") == (-1, -1.0, 2)
+    assert parse_fix("compression=off, cycle_time_ms=5") == (-1, 5.0, 0)
     with pytest.raises(ValueError, match="bad clause"):
         parse_fix("warmup=3")
     with pytest.raises(ValueError, match="bad value"):
         parse_fix("cycle_time_ms=fast")
+    with pytest.raises(ValueError, match="bad value"):
+        parse_fix("compression=int4")
     with pytest.raises(ValueError, match="negative"):
         parse_fix("fusion_threshold=-1")
 

@@ -1,0 +1,612 @@
+"""Wire-level gradient compression tests (docs/performance.md
+#wire-compression): bf16/fp8 on-the-wire allreduce with fp32 master
+copies and error-feedback residuals, negotiated per bucket.
+
+The contracts that must never regress: the kill switch restores the
+bit-identical fp32 wire; bf16-representable payloads reduce exactly;
+lossy modes stay within format tolerance of fp32 and the error-feedback
+residual carries the rounding error forward (cumulative results converge
+where plain quantization would drift); the per-bucket decision is
+lockstep-identical on every rank across response-cache replay and
+elastic reshapes; f16/bf16 payloads ship at native width (no 2x f32
+staging inflation); a mixed-env launch is rejected with a typed error at
+init; and the engine's fp8-e4m3fn encoder is bit-identical to the
+ml_dtypes cast the XLA plane mirrors with.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tests.distributed import distributed_test
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _arm(mode, min_bytes=64):
+    """Arm compression env for a rank process (before hvd.init())."""
+    os.environ["HVD_TPU_COMPRESSION"] = mode
+    os.environ["HVD_TPU_COMPRESSION_MIN_BYTES"] = str(min_bytes)
+
+
+def _allgather_str(hvd, text, name):
+    """Allgather a string across ranks (fixed-width byte rows)."""
+    raw = text.encode()[:4096].ljust(4096, b"\0")
+    rows = np.frombuffer(raw, np.uint8).reshape(1, -1)
+    out = hvd.allgather(rows, name=name)
+    return [bytes(out[i]).rstrip(b"\0").decode()
+            for i in range(out.shape[0])]
+
+
+# ---------------------------------------------------------------------------
+# Units: config parsing, the error-feedback quantizer, metrics surface.
+# ---------------------------------------------------------------------------
+
+
+def test_parse_compression_modes():
+    from horovod_tpu.common.config import Config, parse_compression
+
+    assert parse_compression(None) == 0
+    assert parse_compression("off") == 0
+    assert parse_compression("BF16") == 1
+    assert parse_compression("fp8") == 2
+    with pytest.raises(ValueError, match="HVD_TPU_COMPRESSION"):
+        parse_compression("int4")
+    cfg = Config(compression="bf16", compression_min_bytes=2048)
+    assert cfg.compression_code == 1
+    with pytest.raises(ValueError, match="unknown wire-compression"):
+        _ = Config(compression="wat").compression_code
+
+
+def test_lossy_autotune_pin_without_compression_is_rejected():
+    """HVD_TPU_AUTOTUNE_FIX=compression=bf16 with HVD_TPU_COMPRESSION off
+    (or with the hierarchical topology, whose star phases keep the
+    full-width wire) must fail at init, not silently pin the dead knob at
+    "none" — the parse_fix contract."""
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA"):
+        os.environ.pop(var, None)
+    import horovod_tpu as hvd
+
+    os.environ["HVD_TPU_AUTOTUNE_FIX"] = "compression=bf16"
+    try:
+        with pytest.raises(ValueError, match="HVD_TPU_COMPRESSION is off"):
+            hvd.init()
+        os.environ["HVD_TPU_COMPRESSION"] = "bf16"
+        os.environ["HVD_TPU_HIERARCHICAL_ALLREDUCE"] = "1"
+        with pytest.raises(ValueError, match="full-width wire"):
+            hvd.init()
+    finally:
+        for var in ("HVD_TPU_AUTOTUNE_FIX", "HVD_TPU_COMPRESSION",
+                    "HVD_TPU_HIERARCHICAL_ALLREDUCE"):
+            os.environ.pop(var, None)
+        hvd.shutdown()
+
+
+def test_quantize_error_feedback_residual_is_exact():
+    """The error-feedback unit contract: the residual EXACTLY carries the
+    rounding error (input == wire + residual bitwise in f32), for bf16
+    and fp8 alike, and the quantizer is a pure deterministic function —
+    the property that makes per-rank residual state equivalent on every
+    rank feeding identical inputs."""
+    from horovod_tpu.jax.eager_mesh import quantize_error_feedback
+
+    rng = np.random.RandomState(7)
+    x = np.concatenate([rng.randn(8192).astype(np.float32) * s
+                        for s in (1e-4, 1.0, 37.0, 500.0)])
+    for mode in (1, 2):
+        wire, residual = quantize_error_feedback(x, mode)
+        assert np.array_equal(x, wire.astype(np.float32) + residual), mode
+        wire2, residual2 = quantize_error_feedback(x, mode)
+        assert np.array_equal(wire.view(np.uint8), wire2.view(np.uint8))
+        assert np.array_equal(residual, residual2)
+    # bf16-representable values quantize losslessly: zero residual.
+    exact = np.arange(256, dtype=np.float32)
+    wire, residual = quantize_error_feedback(exact, 1)
+    assert not residual.any()
+    # fp8 saturates at +-448 instead of overflowing to nan (one outlier
+    # must not poison a fused bucket).
+    wire, _ = quantize_error_feedback(np.asarray([1e6, -1e6], np.float32), 2)
+    as_f32 = wire.astype(np.float32)
+    assert np.array_equal(as_f32, [448.0, -448.0]), as_f32
+
+
+def test_error_feedback_accumulates_small_updates():
+    """A component too small to survive one step's rounding accumulates
+    in the residual until it crosses a representable boundary — the sum
+    of quantized steps tracks the true sum, where plain quantization
+    would lose the component forever."""
+    from horovod_tpu.jax.eager_mesh import quantize_error_feedback
+
+    v = np.full(4, 1.0 + 2.0 ** -12, np.float32)  # rounds to 1.0 in bf16
+    residual = np.zeros_like(v)
+    total = np.zeros_like(v)
+    steps = 64
+    for _ in range(steps):
+        wire, residual = quantize_error_feedback(v + residual, 1)
+        total += wire.astype(np.float32)
+    true = float(steps) * (1.0 + 2.0 ** -12)
+    # With error feedback the cumulative sum lands within one bf16 ulp
+    # of the true total; without it the error would be steps * 2^-12.
+    assert abs(total[0] - true) <= 2.0 ** -8 * true, (total[0], true)
+    assert abs(total[0] - true) < steps * 2.0 ** -12 / 2, (total[0], true)
+
+
+def test_registry_compression_section_and_prometheus():
+    from horovod_tpu.common import metrics
+
+    reg = metrics.MetricsRegistry()  # never enabled: the section is ungated
+    snap = reg.snapshot()
+    assert snap["compression"]["mode"] == "off"
+    assert set(snap["compression"]["planes"]) == {"engine", "xla"}
+    reg.set_compression({
+        "mode": "bf16", "min_bytes": 1024,
+        "planes": {"engine": {"wire_bytes": 512, "payload_bytes": 1024,
+                              "ops": {"none": 1, "bf16": 3, "fp8": 0}}},
+        "residual_bytes": 4096, "residual_tensors": 2,
+    })
+    snap = reg.snapshot()
+    assert snap["compression"]["planes"]["engine"]["wire_bytes"] == 512
+    assert snap["compression"]["planes"]["xla"]["ops"]["bf16"] == 0
+    assert json.loads(json.dumps(snap)) == snap
+    text = metrics.prometheus_text(snap)
+    assert "hvd_tpu_compression_mode 1" in text
+    assert ('hvd_tpu_compression_wire_bytes_total{plane="engine"} 512'
+            in text)
+    assert ('hvd_tpu_compression_ops_total{plane="engine",mode="bf16"} 3'
+            in text)
+    assert "hvd_tpu_compression_residual_bytes 4096" in text
+
+
+def test_metrics_dump_renders_compression_line():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "metrics_dump", os.path.join(REPO, "tools", "metrics_dump.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from horovod_tpu.common import metrics
+
+    reg = metrics.MetricsRegistry()
+    reg.set_compression({
+        "mode": "bf16", "min_bytes": 1024,
+        "planes": {"engine": {"wire_bytes": 1 << 20,
+                              "payload_bytes": 1 << 21,
+                              "ops": {"none": 0, "bf16": 4, "fp8": 0}}},
+        "residual_bytes": 8192, "residual_tensors": 2,
+    })
+    out = mod.render(reg.snapshot())
+    assert "== compression ==" in out
+    assert "mode bf16" in out and "2.00x" in out, out
+
+
+def test_xla_plane_compressed_dispatch_single_process():
+    """The plane's jnp-cast mirror end to end on one process: with the
+    negotiated mode stubbed to bf16, an f32 bucket dispatches in the wire
+    dtype, the compiled program widens back to f32 before summing, the
+    residual buffer appears, and the wire/payload accounting shows the
+    2x ratio.  (Multi-process plane runs need a real fabric; the CPU
+    backend cannot run multiprocess XLA computations.)"""
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA"):
+        os.environ.pop(var, None)
+    os.environ["HVD_TPU_XLA_DATA_PLANE"] = "1"
+    import horovod_tpu as hvd
+    from horovod_tpu import common
+    from horovod_tpu.jax import eager_mesh
+
+    try:
+        hvd.init()
+        plane = common._xla_plane
+        assert plane is not None, "XLA plane failed to initialize"
+        plane._compression_for = lambda tick: 1  # stub the negotiated mode
+        plane._comp_min_bytes = 0
+        rng = np.random.RandomState(3)
+        x = rng.randn(2048).astype(np.float32)
+        out = hvd.allreduce(x, average=False, name="xp.comp")
+        # One rank: the "sum" is the quantize->dequantize round trip of
+        # (input + residual); with a zero starting residual that is the
+        # plain bf16 cast, and the residual now carries the error.
+        want = x.astype(eager_mesh._WIRE_DTYPES[1]).astype(np.float32)
+        assert np.array_equal(out, want)
+        assert "xp.comp" in plane._residuals
+        assert np.array_equal(x, out + plane._residuals["xp.comp"])
+        assert plane.comp_stats["ops"]["bf16"] == 1, plane.comp_stats
+        assert (plane.comp_stats["payload_bytes"]
+                == 2 * plane.comp_stats["wire_bytes"]), plane.comp_stats
+        snap = hvd.metrics_snapshot()
+        assert snap["compression"]["planes"]["xla"]["ops"]["bf16"] == 1
+        # Second step: the residual feeds back, so the cumulative sum of
+        # two steps is closer to 2x than 2 * single-step quantization.
+        out2 = hvd.allreduce(x, average=False, name="xp.comp")
+        err_ef = np.abs((out + out2) - 2 * x)
+        err_plain = np.abs(2 * want - 2 * x)
+        assert float(err_ef.sum()) <= float(err_plain.sum())
+    finally:
+        hvd.shutdown()
+        os.environ.pop("HVD_TPU_XLA_DATA_PLANE", None)
+        eager_mesh.reset()
+
+
+# ---------------------------------------------------------------------------
+# Engine end to end: numerics, bytes, lockstep, kill switch, fallbacks.
+# ---------------------------------------------------------------------------
+
+
+@distributed_test(np_=4)
+def test_bf16_exact_and_wire_ratio():
+    """bf16-representable payloads reduce exactly through the compressed
+    wire (quantization is lossless at every hop), the compressed buckets
+    move half the payload bytes, and the per-bucket decision log is
+    allgather-identical on every rank."""
+    import horovod_tpu as hvd
+
+    _arm("bf16")
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    base = hvd.compression_report()["engine"]
+    x = np.full(1024, float(r + 1), np.float32)
+    out = hvd.allreduce(x, average=False, name="cz.exact")
+    want = float(sum(range(1, n + 1)))
+    assert np.array_equal(out, np.full(1024, want, np.float32)), (r, out[:3])
+    rep = hvd.compression_report()
+    assert rep["mode"] == "bf16" and rep["min_bytes"] == 64, rep["mode"]
+    eng = rep["engine"]
+    dw = eng["wire_bytes"] - base["wire_bytes"]
+    dp = eng["payload_bytes"] - base["payload_bytes"]
+    assert (dw, dp) == (2048, 4096), (dw, dp)
+    assert eng["ops"]["bf16"] >= 1, eng
+    # Lockstep: every rank executed the same buckets in the same modes.
+    log = ";".join(f"{e['name']}|{e['mode']}" for e in rep["log"])
+    assert "cz.exact|bf16" in log, log
+    for peer in _allgather_str(hvd, log, "cz.log"):
+        assert peer == log, (r, log, peer)
+    # The flight recorder noted the armed mode (postmortem satellite).
+    from horovod_tpu import common
+
+    assert "compress" in common._lib.hvd_tpu_flight_dump().decode()
+
+
+@distributed_test(np_=4)
+def test_bf16_mean_within_tolerance_of_fp32():
+    import horovod_tpu as hvd
+
+    _arm("bf16")
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    x = np.random.RandomState(r).rand(4096).astype(np.float32) - 0.5
+    out = hvd.allreduce(x, average=True, name="cm.rand")
+    want = np.mean([np.random.RandomState(i).rand(4096).astype(np.float32)
+                    - 0.5 for i in range(n)], axis=0)
+    # Error feedback keeps the first step within a few bf16 ulps of the
+    # exact mean (per-hop f32 accumulation, quantized forwarding).
+    assert np.max(np.abs(out - want)) < 0.02, r
+
+
+@distributed_test(np_=2)
+def test_error_feedback_carries_across_steps():
+    """The residual carries each step's rounding error into the next
+    step's pre-compression add: the cumulative sum of compressed results
+    tracks the true cumulative sum far closer than repeating the plain
+    single-step quantization would."""
+    import horovod_tpu as hvd
+
+    _arm("bf16")
+    hvd.init()
+    n = hvd.size()
+    v = np.full(256, 0.5 + 3 * 2.0 ** -11, np.float32)  # rounds in bf16
+    steps = 64
+    total = np.zeros_like(v)
+    for s in range(steps):
+        total += hvd.allreduce(v, average=False, name="ef.step")
+    true = steps * n * (0.5 + 3 * 2.0 ** -11)
+    import ml_dtypes
+
+    q = float(np.asarray(0.5 + 3 * 2.0 ** -11,
+                         ml_dtypes.bfloat16).astype(np.float32))
+    plain_total = steps * n * q  # what no-EF quantization would deliver
+    err_ef = abs(float(total[0]) - true)
+    err_plain = abs(plain_total - true)
+    assert err_plain > 0  # the value genuinely rounds
+    assert err_ef < err_plain / 4, (err_ef, err_plain)
+    assert err_ef <= 2.0 ** -7 * true, (float(total[0]), true)
+
+
+@distributed_test(np_=2)
+def test_fp8_matches_ml_dtypes_and_tolerance():
+    """The engine's fp8-e4m3fn encoder is BIT-IDENTICAL to the ml_dtypes
+    cast the XLA plane mirrors with (rank 1 contributes zeros, so the
+    result is exactly the engine's quantize->dequantize of rank 0's
+    payload), and a real two-sided reduce stays within format
+    tolerance."""
+    import ml_dtypes
+
+    import horovod_tpu as hvd
+
+    _arm("fp8")
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    rng = np.random.RandomState(11)
+    x = np.concatenate([rng.randn(2048).astype(np.float32) * s
+                        for s in (1e-3, 1.0, 100.0, 400.0)])
+    mine = x if r == 0 else np.zeros_like(x)
+    out = hvd.allreduce(mine, average=False, name="f8.parity")
+    want = np.clip(x, -448, 448).astype(
+        ml_dtypes.float8_e4m3fn).astype(np.float32)
+    assert np.array_equal(out, want), r
+    y = np.random.RandomState(r).rand(4096).astype(np.float32)
+    out2 = hvd.allreduce(y, average=True, name="f8.rand")
+    want2 = np.mean([np.random.RandomState(i).rand(4096).astype(np.float32)
+                     for i in range(n)], axis=0)
+    assert np.max(np.abs(out2 - want2)) < 0.08, r
+    assert hvd.compression_report()["engine"]["ops"]["fp8"] >= 2
+
+
+@distributed_test(np_=3)
+def test_half_payloads_ship_native_width():
+    """f16/bf16 payloads cross the wire at their own width (the old path
+    staged through f32 and paid 2x the payload in bytes), with results
+    unchanged for representable values — even with compression off."""
+    import ml_dtypes
+
+    import horovod_tpu as hvd
+
+    hvd.init()  # compression off: native-width half wire is unconditional
+    r, n = hvd.rank(), hvd.size()
+    for dtype, tag in ((np.float16, "f16"), (ml_dtypes.bfloat16, "bf16")):
+        before = hvd.compression_report()["engine"]
+        x = np.full(512, 0.5 + r, dtype)
+        out = hvd.allreduce(x, average=False, name=f"hw.{tag}")
+        want = sum(0.5 + i for i in range(n))
+        assert np.allclose(np.asarray(out, np.float32), want, rtol=1e-2), \
+            (r, tag)
+        after = hvd.compression_report()["engine"]
+        dw = after["wire_bytes"] - before["wire_bytes"]
+        dp = after["payload_bytes"] - before["payload_bytes"]
+        assert dw == dp == 1024, (tag, dw, dp)  # wire == payload: no 2x
+
+
+@distributed_test(np_=2)
+def test_kill_switch_restores_bit_identical_fp32():
+    """HVD_TPU_COMPRESSION=off (the default) keeps the fp32 wire path
+    bit-identical: at two ranks the reduced value is the single exact
+    f32 add of both contributions, with zero compressed buckets and
+    wire bytes == payload bytes."""
+    import horovod_tpu as hvd
+
+    _arm("off")
+    hvd.init()
+    r = hvd.rank()
+    x = np.random.RandomState(r).randn(4096).astype(np.float32)
+    out = hvd.allreduce(x, average=False, name="ks.bits")
+    want = (np.random.RandomState(0).randn(4096).astype(np.float32)
+            + np.random.RandomState(1).randn(4096).astype(np.float32))
+    assert np.array_equal(out, want), r
+    eng = hvd.compression_report()["engine"]
+    assert eng["ops"]["bf16"] == eng["ops"]["fp8"] == 0, eng
+    assert eng["wire_bytes"] == eng["payload_bytes"], eng
+
+
+@distributed_test(np_=4)
+def test_cache_replay_keeps_compression_lockstep():
+    """Steady-state repeats replay from the response cache; the replayed
+    (re-fused) buckets recompute the same compression verdict on every
+    rank — results stay correct step over step, compressed-bucket counts
+    keep growing through the replay path, and the decision log stays
+    allgather-identical."""
+    import horovod_tpu as hvd
+
+    _arm("bf16")
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    def step(s):
+        handles = [
+            hvd.allreduce_async(np.full(256, float(r + k + s), np.float32),
+                                average=False, name=f"cr.{k}")
+            for k in range(4)
+        ]
+        for k, h in enumerate(handles):
+            out = h.wait()
+            want = float(sum(i + k + s for i in range(n)))
+            assert np.array_equal(out, np.full(256, want, np.float32)), \
+                (r, s, k)
+
+    step(0)  # warm: full negotiation populates the cache
+    warm = hvd.metrics_snapshot()
+    warm_cache = warm["cache"]["engine"]
+    warm_bf16 = warm["compression"]["planes"]["engine"]["ops"]["bf16"]
+    for s in range(1, 9):
+        step(s)
+    snap = hvd.metrics_snapshot()
+    c = snap["cache"]["engine"]
+    assert c["hits"] - warm_cache["hits"] >= 24, (r, warm_cache, c)
+    grown = (snap["compression"]["planes"]["engine"]["ops"]["bf16"]
+             - warm_bf16)
+    assert grown >= 8, (r, grown)  # replayed buckets compressed too
+    log = ";".join(f"{e['name']}|{e['mode']}"
+                   for e in hvd.compression_report()["log"])
+    for peer in _allgather_str(hvd, log, "cr.log"):
+        assert peer == log, (r, log, peer)
+
+
+@distributed_test(np_=2)
+def test_min_bytes_floor_keeps_small_buckets_uncompressed():
+    import horovod_tpu as hvd
+
+    _arm("bf16", min_bytes=8192)
+    hvd.init()
+    r = hvd.rank()
+    small = np.full(64, float(r), np.float32)     # 256 B < floor
+    big = np.full(4096, float(r), np.float32)     # 16 KiB >= floor
+    hvd.allreduce(small, average=False, name="fl.small")
+    hvd.allreduce(big, average=False, name="fl.big")
+    modes = {e["name"]: e["mode"]
+             for e in hvd.compression_report()["log"]}
+    assert modes["fl.small"] == "none", modes
+    assert modes["fl.big"] == "bf16", modes
+
+
+@distributed_test(np_=2)
+def test_mixed_env_init_rejected_with_typed_error():
+    """Disagreeing HVD_TPU_COMPRESSION across ranks must fail init with a
+    typed error naming the knob on EVERY rank — never split the job into
+    ranks that pack the same bucket differently."""
+    import horovod_tpu as hvd
+
+    rank = int(os.environ.get("HVD_TPU_RANK", "0"))
+    os.environ["HVD_TPU_COMPRESSION"] = "bf16" if rank == 0 else "off"
+    with pytest.raises(hvd.HorovodInternalError, match="HVD_TPU_COMPRESSION"):
+        hvd.init()
+
+
+@distributed_test(np_=2, timeout=240.0)
+def test_convergence_bf16_matches_fp32():
+    """A small data-parallel linear model trained with bf16 wire
+    gradients reaches a final loss within 2% of the uncompressed run
+    (same data, same steps; re-init flips the wire format only)."""
+    import horovod_tpu as hvd
+
+    def train(steps=60):
+        r, n = hvd.rank(), hvd.size()
+        rng = np.random.RandomState(0)
+        true_w = rng.randn(32).astype(np.float32)
+        data = rng.randn(n * 64, 32).astype(np.float32)
+        target = data @ true_w
+        mine = slice(r * 64, (r + 1) * 64)
+        X, y = data[mine], target[mine]
+        w = np.zeros(32, np.float32)
+        for s in range(steps):
+            pred = X @ w
+            grad = (2.0 / len(y)) * X.T @ (pred - y)
+            g = hvd.allreduce(grad.astype(np.float32), average=True,
+                              name="cv.grad")
+            w -= 0.01 * g
+        resid = data @ w - target
+        return float(np.mean(resid * resid))
+
+    _arm("bf16", min_bytes=0)
+    hvd.init()
+    loss_comp = train()
+    rep = hvd.compression_report()["engine"]
+    assert rep["ops"]["bf16"] >= 50, rep  # the gradients really compressed
+    hvd.shutdown()
+
+    _arm("off")
+    hvd.init()
+    loss_plain = train()
+    hvd.shutdown()
+    assert loss_comp <= max(loss_plain * 1.02, loss_plain + 1e-6), \
+        (loss_comp, loss_plain)
+
+
+@distributed_test(np_=2)
+def test_timeline_records_compress_attr(tmpdir=None):
+    """Compressed buckets stamp a COMPRESS_<mode> instant on their
+    timeline rows (NEGOTIATE at the coordinator, EXECUTE on every rank),
+    so postmortems show which wire format a bucket used."""
+    import tempfile
+
+    import horovod_tpu as hvd
+
+    _arm("bf16")
+    path = os.path.join(tempfile.gettempdir(),
+                        f"hvd_comp_tl_{os.getpid()}.json")
+    os.environ["HOROVOD_TIMELINE"] = path
+    try:
+        hvd.init()
+        r = hvd.rank()
+        hvd.allreduce(np.ones(1024, np.float32), average=False,
+                      name="tl.comp")
+        hvd.shutdown()
+        if r == 0:  # a plain file path is rank-0-only
+            with open(path) as f:
+                text = f.read()
+            assert "COMPRESS_bf16" in text, text[-2000:]
+    finally:
+        os.environ.pop("HOROVOD_TIMELINE", None)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Elastic reshape: the agreement survives a membership change.
+# ---------------------------------------------------------------------------
+
+_ELASTIC_TRAIN = """\
+import os, sys
+import numpy as np
+import horovod_tpu as hvd
+
+TOTAL = int(sys.argv[1])
+hvd.init()
+state = hvd.ElasticState(weights=np.zeros(1024, np.float32), step=0)
+
+def train(state):
+    while state.step < TOTAL:
+        g = np.ones(1024, np.float32)
+        state.weights = state.weights + hvd.allreduce(
+            g, average=True, name=f"grad.{state.step}")
+        state.step += 1
+    return state.weights
+
+w = hvd.run_elastic(train, state)
+assert np.allclose(w, float(TOTAL)), (hvd.rank(), w[0])
+rep = hvd.compression_report()
+m = hvd.metrics_snapshot()["membership"]
+log_tail = ";".join(f"{e['name']}|{e['mode']}" for e in rep["log"][-6:])
+print("COMP", hvd.rank(), hvd.size(), m["epoch"], rep["mode"],
+      rep["engine"]["ops"]["bf16"], flush=True)
+"""
+
+
+def test_reshape_reagrees_compression(tmp_path):
+    """A 3-rank elastic job with bf16 wire loses rank 2 mid-run: the
+    survivors re-agree the compression scheme at the reshape barrier and
+    keep compressing in the new membership (results stay exact, the mode
+    survives, compressed-bucket counts keep growing past the reshape)."""
+    from horovod_tpu.common.faults import CRASH_EXIT_CODE
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               HVD_TPU_COMPRESSION="bf16",
+               HVD_TPU_COMPRESSION_MIN_BYTES="64",
+               HVD_TPU_FAULT_SPEC="rank=2:crash@op=6",
+               HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20",
+               HVD_TPU_KILL_GRACE_SEC="3")
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA", "HVD_TPU_ELASTIC", "HVD_TPU_MIN_NP",
+                "HVD_TPU_REJOIN", "HVD_TPU_RESTART_EPOCH"):
+        env.pop(var, None)
+    script = tmp_path / "train.py"
+    script.write_text(_ELASTIC_TRAIN)
+    results = run_membership(
+        [sys.executable, str(script), "20"], 3, min_np=2, max_np=3,
+        max_rejoins=0, env=env, timeout=90.0, capture=True,
+        report=lambda msg: None)
+    by_slot = {r.rank: r for r in results}
+    assert by_slot[2].returncode == CRASH_EXIT_CODE, by_slot[2]
+    lines = []
+    for slot in (0, 1):
+        res = by_slot[slot]
+        assert res.returncode == 0, (slot, res.stderr[-800:])
+        lines += [l for l in res.stdout.splitlines()
+                  if l.startswith("COMP ")]
+    assert membership_succeeded(results, 2)
+    assert len(lines) == 2, lines
+    for line in lines:
+        tok = line.split()
+        # rank size epoch mode bf16_ops: mode survives the reshape and
+        # the survivors kept compressing (20 steps > the 6 pre-crash).
+        assert tok[2] == "2" and tok[3] == "1", line
+        assert tok[4] == "bf16", line
+        assert int(tok[5]) >= 12, line

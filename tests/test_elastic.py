@@ -164,6 +164,8 @@ def test_shrink_to_one_smoke(tmp_path):
     assert lost == [1] and w0 == 12, members
 
 
+@pytest.mark.slow  # ~19s SIGSTOP liveness path; the shrink contract
+# itself stays tier-1 (test_shrink_to_one_smoke / shrink_to_three)
 def test_frozen_rank_shrinks_instead_of_fatal_timeout(tmp_path):
     """A SIGSTOP'd rank is caught by the liveness probe AFTER the pending
     collectives have aged past HVD_TPU_COLLECTIVE_TIMEOUT_SEC (the probe

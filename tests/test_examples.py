@@ -43,6 +43,8 @@ def test_tensorflow_mnist_example():
     assert "Loss:" in out
 
 
+@pytest.mark.slow  # ~15s; the estimator binding keeps tier-1 coverage
+# in test_tensorflow.py (warm-start, train hooks)
 def test_tensorflow_mnist_estimator_example(tmp_path):
     """The estimator-path example (reference acceptance surface) runs on
     the shim when tf.estimator is absent: model_fn + EstimatorSpec +
@@ -74,6 +76,8 @@ def test_word2vec_example_sparse_path():
     assert "trained embeddings" in out
 
 
+@pytest.mark.slow  # ~15s; the keras binding keeps tier-1 coverage in
+# test_keras.py (callbacks, optimizer sync, lr warmup)
 def test_keras_mnist_advanced_example():
     """BASELINE.json acceptance config 2: the advanced Keras path
     (epoch-scaled training, LR warmup + schedule callbacks, metric

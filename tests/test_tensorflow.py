@@ -319,6 +319,8 @@ def test_tf_tape_gradient_is_differentiable():
     assert np.allclose(gg.numpy(), want_gg), (gg.numpy(), want_gg)
 
 
+@pytest.mark.slow  # ~16s; first-order tape differentiability stays
+# tier-1 (test_tf_tape_gradient_is_differentiable)
 @distributed_test(np_=2, timeout=300)
 def test_tf_tape_double_backward_in_graph_mode():
     """Gradient penalty under @tf.function with multiple variables: the

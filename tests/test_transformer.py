@@ -299,6 +299,8 @@ def test_migrate_rope_pairing_exact():
         jax.tree_util.tree_structure(params)
 
 
+@pytest.mark.slow  # ~26s compile-bound gradient check; forward parity
+# (test_sequence_parallel_fused_ring_matches) stays tier-1
 def test_sequence_parallel_fused_ring_gradients():
     """Training gradients through TransformerLM(ring_impl='fused') match
     the single-device model's — exercises the fused kernel's composed

@@ -68,16 +68,25 @@ def _numeric(value) -> Optional[float]:
 
 
 # Sign convention for extras: every headline metric in this repo is a
-# throughput (higher is better), but latency extras are the opposite —
-# a time-unit token marks them (`ttft_p99_ms`, `negotiation_p50_us_cached`),
-# so growth past the threshold is the regression, not shrinkage.  A unit
-# preceded by "per" is a rate (`ops_per_sec`), which stays higher-is-better.
-LATENCY_UNITS = frozenset(("ms", "us", "sec", "seconds"))
+# throughput (higher is better), but some extras are the opposite — a
+# time-unit token marks latencies (`ttft_p99_ms`,
+# `negotiation_p50_us_cached`), and a `bytes` / `inflation` token marks
+# wire-byte counters (`bf16_wire_bytes`, `half_wire_inflation` — the
+# compression bench, docs/performance.md#wire-compression): growth past
+# the threshold is the regression, not shrinkage.  A unit preceded by
+# "per" is a rate (`ops_per_sec`, `bytes_per_sec`), which stays
+# higher-is-better.
+LOWER_IS_BETTER_TOKENS = frozenset(
+    ("ms", "us", "sec", "seconds", "bytes", "inflation"))
 
 
 def lower_is_better(name: str) -> bool:
+    # A token adjacent to "per" on either side is part of a rate
+    # ("ops_per_sec", "bytes_per_sec") — rates stay higher-is-better.
     tokens = name.split("_")
-    return any(t in LATENCY_UNITS and (i == 0 or tokens[i - 1] != "per")
+    return any(t in LOWER_IS_BETTER_TOKENS
+               and (i == 0 or tokens[i - 1] != "per")
+               and (i + 1 >= len(tokens) or tokens[i + 1] != "per")
                for i, t in enumerate(tokens))
 
 

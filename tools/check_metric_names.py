@@ -47,6 +47,11 @@ SECTION_FAMILIES = {
                 "hvd_tpu_serving_steps_total"),
     "flight": ("hvd_tpu_flight_events_total",
                "hvd_tpu_flight_ring_capacity"),
+    "compression": ("hvd_tpu_compression_mode",
+                    "hvd_tpu_compression_wire_bytes_total",
+                    "hvd_tpu_compression_payload_bytes_total",
+                    "hvd_tpu_compression_ops_total",
+                    "hvd_tpu_compression_residual_bytes"),
     "histograms": (),
 }
 
@@ -80,6 +85,14 @@ def populated_registry():
     reg.set_serving_gauges(queue_depth=1, active=2, kv_blocks_in_use=3,
                            kv_blocks_total=8)
     reg.set_flight({"events": {"engine": 5, "xla": 2}, "capacity": 512})
+    reg.set_compression({
+        "mode": "bf16", "min_bytes": 1024,
+        "planes": {"engine": {"wire_bytes": 512, "payload_bytes": 1024,
+                              "ops": {"none": 1, "bf16": 2, "fp8": 0}},
+                   "xla": {"wire_bytes": 0, "payload_bytes": 0,
+                           "ops": {"none": 0, "bf16": 0, "fp8": 0}}},
+        "residual_bytes": 4096, "residual_tensors": 2,
+    })
     reg.set_autotune({
         "enabled": True, "frozen": True, "windows": 3,
         "fusion_threshold": 1 << 20, "cycle_time_ms": 2.5,

@@ -179,6 +179,40 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
         lines.append("(no cache traffic — disabled, single step, or a "
                      "pre-cache dump)")
 
+    # Wire compression (docs/performance.md#wire-compression); .get keeps
+    # pre-compression dumps readable.  Counters diff in two-file mode;
+    # mode/min-bytes/residual gauges stay absolute (the B dump's state).
+    comp = snap.get("compression", {})
+    comp_planes = comp.get("planes", {})
+    totals = {"wire": 0, "payload": 0, "compressed": 0}
+    base_planes = (base or {}).get("compression", {}).get("planes", {})
+    for plane, entry in comp_planes.items():
+        wire, payload = entry.get("wire_bytes", 0), entry.get(
+            "payload_bytes", 0)
+        compressed = sum(n for m, n in entry.get("ops", {}).items()
+                         if m != "none")
+        if base:
+            b = base_planes.get(plane, {})
+            wire -= b.get("wire_bytes", 0)
+            payload -= b.get("payload_bytes", 0)
+            compressed -= sum(n for m, n in b.get("ops", {}).items()
+                              if m != "none")
+        totals["wire"] += wire
+        totals["payload"] += payload
+        totals["compressed"] += compressed
+    if totals["payload"] or comp.get("mode", "off") != "off":
+        ratio = (totals["payload"] / totals["wire"]
+                 if totals["wire"] else 0.0)
+        lines.append("== compression ==")
+        lines.append(
+            f"mode {comp.get('mode', 'off')} "
+            f"(min {_fmt_bytes(comp.get('min_bytes', 0))}); wire "
+            f"{_fmt_bytes(totals['wire'])} for "
+            f"{_fmt_bytes(totals['payload'])} payload "
+            f"({ratio:.2f}x); compressed buckets {totals['compressed']}; "
+            f"residuals {_fmt_bytes(comp.get('residual_bytes', 0))} over "
+            f"{comp.get('residual_tensors', 0)} tensor(s)")
+
     # Elastic membership (docs/fault-tolerance.md#elastic-membership);
     # only rendered once the job reshaped, so pre-elastic dumps stay
     # unchanged.
