@@ -17,8 +17,8 @@ Extra errors are clipped to one short line.  BENCH_EXTRA=0 disables,
 BENCH_EXTRA_CONFIGS="seq:batch,..." overrides the sweep.
 
 Env knobs: BENCH_MODEL (resnet101|resnet50|resnet18|vgg16|inception_v3|
-mnist|transformer|allreduce|small_allreduce|big_allreduce|serve_decode|
-scaling), BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
+mnist|transformer|allreduce|small_allreduce|big_allreduce|hier_allreduce|
+serve_decode|scaling), BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
 length); transformer adds BENCH_SEQ/BENCH_VOCAB/BENCH_D_MODEL/BENCH_LAYERS/
 BENCH_HEADS; allreduce adds BENCH_NP/BENCH_BYTES/BENCH_ITERS;
 small_allreduce (the negotiation-bound cache microbench) adds
@@ -552,6 +552,119 @@ if hvd.rank() == 0:
     }))
 
 
+def bench_hier_allreduce() -> None:
+    """Two-level topology bench (docs/performance.md#two-level-topology):
+    flat-ring vs two-level allreduce at BENCH_NP ranks as
+    local_size-2 nodes, BENCH_BYTES fp32 steady-state.  Headline is the
+    two-level ops/sec; extra_metrics carries the flat baseline, the
+    per-phase mean times (``_ms`` extras gate lower-is-better in
+    tools/bench_compare.py), the per-hop wire bytes (``_bytes`` extras,
+    same convention), the bf16 cross-hop run and its DCN byte reduction
+    (asserted >= 1.8x in-bench), and the flat-vs-two-level bit identity
+    with compression off (exact integer payloads; the kill-switch
+    identity bar PR 9 set)."""
+    import subprocess
+    import sys
+
+    np_ = int(os.environ.get("BENCH_NP", "4"))
+    nbytes = int(os.environ.get("BENCH_BYTES", str(8 * 1024 * 1024)))
+    iters = int(os.environ.get("BENCH_ITERS", "16"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = f"""
+import hashlib, json, os, time, numpy as np
+rank = int(os.environ["HVD_TPU_RANK"])
+if os.environ.get("BENCH_HIER") == "1":
+    os.environ["HVD_TPU_LOCAL_SIZE"] = "2"
+    os.environ["HVD_TPU_LOCAL_RANK"] = str(rank % 2)
+    os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+import horovod_tpu as hvd
+hvd.init()
+n = {nbytes} // 4
+# Integer-valued fp32: sums are exact, so flat and two-level results can
+# bit-compare (association order cannot change bits).
+x = (np.arange(n) % 251 + hvd.rank()).astype(np.float32)
+out = hvd.allreduce(x, average=False, name="hier.steady")  # warm
+snap0 = hvd.metrics_snapshot()
+t0 = time.perf_counter()
+for i in range({iters}):
+    out = hvd.allreduce(x, average=False, name="hier.steady")
+dt = time.perf_counter() - t0
+snap1 = hvd.metrics_snapshot()
+topo0, topo1 = snap0["topology"], snap1["topology"]
+
+def phase_ms(name):
+    h0 = snap0["histograms"].get(name, {{"sum": 0.0, "count": 0}})
+    h1 = snap1["histograms"].get(name, {{"sum": 0.0, "count": 0}})
+    cnt = h1["count"] - h0["count"]
+    return 1e3 * (h1["sum"] - h0["sum"]) / cnt if cnt else 0.0
+
+if hvd.rank() == 0:
+    print("HIER_JSON " + json.dumps({{
+        "ops_per_sec": {iters} / dt,
+        "digest": hashlib.sha256(out.tobytes()).hexdigest(),
+        "local_bytes": topo1["bytes"]["local"] - topo0["bytes"]["local"],
+        "cross_bytes": topo1["bytes"]["cross"] - topo0["bytes"]["cross"],
+        "local_rs_ms": round(phase_ms("topology_local_rs_sec"), 3),
+        "cross_ms": round(phase_ms("topology_cross_sec"), 3),
+        "local_ag_ms": round(phase_ms("topology_local_ag_sec"), 3),
+    }}), flush=True)
+hvd.shutdown()
+"""
+
+    def run(hier: bool, mode: str) -> dict:
+        env = dict(os.environ,
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""),
+                   BENCH_HIER="1" if hier else "0",
+                   HVD_TPU_COMPRESSION=mode)
+        env.pop("HOROVOD_HIERARCHICAL_ALLREDUCE", None)
+        out = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
+             "--", sys.executable, "-c", code],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert out.returncode == 0, (hier, mode, out.stderr[-2000:])
+        return next(json.loads(line[len("HIER_JSON "):])
+                    for line in out.stdout.splitlines()
+                    if line.startswith("HIER_JSON "))
+
+    flat = run(False, "off")
+    hier = run(True, "off")
+    hier16 = run(True, "bf16")
+    # Kill-switch identity: flat and two-level agree BITWISE with
+    # compression off (exact payloads).
+    assert flat["digest"] == hier["digest"], (
+        "flat vs two-level results diverged bitwise with compression off")
+    ratio16 = hier["cross_bytes"] / max(hier16["cross_bytes"], 1)
+    floor = float(os.environ.get("BENCH_HIER_MIN_CROSS_RATIO", "1.8"))
+    assert ratio16 >= floor, (
+        f"bf16 cross hop moved only {ratio16:.2f}x fewer DCN bytes than "
+        f"full width (want >= {floor:.1f}x): {hier16['cross_bytes']} vs "
+        f"{hier['cross_bytes']}")
+    speedup = hier["ops_per_sec"] / max(flat["ops_per_sec"], 1e-9)
+    speed_floor = float(os.environ.get("BENCH_HIER_MIN_SPEEDUP", "0.9"))
+    assert speedup >= speed_floor, (
+        f"two-level ran {speedup:.2f}x the flat ring at "
+        f"{nbytes >> 20} MiB (want >= {speed_floor:.2f}x)")
+    print(json.dumps({
+        "metric": f"hier_allreduce_ops_per_sec_np{np_}",
+        "value": round(hier["ops_per_sec"], 2),
+        "unit": "ops/sec",
+        "vs_baseline": None,  # the reference published no such number
+        "extra_metrics": {
+            "flat_ops_per_sec": round(flat["ops_per_sec"], 2),
+            "bf16_ops_per_sec": round(hier16["ops_per_sec"], 2),
+            "two_level_speedup": round(speedup, 3),
+            "local_wire_bytes": hier["local_bytes"],
+            "cross_wire_bytes": hier["cross_bytes"],
+            "cross_wire_bytes_bf16": hier16["cross_bytes"],
+            "cross_compression_ratio": round(ratio16, 3),
+            "local_rs_ms": hier["local_rs_ms"],
+            "cross_ms": hier["cross_ms"],
+            "local_ag_ms": hier["local_ag_ms"],
+        },
+    }))
+
+
 def bench_serve_decode() -> None:
     """Serving-plane bench (docs/inference.md): a synthetic multi-tenant
     request stream against the continuous-batching engine over BENCH_NP
@@ -677,6 +790,8 @@ def main() -> None:
         return bench_small_allreduce()
     if model_name == "big_allreduce":
         return bench_big_allreduce()
+    if model_name == "hier_allreduce":
+        return bench_hier_allreduce()
     if model_name == "serve_decode":
         return bench_serve_decode()
     if model_name == "scaling":

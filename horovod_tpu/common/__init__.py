@@ -114,6 +114,10 @@ _engine_last_announce_seen: list = []
 # Response-cache sync state (docs/performance.md): engine-cumulative
 # hit/miss/eviction counts already folded into the registry.
 _engine_cache_seen = [0, 0, 0]
+# Two-level topology sync state: per-bucket phase records already folded
+# into the topology phase histograms (the engine log is bounded; the
+# cumulative count keeps totals honest past it).
+_engine_topo_seen = 0
 # Deterministic fault injection (common/faults.py, HVD_TPU_FAULT_SPEC):
 # the injector for this (rank, restart epoch), or None; and the per-process
 # submission index of user-level collectives it is driven by.
@@ -143,7 +147,8 @@ def _load_lib():
             ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong,
             ctypes.c_longlong, ctypes.c_double, ctypes.c_int,
             ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
-            ctypes.c_longlong, ctypes.c_longlong]
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_longlong]
         lib.hvd_tpu_init_error.restype = ctypes.c_char_p
         lib.hvd_tpu_enqueue.restype = ctypes.c_longlong
         lib.hvd_tpu_enqueue.argtypes = [
@@ -223,7 +228,15 @@ def _load_lib():
         lib.hvd_tpu_autotune_set.restype = ctypes.c_int
         lib.hvd_tpu_autotune_set.argtypes = [ctypes.c_longlong,
                                              ctypes.c_double,
+                                             ctypes.c_longlong,
                                              ctypes.c_longlong]
+        lib.hvd_tpu_autotune_cross_algo_threshold.restype = \
+            ctypes.c_longlong
+        lib.hvd_tpu_autotune_cross_algo_threshold.argtypes = []
+        lib.hvd_tpu_topology_info.restype = ctypes.c_char_p
+        lib.hvd_tpu_topology_info.argtypes = []
+        lib.hvd_tpu_topology_log.restype = ctypes.c_char_p
+        lib.hvd_tpu_topology_log.argtypes = []
         lib.hvd_tpu_fusion_threshold_at.restype = ctypes.c_longlong
         lib.hvd_tpu_fusion_threshold_at.argtypes = [ctypes.c_longlong]
         lib.hvd_tpu_compression_mode.restype = ctypes.c_int
@@ -325,24 +338,29 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
     # Pin-spec and compression-spec errors must surface at init, not be
     # silently dropped into a knob the user asked to hold
     # (common/autotune.py, common/config.py).
-    fix_fusion, fix_cycle, fix_comp = _autotune.parse_fix(cfg.autotune_fix)
+    fix_fusion, fix_cycle, fix_comp, fix_algo = _autotune.parse_fix(
+        cfg.autotune_fix)
     compression_code = cfg.compression_code  # ValueError on a bad mode
     if fix_comp > 0 and compression_code == 0:
         # The engine pins the autotune axis at "none" whenever the job
         # did not opt into compression (a tuner must never make an exact
         # job lossy) — so a lossy pin here would be silently dropped,
-        # the exact failure mode parse_fix exists to reject.
+        # the exact failure mode parse_fix exists to reject.  (A lossy
+        # pin WITH the two-level topology is fine: the negotiated mode
+        # narrows the cross-node/DCN hop there.)
         raise ValueError(
             "HVD_TPU_AUTOTUNE_FIX pins a lossy wire-compression mode but "
             "HVD_TPU_COMPRESSION is off; set HVD_TPU_COMPRESSION=bf16|fp8 "
             "(or drop the compression pin).")
-    if fix_comp > 0 and cfg.hierarchical_allreduce:
-        # Same contract for the two-level topology: its star phases keep
-        # the full-width wire, so the pinned knob would be dead.
+    if fix_algo >= 0 and not cfg.hierarchical_allreduce:
+        # The cross-algo axis only means anything on the two-level
+        # topology; the flat ring pins it silently at the env value, so
+        # an explicit pin there would be dropped — the same parse_fix
+        # contract the compression pin enforces.
         raise ValueError(
-            "HVD_TPU_AUTOTUNE_FIX pins a lossy wire-compression mode but "
-            "HOROVOD_HIERARCHICAL_ALLREDUCE keeps the full-width wire; "
-            "use the flat ring (or drop the compression pin).")
+            "HVD_TPU_AUTOTUNE_FIX pins cross_algo_threshold but the flat "
+            "ring has no cross-node hop; set "
+            "HVD_TPU_HIERARCHICAL_ALLREDUCE=1 (or drop the pin).")
     rc = lib.hvd_tpu_init(
         ps.rank, ps.size, ps.local_rank, ps.local_size,
         (ps.coord_endpoint or "").encode(), data.encode(),
@@ -352,7 +370,8 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
         int(cfg.autotune), cfg.autotune_warmup, cfg.autotune_window,
         fix_fusion, fix_cycle, int(cfg.elastic or cfg.rejoin),
         cfg.min_np, int(cfg.rejoin), compression_code,
-        cfg.compression_min_bytes, fix_comp)
+        cfg.compression_min_bytes, fix_comp, cfg.cross_algo_threshold,
+        fix_algo)
     if rc != 0:
         raise HorovodInternalError(
             "engine initialization failed: "
@@ -816,6 +835,53 @@ def _sync_engine_compression() -> None:
         })
 
 
+def _sync_engine_topology() -> None:
+    """Mirror the engine's two-level topology state into the registry's
+    ungated ``"topology"`` section (docs/performance.md
+    #two-level-topology) and fold the bounded per-bucket phase log into
+    the ``topology_*_sec`` phase histograms.  The gauges/counters are a
+    state copy like the compression sync; the log is delta-consumed like
+    the stall sync so repeated snapshots never double-observe."""
+    global _engine_topo_seen
+    if _lib is None:
+        return
+    with _stall_sync_lock:
+        parts = _lib.hvd_tpu_topology_info().decode().split("|")
+        try:
+            (hier, nodes, local_size, threshold, ops_ring, ops_tree,
+             local_bytes, cross_bytes, log_total) = (
+                int(p) for p in parts[:9])
+        except ValueError:
+            return
+        metrics.registry.set_topology({
+            "hierarchical": bool(hier),
+            "nodes": nodes,
+            "local_size": local_size,
+            "cross_algo_threshold": threshold,
+            "cross_ops": {"ring": ops_ring, "tree": ops_tree},
+            "bytes": {"local": local_bytes, "cross": cross_bytes},
+        })
+        new = log_total - _engine_topo_seen
+        if new <= 0:
+            return
+        _engine_topo_seen = log_total
+        entries = [e for e in
+                   _lib.hvd_tpu_topology_log().decode().split(";") if e]
+        for entry in entries[-new:]:
+            fields = entry.split("|")
+            if len(fields) != 5:
+                continue
+            try:
+                rs_us, cross_us, ag_us = (int(f) for f in fields[2:5])
+            except ValueError:
+                continue
+            metrics.registry.observe("topology_local_rs_sec", rs_us / 1e6)
+            if cross_us:
+                metrics.registry.observe("topology_cross_sec",
+                                         cross_us / 1e6)
+            metrics.registry.observe("topology_local_ag_sec", ag_us / 1e6)
+
+
 def _sync_engine_autotune() -> None:
     """Mirror the engine's autotuning state into the registry's ungated
     ``"autotune"`` section (docs/performance.md#autotuning).  Unlike the
@@ -848,6 +914,7 @@ def metrics_snapshot() -> dict:
     _sync_engine_membership()
     _sync_engine_flight()
     _sync_engine_compression()
+    _sync_engine_topology()
     return metrics.registry.snapshot()
 
 
@@ -859,6 +926,7 @@ def metrics_reset() -> None:
     _sync_engine_aborts()
     _sync_engine_announces()
     _sync_engine_cache()
+    _sync_engine_topology()
     metrics.registry.reset()
 
 
@@ -883,7 +951,8 @@ def autotune_report() -> dict:
 
 def autotune_set(fusion_threshold: Optional[int] = None,
                  cycle_time_ms: Optional[float] = None,
-                 compression: Optional[str] = None) -> None:
+                 compression: Optional[str] = None,
+                 cross_algo_threshold: Optional[int] = None) -> None:
     """Inject engine parameters for lockstep broadcast at the next
     negotiation tick — the pluggable-policy seam: a custom tuning policy
     runs on rank 0, reads ``metrics_snapshot()``, and drives the same
@@ -891,12 +960,15 @@ def autotune_set(fusion_threshold: Optional[int] = None,
     the change at the same tick boundary.  Works with the built-in tuner
     disabled or frozen; while a search is live it resumes from the
     nearest grid point.  ``compression`` takes a wire mode name
-    ("off"/"bf16"/"fp8").  Rank 0 only (``ValueError`` elsewhere)."""
+    ("off"/"bf16"/"fp8"); ``cross_algo_threshold`` the two-level
+    ring-vs-tree byte boundary (docs/performance.md#two-level-topology).
+    Rank 0 only (``ValueError`` elsewhere)."""
     lib = _load_lib()
     _check_initialized(lib)
     from horovod_tpu.common import autotune as _autotune
 
-    _autotune.set_params(lib, fusion_threshold, cycle_time_ms, compression)
+    _autotune.set_params(lib, fusion_threshold, cycle_time_ms, compression,
+                         cross_algo_threshold)
 
 
 def compression_report() -> dict:

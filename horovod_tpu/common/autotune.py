@@ -36,20 +36,28 @@ FUSION_GRID: Tuple[int, ...] = tuple(
     v << 10 for v in (64, 256, 1024, 4096, 16384, 65536, 262144))
 CYCLE_GRID_MS: Tuple[float, ...] = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0)
 COMPRESSION_GRID: Tuple[str, ...] = ("off", "bf16", "fp8")
+# The fourth axis (docs/performance.md#two-level-topology): the byte
+# boundary under which a two-level bucket's cross-node hop takes the
+# recursive-doubling tree instead of the ring.  Searchable only on the
+# hierarchical topology — the flat ring pins it (dead knob).
+CROSS_ALGO_GRID: Tuple[int, ...] = (0, 16 << 10, 64 << 10, 256 << 10,
+                                    1 << 20)
 
 # Knob names accepted by HVD_TPU_AUTOTUNE_FIX (and their report keys).
-KNOBS = ("fusion_threshold", "cycle_time_ms", "compression")
+KNOBS = ("fusion_threshold", "cycle_time_ms", "compression",
+         "cross_algo_threshold")
 
 
-def parse_fix(spec: str) -> Tuple[int, float, int]:
+def parse_fix(spec: str) -> Tuple[int, float, int, int]:
     """Parse ``HVD_TPU_AUTOTUNE_FIX`` ("k=v,..." with knobs from
     :data:`KNOBS`) into the engine's pin values ``(fix_fusion_bytes,
-    fix_cycle_ms, fix_compression_code)``; -1 means "tune this knob".
-    Raises ``ValueError`` on unknown knobs or unparsable/negative values
-    — a silently dropped pin would tune a knob the user asked to hold."""
+    fix_cycle_ms, fix_compression_code, fix_cross_algo_bytes)``; -1 means
+    "tune this knob".  Raises ``ValueError`` on unknown knobs or
+    unparsable/negative values — a silently dropped pin would tune a knob
+    the user asked to hold."""
     from horovod_tpu.common.config import parse_compression
 
-    fix_fusion, fix_cycle, fix_comp = -1, -1.0, -1
+    fix_fusion, fix_cycle, fix_comp, fix_algo = -1, -1.0, -1, -1
     for clause in (spec or "").split(","):
         clause = clause.strip()
         if not clause:
@@ -78,9 +86,11 @@ def parse_fix(spec: str) -> Tuple[int, float, int]:
                 f"HVD_TPU_AUTOTUNE_FIX: negative value in {clause!r}")
         if key == "fusion_threshold":
             fix_fusion = int(num)
+        elif key == "cross_algo_threshold":
+            fix_algo = int(num)
         else:
             fix_cycle = num
-    return fix_fusion, fix_cycle, fix_comp
+    return fix_fusion, fix_cycle, fix_comp, fix_algo
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,10 +134,12 @@ def _comp_name(code: str) -> str:
 
 _HISTORY_FIELDS = (("window", int), ("fusion_threshold", int),
                    ("cycle_time_ms", _cycle_ms),
-                   ("compression", _comp_name), ("score", float))
+                   ("compression", _comp_name),
+                   ("cross_algo_threshold", int), ("score", float))
 _APPLIED_FIELDS = (("tick", int), ("fusion_threshold", int),
                    ("cycle_time_ms", _cycle_ms),
                    ("compression", _comp_name),
+                   ("cross_algo_threshold", int),
                    ("frozen", lambda v: v == "1"))
 
 
@@ -147,6 +159,8 @@ def report(lib) -> dict:
         "cycle_time_ms": int(lib.hvd_tpu_autotune_cycle_time_us()) / 1000.0,
         "compression": COMPRESSION_NAMES.get(
             int(lib.hvd_tpu_compression_mode()), "off"),
+        "cross_algo_threshold": int(
+            lib.hvd_tpu_autotune_cross_algo_threshold()),
         "best_score": float(lib.hvd_tpu_autotune_best_score()),
         "history": _parse_log(
             lib.hvd_tpu_autotune_history().decode(), _HISTORY_FIELDS),
@@ -160,13 +174,14 @@ def empty_report() -> dict:
     ``metrics_snapshot()["autotune"]`` structurally stable (ungated)."""
     return {"enabled": False, "frozen": False, "windows": 0,
             "fusion_threshold": 0, "cycle_time_ms": 0.0,
-            "compression": "off", "best_score": 0.0,
-            "history": [], "applied": []}
+            "compression": "off", "cross_algo_threshold": 0,
+            "best_score": 0.0, "history": [], "applied": []}
 
 
 def set_params(lib, fusion_threshold: Optional[int] = None,
                cycle_time_ms: Optional[float] = None,
-               compression: Optional[str] = None) -> None:
+               compression: Optional[str] = None,
+               cross_algo_threshold: Optional[int] = None) -> None:
     """Inject parameters for lockstep broadcast at the next tick (rank 0
     only — the coordinator owns the broadcast).  The engine applies them
     on every rank at the same tick boundary, exactly like search
@@ -174,14 +189,16 @@ def set_params(lib, fusion_threshold: Optional[int] = None,
     from horovod_tpu.common.config import parse_compression
 
     if (fusion_threshold is None and cycle_time_ms is None
-            and compression is None):
+            and compression is None and cross_algo_threshold is None):
         raise ValueError(
             "autotune_set: provide fusion_threshold, cycle_time_ms, "
-            "and/or compression")
+            "compression, and/or cross_algo_threshold")
     if fusion_threshold is not None and int(fusion_threshold) < 0:
         raise ValueError("autotune_set: fusion_threshold must be >= 0")
     if cycle_time_ms is not None and float(cycle_time_ms) < 0:
         raise ValueError("autotune_set: cycle_time_ms must be >= 0")
+    if cross_algo_threshold is not None and int(cross_algo_threshold) < 0:
+        raise ValueError("autotune_set: cross_algo_threshold must be >= 0")
     comp_code = -1
     if compression is not None:
         try:
@@ -193,7 +210,8 @@ def set_params(lib, fusion_threshold: Optional[int] = None,
     rc = lib.hvd_tpu_autotune_set(
         -1 if fusion_threshold is None else int(fusion_threshold),
         -1.0 if cycle_time_ms is None else float(cycle_time_ms),
-        comp_code)
+        comp_code,
+        -1 if cross_algo_threshold is None else int(cross_algo_threshold))
     if rc == 1:
         raise ValueError(
             "autotune_set: only rank 0 (the coordinator) can inject "

@@ -54,11 +54,18 @@ class Config:
     cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
     stall_warning_sec: float = DEFAULT_STALL_WARNING_SEC
     timeline_path: str = ""          # Chrome-tracing JSON output, rank 0
-    # Two-level allreduce: node-local reduce to the leader, ring across
-    # leaders, node-local broadcast (requires the hvdrun contiguous-block
-    # rank layout).  The engine analogue of the reference's
-    # HOROVOD_HIERARCHICAL_ALLREDUCE (operations.cc:1003-1048).
+    # Two-level allreduce (docs/performance.md#two-level-topology):
+    # node-local reduce-scatter, one cross-node (DCN) exchange per local
+    # rank over its 1/local_size shard, node-local allgather — requires
+    # the hvdrun contiguous-block rank layout.  The bandwidth-optimal
+    # successor of the reference's HOROVOD_HIERARCHICAL_ALLREDUCE star
+    # (operations.cc:1003-1048).
     hierarchical_allreduce: bool = False
+    # Ring-vs-tree boundary for the two-level cross-node hop: buckets
+    # under this many bytes take the recursive-doubling (tree) exchange
+    # (log2(nodes) latency steps), the rest the bandwidth-optimal ring.
+    # Autotuned as the fourth ParameterManager axis; 0 = ring always.
+    cross_algo_threshold: int = 64 * 1024
     # Execute eager collectives as compiled XLA collectives over the
     # accelerator fabric (jax.distributed across the job) instead of the TCP
     # ring — the TPU mapping of the reference's NCCL data plane
@@ -176,6 +183,8 @@ class Config:
             hierarchical_allreduce=_flag(
                 _get("HVD_TPU_HIERARCHICAL_ALLREDUCE",
                      "HOROVOD_HIERARCHICAL_ALLREDUCE")),
+            cross_algo_threshold=int(os.environ.get(
+                "HVD_TPU_CROSS_ALGO_THRESHOLD") or 64 * 1024),
             xla_data_plane=(None if (plane := _get(
                 "HVD_TPU_XLA_DATA_PLANE", "HOROVOD_XLA_DATA_PLANE")) is None
                 else _flag(plane)),

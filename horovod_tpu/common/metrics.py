@@ -69,6 +69,15 @@ HISTOGRAMS = {
     "serving_token_sec": (LATENCY_BUCKETS,
                           "serving plane: mean per-token latency of "
                           "retired requests (end-to-end / tokens)"),
+    "topology_local_rs_sec": (LATENCY_BUCKETS,
+                              "two-level allreduce: node-local "
+                              "reduce-scatter phase per bucket"),
+    "topology_cross_sec": (LATENCY_BUCKETS,
+                           "two-level allreduce: cross-node (DCN) "
+                           "exchange per bucket, ring or tree"),
+    "topology_local_ag_sec": (LATENCY_BUCKETS,
+                              "two-level allreduce: node-local "
+                              "allgather phase per bucket"),
 }
 
 # Cap on distinct stalled-tensor entries kept by name; beyond it new names
@@ -200,6 +209,18 @@ class MetricsRegistry:
                        for p in PLANES},
             "residual_bytes": 0, "residual_tensors": 0,
         }
+        # Two-level topology (docs/performance.md#two-level-topology):
+        # the engine's topology shape, ring/tree bucket counts, and
+        # per-hop byte totals, mirrored on every snapshot; the matching
+        # per-bucket phase timings land in the topology_*_sec
+        # histograms.  Ungated, like stalls: topology tests assert byte
+        # splits without enabling full metrics.
+        self._topology = {
+            "hierarchical": False, "nodes": 1, "local_size": 1,
+            "cross_algo_threshold": 0,
+            "cross_ops": {"ring": 0, "tree": 0},
+            "bytes": {"local": 0, "cross": 0},
+        }
         self._hists = {name: Histogram(bounds)
                        for name, (bounds, _) in HISTOGRAMS.items()}
 
@@ -306,6 +327,23 @@ class MetricsRegistry:
                 "planes": planes,
                 "residual_bytes": int(state.get("residual_bytes", 0)),
                 "residual_tensors": int(state.get("residual_tensors", 0)),
+            }
+
+    def set_topology(self, state: dict) -> None:
+        """Mirror the engine's two-level topology state (a state copy —
+        the underlying counters are cumulative, so overwriting is
+        idempotent, like the compression mirror).  Ungated."""
+        with self._lock:
+            self._topology = {
+                "hierarchical": bool(state.get("hierarchical", False)),
+                "nodes": int(state.get("nodes", 1)),
+                "local_size": int(state.get("local_size", 1)),
+                "cross_algo_threshold": int(
+                    state.get("cross_algo_threshold", 0)),
+                "cross_ops": {a: int(state.get("cross_ops", {}).get(a, 0))
+                              for a in ("ring", "tree")},
+                "bytes": {h: int(state.get("bytes", {}).get(h, 0))
+                          for h in ("local", "cross")},
             }
 
     def set_autotune(self, report: dict) -> None:
@@ -443,6 +481,12 @@ class MetricsRegistry:
                     "residual_bytes": self._compression["residual_bytes"],
                     "residual_tensors":
                         self._compression["residual_tensors"],
+                },
+                "topology": {
+                    **{k: v for k, v in self._topology.items()
+                       if k not in ("cross_ops", "bytes")},
+                    "cross_ops": dict(self._topology["cross_ops"]),
+                    "bytes": dict(self._topology["bytes"]),
                 },
                 "histograms": {name: h.to_dict()
                                for name, h in self._hists.items()},
@@ -697,6 +741,39 @@ def prometheus_text(snapshot: dict) -> str:
     out.append("# TYPE hvd_tpu_compression_residual_bytes gauge")
     out.append("hvd_tpu_compression_residual_bytes "
                f"{comp.get('residual_bytes', 0)}")
+
+    topo = snapshot.get("topology", {})
+    out.append("# HELP hvd_tpu_topology_hierarchical "
+               "two-level allreduce topology active "
+               "(docs/performance.md#two-level-topology)")
+    out.append("# TYPE hvd_tpu_topology_hierarchical gauge")
+    out.append("hvd_tpu_topology_hierarchical "
+               f"{int(topo.get('hierarchical', False))}")
+    out.append("# HELP hvd_tpu_topology_nodes "
+               "node count of the two-level topology (1 = flat)")
+    out.append("# TYPE hvd_tpu_topology_nodes gauge")
+    out.append(f"hvd_tpu_topology_nodes {topo.get('nodes', 1)}")
+    out.append("# HELP hvd_tpu_topology_local_size "
+               "ranks per node in the two-level topology")
+    out.append("# TYPE hvd_tpu_topology_local_size gauge")
+    out.append(f"hvd_tpu_topology_local_size {topo.get('local_size', 1)}")
+    out.append("# HELP hvd_tpu_topology_cross_algo_threshold_bytes "
+               "ring-vs-tree boundary for the cross-node hop "
+               "(buckets under it take the tree)")
+    out.append("# TYPE hvd_tpu_topology_cross_algo_threshold_bytes gauge")
+    out.append("hvd_tpu_topology_cross_algo_threshold_bytes "
+               f"{topo.get('cross_algo_threshold', 0)}")
+    out.append("# HELP hvd_tpu_topology_cross_ops_total "
+               "two-level buckets executed per cross-node algorithm")
+    out.append("# TYPE hvd_tpu_topology_cross_ops_total counter")
+    for algo, n in topo.get("cross_ops", {}).items():
+        out.append(f'hvd_tpu_topology_cross_ops_total{{algo="{algo}"}} {n}')
+    out.append("# HELP hvd_tpu_topology_bytes_total "
+               "two-level allreduce wire bytes sent per hop "
+               "(local = intra-node ring, cross = DCN)")
+    out.append("# TYPE hvd_tpu_topology_bytes_total counter")
+    for hop, n in topo.get("bytes", {}).items():
+        out.append(f'hvd_tpu_topology_bytes_total{{hop="{hop}"}} {n}')
 
     skew = snapshot.get("skew", {})
     out.append("# HELP hvd_tpu_announce_total "

@@ -18,6 +18,12 @@ const std::vector<double> kCycleGridMs = {0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
 // CompressionMode codes ordered by wire aggressiveness (none 0, bf16 1,
 // fp8 2): climbing +1 moves fewer bytes per bucket.
 const std::vector<int64_t> kCompressionGrid = {0, 1, 2};
+// Ring-vs-tree boundary for the two-level cross-node hop (bytes; buckets
+// under the boundary take the recursive-doubling tree).  0 = ring always;
+// the top end brackets the latency-bound bucket sizes the small-allreduce
+// bench exercises.
+const std::vector<int64_t> kCrossAlgoGrid = {0, 16 << 10, 64 << 10,
+                                             256 << 10, 1 << 20};
 
 namespace {
 
@@ -43,6 +49,7 @@ int SnapLog(const std::vector<T>& grid, double value) {
   int best = 0;
   double best_d = -1;
   for (size_t i = 0; i < grid.size(); ++i) {
+    if (static_cast<double>(grid[i]) <= 0) continue;  // log(0): see below
     double d = std::fabs(std::log(static_cast<double>(grid[i])) -
                          std::log(value));
     if (best_d < 0 || d < best_d) {
@@ -50,6 +57,8 @@ int SnapLog(const std::vector<T>& grid, double value) {
       best = static_cast<int>(i);
     }
   }
+  // A non-positive grid point (the cross-algo grid's "0 = ring always")
+  // is only reachable by a non-positive value, handled above.
   return best;
 }
 
@@ -59,8 +68,10 @@ void ParameterManager::Configure(bool enabled, int64_t warmup_windows,
                                  int64_t window_ops, int64_t fix_fusion,
                                  double fix_cycle_ms,
                                  int64_t fix_compression,
+                                 int64_t fix_cross_algo,
                                  int64_t init_fusion, double init_cycle_ms,
-                                 int64_t init_compression) {
+                                 int64_t init_compression,
+                                 int64_t init_cross_algo) {
   std::lock_guard<std::mutex> lk(mu_);
   enabled_ = enabled;
   done_ = !enabled;
@@ -73,20 +84,25 @@ void ParameterManager::Configure(bool enabled, int64_t warmup_windows,
   axes_comp_ = fix_compression >= 0
                    ? std::vector<int64_t>{fix_compression}
                    : kCompressionGrid;
+  axes_algo_ = fix_cross_algo >= 0 ? std::vector<int64_t>{fix_cross_algo}
+                                   : kCrossAlgoGrid;
   init_fusion_ = init_fusion;
   init_cycle_ms_ = init_cycle_ms;
   init_comp_ = init_compression;
+  init_algo_ = init_cross_algo;
   idx_[0] = SnapLog(axes_fusion_, static_cast<double>(init_fusion));
   idx_[1] = SnapLog(axes_cycle_, init_cycle_ms);
   idx_[2] = 0;
   for (size_t i = 0; i < axes_comp_.size(); ++i)
     if (axes_comp_[i] == init_compression) idx_[2] = static_cast<int>(i);
+  idx_[3] = SnapLog(axes_algo_, static_cast<double>(init_cross_algo));
   // Cycle first, climbing down: the idle-cadence co-arrival sleep is the
   // dominant knob for the negotiation-bound steady state (docs/
   // performance.md), and a too-high cycle drowns any fusion signal.
   axis_ = axes_cycle_.size() > 1 ? 1
           : axes_fusion_.size() > 1 ? 0
-                                    : 2;
+          : axes_comp_.size() > 1  ? 2
+                                    : 3;
   dir_ = axis_ == 1 ? -1 : +1;
   tried_flip_ = false;
   have_anchor_ = false;
@@ -122,23 +138,26 @@ ParameterManager::Proposal ParameterManager::MakeProposal(bool frozen) {
   p.fusion_threshold = GridFusion();
   p.cycle_time_us = static_cast<int64_t>(GridCycleMs() * 1000.0);
   p.compression = GridCompression();
+  p.cross_algo_threshold = GridCrossAlgo();
   std::lock_guard<std::mutex> lk(mu_);
   p.window = windows_;
   return p;
 }
 
 void ParameterManager::Inject(int64_t fusion, double cycle_ms,
-                              int64_t compression) {
+                              int64_t compression, int64_t cross_algo) {
   std::lock_guard<std::mutex> lk(mu_);
   inject_pending_ = true;
   inject_fusion_ = fusion;
   inject_cycle_ms_ = cycle_ms;
   inject_comp_ = compression;
+  inject_algo_ = cross_algo;
 }
 
 void ParameterManager::Tick(std::chrono::steady_clock::time_point now,
                             int64_t cur_fusion, double cur_cycle_ms,
-                            int64_t cur_compression, Proposal* out) {
+                            int64_t cur_compression,
+                            int64_t cur_cross_algo, Proposal* out) {
   {
     // Manual injection (hvd.autotune_set) broadcasts exactly the caller's
     // values this tick — works with the tuner disabled or frozen (the
@@ -153,12 +172,15 @@ void ParameterManager::Tick(std::chrono::steady_clock::time_point now,
       double cycle = inject_cycle_ms_ >= 0 ? inject_cycle_ms_
                                            : cur_cycle_ms;
       int64_t comp = inject_comp_ >= 0 ? inject_comp_ : cur_compression;
+      int64_t algo = inject_algo_ >= 0 ? inject_algo_ : cur_cross_algo;
       if (inject_fusion_ >= 0)
         idx_[0] = SnapLog(axes_fusion_, static_cast<double>(fusion));
       if (inject_cycle_ms_ >= 0) idx_[1] = SnapLog(axes_cycle_, cycle);
       if (inject_comp_ >= 0)
         for (size_t i = 0; i < axes_comp_.size(); ++i)
           if (axes_comp_[i] == comp) idx_[2] = static_cast<int>(i);
+      if (inject_algo_ >= 0)
+        idx_[3] = SnapLog(axes_algo_, static_cast<double>(algo));
       have_anchor_ = false;
       tried_flip_ = false;
       // De-anchor: the next window runs under the EXACT injected values,
@@ -175,6 +197,7 @@ void ParameterManager::Tick(std::chrono::steady_clock::time_point now,
       out->fusion_threshold = fusion;
       out->cycle_time_us = static_cast<int64_t>(cycle * 1000.0);
       out->compression = comp;
+      out->cross_algo_threshold = algo;
       out->window = windows_;
       return;
     }
@@ -203,12 +226,14 @@ void ParameterManager::CloseWindow(double score, Proposal* out) {
     int64_t fus = anchored_ ? GridFusion() : init_fusion_;
     double cyc = anchored_ ? GridCycleMs() : init_cycle_ms_;
     int64_t cmp = anchored_ ? GridCompression() : init_comp_;
-    char buf[112];
-    snprintf(buf, sizeof(buf), "%lld|%lld|%lld|%lld|%.1f",
+    int64_t alg = anchored_ ? GridCrossAlgo() : init_algo_;
+    char buf[144];
+    snprintf(buf, sizeof(buf), "%lld|%lld|%lld|%lld|%lld|%.1f",
              static_cast<long long>(windows_),
              static_cast<long long>(fus),
              static_cast<long long>(cyc * 1000.0),
-             static_cast<long long>(cmp), score);
+             static_cast<long long>(cmp),
+             static_cast<long long>(alg), score);
     history_.emplace_back(buf);
     while (history_.size() > kHistoryCap) history_.pop_front();
   }
@@ -234,7 +259,7 @@ void ParameterManager::CloseWindow(double score, Proposal* out) {
 void ParameterManager::BroadcastAnchor(Proposal* out) {
   anchored_ = true;
   if (axes_fusion_.size() == 1 && axes_cycle_.size() == 1 &&
-      axes_comp_.size() == 1) {
+      axes_comp_.size() == 1 && axes_algo_.size() == 1) {
     // Every knob pinned: nothing to search.  Broadcast the pinned point
     // once, frozen.
     FreezeAtBest(out);
@@ -244,7 +269,7 @@ void ParameterManager::BroadcastAnchor(Proposal* out) {
 }
 
 void ParameterManager::Step(double score, Proposal* out) {
-  std::array<int, 3> point{{idx_[0], idx_[1], idx_[2]}};
+  std::array<int, 4> point{{idx_[0], idx_[1], idx_[2], idx_[3]}};
   auto& mem = memory_[point];
   mem.first += score;
   mem.second += 1;
@@ -313,7 +338,8 @@ void ParameterManager::Step(double score, Proposal* out) {
 bool ParameterManager::MoveOn(int axis, int dir) {
   int n = axis == 0   ? static_cast<int>(axes_fusion_.size())
           : axis == 1 ? static_cast<int>(axes_cycle_.size())
-                      : static_cast<int>(axes_comp_.size());
+          : axis == 2 ? static_cast<int>(axes_comp_.size())
+                      : static_cast<int>(axes_algo_.size());
   int next = idx_[axis] + dir;
   if (next < 0 || next >= n) return false;
   idx_[axis] = next;
@@ -323,10 +349,10 @@ bool ParameterManager::MoveOn(int axis, int dir) {
 void ParameterManager::SwitchAxis(double last_score) {
   // Hand the climb to the next knob; the measurement of the CURRENT
   // point becomes its anchor, so no window is wasted re-measuring.
-  for (int attempt = 0; attempt < 3; ++attempt) {
-    axis_ = (axis_ + 1) % 3;
+  for (int attempt = 0; attempt < 4; ++attempt) {
+    axis_ = (axis_ + 1) % 4;
     // Heuristic first direction: bigger fusion buckets, tighter cycle,
-    // more aggressive wire compression.
+    // more aggressive wire compression, wider tree boundary.
     dir_ = axis_ == 1 ? -1 : +1;
     have_anchor_ = true;
     anchor_score_ = last_score;
@@ -349,7 +375,7 @@ void ParameterManager::FreezeAtBest(Proposal* out) {
   // view), so a run of small accepted moves can leave the real best only
   // in memory_; means, not maxes, keep one lucky window from deciding
   // the job's permanent parameters.
-  const std::array<int, 3>* argmax = nullptr;
+  const std::array<int, 4>* argmax = nullptr;
   double argmax_score = 0.0;
   for (const auto& kv : memory_) {
     double mean = kv.second.first / kv.second.second;
@@ -359,18 +385,14 @@ void ParameterManager::FreezeAtBest(Proposal* out) {
     }
   }
   if (argmax != nullptr) {
-    idx_[0] = (*argmax)[0];
-    idx_[1] = (*argmax)[1];
-    idx_[2] = (*argmax)[2];
+    for (int a = 0; a < 4; ++a) idx_[a] = (*argmax)[a];
     // The reported best score must describe the FROZEN point: assign the
     // argmax mean outright — best_score_ may hold a lucky spike from a
     // point the mean ranking rejected.
     std::lock_guard<std::mutex> lk(mu_);
     best_score_ = argmax_score;
   } else if (have_best_) {
-    idx_[0] = best_point_[0];
-    idx_[1] = best_point_[1];
-    idx_[2] = best_point_[2];
+    for (int a = 0; a < 4; ++a) idx_[a] = best_point_[a];
   }
   done_ = true;
   *out = MakeProposal(true);

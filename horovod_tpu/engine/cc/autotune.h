@@ -47,6 +47,11 @@ extern const std::vector<double> kCycleGridMs;   // milliseconds
 // must never turn a lossy wire format on for a job that asked for exact
 // fp32 (engine.cc pins the axis at the env value in that case).
 extern const std::vector<int64_t> kCompressionGrid;
+// Two-level cross-node ring-vs-tree boundary (bytes; buckets under it take
+// the recursive-doubling tree exchange): searched only when the job runs
+// the hierarchical topology — on the flat ring the knob is dead and
+// engine.cc pins the axis at the env value.  0 = ring always.
+extern const std::vector<int64_t> kCrossAlgoGrid;
 
 class ParameterManager {
  public:
@@ -56,16 +61,19 @@ class ParameterManager {
     int64_t fusion_threshold = 0;
     int64_t cycle_time_us = 0;
     int64_t compression = 0;  // CompressionMode code
+    int64_t cross_algo_threshold = 0;  // ring-vs-tree boundary, bytes
     int64_t window = 0;  // completed-window count when proposed
   };
 
-  // `fix_fusion` / `fix_cycle_ms` / `fix_compression` pin a knob (< 0 =
-  // tune it); the initial values seed the search (snapped to the nearest
-  // grid point in log space at the first post-warmup broadcast).
+  // `fix_fusion` / `fix_cycle_ms` / `fix_compression` / `fix_cross_algo`
+  // pin a knob (< 0 = tune it); the initial values seed the search
+  // (snapped to the nearest grid point in log space at the first
+  // post-warmup broadcast).
   void Configure(bool enabled, int64_t warmup_windows, int64_t window_ops,
                  int64_t fix_fusion, double fix_cycle_ms,
-                 int64_t fix_compression, int64_t init_fusion,
-                 double init_cycle_ms, int64_t init_compression);
+                 int64_t fix_compression, int64_t fix_cross_algo,
+                 int64_t init_fusion, double init_cycle_ms,
+                 int64_t init_compression, int64_t init_cross_algo);
 
   bool enabled() const { return enabled_; }
   // Still searching: windows are being scored and candidates proposed.
@@ -80,17 +88,20 @@ class ParameterManager {
   // Rank 0, once per engine tick: closes the window when due and fills
   // `out` with the next candidate (or the freeze verdict).  `out->present`
   // stays false on ticks with nothing to broadcast.  `cur_fusion` /
-  // `cur_cycle_ms` / `cur_compression` are the engine's currently APPLIED
-  // values — a manual injection that sets only some knobs keeps the
-  // others at their applied values (which need not be grid points).
+  // `cur_cycle_ms` / `cur_compression` / `cur_cross_algo` are the
+  // engine's currently APPLIED values — a manual injection that sets only
+  // some knobs keeps the others at their applied values (which need not
+  // be grid points).
   void Tick(std::chrono::steady_clock::time_point now, int64_t cur_fusion,
-            double cur_cycle_ms, int64_t cur_compression, Proposal* out);
+            double cur_cycle_ms, int64_t cur_compression,
+            int64_t cur_cross_algo, Proposal* out);
 
   // Manual injection (hvd.autotune_set, the pluggable-policy seam): the
   // injected values are broadcast on the next tick and the search state
   // snaps to the nearest grid point so a resumed search continues from
   // there.  Values < 0 keep the current value for that knob.
-  void Inject(int64_t fusion, double cycle_ms, int64_t compression);
+  void Inject(int64_t fusion, double cycle_ms, int64_t compression,
+              int64_t cross_algo);
 
   // Observability (any thread).
   int64_t windows() const;
@@ -103,6 +114,7 @@ class ParameterManager {
   int64_t GridFusion() const { return axes_fusion_[idx_[0]]; }
   double GridCycleMs() const { return axes_cycle_[idx_[1]]; }
   int64_t GridCompression() const { return axes_comp_[idx_[2]]; }
+  int64_t GridCrossAlgo() const { return axes_algo_[idx_[3]]; }
   Proposal MakeProposal(bool frozen);
   // Broadcast the snapped anchor point (or the freeze verdict when both
   // knobs are pinned); the measured score of the window that triggered
@@ -125,12 +137,14 @@ class ParameterManager {
   std::vector<int64_t> axes_fusion_;
   std::vector<double> axes_cycle_;
   std::vector<int64_t> axes_comp_;
+  std::vector<int64_t> axes_algo_;
   // Raw initial env values — what warmup windows actually run under
   // (the applied params change only at the first broadcast).
   int64_t init_fusion_ = 0;
   double init_cycle_ms_ = 0.0;
   int64_t init_comp_ = 0;
-  int idx_[3] = {0, 0, 0};     // current grid point (fusion, cycle, comp)
+  int64_t init_algo_ = 0;
+  int idx_[4] = {0, 0, 0, 0};  // grid point (fusion, cycle, comp, algo)
   int axis_ = 1;               // knob being climbed (cycle first: the
                                // idle-cadence win is the common case)
   int dir_ = -1;               // climb direction on axis_
@@ -149,8 +163,8 @@ class ParameterManager {
   // The freeze verdict takes the argmax of per-point MEANS — repeated
   // visits (anchors are re-measured on every axis switch) average out
   // window noise instead of keeping a lucky spike.
-  std::map<std::array<int, 3>, std::pair<double, int>> memory_;
-  std::array<int, 3> best_point_{{0, 0, 0}};
+  std::map<std::array<int, 4>, std::pair<double, int>> memory_;
+  std::array<int, 4> best_point_{{0, 0, 0, 0}};
   bool have_best_ = false;
   int stall_windows_ = 0;
 
@@ -160,6 +174,7 @@ class ParameterManager {
   int64_t inject_fusion_ = -1;
   double inject_cycle_ms_ = -1.0;
   int64_t inject_comp_ = -1;
+  int64_t inject_algo_ = -1;
 
   int64_t windows_ = 0;
   double best_score_ = 0.0;

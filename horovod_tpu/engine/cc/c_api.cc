@@ -49,7 +49,9 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
                  double autotune_fix_cycle_ms, int elastic,
                  long long min_size, int rejoin, int compression_mode,
                  long long compression_min_bytes,
-                 long long autotune_fix_compression) {
+                 long long autotune_fix_compression,
+                 long long cross_algo_threshold,
+                 long long autotune_fix_cross_algo) {
   EngineOptions opts;
   opts.rank = rank;
   opts.size = size;
@@ -76,6 +78,9 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
   opts.compression_min_bytes =
       compression_min_bytes >= 0 ? compression_min_bytes : 0;
   opts.autotune_fix_compression = autotune_fix_compression;
+  opts.cross_algo_threshold =
+      cross_algo_threshold >= 0 ? cross_algo_threshold : 64 * 1024;
+  opts.autotune_fix_cross_algo = autotune_fix_cross_algo;
   std::string err;
   int rc = GlobalEngine()->Init(opts, &err);
   if (rc != 0) {
@@ -324,12 +329,20 @@ const char* hvd_tpu_autotune_applied() {
 }
 
 // Manual parameter injection (hvd.autotune_set; the pluggable-policy
-// seam): broadcast fusion/cycle/compression (< 0 keeps the current
-// value) at the next tick.  0 ok, 1 not-the-coordinator, 2 uninitialized.
+// seam): broadcast fusion/cycle/compression/cross-algo (< 0 keeps the
+// current value) at the next tick.  0 ok, 1 not-the-coordinator, 2
+// uninitialized.
 int hvd_tpu_autotune_set(long long fusion_threshold, double cycle_time_ms,
-                         long long compression) {
+                         long long compression,
+                         long long cross_algo_threshold) {
   return GlobalEngine()->AutotuneInject(fusion_threshold, cycle_time_ms,
-                                        compression);
+                                        compression, cross_algo_threshold);
+}
+
+// Two-level cross-node ring-vs-tree boundary currently applied (bytes;
+// lockstep-broadcast state, identical on every rank of a healthy job).
+long long hvd_tpu_autotune_cross_algo_threshold() {
+  return GlobalEngine()->CurrentCrossAlgoThreshold();
 }
 
 // Fusion threshold in force at engine tick `tick` (the XLA plane keys its
@@ -367,6 +380,24 @@ const char* hvd_tpu_compression_log() {
   static thread_local std::string tl_compression_log;
   tl_compression_log = GlobalEngine()->CompressionLog();
   return tl_compression_log.c_str();
+}
+
+// Two-level topology observability (docs/performance.md
+// #two-level-topology).  Info serializes "hier|nodes|local_size|
+// threshold|ops_ring|ops_tree|local_bytes|cross_bytes|log_total";
+// the log is the bounded per-bucket phase record
+// "name|algo|local_rs_us|cross_us|local_ag_us;..." the Python sync
+// delta-consumes into the topology phase histograms.
+const char* hvd_tpu_topology_info() {
+  static thread_local std::string tl_topology_info;
+  tl_topology_info = GlobalEngine()->TopologyInfo();
+  return tl_topology_info.c_str();
+}
+
+const char* hvd_tpu_topology_log() {
+  static thread_local std::string tl_topology_log;
+  tl_topology_log = GlobalEngine()->TopologyLog();
+  return tl_topology_log.c_str();
 }
 
 // Elastic-membership observability and control

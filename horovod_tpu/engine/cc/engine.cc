@@ -695,20 +695,31 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   // The compression axis is searchable only when the job opted into a
   // lossy wire format: with HVD_TPU_COMPRESSION off the axis pins at
   // "none" so the tuner can never silently make an exact job lossy.
-  // The two-level topology pins it too — ChooseCompression returns
-  // "none" for every bucket there, so the knob is dead and searching it
-  // would burn windows scoring three identical points.
+  // (The two-level topology no longer pins it: the DCN hop compresses
+  // like the flat ring.)  The cross-algo axis is the dual: it only means
+  // anything on the two-level topology, so a flat-ring job pins it at
+  // the env value instead of burning windows scoring a dead knob.
+  // Both topology-coupled axes go dead on a single-NODE two-level job
+  // (no DCN hop): pin them rather than burn windows scoring identical
+  // points.  n_nodes_ is known here — SetupSockets already ran.
+  bool cross_hop_live = opts_.hierarchical_allreduce && n_nodes_ > 1;
+  int64_t tuner_fix_comp = opts_.compression_mode == COMP_NONE
+                               ? COMP_NONE
+                               : opts_.autotune_fix_compression;
+  if (opts_.hierarchical_allreduce && !cross_hop_live)
+    tuner_fix_comp = opts_.compression_mode;
   tuner_.Configure(opts_.autotune && (opts_.rank == 0 || opts_.size == 1),
                    opts_.autotune_warmup, opts_.autotune_window,
                    opts_.autotune_fix_fusion, opts_.autotune_fix_cycle_ms,
-                   opts_.compression_mode == COMP_NONE ||
-                           opts_.hierarchical_allreduce
-                       ? COMP_NONE
-                       : opts_.autotune_fix_compression,
+                   tuner_fix_comp,
+                   cross_hop_live ? opts_.autotune_fix_cross_algo
+                                  : opts_.cross_algo_threshold,
                    opts_.fusion_threshold, opts_.cycle_time_ms,
-                   opts_.compression_mode);
+                   opts_.compression_mode, opts_.cross_algo_threshold);
   cur_fusion_.store(opts_.fusion_threshold);
   cur_cycle_us_.store(static_cast<int64_t>(opts_.cycle_time_ms * 1000.0));
+  cur_cross_algo_.store(opts_.cross_algo_threshold);
+  topo_last_algo_.store(-1);
   autotune_frozen_.store(false);
   applied_window_.store(0);
   {
@@ -939,34 +950,62 @@ bool Engine::SetupSockets(std::string* err) {
   };
 
   bool hier = opts_.hierarchical_allreduce;
-  bool leader = opts_.local_rank == 0;
+  const int L = opts_.local_size;
+  // Recursive-doubling tree partners exist only for power-of-two node
+  // counts; otherwise the tree path falls back to the ring and no fds
+  // are built.
+  int tree_levels = 0;
+  if (hier && n_nodes_ > 1 && (n_nodes_ & (n_nodes_ - 1)) == 0)
+    for (int m = n_nodes_; m > 1; m >>= 1) ++tree_levels;
+  const uint32_t kHelloTree = 4u << 24;
   // Connect to the right global-ring neighbour.
   int right = (opts_.rank + 1) % opts_.size;
   right_fd_ = connect_hello(opts_.data_endpoints[right],
                             kHelloRing | (uint32_t)opts_.rank, err);
   if (right_fd_ < 0) return false;
-  if (hier && !leader) {
-    // Member: connect to this node's leader.
-    int leader_rank = opts_.rank - opts_.local_rank;
-    local_leader_fd_ = connect_hello(
-        opts_.data_endpoints[leader_rank],
+  if (hier) {
+    // Node-local ring: every rank connects to its right local neighbour
+    // (same node, local_rank+1 mod L) — the hop the local reduce-scatter
+    // and allgather phases run over.
+    int node_base = opts_.rank - opts_.local_rank;
+    int local_right = node_base + (opts_.local_rank + 1) % L;
+    local_right_fd_ = connect_hello(
+        opts_.data_endpoints[local_right],
         kHelloLocal | (uint32_t)opts_.local_rank, err);
-    if (local_leader_fd_ < 0) return false;
+    if (local_right_fd_ < 0) return false;
   }
-  if (hier && leader && n_nodes_ > 1) {
-    // Leader: connect to the next node's leader (cross ring).
-    int peer = ((node_id_ + 1) % n_nodes_) * opts_.local_size;
+  if (hier && n_nodes_ > 1) {
+    // Sharded cross-node ring: EVERY local rank connects to its
+    // same-local-rank peer on the next node, so each of the local_size
+    // shards crosses the DCN on its own stream (the single-leader-NIC
+    // bottleneck this topology replaces).
+    int peer = ((node_id_ + 1) % n_nodes_) * L + opts_.local_rank;
     cross_right_fd_ = connect_hello(opts_.data_endpoints[peer],
                                     kHelloCross | (uint32_t)node_id_, err);
     if (cross_right_fd_ < 0) return false;
+    // Tree partners: for each XOR level the side with the level bit
+    // CLEAR connects, the side with it SET accepts — exactly one
+    // connection per partner pair per level.
+    cross_tree_fds_.assign(tree_levels, -1);
+    for (int k = 0; k < tree_levels; ++k) {
+      if (node_id_ & (1 << k)) continue;  // this side accepts
+      int p = (node_id_ ^ (1 << k)) * L + opts_.local_rank;
+      cross_tree_fds_[k] = connect_hello(
+          opts_.data_endpoints[p],
+          kHelloTree | ((uint32_t)k << 16) | (uint32_t)node_id_, err);
+      if (cross_tree_fds_[k] < 0) return false;
+    }
   }
 
   int expected = 1;  // left global-ring neighbour
-  if (hier && leader) {
-    expected += opts_.local_size - 1;
-    if (n_nodes_ > 1) expected += 1;
+  if (hier) {
+    expected += 1;  // left local-ring neighbour
+    if (n_nodes_ > 1) {
+      expected += 1;  // cross-ring left neighbour
+      for (int k = 0; k < tree_levels; ++k)
+        if (node_id_ & (1 << k)) expected += 1;  // tree partner connects
+    }
   }
-  if (hier && leader) local_member_fds_.assign(opts_.local_size, -1);
   for (int i = 0; i < expected; ++i) {
     int fd = AcceptOne(data_listen_fd_, kTimeout, err);
     if (fd < 0) return false;
@@ -980,12 +1019,21 @@ bool Engine::SetupSockets(std::string* err) {
     uint32_t id = hello & 0x00ffffffu;
     if (kind == kHelloRing && left_fd_ < 0) {
       left_fd_ = fd;
-    } else if (kind == kHelloLocal && hier && leader && id > 0 &&
-               id < (uint32_t)opts_.local_size &&
-               local_member_fds_[id] < 0) {
-      local_member_fds_[id] = fd;
-    } else if (kind == kHelloCross && hier && leader && cross_left_fd_ < 0) {
+    } else if (kind == kHelloLocal && hier && local_left_fd_ < 0 &&
+               id == (uint32_t)((opts_.local_rank + L - 1) % L)) {
+      local_left_fd_ = fd;
+    } else if (kind == kHelloCross && hier && n_nodes_ > 1 &&
+               cross_left_fd_ < 0) {
       cross_left_fd_ = fd;
+    } else if (kind == kHelloTree && hier && n_nodes_ > 1) {
+      int k = (int)((id >> 16) & 0xff);
+      if (k >= tree_levels || !(node_id_ & (1 << k)) ||
+          cross_tree_fds_[k] >= 0) {
+        *err = "unexpected tree-partner hello " + std::to_string(hello);
+        CloseFd(fd);
+        return false;
+      }
+      cross_tree_fds_[k] = fd;
     } else {
       *err = "unexpected data-plane hello " + std::to_string(hello);
       CloseFd(fd);
@@ -994,6 +1042,10 @@ bool Engine::SetupSockets(std::string* err) {
   }
   if (left_fd_ < 0) {
     *err = "global ring left neighbour never connected";
+    return false;
+  }
+  if (hier && local_left_fd_ < 0) {
+    *err = "node-local ring left neighbour never connected";
     return false;
   }
   return true;
@@ -1017,13 +1069,27 @@ void Engine::TeardownSockets() {
   CloseFd(data_listen_fd_);
   CloseFd(left_fd_);
   CloseFd(right_fd_);
-  for (int fd : local_member_fds_) CloseFd(fd);
-  local_member_fds_.clear();
-  CloseFd(local_leader_fd_);
+  CloseTopologyFds();
+  coord_listen_fd_ = coord_fd_ = data_listen_fd_ = left_fd_ = right_fd_ = -1;
+}
+
+void Engine::ShutdownTopologyFds() {
+  ShutdownFd(local_left_fd_);
+  ShutdownFd(local_right_fd_);
+  ShutdownFd(cross_left_fd_);
+  ShutdownFd(cross_right_fd_);
+  for (int fd : cross_tree_fds_) ShutdownFd(fd);
+}
+
+void Engine::CloseTopologyFds() {
+  CloseFd(local_left_fd_);
+  CloseFd(local_right_fd_);
   CloseFd(cross_left_fd_);
   CloseFd(cross_right_fd_);
-  coord_listen_fd_ = coord_fd_ = data_listen_fd_ = left_fd_ = right_fd_ = -1;
-  local_leader_fd_ = cross_left_fd_ = cross_right_fd_ = -1;
+  for (int fd : cross_tree_fds_) CloseFd(fd);
+  cross_tree_fds_.clear();
+  local_left_fd_ = local_right_fd_ = -1;
+  cross_left_fd_ = cross_right_fd_ = -1;
 }
 
 int64_t Engine::EpochNowUs() const {
@@ -1275,7 +1341,6 @@ bool Engine::RunLoopOnce() {
   if (!fusion_buffer_.empty() &&
       tick_start - last_fusion_use_ > std::chrono::seconds(10)) {
     std::vector<char>().swap(fusion_buffer_);
-    std::vector<char>().swap(stage_buffer_);
   }
 
   RequestList my_requests;
@@ -2297,13 +2362,14 @@ void Engine::AttachTunedParams(ResponseList* out) {
   ParameterManager::Proposal p;
   tuner_.Tick(std::chrono::steady_clock::now(), cur_fusion_.load(),
               static_cast<double>(cur_cycle_us_.load()) / 1000.0,
-              cur_compression_.load(), &p);
+              cur_compression_.load(), cur_cross_algo_.load(), &p);
   if (!p.present) return;
   out->tuned_present = true;
   out->tuned_frozen = p.frozen;
   out->tuned_fusion_threshold = p.fusion_threshold;
   out->tuned_cycle_time_us = p.cycle_time_us;
   out->tuned_compression = static_cast<uint8_t>(p.compression);
+  out->tuned_cross_algo_threshold = p.cross_algo_threshold;
   out->tuned_window = p.window;
 }
 
@@ -2320,19 +2386,22 @@ void Engine::ApplyTunedParams(const ResponseList& rl) {
   opts_.cycle_time_ms =
       static_cast<double>(rl.tuned_cycle_time_us) / 1000.0;
   opts_.compression_mode = rl.tuned_compression;
+  opts_.cross_algo_threshold = rl.tuned_cross_algo_threshold;
   cur_fusion_.store(rl.tuned_fusion_threshold);
   cur_cycle_us_.store(rl.tuned_cycle_time_us);
   cur_compression_.store(rl.tuned_compression);
+  cur_cross_algo_.store(rl.tuned_cross_algo_threshold);
   if (rl.tuned_frozen) autotune_frozen_.store(true);
   applied_window_.store(rl.tuned_window);
   {
     std::lock_guard<std::mutex> lk(autotune_mu_);
-    char buf[112];
-    snprintf(buf, sizeof(buf), "%lld|%lld|%lld|%d|%d",
+    char buf[144];
+    snprintf(buf, sizeof(buf), "%lld|%lld|%lld|%d|%lld|%d",
              static_cast<long long>(tick),
              static_cast<long long>(rl.tuned_fusion_threshold),
              static_cast<long long>(rl.tuned_cycle_time_us),
              static_cast<int>(rl.tuned_compression),
+             static_cast<long long>(rl.tuned_cross_algo_threshold),
              rl.tuned_frozen ? 1 : 0);
     applied_log_.emplace_back(buf);
     while (applied_log_.size() > 256) applied_log_.pop_front();
@@ -2381,10 +2450,10 @@ std::string Engine::AutotuneApplied() {
 }
 
 int Engine::AutotuneInject(int64_t fusion, double cycle_ms,
-                           int64_t compression) {
+                           int64_t compression, int64_t cross_algo) {
   if (!initialized_.load()) return 2;
   if (opts_.rank != 0 && opts_.size > 1) return 1;
-  tuner_.Inject(fusion, cycle_ms, compression);
+  tuner_.Inject(fusion, cycle_ms, compression, cross_algo);
   return 0;
 }
 
@@ -2778,15 +2847,17 @@ bool Engine::ApplyReshape(const ResponseList& rl) {
     coord_->ready.clear();
     coord_->cache_pending.clear();
     coord_->cached_ready.clear();
+    // Reshapes force the flat ring, so the cross-algo axis pins (the
+    // knob is dead in the new membership).
     tuner_.Configure(opts_.autotune, opts_.autotune_warmup,
                      opts_.autotune_window, opts_.autotune_fix_fusion,
                      opts_.autotune_fix_cycle_ms,
-                     opts_.compression_mode == COMP_NONE ||
-                             opts_.hierarchical_allreduce
+                     opts_.compression_mode == COMP_NONE
                          ? COMP_NONE
                          : opts_.autotune_fix_compression,
+                     opts_.cross_algo_threshold,
                      opts_.fusion_threshold, opts_.cycle_time_ms,
-                     opts_.compression_mode);
+                     opts_.compression_mode, opts_.cross_algo_threshold);
     std::lock_guard<std::mutex> lk(announce_mu_);
     if (static_cast<int>(last_announce_counts_.size()) < new_size)
       last_announce_counts_.resize(new_size, 0);
@@ -2821,12 +2892,7 @@ bool Engine::RebuildRing(std::string* err) {
   left_fd_ = right_fd_ = -1;
   // Elastic jobs run the flat ring only; make sure no stale two-level
   // topology outlives a reshape.
-  for (int fd : local_member_fds_) CloseFd(fd);
-  local_member_fds_.clear();
-  CloseFd(local_leader_fd_);
-  CloseFd(cross_left_fd_);
-  CloseFd(cross_right_fd_);
-  local_leader_fd_ = cross_left_fd_ = cross_right_fd_ = -1;
+  CloseTopologyFds();
   node_id_ = 0;
   n_nodes_ = 1;
   if (opts_.size == 1) return true;
@@ -3111,34 +3177,50 @@ void Engine::ExecuteAllreduce(const Response& resp,
   bool half = (dtype == HVD_FLOAT16 || dtype == HVD_BFLOAT16);
   bool hier = opts_.hierarchical_allreduce && opts_.size > 1;
   // Negotiated wire compression (the Response's per-bucket verdict)
-  // applies to fp32 payloads on the flat ring; the two-level topology's
-  // node-local star keeps the legacy full-width path.
-  uint8_t comp =
-      (dtype == HVD_FLOAT32 && !hier) ? resp.compression : COMP_NONE;
-  // Wire format for the f32-master ring: a lossy compressed format for
-  // fp32 buckets, or the payload's OWN width for f16/bf16 (fixing the
-  // old 2x staging inflation: halves used to widen to f32 before they
-  // ever reached the wire) — 255 = plain ring in the payload dtype.
-  // Reduction accumulates in f32 at each ring hop in all wire modes.
+  // applies to fp32 payloads on the flat ring AND on the two-level
+  // topology's cross-node (DCN) hop — the hop where bytes cost money.
+  uint8_t comp = (dtype == HVD_FLOAT32) ? resp.compression : COMP_NONE;
+  // Wire format for the f32-master paths: a lossy compressed format for
+  // fp32 buckets, or the payload's OWN width for f16/bf16 (halves ship
+  // native-width on the flat ring and on BOTH hops of the two-level
+  // topology) — 255 = plain wire in the payload dtype.  Reduction
+  // accumulates in f32 at each hop in all wire modes.
   uint8_t wire = 255;
   if (comp == COMP_BF16)
     wire = WIRE_BF16;
   else if (comp == COMP_FP8)
     wire = WIRE_FP8;
-  else if (half && !hier)
+  else if (half)
     wire = dtype == HVD_FLOAT16 ? WIRE_F16 : WIRE_BF16;
-  uint8_t legacy_wire_dtype = half ? HVD_FLOAT32 : dtype;
   size_t esize = DataTypeSize(dtype);
-  size_t wsize = DataTypeSize(legacy_wire_dtype);
 
   int64_t total_elems = 0;
   for (auto& e : entries) total_elems += NumElements(e.dims);
   for (auto& e : entries) timeline_.Start(e.name, "ALLREDUCE");
+
+  // Two-level cross-node algorithm selection (per bucket, lockstep: the
+  // threshold is broadcast tuned state and the bucket size follows the
+  // lockstep fusion plan, so every rank flips ring<->tree at the same
+  // bucket).  Latency-bound small buckets take the recursive-doubling
+  // tree; bandwidth-bound big ones the ring.
+  bool use_tree = false;
+  if (hier && n_nodes_ > 1) {
+    int64_t bucket_bytes = total_elems * static_cast<int64_t>(esize);
+    use_tree = !cross_tree_fds_.empty() &&
+               bucket_bytes < cur_cross_algo_.load();
+    (use_tree ? topo_ops_tree_ : topo_ops_ring_).fetch_add(1);
+    int algo = use_tree ? 1 : 0;
+    int last = topo_last_algo_.exchange(algo);
+    if (last != -1 && last != algo && flight_.Enabled())
+      flight_.Record(FL_TOPOLOGY, entries[0].name, algo);
+  }
+
   // Compression metrics: every executed bucket records its payload width
-  // and its wire width, so wire_bytes/payload_bytes exposes both the
-  // compression win and any residual staging inflation.
+  // and its wire width (on the two-level topology: the cross/DCN hop's
+  // width), so wire_bytes/payload_bytes exposes both the compression win
+  // and any residual staging inflation (native halves: wire == payload).
   int64_t wire_unit = wire != 255 ? static_cast<int64_t>(WireFormatSize(wire))
-                                  : static_cast<int64_t>(wsize);
+                                  : static_cast<int64_t>(esize);
   RecordCompressedOp(entries[0].name, comp,
                      total_elems * static_cast<int64_t>(esize),
                      total_elems * wire_unit);
@@ -3148,10 +3230,11 @@ void Engine::ExecuteAllreduce(const Response& resp,
   const char* reduce_activity =
       hier ? "HIERARCHICAL_ALLREDUCE" : "RING_ALLREDUCE";
   auto do_allreduce = [&](void* buf, int64_t n, std::string* e) {
-    return hier ? HierarchicalAllreduce(buf, n, legacy_wire_dtype, e)
-                : RingAllreduce(buf, n, legacy_wire_dtype, e);
+    return hier ? TwoLevelAllreduce(buf, n, dtype, 255, 255, use_tree,
+                                    entries[0].name, e)
+                : RingAllreduce(buf, n, dtype, e);
   };
-  if (wire != 255) {
+  if (wire != 255 || (hier && dtype == HVD_FLOAT32)) {
     // Compressed / native-width wire path: fp32 master copies live in the
     // fusion buffer, segments cross the wire narrowed.  For lossy fp32
     // compression each tensor carries an error-feedback residual: the
@@ -3217,8 +3300,17 @@ void Engine::ExecuteAllreduce(const Response& resp,
         timeline_.Instant(
             e.name, std::string("COMPRESS_") + CompressionName(comp));
     timeline_.ActivityStart(entries[0].name, reduce_activity);
-    ok = RingAllreduceWire(fb, total_elems, wire, opts_.size, opts_.rank,
-                           left_fd_, right_fd_, &err);
+    if (hier) {
+      // Two-level: the local hop stays full/native width (halves ship
+      // their own width, f32 ships f32) while the cross (DCN) hop takes
+      // the negotiated compressed format.
+      uint8_t local_wire = half ? wire : 255;
+      ok = TwoLevelAllreduce(fb, total_elems, HVD_FLOAT32, local_wire,
+                             wire, use_tree, entries[0].name, &err);
+    } else {
+      ok = RingAllreduceWire(fb, total_elems, wire, opts_.size, opts_.rank,
+                             left_fd_, right_fd_, &err);
+    }
     timeline_.ActivityEnd(entries[0].name);
     if (ok) {
       off = 0;
@@ -3249,23 +3341,21 @@ void Engine::ExecuteAllreduce(const Response& resp,
     timeline_.ActivityEnd(e.name);
     if (ok && e.average) DivideBuffer(e.out, total_elems, dtype, opts_.size);
   } else {
-    // Fuse into one contiguous buffer, one ring pass, scatter back out --
-    // the reference's fusion-buffer dance (operations.cc:1109-1186).
-    // Half dtypes only reach here under the two-level topology, where
-    // they still stage through f32 (the node-local star reduce has no
-    // compressed path).
+    // Fuse into one contiguous buffer, one pass, scatter back out -- the
+    // reference's fusion-buffer dance (operations.cc:1109-1186).  Halves
+    // never reach here any more (they always take the f32-master wire
+    // path above, at native width on every hop); this branch serves
+    // int/f64 payloads at their own width — on the two-level topology as
+    // an uncompressed native-dtype two-level pass.
     last_fusion_use_ = std::chrono::steady_clock::now();
-    if (fusion_buffer_.size() < static_cast<size_t>(total_elems) * wsize)
-      fusion_buffer_.resize(static_cast<size_t>(total_elems) * wsize);
+    if (fusion_buffer_.size() < static_cast<size_t>(total_elems) * esize)
+      fusion_buffer_.resize(static_cast<size_t>(total_elems) * esize);
     char* fb = fusion_buffer_.data();
     int64_t off = 0;
     for (auto& e : entries) {
       timeline_.ActivityStart(e.name, "MEMCPY_IN_FUSION_BUFFER");
       int64_t n = NumElements(e.dims);
-      if (half)
-        HalfBufToFloat(e.in, reinterpret_cast<float*>(fb) + off, n, dtype);
-      else
-        memcpy(fb + off * esize, e.in, static_cast<size_t>(n) * esize);
+      memcpy(fb + off * esize, e.in, static_cast<size_t>(n) * esize);
       off += n;
       timeline_.ActivityEnd(e.name);
     }
@@ -3279,14 +3369,8 @@ void Engine::ExecuteAllreduce(const Response& resp,
         int64_t n = NumElements(e.dims);
         // `average` is a per-tensor attribute, so divide per segment: fused
         // neighbours may mix averaged and summed reductions.
-        if (half) {
-          float* seg = reinterpret_cast<float*>(fb) + off;
-          if (e.average) DivideBuffer(seg, n, HVD_FLOAT32, opts_.size);
-          FloatBufToHalf(seg, e.out, n, dtype);
-        } else {
-          memcpy(e.out, fb + off * esize, static_cast<size_t>(n) * esize);
-          if (e.average) DivideBuffer(e.out, n, dtype, opts_.size);
-        }
+        memcpy(e.out, fb + off * esize, static_cast<size_t>(n) * esize);
+        if (e.average) DivideBuffer(e.out, n, dtype, opts_.size);
         off += n;
         timeline_.ActivityEnd(e.name);
       }
@@ -3578,12 +3662,17 @@ bool Engine::RingAllreduceWire(float* buf, int64_t count, uint8_t wire,
 uint8_t Engine::ChooseCompression(uint8_t dtype, int64_t bytes) const {
   uint8_t mode = static_cast<uint8_t>(cur_compression_.load());
   if (mode == COMP_NONE) return COMP_NONE;
-  // Lossy wire formats apply to fp32 payloads on the flat multi-rank
-  // ring only: f16/bf16 already ship at native width, integer sums must
-  // stay exact, the two-level topology keeps its legacy star paths, and
-  // a single-rank job moves no wire bytes at all.
+  // Lossy wire formats apply to fp32 payloads only: f16/bf16 already
+  // ship at native width and integer sums must stay exact.  A
+  // single-rank job moves no wire bytes at all.  On the two-level
+  // topology the verdict narrows the cross-node (DCN) hop while the
+  // intra-node hop stays full width (TwoLevelAllreduce).
   if (dtype != HVD_FLOAT32) return COMP_NONE;
-  if (opts_.hierarchical_allreduce || opts_.size <= 1) return COMP_NONE;
+  if (opts_.size <= 1) return COMP_NONE;
+  // A single-node two-level job has no DCN hop — the only hop the
+  // verdict narrows — so compressing would round gradients for zero
+  // wire savings (and report a phantom compression win in the metrics).
+  if (opts_.hierarchical_allreduce && n_nodes_ <= 1) return COMP_NONE;
   // The min-bytes floor keeps latency-bound small buckets uncompressed:
   // their cost is negotiation + syscalls, not bandwidth, and the
   // quantize/dequantize passes would be pure overhead.
@@ -3629,110 +3718,426 @@ std::string Engine::CompressionLog() {
   return out;
 }
 
-bool Engine::HierarchicalAllreduce(void* buf, int64_t count, uint8_t dtype,
-                                   std::string* err) {
-  // Three phases, the reference's ncclReduce -> cross MPI_Allreduce ->
-  // ncclBcast (operations.cc:1003-1048) over TCP: node-local star reduce to
-  // the leader, ring allreduce across leaders (the DCN hop), node-local
-  // broadcast.  Sum semantics throughout; averaging stays the caller's
+// Segment bookkeeping for the node-local reduce-scatter/allgather: `n`
+// elements split into `P` near-equal parts (the first `rem` get one
+// extra), matching the HalfRing partition convention.
+namespace {
+struct SegPart {
+  int64_t n;
+  int P;
+  int64_t base() const { return n / P; }
+  int64_t rem() const { return n % P; }
+  int64_t start(int i) const {
+    return i * base() + std::min<int64_t>(i, rem());
+  }
+  int64_t cnt(int i) const { return base() + (i < rem() ? 1 : 0); }
+};
+}  // namespace
+
+bool Engine::LocalReduceScatter(char* data, int64_t n, uint8_t dtype,
+                                uint8_t wire, int64_t* bytes_moved,
+                                std::string* err) {
+  const int L = opts_.local_size, r = opts_.local_rank;
+  if (L == 1 || n == 0) return true;
+  const size_t esize = DataTypeSize(dtype);
+  const size_t unit = wire == 255 ? esize : WireFormatSize(wire);
+  SegPart part{n, L};
+  int64_t max_seg = part.base() + (part.rem() ? 1 : 0);
+  std::vector<uint8_t> sendw;
+  if (wire != 255) sendw.resize(static_cast<size_t>(max_seg) * unit);
+  std::vector<uint8_t> recvw(static_cast<size_t>(max_seg) *
+                             std::max(unit, esize));
+  // Standard ring reduce-scatter: after L-1 steps local rank r owns the
+  // fully reduced segment (r+1) % L.
+  for (int step = 0; step < L - 1; ++step) {
+    int ss = ((r - step) % L + L) % L;
+    int rs = ((r - step - 1) % L + L) % L;
+    const void* sp = data + part.start(ss) * esize;
+    if (wire != 255) {
+      CompressBuf(reinterpret_cast<const float*>(data) + part.start(ss),
+                  sendw.data(), part.cnt(ss), wire);
+      sp = sendw.data();
+    }
+    if (!Exchange(local_right_fd_, sp,
+                  static_cast<size_t>(part.cnt(ss)) * unit, local_left_fd_,
+                  recvw.data(), static_cast<size_t>(part.cnt(rs)) * unit)) {
+      *err = "node-local reduce-scatter exchange failed (step " +
+             std::to_string(step) + ")";
+      return false;
+    }
+    *bytes_moved += part.cnt(ss) * static_cast<int64_t>(unit);
+    if (wire == 255)
+      AccumulateSum(data + part.start(rs) * esize, recvw.data(),
+                    part.cnt(rs), dtype);
+    else
+      DecompressAccumulate(recvw.data(),
+                           reinterpret_cast<float*>(data) + part.start(rs),
+                           part.cnt(rs), wire);
+  }
+  return true;
+}
+
+bool Engine::LocalAllgather(char* data, int64_t n, uint8_t dtype,
+                            uint8_t wire, int64_t* bytes_moved,
+                            std::string* err) {
+  const int L = opts_.local_size, r = opts_.local_rank;
+  if (L == 1 || n == 0) return true;
+  const size_t esize = DataTypeSize(dtype);
+  const size_t unit = wire == 255 ? esize : WireFormatSize(wire);
+  SegPart part{n, L};
+  int64_t max_seg = part.base() + (part.rem() ? 1 : 0);
+  std::vector<uint8_t> sendw, recvw;
+  if (wire != 255) {
+    sendw.resize(static_cast<size_t>(max_seg) * unit);
+    recvw.resize(static_cast<size_t>(max_seg) * unit);
+  }
+  // Ring allgather from the RS ownership map (rank r owns (r+1) % L):
+  // step s forwards segment (r+1-s) % L rightward and adopts
+  // (r-s) % L from the left.
+  for (int step = 0; step < L - 1; ++step) {
+    int ss = ((r + 1 - step) % L + L) % L;
+    int rs = ((r - step) % L + L) % L;
+    const void* sp = data + part.start(ss) * esize;
+    void* rp = data + part.start(rs) * esize;
+    if (wire != 255) {
+      // Exact: allgather segments are already wire-representable (the
+      // owner quantized its reduced segment before the first forward).
+      CompressBuf(reinterpret_cast<const float*>(data) + part.start(ss),
+                  sendw.data(), part.cnt(ss), wire);
+      sp = sendw.data();
+      rp = recvw.data();
+    }
+    if (!Exchange(local_right_fd_, sp,
+                  static_cast<size_t>(part.cnt(ss)) * unit, local_left_fd_,
+                  rp, static_cast<size_t>(part.cnt(rs)) * unit)) {
+      *err = "node-local allgather exchange failed (step " +
+             std::to_string(step) + ")";
+      return false;
+    }
+    *bytes_moved += part.cnt(ss) * static_cast<int64_t>(unit);
+    if (wire != 255)
+      DecompressBuf(recvw.data(),
+                    reinterpret_cast<float*>(data) + part.start(rs),
+                    part.cnt(rs), wire);
+  }
+  return true;
+}
+
+bool Engine::CrossTreeAllreduce(char* seg, int64_t n, uint8_t dtype,
+                                uint8_t wire, std::string* err) {
+  const size_t esize = DataTypeSize(dtype);
+  const size_t unit = wire == 255 ? esize : WireFormatSize(wire);
+  std::vector<uint8_t> sendw;
+  if (wire != 255) sendw.resize(static_cast<size_t>(n) * unit);
+  std::vector<uint8_t> recvw(static_cast<size_t>(n) * unit);
+  float* f = reinterpret_cast<float*>(seg);
+  // Recursive doubling: at level k, XOR partners exchange their full
+  // running sums and both add — log2(nodes) latency steps, the win for
+  // latency-bound small shards.
+  for (size_t k = 0; k < cross_tree_fds_.size(); ++k) {
+    int fd = cross_tree_fds_[k];
+    if (fd < 0) {
+      *err = "cross-node tree partner closed after an earlier failure";
+      return false;
+    }
+    const void* sp = seg;
+    if (wire != 255) {
+      // Quantize the running sum first, so both partners add IDENTICAL
+      // dequantized values — float addition is commutative, which keeps
+      // every node's shard bit-identical through the whole tree.
+      for (int64_t i = 0; i < n; ++i) f[i] = QuantDequant(f[i], wire);
+      CompressBuf(f, sendw.data(), n, wire);
+      sp = sendw.data();
+    }
+    if (!Exchange(fd, sp, static_cast<size_t>(n) * unit, fd, recvw.data(),
+                  static_cast<size_t>(n) * unit)) {
+      *err = "cross-node tree exchange failed (level " +
+             std::to_string(k) + ")";
+      return false;
+    }
+    if (wire == 255)
+      AccumulateSum(seg, recvw.data(), n, dtype);
+    else
+      DecompressAccumulate(recvw.data(), f, n, wire);
+  }
+  return true;
+}
+
+bool Engine::CrossShardAllreduce(char* seg, int64_t n, uint8_t dtype,
+                                 uint8_t wire, bool use_tree,
+                                 int64_t* bytes_moved, std::string* err) {
+  if (n_nodes_ == 1 || n == 0) return true;
+  const size_t esize = DataTypeSize(dtype);
+  const size_t unit = wire == 255 ? esize : WireFormatSize(wire);
+  if (use_tree && !cross_tree_fds_.empty()) {
+    if (!CrossTreeAllreduce(seg, n, dtype, wire, err)) return false;
+    *bytes_moved += static_cast<int64_t>(cross_tree_fds_.size()) * n *
+                    static_cast<int64_t>(unit);
+    return true;
+  }
+  if (cross_left_fd_ < 0 || cross_right_fd_ < 0) {
+    *err = "cross-node ring closed after an earlier failure";
+    return false;
+  }
+  bool ok =
+      wire == 255
+          ? RingAllreduceOn(seg, n, dtype, n_nodes_, node_id_,
+                            cross_left_fd_, cross_right_fd_, err)
+          : RingAllreduceWire(reinterpret_cast<float*>(seg), n, wire,
+                              n_nodes_, node_id_, cross_left_fd_,
+                              cross_right_fd_, err);
+  if (ok)
+    *bytes_moved += 2 * static_cast<int64_t>(n_nodes_ - 1) *
+                    ((n + n_nodes_ - 1) / n_nodes_) *
+                    static_cast<int64_t>(unit);
+  return ok;
+}
+
+bool Engine::TwoLevelAllreduce(void* vbuf, int64_t count, uint8_t dtype,
+                               uint8_t local_wire, uint8_t cross_wire,
+                               bool use_tree, const std::string& name,
+                               std::string* err) {
+  // The bandwidth-optimal two-level decomposition (docs/performance.md
+  // #two-level-topology), replacing the reference's ncclReduce ->
+  // MPI_Allreduce -> ncclBcast star (operations.cc:1003-1048):
+  //
+  //   1. LOCAL_RS    node-local ring reduce-scatter — local rank r ends
+  //                  owning the fully node-reduced shard (r+1) % L.
+  //   2. CROSS_*     EVERY local rank drives its own cross-node
+  //                  exchange (ring or recursive-doubling tree) over its
+  //                  shard — local_size parallel DCN streams instead of
+  //                  one leader NIC, optionally compressed (bf16/fp8
+  //                  with f32 accumulation, the PR-9 wire machinery).
+  //   3. LOCAL_AG    node-local ring allgather of the reduced shards.
+  //
+  // Chunk-level pipelining: the bucket splits into chunks; a helper
+  // thread runs phase 2 on the cross fds while the engine thread runs
+  // phases 1/3 on the local fds, so the local hops of chunk c overlap
+  // the DCN hop of its neighbours instead of waiting behind phase
+  // barriers.  Sum semantics throughout; averaging stays the caller's
   // divide-by-global-size.
   if (opts_.size == 1 || count == 0) return true;
-  size_t esize = DataTypeSize(dtype);
-  char* data = static_cast<char*>(buf);
-  int64_t nbytes = count * static_cast<int64_t>(esize);
-  const int64_t kChunk = 4 << 20;
-  bool leader = opts_.local_rank == 0;
+  const int L = opts_.local_size;
+  const int M = n_nodes_;
+  char* data = static_cast<char*>(vbuf);
+  const size_t esize = DataTypeSize(dtype);
+  if (L > 1 && (local_left_fd_ < 0 || local_right_fd_ < 0)) {
+    *err = "node-local ring closed after an earlier failure";
+    return false;
+  }
+  if (M > 1 && (cross_left_fd_ < 0 || cross_right_fd_ < 0)) {
+    *err = "cross-node ring closed after an earlier failure";
+    return false;
+  }
+  const int64_t kChunkBytes = 4 << 20;
+  int64_t chunk_elems =
+      std::max<int64_t>(kChunkBytes / static_cast<int64_t>(esize), L);
+  int n_chunks = static_cast<int>((count + chunk_elems - 1) / chunk_elems);
+
+  int64_t local_bytes = 0, cross_bytes = 0;
+  int64_t local_rs_us = 0, cross_us_total = 0, local_ag_us = 0;
+
+  // Pipeline handshake: rs_done / cross_done are chunk high-water marks.
+  std::mutex pmu;
+  std::condition_variable pcv;
+  int rs_done = 0, cross_done = 0;
+  bool failed = false;
+  std::string cross_err;
+
+  const int own = (opts_.local_rank + 1) % L;
+  auto own_seg = [&](int c, int64_t* s, int64_t* cn) {
+    int64_t off = static_cast<int64_t>(c) * chunk_elems;
+    int64_t n = std::min(chunk_elems, count - off);
+    SegPart part{n, L};
+    *s = off + part.start(own);
+    *cn = part.cnt(own);
+  };
+
+  // Pipelining pays one thread spawn per bucket; a single-chunk bucket
+  // has nothing to overlap, so latency-bound buckets run the cross hop
+  // inline on the engine thread instead.
+  const bool pipelined = M > 1 && n_chunks > 1;
+  std::thread cross_thread;
+  if (pipelined) {
+    cross_thread = std::thread([&]() {
+      for (int c = 0; c < n_chunks; ++c) {
+        {
+          std::unique_lock<std::mutex> lk(pmu);
+          pcv.wait(lk, [&] { return rs_done > c || failed; });
+          if (failed) return;
+        }
+        int64_t s, cn;
+        own_seg(c, &s, &cn);
+        std::string e;
+        auto t0 = std::chrono::steady_clock::now();
+        bool ok_c = CrossShardAllreduce(data + s * esize, cn, dtype,
+                                        cross_wire, use_tree, &cross_bytes,
+                                        &e);
+        cross_us_total +=
+            std::chrono::duration_cast<std::chrono::microseconds>(
+                std::chrono::steady_clock::now() - t0)
+                .count();
+        std::lock_guard<std::mutex> lk(pmu);
+        if (!ok_c) {
+          failed = true;
+          cross_err = e;
+          pcv.notify_all();
+          return;
+        }
+        cross_done = c + 1;
+        pcv.notify_all();
+      }
+    });
+  }
 
   bool ok = true;
-  if (opts_.local_size > 1) {
-    if (leader) {
-      // Round-robin chunked accumulate: each member streams its whole
-      // buffer; consuming in chunk order bounds leader memory and keeps
-      // every member's stream draining.  On a member failure, keep
-      // draining the *other* members to the end — their untimed SendAll
-      // must complete before they can read the abort status byte.
-      int64_t chunk_elems = std::max<int64_t>(kChunk / (int64_t)esize, 1);
-      std::vector<char> tmp(
-          static_cast<size_t>(std::min(chunk_elems, count)) * esize);
-      std::vector<bool> dead(opts_.local_size, false);
-      for (int64_t off = 0; off < count; off += chunk_elems) {
-        int64_t n = std::min(chunk_elems, count - off);
-        for (int m = 1; m < opts_.local_size; ++m) {
-          if (dead[m]) continue;
-          if (!RecvAll(local_member_fds_[m], tmp.data(),
-                       static_cast<size_t>(n) * esize)) {
-            *err = "local reduce recv failed (member " + std::to_string(m) +
-                   ")";
-            ok = false;
-            dead[m] = true;
-            continue;
-          }
-          if (ok) AccumulateSum(data + off * esize, tmp.data(), n, dtype);
-        }
+  // Phase 1: each reduce-scattered chunk is handed to the cross thread
+  // immediately.
+  timeline_.ActivityStart(name, "LOCAL_RS");
+  {
+    auto t0 = std::chrono::steady_clock::now();
+    for (int c = 0; c < n_chunks && ok; ++c) {
+      int64_t off = static_cast<int64_t>(c) * chunk_elems;
+      int64_t n = std::min(chunk_elems, count - off);
+      if (!LocalReduceScatter(data + off * esize, n, dtype, local_wire,
+                              &local_bytes, err)) {
+        ok = false;
+        break;
       }
+      std::lock_guard<std::mutex> lk(pmu);
+      rs_done = c + 1;
+      pcv.notify_all();
+    }
+    local_rs_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                      std::chrono::steady_clock::now() - t0)
+                      .count();
+  }
+  timeline_.ActivityEnd(name);
+  // Phase 2 as the engine thread sees it: pipelined, the exposed head of
+  // the cross pipeline (the DCN hop of later chunks overlaps phase 3
+  // below); unpipelined, the whole inline cross exchange.
+  if (ok && M > 1) {
+    timeline_.ActivityStart(name, use_tree && !cross_tree_fds_.empty()
+                                      ? "CROSS_TREE"
+                                      : "CROSS_RING");
+    if (pipelined) {
+      std::unique_lock<std::mutex> lk(pmu);
+      pcv.wait(lk, [&] { return cross_done >= 1 || failed; });
     } else {
-      if (!SendAll(local_leader_fd_, data, static_cast<size_t>(nbytes))) {
-        *err = "local reduce send failed";
-        return false;
-      }
-    }
-  }
-
-  if (leader && n_nodes_ > 1) {
-    if (ok && (cross_left_fd_ < 0 || cross_right_fd_ < 0)) {
-      *err = "cross-node ring closed after an earlier failure";
-      ok = false;
-    }
-    if (ok) {
-      ok = RingAllreduceOn(buf, count, dtype, n_nodes_, node_id_,
-                           cross_left_fd_, cross_right_fd_, err);
-    }
-    if (!ok) {
-      // Never feed partial sums into the ring (peers would report
-      // success on wrong values); closing the cross fds instead makes
-      // the peer leaders' Exchange fail fast with EOF rather than stall
-      // out their 30 s silence timeout.
-      CloseFd(cross_left_fd_);
-      CloseFd(cross_right_fd_);
-      cross_left_fd_ = cross_right_fd_ = -1;
-    }
-  }
-
-  if (opts_.local_size > 1) {
-    if (leader) {
-      // One status byte ahead of the payload: on a leader-side failure
-      // (cross-ring or local-reduce) members must get an abort instead of
-      // blocking forever in an untimed RecvAll on the payload.
-      uint8_t status = ok ? 0 : 1;
-      for (int m = 1; m < opts_.local_size; ++m) {
-        bool sent = SendAll(local_member_fds_[m], &status, 1) &&
-                    (!ok || SendAll(local_member_fds_[m], data,
-                                    static_cast<size_t>(nbytes)));
-        if (!sent && ok) {
-          *err = "local broadcast send failed (member " + std::to_string(m) +
-                 ")";
+      for (int c = 0; c < n_chunks && ok; ++c) {
+        int64_t s, cn;
+        own_seg(c, &s, &cn);
+        auto t0 = std::chrono::steady_clock::now();
+        if (!CrossShardAllreduce(data + s * esize, cn, dtype, cross_wire,
+                                 use_tree, &cross_bytes, err))
           ok = false;
-          // Keep aborting the remaining members.
-          status = 1;
-        }
-      }
-    } else {
-      uint8_t status;
-      if (!RecvAll(local_leader_fd_, &status, 1)) {
-        *err = "local broadcast recv failed";
-        return false;
-      }
-      if (status != 0) {
-        *err = "node leader aborted the allreduce (cross-node failure)";
-        return false;
-      }
-      if (!RecvAll(local_leader_fd_, data, static_cast<size_t>(nbytes))) {
-        *err = "local broadcast recv failed";
-        return false;
+        cross_us_total +=
+            std::chrono::duration_cast<std::chrono::microseconds>(
+                std::chrono::steady_clock::now() - t0)
+                .count();
       }
     }
+    timeline_.ActivityEnd(name);
   }
+  // Phase 3: allgather each chunk as its cross hop completes.
+  if (ok) {
+    timeline_.ActivityStart(name, "LOCAL_AG");
+    for (int c = 0; c < n_chunks && ok; ++c) {
+      if (pipelined) {
+        std::unique_lock<std::mutex> lk(pmu);
+        pcv.wait(lk, [&] { return cross_done > c || failed; });
+        if (failed) {
+          ok = false;
+          break;
+        }
+      }
+      int64_t off = static_cast<int64_t>(c) * chunk_elems;
+      int64_t n = std::min(chunk_elems, count - off);
+      if (local_wire != 255) {
+        // The reduced shard is forwarded narrowed: quantize the local
+        // copy first so every local rank converges to IDENTICAL values
+        // (the RingAllreduceWire owner-quantize rule).  Exact when the
+        // cross hop already quantized to the same format.
+        int64_t s, cn;
+        own_seg(c, &s, &cn);
+        float* p = reinterpret_cast<float*>(data) + s;
+        for (int64_t i = 0; i < cn; ++i)
+          p[i] = QuantDequant(p[i], local_wire);
+      }
+      auto t0 = std::chrono::steady_clock::now();
+      if (!LocalAllgather(data + off * esize, n, dtype, local_wire,
+                          &local_bytes, err))
+        ok = false;
+      local_ag_us += std::chrono::duration_cast<std::chrono::microseconds>(
+                         std::chrono::steady_clock::now() - t0)
+                         .count();
+    }
+    timeline_.ActivityEnd(name);
+  }
+  {
+    std::lock_guard<std::mutex> lk(pmu);
+    if (!ok) failed = true;
+    pcv.notify_all();
+  }
+  // On any failure wake everyone fast: peers blocked on our topology
+  // sockets see EOF instead of stalling to the 30s exchange timeout, and
+  // the helper thread's in-flight exchange errors out so the join below
+  // cannot hang.  Close (and latch the fds at -1) only after the join.
+  if (!ok) ShutdownTopologyFds();
+  if (cross_thread.joinable()) cross_thread.join();
+  if (ok && failed) {
+    ok = false;
+    ShutdownTopologyFds();
+  }
+  if (!ok && err->empty())
+    *err = cross_err.empty() ? "cross-node exchange failed" : cross_err;
+  if (!ok) CloseTopologyFds();
+  topo_local_bytes_.fetch_add(local_bytes);
+  topo_cross_bytes_.fetch_add(cross_bytes);
+  RecordTopologyOp(name, use_tree && M > 1 && !cross_tree_fds_.empty(),
+                   local_rs_us, cross_us_total, local_ag_us);
   return ok;
+}
+
+void Engine::RecordTopologyOp(const std::string& name, bool tree,
+                              int64_t local_rs_us, int64_t cross_us,
+                              int64_t local_ag_us) {
+  std::lock_guard<std::mutex> lk(topo_mu_);
+  std::string entry;
+  for (char c : name) entry += (c == ';' || c == '|') ? '_' : c;
+  entry += std::string("|") + (tree ? "tree" : "ring") + "|" +
+           std::to_string(local_rs_us) + "|" + std::to_string(cross_us) +
+           "|" + std::to_string(local_ag_us);
+  topo_log_.push_back(std::move(entry));
+  while (topo_log_.size() > 256) topo_log_.pop_front();
+  ++topo_log_total_;
+}
+
+std::string Engine::TopologyInfo() {
+  int64_t log_total;
+  {
+    std::lock_guard<std::mutex> lk(topo_mu_);
+    log_total = topo_log_total_;
+  }
+  bool hier = opts_.hierarchical_allreduce && cur_size_.load() > 1;
+  return std::string(hier ? "1" : "0") + "|" + std::to_string(n_nodes_) +
+         "|" + std::to_string(cur_local_size_.load()) + "|" +
+         std::to_string(cur_cross_algo_.load()) + "|" +
+         std::to_string(topo_ops_ring_.load()) + "|" +
+         std::to_string(topo_ops_tree_.load()) + "|" +
+         std::to_string(topo_local_bytes_.load()) + "|" +
+         std::to_string(topo_cross_bytes_.load()) + "|" +
+         std::to_string(log_total);
+}
+
+std::string Engine::TopologyLog() {
+  std::lock_guard<std::mutex> lk(topo_mu_);
+  std::string out;
+  for (const auto& e : topo_log_) {
+    if (!out.empty()) out += ';';
+    out += e;
+  }
+  return out;
 }
 
 bool Engine::RingAllgather(char* buf, const std::vector<int64_t>& block_bytes,

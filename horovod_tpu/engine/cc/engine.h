@@ -73,6 +73,7 @@ struct EngineOptions {
   int64_t autotune_fix_fusion = -1;
   double autotune_fix_cycle_ms = -1.0;
   int64_t autotune_fix_compression = -1;
+  int64_t autotune_fix_cross_algo = -1;
   // Wire-level gradient compression (docs/performance.md#wire-compression,
   // HVD_TPU_COMPRESSION off|bf16|fp8): fp32 allreduce buckets at least
   // `compression_min_bytes` big transfer as bf16 / fp8-e4m3 with fp32
@@ -83,12 +84,22 @@ struct EngineOptions {
   // tuned-parameter broadcasts, and re-agreed across elastic reshapes.
   uint8_t compression_mode = COMP_NONE;
   int64_t compression_min_bytes = 1024;
-  // Two-level allreduce: reduce to the node-local leader, ring-allreduce
-  // across leaders, broadcast back within the node — the reference's
-  // HOROVOD_HIERARCHICAL_ALLREDUCE (operations.cc:1003-1048) mapped to
-  // intra-host loopback + cross-host DCN.  Requires ranks grouped in
-  // contiguous blocks of local_size (the hvdrun layout).
+  // Two-level allreduce (docs/performance.md#two-level-topology):
+  // node-local reduce-scatter -> one cross-node exchange PER LOCAL RANK
+  // over its 1/local_size shard (local_size parallel DCN streams) ->
+  // node-local allgather, chunk-pipelined so the local and cross phases
+  // overlap.  The bandwidth-optimal successor of the reference's
+  // ncclReduce -> MPI_Allreduce -> ncclBcast star
+  // (HOROVOD_HIERARCHICAL_ALLREDUCE, operations.cc:1003-1048).  Requires
+  // ranks grouped in contiguous blocks of local_size (the hvdrun layout).
   bool hierarchical_allreduce = false;
+  // Ring-vs-tree boundary for the cross-node hop: hierarchical buckets
+  // with payload under this many bytes take the recursive-doubling
+  // (tree) exchange — log2(nodes) latency steps instead of
+  // 2*(nodes-1) — and everything else takes the bandwidth-optimal ring.
+  // HVD_TPU_CROSS_ALGO_THRESHOLD; autotuned as the fourth ParameterManager
+  // axis; 0 = ring always.
+  int64_t cross_algo_threshold = 64 * 1024;
   // Elastic membership (docs/fault-tolerance.md#elastic-membership,
   // HVD_TPU_ELASTIC): when a worker dies, the coordinator reshapes the
   // job around the survivors (new dense ranks, rebuilt ring, membership
@@ -313,6 +324,9 @@ class Engine {
   int64_t AutotuneWindows();
   int64_t CurrentFusionThreshold() const { return cur_fusion_.load(); }
   int64_t CurrentCycleTimeUs() const { return cur_cycle_us_.load(); }
+  int64_t CurrentCrossAlgoThreshold() const {
+    return cur_cross_algo_.load();
+  }
   double AutotuneBestScore() { return tuner_.best_score(); }
   // Rank 0 search history: "window|fusion|cycle_us|score;...".
   std::string AutotuneHistory() { return tuner_.History(); }
@@ -321,9 +335,11 @@ class Engine {
   // allgather and compare it).
   std::string AutotuneApplied();
   // Manual parameter injection (hvd.autotune_set, rank 0 only): broadcast
-  // `fusion` / `cycle_ms` / `compression` (< 0 keeps the current value)
-  // next tick.  Returns 0 ok, 1 off the coordinator, 2 uninitialized.
-  int AutotuneInject(int64_t fusion, double cycle_ms, int64_t compression);
+  // `fusion` / `cycle_ms` / `compression` / `cross_algo` (< 0 keeps the
+  // current value) next tick.  Returns 0 ok, 1 off the coordinator, 2
+  // uninitialized.
+  int AutotuneInject(int64_t fusion, double cycle_ms, int64_t compression,
+                     int64_t cross_algo);
   // Fusion threshold in force at engine tick `tick` (the XLA plane's
   // bucket boundaries must follow autotuned thresholds in lockstep;
   // jax/eager_mesh.py).  Past ticks are stable: the history is
@@ -350,6 +366,20 @@ class Engine {
   // order — identical on every rank of a healthy job (tests allgather
   // and compare it across cache replay and reshapes).
   std::string CompressionLog();
+
+  // Two-level topology observability (docs/performance.md
+  // #two-level-topology).  TopologyInfo serializes
+  // "hier|nodes|local_size|threshold|ops_ring|ops_tree|local_bytes|
+  //  cross_bytes|log_total" for the Python metrics sync: the cumulative
+  // per-phase byte counters split by hop (local = intra-node ring, cross
+  // = the DCN hop — the bytes the compression satellite claims shrink),
+  // ring/tree bucket counts, and the cumulative per-bucket log count so
+  // the Python side can delta-consume TopologyLog.  TopologyLog is the
+  // bounded per-bucket phase record
+  // "name|algo|local_rs_us|cross_us|local_ag_us;..." feeding the phase
+  // histograms.
+  std::string TopologyInfo();
+  std::string TopologyLog();
 
   // Elastic-membership observability (docs/fault-tolerance.md).  The
   // epoch counts reshapes survived by THIS engine lifetime (0 until the
@@ -530,14 +560,48 @@ class Engine {
                          int N, int index, int left_fd, int right_fd,
                          std::string* err);
   // Ring allreduce over an arbitrary participant ring (used for both the
-  // global ring and the cross-node leader ring).
+  // global ring and the per-shard cross-node rings).
   bool RingAllreduceOn(void* buf, int64_t count, uint8_t dtype, int n,
                        int index, int left_fd, int right_fd,
                        std::string* err);
-  // Two-level: local star-reduce to the leader, leader ring across nodes,
-  // local broadcast back.
-  bool HierarchicalAllreduce(void* buf, int64_t count, uint8_t dtype,
-                             std::string* err);
+  // Two-level allreduce (docs/performance.md#two-level-topology): local
+  // reduce-scatter over the node ring -> every local rank drives a
+  // cross-node exchange (ring or recursive-doubling tree) over its own
+  // 1/local_size shard -> local allgather, with the chunks of one bucket
+  // pipelined through the three phases (a helper thread drives the cross
+  // hop while the engine thread keeps the local ring busy).  `dtype` is
+  // the REDUCTION buffer's element type (f32 master for the wire-staged
+  // path; the native dtype for int/f64 payloads); `local_wire` /
+  // `cross_wire` narrow the respective hop's bytes (255 = raw dtype;
+  // != 255 requires an f32 buffer): halves ship native-width on BOTH
+  // hops, lossy compression applies to the cross (DCN) hop only.
+  bool TwoLevelAllreduce(void* buf, int64_t count, uint8_t dtype,
+                         uint8_t local_wire, uint8_t cross_wire,
+                         bool use_tree, const std::string& name,
+                         std::string* err);
+  // One chunk's node-local ring steps (engine thread).  After
+  // LocalReduceScatter local rank r owns fully reduced segment
+  // (r+1) % local_size; LocalAllgather redistributes the reduced
+  // segments.  `bytes_moved` accumulates this rank's sent wire bytes.
+  bool LocalReduceScatter(char* data, int64_t n, uint8_t dtype,
+                          uint8_t wire, int64_t* bytes_moved,
+                          std::string* err);
+  bool LocalAllgather(char* data, int64_t n, uint8_t dtype, uint8_t wire,
+                      int64_t* bytes_moved, std::string* err);
+  // One chunk's cross-node hop over this rank's shard (helper thread):
+  // ring (RingAllreduceOn / RingAllreduceWire over the cross fds) or
+  // recursive-doubling tree over the XOR-partner fds.
+  bool CrossShardAllreduce(char* seg, int64_t n, uint8_t dtype,
+                           uint8_t wire, bool use_tree,
+                           int64_t* bytes_moved, std::string* err);
+  bool CrossTreeAllreduce(char* seg, int64_t n, uint8_t dtype,
+                          uint8_t wire, std::string* err);
+  // Wake every peer blocked on this rank's topology sockets (local ring,
+  // cross ring, tree partners) and mark them unusable: a mid-collective
+  // failure must fail fast everywhere instead of stalling peers to the
+  // 30s exchange timeout.  Close happens after helper threads joined.
+  void ShutdownTopologyFds();
+  void CloseTopologyFds();
   bool RingAllgather(char* buf, const std::vector<int64_t>& block_bytes,
                      std::string* err);
   bool RingBroadcast(void* buf, int64_t nbytes, int root, std::string* err);
@@ -569,17 +633,22 @@ class Engine {
   int coord_fd_ = -1;                        // workers: fd to rank 0
   int data_listen_fd_ = -1;
   int left_fd_ = -1, right_fd_ = -1;         // ring neighbours
-  // Hierarchical topology (only when opts_.hierarchical_allreduce):
+  // Two-level topology (only when opts_.hierarchical_allreduce):
   int node_id_ = 0;                          // rank / local_size
   int n_nodes_ = 1;                          // size / local_size
-  std::vector<int> local_member_fds_;        // leader: fd per local member
-  int local_leader_fd_ = -1;                 // member: fd to its leader
-  int cross_left_fd_ = -1, cross_right_fd_ = -1;  // leader ring
+  int local_left_fd_ = -1, local_right_fd_ = -1;  // node-local ring
+  // EVERY local rank's own cross-node ring over its same-local-rank
+  // peers (node±1, same local_rank) — local_size parallel DCN streams
+  // instead of one leader NIC.
+  int cross_left_fd_ = -1, cross_right_fd_ = -1;
+  // Recursive-doubling partners for the tree exchange: fd per XOR level
+  // (peer node = node_id ^ (1 << k)).  Built only when n_nodes is a
+  // power of two; empty otherwise (tree requests fall back to the ring).
+  std::vector<int> cross_tree_fds_;
 
   // Fusion buffer (lazily grown; analogue of the reference's persistent
   // fusion buffer, operations.cc:696-749).
   std::vector<char> fusion_buffer_;
-  std::vector<char> stage_buffer_;  // f16/bf16 -> f32 staging
 
   std::unique_ptr<Coordinator> coord_;
   uint8_t last_fused_dtype_ = 255;  // dtype of the current fusion group
@@ -674,6 +743,7 @@ class Engine {
   ParameterManager tuner_;
   std::atomic<int64_t> cur_fusion_{0};
   std::atomic<int64_t> cur_cycle_us_{0};
+  std::atomic<int64_t> cur_cross_algo_{0};
   std::atomic<bool> autotune_frozen_{false};
   std::atomic<int64_t> applied_window_{0};
   std::mutex autotune_mu_;  // guards applied_log_, *_history_
@@ -711,6 +781,25 @@ class Engine {
   std::atomic<int64_t> residual_tensors_{0};
   std::mutex comp_mu_;  // guards comp_log_
   std::deque<std::string> comp_log_;  // "first_name|mode", bounded
+
+  // Two-level topology accounting (docs/performance.md
+  // #two-level-topology).  Byte/op counters are process-cumulative (the
+  // metrics contract StallEvents set); the per-bucket phase log is
+  // bounded, with topo_log_total_ letting the Python sync delta-consume
+  // it into the phase histograms.  topo_last_algo_ (-1 = none yet)
+  // detects ring<->tree switches for the flight recorder.
+  std::atomic<int64_t> topo_ops_ring_{0};
+  std::atomic<int64_t> topo_ops_tree_{0};
+  std::atomic<int64_t> topo_local_bytes_{0};
+  std::atomic<int64_t> topo_cross_bytes_{0};
+  std::atomic<int> topo_last_algo_{-1};
+  std::mutex topo_mu_;  // guards topo_log_, topo_log_total_
+  std::deque<std::string> topo_log_;  // "name|algo|rs_us|cross_us|ag_us"
+  int64_t topo_log_total_ = 0;
+  // One per-bucket record for log + histograms (any thread).
+  void RecordTopologyOp(const std::string& name, bool tree,
+                        int64_t local_rs_us, int64_t cross_us,
+                        int64_t local_ag_us);
 
   // Announce-order accounting (rank 0).  Counts are process-cumulative;
   // the log is bounded so an unconsumed Python side cannot grow it.

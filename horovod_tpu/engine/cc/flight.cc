@@ -24,6 +24,7 @@ const char* FlightEventName(uint8_t event) {
     case FL_RESHAPE:   return "reshape";
     case FL_TUNE:      return "tune";
     case FL_COMPRESS:  return "compress";
+    case FL_TOPOLOGY:  return "topology";
     default:           return "unknown";
   }
 }

@@ -38,6 +38,8 @@ enum FlightEventType : uint8_t {
   FL_RESHAPE = 8,    // elastic membership adopted (arg: new epoch)
   FL_TUNE = 9,       // lockstep parameter broadcast applied (arg: fusion)
   FL_COMPRESS = 10,  // wire-compression mode armed / changed (arg: mode)
+  FL_TOPOLOGY = 11,  // two-level cross-node algorithm switched
+                     // (arg: 1 = tree, 0 = ring; name = first bucket name)
 };
 
 const char* FlightEventName(uint8_t event);

@@ -349,4 +349,8 @@ void CloseFd(int fd) {
   if (fd >= 0) close(fd);
 }
 
+void ShutdownFd(int fd) {
+  if (fd >= 0) shutdown(fd, SHUT_RDWR);
+}
+
 }  // namespace hvdtpu

@@ -71,4 +71,10 @@ bool ExchangeBi(int right_fd, const void* send_r, size_t send_r_len,
 
 void CloseFd(int fd);
 
+// shutdown(2) both directions WITHOUT closing: any thread blocked in
+// poll/send/recv on the fd wakes with an error immediately, and the fd
+// number stays allocated — no close-vs-concurrent-use reuse race.  The
+// owner still calls CloseFd afterwards (after joining helpers).
+void ShutdownFd(int fd);
+
 }  // namespace hvdtpu

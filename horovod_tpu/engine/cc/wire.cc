@@ -179,6 +179,7 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
     w.I64(rl.tuned_cycle_time_us);
     w.I64(rl.tuned_window);
     w.U8(rl.tuned_compression);
+    w.I64(rl.tuned_cross_algo_threshold);
   }
   w.U8(rl.reshape_present ? 1 : 0);
   if (rl.reshape_present) {
@@ -230,6 +231,7 @@ bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
     rl->tuned_cycle_time_us = rd.I64();
     rl->tuned_window = rd.I64();
     rl->tuned_compression = rd.U8();
+    rl->tuned_cross_algo_threshold = rd.I64();
   }
   rl->member_old_ranks.clear();
   rl->member_endpoints.clear();

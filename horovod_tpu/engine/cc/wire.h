@@ -151,6 +151,13 @@ struct ResponseList {
   // autotune axis): applied in the same lockstep as fusion/cycle, so the
   // compression decision function mutates at one tick boundary everywhere.
   uint8_t tuned_compression = COMP_NONE;
+  // Two-level cross-node algorithm boundary (the fourth autotune axis,
+  // HVD_TPU_CROSS_ALGO_THRESHOLD): hierarchical allreduce buckets whose
+  // payload is under this many bytes take the latency-bound
+  // recursive-doubling (tree) cross-node exchange instead of the
+  // bandwidth-optimal ring.  Broadcast with the tuned params so every
+  // rank's per-bucket ring-vs-tree decision flips at one tick boundary.
+  int64_t tuned_cross_algo_threshold = 0;
   // Elastic membership reshape (docs/fault-tolerance.md): when present,
   // this tick IS the reshape barrier.  The list carries the complete new
   // membership — for each new dense rank its previous rank (-1 for a

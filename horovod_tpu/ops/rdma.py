@@ -17,7 +17,21 @@ mesh (or in interpret mode on any mesh, which is how the unit tests
 exercise it without multi-chip hardware).  On real TPUs the kernel takes a
 neighbour barrier first (remote DMA writes into the peer's buffer, so both
 sides must have entered the kernel); barrier semaphores need a
-``collective_id``, reserved here as 13.
+``collective_id``, reserved here as 13/14/17/18 (15/16 belong to
+ops/ring_flash.py).
+
+Barrier-namespace discipline: consecutive invocations in one DEPENDENCY
+CHAIN (a sequence of rotations where each consumes the previous's output)
+must alternate namespaces, so a lagging device's ready-wait can never be
+satisfied by a neighbour's next-invocation signal.  Two namespaces per
+chain suffice — program order within a chain is forced by data
+dependence.  Chains that are INDEPENDENT of each other (ring_attention's
+K and V streams) get disjoint namespace pairs: their runtime interleaving
+is scheduler-chosen, so sharing a namespace across chains would let one
+chain's signal satisfy the other's wait.  This also divorces correctness
+from jax's tracing order: current jax traces custom_vjp transposes
+grouped per cotangent chain (not interleaved with program order), which
+broke the old global-alternation scheme.
 
 HARDWARE CAVEAT: this module (and ops/ring_flash.py, which shares the
 barrier scheme) has NEVER run on a physical multi-chip slice — every
@@ -52,7 +66,10 @@ try:
 except ImportError:  # pragma: no cover
     _HAS_PALLAS = False
 
-_COLLECTIVE_IDS = (13, 14)  # phase-alternating barrier namespaces
+# Barrier namespaces: phases 0/1 = chain A (ids 13/14), phases 2/3 =
+# chain B (ids 17/18).  ``phase ^ 1`` flips within a chain — the VJP's
+# move — while ``phase // 2`` names the chain.
+_COLLECTIVE_IDS = (13, 14, 17, 18)
 
 
 def _device_id(ring_idx, ring_axis, mesh_axes):
@@ -126,7 +143,7 @@ def _ring_permute_raw(x, axis_name, shift, interpret, phase):
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
         compiler_params=_compiler_params(
-            collective_id=_COLLECTIVE_IDS[phase % 2],
+            collective_id=_COLLECTIVE_IDS[phase % 4],
             has_side_effects=True),
         interpret=interpret,
     )(x)
@@ -144,16 +161,17 @@ def _ring_permute_fwd(x, axis_name, shift, interpret, phase):
 def _ring_permute_bwd(axis_name, shift, interpret, phase, _res, g):
     # The transpose of "send my shard +shift" is "send the cotangent
     # -shift" — identical to ppermute's transpose rule.  The barrier
-    # namespace is FLIPPED relative to the forward call: autodiff replays
-    # the transposed rotations in reverse order, so the last forward
-    # rotation (phase p) is immediately followed by its own backward
-    # rotation — with the flip that backward uses p^1, and since forward
-    # phases alternate ..., p^1, p, the backward sequence p^1, p, ...
-    # keeps the whole composed fwd+bwd chain strictly alternating.
-    # Without the flip, two adjacent invocations would share a semaphore
-    # namespace and a lagging device's ready-wait could be satisfied by a
-    # neighbour's *next*-invocation signal, licensing a DMA into a buffer
-    # that is not yet live.
+    # namespace is FLIPPED within the chain (phase ^ 1 keeps phase // 2,
+    # the chain id): the transposed rotations execute in reverse
+    # dependency order, so the chain's last forward rotation (phase p) is
+    # immediately followed by its own backward rotation — with the flip
+    # that backward uses p^1, and since the chain's forward phases
+    # alternate ..., p^1, p, the composed fwd+bwd chain stays strictly
+    # alternating, seam included.  Without the flip, two adjacent
+    # same-chain invocations would share a semaphore namespace and a
+    # lagging device's ready-wait could be satisfied by a neighbour's
+    # *next*-invocation signal, licensing a DMA into a buffer that is
+    # not yet live.
     return (_ring_permute_raw(g, axis_name, -shift, interpret, phase ^ 1),)
 
 
@@ -167,9 +185,11 @@ def ring_permute(x, axis_name: str, shift: int = 1,
     Equivalent to ``lax.ppermute(x, axis_name, [(i, (i+shift) % n)])``,
     executed as one Pallas async remote copy per device.  Differentiable.
     Must be called inside ``shard_map`` over ``axis_name``.  Callers
-    issuing a *sequence* of rotations should alternate ``phase`` (0/1)
-    between consecutive calls so the ready-handshake barriers of adjacent
-    invocations use distinct semaphore namespaces.
+    issuing a *sequence* of dependent rotations should alternate
+    ``phase`` between consecutive calls of that chain (0,1,0,... or
+    2,3,2,...) so adjacent invocations use distinct semaphore
+    namespaces; an INDEPENDENT concurrent chain must use the other
+    namespace pair (``phase // 2`` differs) — see the module docstring.
     """
     if not _HAS_PALLAS:
         raise RuntimeError("ring_permute requires Pallas (TPU jaxlib)")

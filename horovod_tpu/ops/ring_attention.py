@@ -91,15 +91,15 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
                                   rotate_impl="ppermute")
 
         def rotate(t, phase):
-            # Alternate barrier namespaces between consecutive rotations
-            # (see ring_permute).  Invariant: the phases of *every*
-            # adjacent pair of ring_permute invocations — including the
-            # autodiff-composed sequence, where the backward rotations
-            # run in reverse order right after the last forward one —
-            # must differ.  Here k uses 0 and v uses 1 within a step, so
-            # the forward stream is 0,1,0,1,…; ring_permute's VJP flips
-            # the phase, making the seam (last fwd = 1, first bwd = 0)
-            # and the whole backward stream alternate too.
+            # Barrier-namespace discipline (see rdma.py): the K and V
+            # rotation chains are independent of each other, so each
+            # gets its own namespace PAIR (K: phases 0/1, V: 2/3) and
+            # alternates within the pair per step.  Adjacent rotations
+            # of one chain — the only orderings data dependence forces —
+            # then always differ, forward, backward (the VJP flips
+            # within the pair), and across the fwd/bwd seam, regardless
+            # of how jax orders the traced transposes or how the
+            # scheduler interleaves the two chains at runtime.
             return ring_permute(t, axis_name, phase=phase)
     else:
         raise ValueError(f"unknown rotate_impl {rotate_impl!r}")
@@ -127,6 +127,6 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         # originated on device (my_idx - t) mod n.
         m, l, acc = attend(q, k_cur, v_cur, m, l, acc, (my_idx - t) % n)
         if t < n - 1:  # rotate K/V to the right neighbour
-            k_cur = rotate(k_cur, 0)
-            v_cur = rotate(v_cur, 1)
+            k_cur = rotate(k_cur, t % 2)
+            v_cur = rotate(v_cur, 2 + t % 2)
     return _finalize(m, l, acc, q.dtype)

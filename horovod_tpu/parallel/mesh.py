@@ -27,6 +27,14 @@ def hierarchical_mesh(devices: Optional[Sequence] = None,
     reductions along ``inner_axis`` stay on ICI; the ``outer_axis`` step
     crosses DCN.  ``num_slices`` defaults to the process count (one process
     per host) or to the device `slice_index` topology when available.
+
+    This is the XLA-compiled mirror of the engine's two-level allreduce
+    (``HOROVOD_HIERARCHICAL_ALLREDUCE``,
+    docs/performance.md#two-level-topology): a ``psum`` over
+    ``(inner, outer)`` lowers to reduce-scatter-on-ICI →
+    cross-slice-on-DCN → allgather-on-ICI, the same decomposition the
+    TCP engine runs by hand — every inner-axis member drives its own
+    shard's DCN stream, not a single per-slice leader.
     """
     devices = list(devices) if devices is not None else jax.devices()
     if num_slices is None:

@@ -3,10 +3,19 @@ replacement, /root/reference/docs/running.md).
 
 A host spec assigns ranks to hosts in contiguous blocks (host order, then
 slot order), which both defines local_rank/local_size and satisfies the
-engine's hierarchical-allreduce layout contract.  Endpoints use fixed,
-configurable ports (free-port probing is impossible on remote hosts):
-the coordinator lives on the first host at ``port_base``; each rank's data
-endpoint is ``host:port_base + 1 + local_rank``.
+engine's two-level-topology layout contract
+(docs/performance.md#two-level-topology): with
+HOROVOD_HIERARCHICAL_ALLREDUCE, every local rank drives its OWN
+cross-node (DCN) stream to its same-local-rank peers — rank
+``node*L + r`` connects to ``(node±1)*L + r`` and, for the tree
+exchange, to ``(node^2^k)*L + r`` — so equal ``local_size`` on every
+host and contiguous rank blocks are required (the engine validates this
+job-wide at init and falls back to the flat ring otherwise).  Endpoints
+use fixed, configurable ports (free-port probing is impossible on remote
+hosts): the coordinator lives on the first host at ``port_base``; each
+rank's data endpoint is ``host:port_base + 1 + local_rank``, and the
+intra-node ring, cross-node rings, and tree partners all multiplex over
+each rank's single data listen port via typed hellos.
 
 Remote ranks are started over ``ssh`` with the rank environment inlined
 into the remote command; local ranks spawn directly.

@@ -277,16 +277,20 @@ def test_autotune_set_is_rank0_only():
 def test_parse_fix():
     from horovod_tpu.common.autotune import parse_fix
 
-    assert parse_fix("") == (-1, -1.0, -1)
-    assert parse_fix("fusion_threshold=1024") == (1024, -1.0, -1)
-    assert parse_fix("cycle_time_ms=2.5") == (-1, 2.5, -1)
+    assert parse_fix("") == (-1, -1.0, -1, -1)
+    assert parse_fix("fusion_threshold=1024") == (1024, -1.0, -1, -1)
+    assert parse_fix("cycle_time_ms=2.5") == (-1, 2.5, -1, -1)
     assert parse_fix("fusion_threshold=8192, cycle_time_ms=5") == \
-        (8192, 5.0, -1)
+        (8192, 5.0, -1, -1)
     # The wire-compression axis (docs/performance.md#wire-compression)
     # pins by mode name; "off" pins it disabled rather than tuning it.
-    assert parse_fix("compression=bf16") == (-1, -1.0, 1)
-    assert parse_fix("compression=fp8") == (-1, -1.0, 2)
-    assert parse_fix("compression=off, cycle_time_ms=5") == (-1, 5.0, 0)
+    assert parse_fix("compression=bf16") == (-1, -1.0, 1, -1)
+    assert parse_fix("compression=fp8") == (-1, -1.0, 2, -1)
+    assert parse_fix("compression=off, cycle_time_ms=5") == (-1, 5.0, 0, -1)
+    # The cross-algo axis (docs/performance.md#two-level-topology) pins
+    # in bytes; 0 pins "ring always".
+    assert parse_fix("cross_algo_threshold=65536") == (-1, -1.0, -1, 65536)
+    assert parse_fix("cross_algo_threshold=0") == (-1, -1.0, -1, 0)
     with pytest.raises(ValueError, match="bad clause"):
         parse_fix("warmup=3")
     with pytest.raises(ValueError, match="bad value"):
@@ -295,6 +299,8 @@ def test_parse_fix():
         parse_fix("compression=int4")
     with pytest.raises(ValueError, match="negative"):
         parse_fix("fusion_threshold=-1")
+    with pytest.raises(ValueError, match="negative"):
+        parse_fix("cross_algo_threshold=-1")
 
 
 def test_snapshot_has_ungated_autotune_section():

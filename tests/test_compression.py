@@ -65,9 +65,11 @@ def test_parse_compression_modes():
 
 def test_lossy_autotune_pin_without_compression_is_rejected():
     """HVD_TPU_AUTOTUNE_FIX=compression=bf16 with HVD_TPU_COMPRESSION off
-    (or with the hierarchical topology, whose star phases keep the
-    full-width wire) must fail at init, not silently pin the dead knob at
-    "none" — the parse_fix contract."""
+    must fail at init, not silently pin the dead knob at "none" — the
+    parse_fix contract.  A cross_algo_threshold pin on the flat ring is
+    the dual dead knob and fails the same way.  A compression pin WITH
+    the two-level topology is now VALID (the mode narrows the DCN hop —
+    docs/performance.md#two-level-topology)."""
     for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
                 "HVD_TPU_DATA"):
         os.environ.pop(var, None)
@@ -79,7 +81,12 @@ def test_lossy_autotune_pin_without_compression_is_rejected():
             hvd.init()
         os.environ["HVD_TPU_COMPRESSION"] = "bf16"
         os.environ["HVD_TPU_HIERARCHICAL_ALLREDUCE"] = "1"
-        with pytest.raises(ValueError, match="full-width wire"):
+        hvd.init()  # hierarchical + lossy pin: the DCN hop compresses
+        assert hvd.is_initialized()
+        hvd.shutdown()
+        os.environ.pop("HVD_TPU_HIERARCHICAL_ALLREDUCE")
+        os.environ["HVD_TPU_AUTOTUNE_FIX"] = "cross_algo_threshold=65536"
+        with pytest.raises(ValueError, match="no cross-node hop"):
             hvd.init()
     finally:
         for var in ("HVD_TPU_AUTOTUNE_FIX", "HVD_TPU_COMPRESSION",

@@ -200,8 +200,9 @@ def _hier_env(local_size):
 
 @distributed_test(np_=4)
 def test_hierarchical_allreduce_two_nodes():
-    """4 ranks as 2 nodes x 2 local: local star reduce -> leader ring ->
-    local broadcast must equal the flat ring result (the reference's
+    """4 ranks as 2 nodes x 2 local: local reduce-scatter -> per-shard
+    cross-node exchange -> local allgather must equal the flat ring
+    result (the two-level successor of the reference's
     HOROVOD_HIERARCHICAL_ALLREDUCE, operations.cc:1003-1048)."""
     _hier_env(local_size=2)
     hvd = _init()
@@ -244,8 +245,8 @@ def test_hierarchical_bad_layout_falls_back():
 
 @distributed_test(np_=3)
 def test_hierarchical_single_node():
-    """All ranks on one node: the cross ring degenerates to nothing and the
-    result is a pure star reduce + broadcast."""
+    """All ranks on one node: the cross phase degenerates to nothing and
+    the result is a pure local reduce-scatter + allgather."""
     _hier_env(local_size=3)
     hvd = _init()
     r, n = hvd.rank(), hvd.size()
@@ -449,11 +450,11 @@ def test_rank_death_mid_allreduce_aborts_survivors():
 
 @distributed_test(np_=4, timeout=120.0)
 def test_leader_death_mid_hierarchical_aborts_all():
-    """Killing a node leader mid-hierarchical-allreduce: the peer leader's
-    cross-ring exchange fails, its members get the abort status byte, and
-    the dead leader's member fails its local recv -- every survivor raises
-    HorovodInternalError (exercises engine.cc's cross-ring abort and
-    status-byte paths), and later collectives fail uniformly."""
+    """Killing a rank mid-two-level-allreduce: its node peer's local-ring
+    exchange and its cross-ring peers' exchanges fail, the failure
+    cascades through the closed topology fds, and every survivor raises
+    HorovodInternalError (never hangs); later collectives fail
+    uniformly."""
     import os
     import time
 

@@ -357,19 +357,24 @@ def test_ring_attention_rdma_rotate_matches(causal):
 
 
 def test_rdma_phase_alternates_through_backward(monkeypatch):
-    """The barrier-namespace (phase) sequence of ring_permute invocations
-    must strictly alternate across the WHOLE autodiff-composed program:
-    the backward rotations run immediately after the last forward one, so
-    the VJP flips the phase (rdma.py _ring_permute_bwd).  On real hardware
-    two adjacent same-namespace invocations would let a lagging device's
-    ready-wait be satisfied by a neighbour's next-invocation signal."""
+    """The barrier-namespace discipline of ring_permute (rdma.py): within
+    each DEPENDENCY CHAIN of rotations (ring_attention's K stream, and
+    its V stream) the phase sequence must strictly alternate across the
+    whole autodiff-composed program — forward, backward (the VJP flips
+    within the chain pair), and the fwd/bwd seam — while the two
+    independent chains use DISJOINT namespace pairs, so a lagging
+    device's ready-wait can never be satisfied by a signal from either
+    its chain's next invocation or the concurrently-scheduled other
+    chain.  (The old single-pair global-alternation scheme asserted on
+    jax's tracing order, which current jax no longer interleaves: custom
+    VJP transposes now trace grouped per cotangent chain.)"""
     import horovod_tpu.ops.rdma as rdma
 
     phases = []
     real_raw = rdma._ring_permute_raw
 
     def recording_raw(x, axis_name, shift, interpret, phase):
-        phases.append(phase % 2)
+        phases.append(phase % 4)
         return real_raw(x, axis_name, shift, interpret, phase)
 
     monkeypatch.setattr(rdma, "_ring_permute_raw", recording_raw)
@@ -387,11 +392,26 @@ def test_rdma_phase_alternates_through_backward(monkeypatch):
         return (out ** 2).sum()
 
     jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
-    # Tracing order is program order for these sequenced collectives; the
-    # recorded stream covers forward and backward rotations.
-    assert len(phases) >= 4, phases
-    for a, b in zip(phases, phases[1:]):
-        assert a != b, f"adjacent invocations share a namespace: {phases}"
+    # Two chains (phase // 2), each recorded over forward AND backward
+    # (3 fwd + 3 bwd rotations per chain on a 4-device ring).
+    chains = {0: [], 1: []}
+    for p in phases:
+        chains[p // 2].append(p % 2)
+    assert len(chains[0]) >= 4 and len(chains[1]) >= 4, phases
+    # Within a chain, trace order follows the dependency chain (each
+    # rotation consumes the previous one's output — forward — and each
+    # transpose the next one's cotangent — backward), so the recorded
+    # per-chain stream is the execution-order stream: it must strictly
+    # alternate, seam included.
+    for chain, stream in chains.items():
+        for a, b in zip(stream, stream[1:]):
+            assert a != b, (
+                f"chain {chain}: adjacent invocations share a namespace: "
+                f"{phases}")
+    # Distinct chains map to disjoint collective_id namespaces.
+    ids = {c: {rdma._COLLECTIVE_IDS[2 * c + p] for p in stream}
+           for c, stream in chains.items()}
+    assert not (ids[0] & ids[1]), ids
 
 
 def test_blockwise_offsets_compose():

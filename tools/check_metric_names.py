@@ -52,6 +52,12 @@ SECTION_FAMILIES = {
                     "hvd_tpu_compression_payload_bytes_total",
                     "hvd_tpu_compression_ops_total",
                     "hvd_tpu_compression_residual_bytes"),
+    "topology": ("hvd_tpu_topology_hierarchical",
+                 "hvd_tpu_topology_nodes",
+                 "hvd_tpu_topology_local_size",
+                 "hvd_tpu_topology_cross_algo_threshold_bytes",
+                 "hvd_tpu_topology_cross_ops_total",
+                 "hvd_tpu_topology_bytes_total"),
     "histograms": (),
 }
 
@@ -85,6 +91,10 @@ def populated_registry():
     reg.set_serving_gauges(queue_depth=1, active=2, kv_blocks_in_use=3,
                            kv_blocks_total=8)
     reg.set_flight({"events": {"engine": 5, "xla": 2}, "capacity": 512})
+    reg.set_topology({"hierarchical": True, "nodes": 2, "local_size": 2,
+                      "cross_algo_threshold": 64 << 10,
+                      "cross_ops": {"ring": 3, "tree": 1},
+                      "bytes": {"local": 4096, "cross": 1024}})
     reg.set_compression({
         "mode": "bf16", "min_bytes": 1024,
         "planes": {"engine": {"wire_bytes": 512, "payload_bytes": 1024,

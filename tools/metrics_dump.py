@@ -213,6 +213,29 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
             f"residuals {_fmt_bytes(comp.get('residual_bytes', 0))} over "
             f"{comp.get('residual_tensors', 0)} tensor(s)")
 
+    # Two-level topology (docs/performance.md#two-level-topology); only
+    # rendered when the job ran hierarchical, so flat-ring dumps stay
+    # unchanged.  Byte/op counters diff in two-file mode; the shape and
+    # threshold stay absolute.
+    topo = snap.get("topology", {})
+    if topo.get("hierarchical"):
+        ops = dict(topo.get("cross_ops", {}))
+        tbytes = dict(topo.get("bytes", {}))
+        if base:
+            b = base.get("topology", {})
+            for a in ops:
+                ops[a] -= b.get("cross_ops", {}).get(a, 0)
+            for h in tbytes:
+                tbytes[h] -= b.get("bytes", {}).get(h, 0)
+        lines.append("== topology ==")
+        lines.append(
+            f"two-level, {topo.get('nodes', 1)} node(s) x "
+            f"{topo.get('local_size', 1)} local; cross algo ring "
+            f"{ops.get('ring', 0)} / tree {ops.get('tree', 0)} "
+            f"(boundary {_fmt_bytes(topo.get('cross_algo_threshold', 0))}); "
+            f"wire local {_fmt_bytes(tbytes.get('local', 0))}, cross "
+            f"{_fmt_bytes(tbytes.get('cross', 0))}")
+
     # Elastic membership (docs/fault-tolerance.md#elastic-membership);
     # only rendered once the job reshaped, so pre-elastic dumps stay
     # unchanged.
