@@ -56,5 +56,9 @@ from horovod_tpu.common.elastic import (  # noqa: F401
     ElasticState,
     run_elastic,
 )
+# State plane (docs/fault-tolerance.md#state-plane): hvd.state.arm() /
+# hvd.state.current() / hvd.state.disarm(), plus the sharded-checkpoint
+# helpers under horovod_tpu.state.checkpoint.
+from horovod_tpu import state  # noqa: E402,F401
 
 __version__ = "0.1.0"

@@ -539,6 +539,15 @@ def shutdown() -> None:
     prior ``init()`` (both are no-ops beyond flushing metrics plumbing)."""
     global _process_set, _xla_plane, _fault_injector
     _fault_injector = None
+    # The state plane's lifetime is the engine's: disarm (close the
+    # snapshot worker + peer listener) so a later init()+arm() starts
+    # clean and a stale plane can never route a new job's resyncs.
+    try:
+        from horovod_tpu import state as _state_mod
+
+        _state_mod.disarm()
+    except Exception:
+        pass
     if _lib is not None and int(_lib.hvd_tpu_abort_code()) != 0:
         # A typed abort the process never consumed through a Handle.wait
         # (e.g. the driver was between collectives when the coordinator

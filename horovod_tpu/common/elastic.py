@@ -85,16 +85,24 @@ class ElasticState:
             arr = np.asarray(value)
             out = _common.broadcast(arr, root,
                                     name=f"__elastic.sync.{key}.{name}")
-            if isinstance(value, np.ndarray):
-                setattr(self, name, out)
-            elif isinstance(value, (bool, np.bool_)):
-                setattr(self, name, bool(out))
-            elif isinstance(value, (int, np.integer)):
-                setattr(self, name, int(out))
-            elif isinstance(value, (float, np.floating)):
-                setattr(self, name, float(out))
-            else:
-                setattr(self, name, out)
+            setattr(self, name, _coerce_like(value, out))
+
+
+def _coerce_like(old: Any, new) -> Any:
+    """A synced/restored leaf value with the ORIGINAL leaf's Python type
+    preserved (step counters stay ints, flags stay bools) — shared by the
+    root-broadcast sync and the state plane's sharded restore
+    (horovod_tpu/state/partition.py), so the two resync paths cannot
+    drift on scalar round-tripping."""
+    if isinstance(old, np.ndarray):
+        return new
+    if isinstance(old, (bool, np.bool_)):
+        return bool(new)
+    if isinstance(old, (int, np.integer)):
+        return int(new)
+    if isinstance(old, (float, np.floating)):
+        return float(new)
+    return new
 
 
 def _tree_flatten(tree: Any):
@@ -134,7 +142,8 @@ def _tree_flatten(tree: Any):
 
 def run_elastic(train_fn: Callable[[ElasticState], Any],
                 state: ElasticState,
-                reshape_timeout: Optional[float] = None) -> Any:
+                reshape_timeout: Optional[float] = None,
+                state_plane=None) -> Any:
     """Run ``train_fn(state)`` under elastic membership, returning its
     result.
 
@@ -156,6 +165,14 @@ def run_elastic(train_fn: Callable[[ElasticState], Any],
     retryable failure (default: twice ``HVD_TPU_COLLECTIVE_TIMEOUT_SEC``
     plus slack, min 30s); if no reshape lands in time the original error
     re-raises.
+
+    With the state plane armed (``hvd.state.arm()``, or an explicit
+    ``state_plane=``; docs/fault-tolerance.md#state-plane) the resync
+    routes through it first: survivors restore from shard snapshots and
+    peer copies in O(model/size) per rank, and only a membership no
+    snapshot generation covers (nothing snapshotted yet, a neighbor pair
+    lost together, a state-shape change) falls back to the root
+    broadcast above — ``metrics_snapshot()["state"]`` counts both paths.
     """
     from horovod_tpu import common as _common
     from horovod_tpu.common import (CollectiveTimeoutError,
@@ -174,11 +191,20 @@ def run_elastic(train_fn: Callable[[ElasticState], Any],
         try:
             epoch = int(lib.hvd_tpu_membership_epoch())
             if epoch != synced:
-                # Ack BEFORE the resync broadcasts: they are the first
-                # collectives of the new membership and must not hit the
-                # engine's post-reshape enqueue poison.
+                # Ack BEFORE the resync collectives: they are the first
+                # of the new membership and must not hit the engine's
+                # post-reshape enqueue poison.
                 lib.hvd_tpu_membership_ack()
-                state.sync(root=0, key=epoch)
+                plane = state_plane
+                if plane is None:
+                    from horovod_tpu import state as _state_mod
+
+                    plane = _state_mod.current()
+                # The plane's restore is COLLECTIVE (plan allgather +
+                # shard broadcasts), so the armed/None decision must be
+                # rank-symmetric — arming is documented as every-rank.
+                if plane is None or not plane.restore(state, epoch):
+                    state.sync(root=0, key=epoch)
                 synced = epoch
             return train_fn(state)
         except (RanksDownError, CollectiveTimeoutError,

@@ -78,7 +78,21 @@ HISTOGRAMS = {
     "topology_local_ag_sec": (LATENCY_BUCKETS,
                               "two-level allreduce: node-local "
                               "allgather phase per bucket"),
+    "state_snapshot_sec": (LATENCY_BUCKETS,
+                           "state plane: background serialize + spill + "
+                           "peer push per committed shard snapshot"),
+    "state_restore_sec": (LATENCY_BUCKETS,
+                          "state plane: sharded restore after an elastic "
+                          "reshape (plan + per-shard broadcasts)"),
 }
+
+# State-plane restore outcomes — the `source` label values of
+# hvd_tpu_state_restores_total and the keys the elastic acceptance tests
+# assert on (docs/fault-tolerance.md#state-plane).
+STATE_RESTORE_SOURCES = ("peer", "local", "root_broadcast")
+# Checkpoint lifecycle events — the `event` label values of
+# hvd_tpu_state_checkpoint_events_total.
+STATE_CKPT_EVENTS = ("sharded_saves", "legacy_saves", "loads", "pruned")
 
 # Cap on distinct stalled-tensor entries kept by name; beyond it new names
 # fold into a single overflow key so a pathological job (auto-named tensors
@@ -220,6 +234,23 @@ class MetricsRegistry:
             "cross_algo_threshold": 0,
             "cross_ops": {"ring": 0, "tree": 0},
             "bytes": {"local": 0, "cross": 0},
+        }
+        # State plane (docs/fault-tolerance.md#state-plane): snapshot /
+        # peer-copy / restore counters and the checkpoint lifecycle.
+        # Ungated, like stalls: the elastic acceptance path asserts
+        # peer_restores (and ZERO root-broadcast fallbacks) without
+        # enabling full metrics, and the bench reads the overlap gauges.
+        self._state = {
+            "armed": False,
+            "snapshots": 0, "snapshot_bytes": 0,
+            "last_snapshot_step": -1,
+            "blocked_sec": 0.0, "async_sec": 0.0,
+            "peer_copies_sent": 0, "peer_bytes_sent": 0,
+            "peer_copies_received": 0, "peer_last_step": -1,
+            "restores": 0, "peer_restores": 0,
+            "root_broadcast_fallbacks": 0,
+            "ckpt": {**{e: 0 for e in STATE_CKPT_EVENTS},
+                     "shard_bytes": 0},
         }
         self._hists = {name: Histogram(bounds)
                        for name, (bounds, _) in HISTOGRAMS.items()}
@@ -405,6 +436,63 @@ class MetricsRegistry:
                     raise KeyError(f"unknown serving gauge {key!r}")
                 self._serving[key] = int(value)
 
+    def set_state_armed(self, armed: bool) -> None:
+        """The state plane armed/closed on this rank.  Ungated."""
+        with self._lock:
+            self._state["armed"] = bool(armed)
+
+    def record_state_snapshot(self, step: int, nbytes: int) -> None:
+        """One shard snapshot committed (background worker).  Ungated."""
+        with self._lock:
+            self._state["snapshots"] += 1
+            self._state["snapshot_bytes"] += int(nbytes)
+            self._state["last_snapshot_step"] = int(step)
+
+    def set_state_overlap(self, blocked_sec: float,
+                          async_sec: float) -> None:
+        """Cumulative step-path blocked vs background overlapped seconds
+        (gauges — the snapshotter owns the totals).  Ungated."""
+        with self._lock:
+            self._state["blocked_sec"] = float(blocked_sec)
+            self._state["async_sec"] = float(async_sec)
+
+    def record_state_peer(self, sent_bytes: Optional[int] = None,
+                          received_step: Optional[int] = None) -> None:
+        """A peer-mirror push sent (``sent_bytes``) or a full copy
+        received (``received_step`` — the freshness gauge).  Ungated."""
+        with self._lock:
+            if sent_bytes is not None:
+                self._state["peer_copies_sent"] += 1
+                self._state["peer_bytes_sent"] += int(sent_bytes)
+            if received_step is not None:
+                self._state["peer_copies_received"] += 1
+                self._state["peer_last_step"] = int(received_step)
+
+    def record_state_restore(self, source: str) -> None:
+        """One elastic resync routed by its source: ``"peer"`` (at least
+        one shard came from a peer copy), ``"local"`` (own/survivor
+        snapshots covered everything), or ``"root_broadcast"`` (the plane
+        fell back to the classic O(model) sync).  Ungated."""
+        if source not in STATE_RESTORE_SOURCES:
+            raise ValueError(f"unknown state restore source {source!r}")
+        with self._lock:
+            if source == "root_broadcast":
+                self._state["root_broadcast_fallbacks"] += 1
+            else:
+                self._state["restores"] += 1
+                if source == "peer":
+                    self._state["peer_restores"] += 1
+
+    def record_state_ckpt(self, event: str, n: int = 1,
+                          nbytes: int = 0) -> None:
+        """Checkpoint lifecycle events (:data:`STATE_CKPT_EVENTS`).
+        Ungated."""
+        if event not in STATE_CKPT_EVENTS:
+            raise ValueError(f"unknown state checkpoint event {event!r}")
+        with self._lock:
+            self._state["ckpt"][event] += int(n)
+            self._state["ckpt"]["shard_bytes"] += int(nbytes)
+
     def record_stall(self, name: str, duration_sec: float) -> None:
         with self._lock:
             self._stall_count += 1
@@ -487,6 +575,17 @@ class MetricsRegistry:
                        if k not in ("cross_ops", "bytes")},
                     "cross_ops": dict(self._topology["cross_ops"]),
                     "bytes": dict(self._topology["bytes"]),
+                },
+                "state": {
+                    **{k: v for k, v in self._state.items()
+                       if k != "ckpt"},
+                    "overlap_ratio": (
+                        self._state["async_sec"]
+                        / (self._state["async_sec"]
+                           + self._state["blocked_sec"])
+                        if self._state["async_sec"]
+                        + self._state["blocked_sec"] > 0 else 1.0),
+                    "ckpt": dict(self._state["ckpt"]),
                 },
                 "histograms": {name: h.to_dict()
                                for name, h in self._hists.items()},
@@ -774,6 +873,65 @@ def prometheus_text(snapshot: dict) -> str:
     out.append("# TYPE hvd_tpu_topology_bytes_total counter")
     for hop, n in topo.get("bytes", {}).items():
         out.append(f'hvd_tpu_topology_bytes_total{{hop="{hop}"}} {n}')
+
+    state = snapshot.get("state", {})
+    out.append("# HELP hvd_tpu_state_armed state plane armed on this "
+               "rank (docs/fault-tolerance.md#state-plane)")
+    out.append("# TYPE hvd_tpu_state_armed gauge")
+    out.append(f"hvd_tpu_state_armed {int(state.get('armed', False))}")
+    out.append("# HELP hvd_tpu_state_snapshots_total shard snapshots "
+               "committed by the state plane")
+    out.append("# TYPE hvd_tpu_state_snapshots_total counter")
+    out.append(f"hvd_tpu_state_snapshots_total {state.get('snapshots', 0)}")
+    out.append("# HELP hvd_tpu_state_snapshot_bytes_total bytes captured "
+               "into committed shard snapshots")
+    out.append("# TYPE hvd_tpu_state_snapshot_bytes_total counter")
+    out.append("hvd_tpu_state_snapshot_bytes_total "
+               f"{state.get('snapshot_bytes', 0)}")
+    out.append("# HELP hvd_tpu_state_last_snapshot_step step of the "
+               "newest committed shard snapshot (-1 = none)")
+    out.append("# TYPE hvd_tpu_state_last_snapshot_step gauge")
+    out.append("hvd_tpu_state_last_snapshot_step "
+               f"{state.get('last_snapshot_step', -1)}")
+    out.append("# HELP hvd_tpu_state_overlap_ratio fraction of snapshot "
+               "work overlapped with compute (1.0 = fully async)")
+    out.append("# TYPE hvd_tpu_state_overlap_ratio gauge")
+    out.append("hvd_tpu_state_overlap_ratio "
+               f"{repr(float(state.get('overlap_ratio', 1.0)))}")
+    out.append("# HELP hvd_tpu_state_peer_copies_total peer-mirror shard "
+               "copies moved over the state plane")
+    out.append("# TYPE hvd_tpu_state_peer_copies_total counter")
+    out.append('hvd_tpu_state_peer_copies_total{direction="sent"} '
+               f"{state.get('peer_copies_sent', 0)}")
+    out.append('hvd_tpu_state_peer_copies_total{direction="received"} '
+               f"{state.get('peer_copies_received', 0)}")
+    out.append("# HELP hvd_tpu_state_peer_last_step step of the newest "
+               "fully received peer copy (freshness; -1 = none)")
+    out.append("# TYPE hvd_tpu_state_peer_last_step gauge")
+    out.append("hvd_tpu_state_peer_last_step "
+               f"{state.get('peer_last_step', -1)}")
+    out.append("# HELP hvd_tpu_state_restores_total elastic resyncs by "
+               "source (peer / local snapshots / root-broadcast fallback)")
+    out.append("# TYPE hvd_tpu_state_restores_total counter")
+    out.append('hvd_tpu_state_restores_total{source="peer"} '
+               f"{state.get('peer_restores', 0)}")
+    local_restores = max(state.get("restores", 0)
+                         - state.get("peer_restores", 0), 0)
+    out.append('hvd_tpu_state_restores_total{source="local"} '
+               f"{local_restores}")
+    out.append('hvd_tpu_state_restores_total{source="root_broadcast"} '
+               f"{state.get('root_broadcast_fallbacks', 0)}")
+    out.append("# HELP hvd_tpu_state_checkpoint_events_total durable "
+               "checkpoint lifecycle (sharded/legacy saves, loads, prunes)")
+    out.append("# TYPE hvd_tpu_state_checkpoint_events_total counter")
+    for event in STATE_CKPT_EVENTS:
+        out.append(f'hvd_tpu_state_checkpoint_events_total{{event='
+                   f'"{event}"}} {state.get("ckpt", {}).get(event, 0)}')
+    out.append("# HELP hvd_tpu_state_checkpoint_shard_bytes_total bytes "
+               "this rank wrote into checkpoint shards")
+    out.append("# TYPE hvd_tpu_state_checkpoint_shard_bytes_total counter")
+    out.append("hvd_tpu_state_checkpoint_shard_bytes_total "
+               f"{state.get('ckpt', {}).get('shard_bytes', 0)}")
 
     skew = snapshot.get("skew", {})
     out.append("# HELP hvd_tpu_announce_total "

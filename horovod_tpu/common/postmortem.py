@@ -250,6 +250,16 @@ def _write(directory: str, reason: str,
         doc["autotune"] = common.autotune_report()
     except Exception:
         doc["autotune"] = {}
+    # State plane (docs/fault-tolerance.md#state-plane): the last
+    # committed snapshot step + peer-copy freshness answer the operator's
+    # first postmortem question — "how much work did this death cost?".
+    try:
+        from horovod_tpu import state as _state
+
+        plane = _state.current()
+        doc["state"] = plane.status() if plane is not None else None
+    except Exception:
+        doc["state"] = None
     try:
         doc["metrics"] = common.metrics_snapshot()
     except Exception:

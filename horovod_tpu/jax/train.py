@@ -58,66 +58,119 @@ _CKPT_PREFIX = "ckpt-"
 _CKPT_SUFFIX = ".pkl"
 
 
-def save_checkpoint(directory: str, step: int, tree) -> str:
-    """Write ``tree`` (any picklable pytree — params, opt_state, rng, ...)
-    as ``ckpt-<step>.pkl`` under ``directory``; returns the path.  Atomic
-    (write + rename), so a rank crash mid-save can never leave a torn
-    checkpoint for the restarted job to resume from.  Call on ONE rank
-    (conventionally 0); the restart path re-replicates via broadcast."""
-    import os
-    import pickle
-    import tempfile
+def _ckpt_barrier(name: str) -> None:
+    """Named-collective barrier for the sharded commit protocol (an
+    allreduce of one int — every rank must pass it before the manifest
+    commits, and again before save returns)."""
+    import numpy as np
 
+    from horovod_tpu import common as _common
+
+    _common.allreduce(np.ones(1, np.int32), average=False, name=name)
+
+
+def save_checkpoint(directory: str, step: int, tree,
+                    sharded: bool = False,
+                    keep: Optional[int] = None) -> str:
+    """Write ``tree`` (any picklable pytree — params, opt_state, rng, ...)
+    as a checkpoint under ``directory``; returns the committed path.
+
+    **Legacy mode** (``sharded=False``, the default): one atomic
+    ``ckpt-<step>.pkl`` — call on ONE rank (conventionally 0); the
+    restart path re-replicates via broadcast.
+
+    **Sharded mode** (``sharded=True``;
+    docs/fault-tolerance.md#state-plane): call on EVERY rank — each
+    writes only the 1/size shard of leaves it owns
+    (``ckpt-<step>/rank-N.pkl``), a named-collective barrier confirms all
+    shards landed, and rank 0 commits ``manifest.json`` atomically —
+    checkpoint wall time drops from O(model) on one rank's disk/NIC to
+    O(model/size) per rank, and a directory without a committed manifest
+    is torn by definition (invisible to :func:`latest_checkpoint`).
+    Sharded is a deliberate API opt-in, NOT an env knob: the two modes
+    have different call contracts (one rank vs every rank), and an
+    environment flip of a rank-0-only call site would park rank 0 in a
+    barrier nobody else enqueues.
+
+    Retention (both modes): ``keep`` (default ``HVD_TPU_CKPT_KEEP``;
+    unset = unbounded) prunes the oldest committed checkpoints AFTER the
+    new one commits — never the one being written, never a torn
+    directory some writer still owns.
+    """
+    import os
+
+    from horovod_tpu import common as _common
+    from horovod_tpu.common import metrics as _metrics
+    from horovod_tpu.state import checkpoint as _ckpt
+
+    if keep is None:
+        keep = _ckpt.retention_keep()
     os.makedirs(directory, exist_ok=True)
+    if sharded:
+        if _common.is_initialized():
+            rank, size = _common.rank(), _common.size()
+            barrier = _ckpt_barrier if size > 1 else None
+        else:
+            rank, size, barrier = 0, 1, None
+        path = _ckpt.save_sharded(directory, step, tree, rank, size,
+                                  barrier=barrier)
+        if rank == 0:
+            _ckpt.prune_checkpoints(directory, keep, protect_step=step)
+        return path
+    import pickle
+
     path = os.path.join(directory, f"{_CKPT_PREFIX}{step:08d}{_CKPT_SUFFIX}")
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            # device_get: materialize device arrays as host numpy so the
-            # pickle is portable across restarts (and device topologies).
-            pickle.dump({"step": int(step),
-                         "tree": jax.device_get(tree)}, f)
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    # device_get: materialize device arrays as host numpy so the pickle
+    # is portable across restarts (and device topologies).
+    _ckpt._atomic_write(path, lambda f: pickle.dump(
+        {"step": int(step), "tree": jax.device_get(tree)}, f))
+    _metrics.registry.record_state_ckpt("legacy_saves",
+                                        nbytes=os.path.getsize(path))
+    _ckpt.prune_checkpoints(directory, keep, protect_step=step)
     return path
 
 
 def latest_checkpoint(directory: str) -> Optional[str]:
-    """Path of the highest-step ``ckpt-*.pkl`` in ``directory``; None when
-    there is none (first run, or checkpointing disabled)."""
+    """Path of the highest-step committed checkpoint in ``directory`` —
+    a legacy ``ckpt-*.pkl`` file or a sharded ``ckpt-*/`` directory with
+    a committed manifest (torn sharded directories are invisible); None
+    when there is none (first run, or checkpointing disabled)."""
+    from horovod_tpu.state import checkpoint as _ckpt
+
+    entries = _ckpt.scan_checkpoints(directory)
+    return entries[-1][1] if entries else None
+
+
+def load_checkpoint(path: str, collective: bool = True):
+    """``(step, tree)`` from one checkpoint ``path`` (legacy pickle file
+    or sharded directory).  For sharded checkpoints ``collective=True``
+    reads only this rank's shard and gathers the rest by broadcast when
+    the engine is up at the saved world size (every rank must call);
+    ``collective=False`` assembles every shard locally (root-only resume
+    glue, tools, mismatched world sizes)."""
     import os
-
-    try:
-        names = os.listdir(directory)
-    except OSError:
-        return None
-    steps = []
-    for name in names:
-        if name.startswith(_CKPT_PREFIX) and name.endswith(_CKPT_SUFFIX):
-            try:
-                steps.append(
-                    (int(name[len(_CKPT_PREFIX):-len(_CKPT_SUFFIX)]), name))
-            except ValueError:
-                continue
-    if not steps:
-        return None
-    return os.path.join(directory, max(steps)[1])
-
-
-def load_latest_checkpoint(directory: str):
-    """``(step, tree)`` from the newest checkpoint in ``directory``, or
-    ``(0, None)`` when none exists — so resume code can be unconditional:
-    ``step, state = load_latest_checkpoint(d); state = state or init()``."""
     import pickle
 
+    from horovod_tpu.common import metrics as _metrics
+    from horovod_tpu.state import checkpoint as _ckpt
+
+    if os.path.isdir(path):
+        return _ckpt.load_sharded(path, collective=collective)
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    _metrics.registry.record_state_ckpt("loads")
+    return int(payload["step"]), payload["tree"]
+
+
+def load_latest_checkpoint(directory: str, collective: bool = True):
+    """``(step, tree)`` from the newest committed checkpoint in
+    ``directory`` — legacy and sharded formats alike — or ``(0, None)``
+    when none exists, so resume code can be unconditional:
+    ``step, state = load_latest_checkpoint(d); state = state or init()``."""
     path = latest_checkpoint(directory)
     if path is None:
         return 0, None
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
-    return int(payload["step"]), payload["tree"]
+    return load_checkpoint(path, collective=collective)
 
 
 class _TimedStep:

@@ -41,6 +41,53 @@ def _latest_weights_file(directory: str) -> Optional[str]:
     return os.path.join(directory, max(names, key=key))
 
 
+def _latest_resume_source(directory: str):
+    """``(kind, path)`` of the newest resumable checkpoint under
+    ``directory``: a keras ``*.weights.h5`` (``"weights_h5"``) or a
+    ``jax.train.save_checkpoint`` artifact (``"checkpoint"`` — legacy
+    pickle or committed sharded directory; torn sharded directories are
+    invisible).  When both formats exist and both carry a ``ckpt-<n>``
+    step, the higher step wins (ties go to the keras-native weights
+    file); without comparable steps (e.g. a fixed-name
+    ``final.weights.h5``), newer mtime wins — a stale jax artifact must
+    never outrank the weights file ModelCheckpoint just wrote."""
+    import os
+    import re
+
+    from horovod_tpu.state import checkpoint as _ckpt
+
+    h5 = _latest_weights_file(directory)
+    entries = _ckpt.scan_checkpoints(directory)
+    if not entries:
+        return ("weights_h5", h5) if h5 else (None, None)
+    ck_step, ck_path, _ = entries[-1]
+    if h5 is None:
+        return "checkpoint", ck_path
+    m = re.match(r"ckpt-(\d+)", os.path.basename(h5))
+    if m:
+        return (("checkpoint", ck_path) if ck_step > int(m.group(1))
+                else ("weights_h5", h5))
+    try:
+        newer_ck = os.path.getmtime(ck_path) > os.path.getmtime(h5)
+    except OSError:
+        newer_ck = False
+    return ("checkpoint", ck_path) if newer_ck else ("weights_h5", h5)
+
+
+def _weights_list(tree) -> Optional[list]:
+    """The flat weight list a checkpoint tree carries, in
+    ``model.set_weights`` order — a list/tuple of arrays (the
+    ``model.get_weights()`` shape), or a dict holding one under
+    ``"weights"``.  None when the tree is some other pytree (full jax
+    train state), which weights-only resume cannot consume."""
+    if isinstance(tree, dict) and "weights" in tree:
+        tree = tree["weights"]
+    if isinstance(tree, (list, tuple)) and tree and all(
+            hasattr(w, "shape") for w in tree):
+        return [np.asarray(w) for w in tree]
+    return None
+
+
 class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
     """Broadcast model + optimizer state from ``root_rank`` once, at the
     start of training (reference lines 8-34).
@@ -48,10 +95,17 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
     ``checkpoint_dir`` adds the job-level-restart glue
     (docs/fault-tolerance.md): on a relaunched run (``hvdrun
     --max-restarts``, detected via ``HVD_TPU_RESTART_EPOCH``), the root
-    rank reloads the newest ``*.weights.h5`` in that directory before
+    rank reloads the newest checkpoint in that directory before
     broadcasting, so every rank resumes from the last checkpoint instead
     of reinitialized weights.  Pair it with a
     ``keras.callbacks.ModelCheckpoint`` writing into the same directory.
+    Besides ``*.weights.h5``, the resume path reads
+    ``jax.train.save_checkpoint`` artifacts — the legacy pickle AND the
+    sharded ``ckpt-<step>/`` format (docs/fault-tolerance.md
+    #state-plane) — when the tree is a flat ``model.get_weights()`` list
+    (or a dict with a ``"weights"`` entry), so an elastic job that saved
+    sharded checkpoints and fell below ``--min-np`` resumes through
+    ``--max-restarts`` too.
 
     Scope: this resumes **weights only** — the optimizer (iteration
     counter, momentum/slot variables) restarts fresh, so LR schedules
@@ -88,16 +142,54 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
 
         if (self.checkpoint_dir and _common.restart_epoch() > 0
                 and _common.rank() == self.root_rank):
-            latest = _latest_weights_file(self.checkpoint_dir)
-            if latest is not None:
+            kind, latest = _latest_resume_source(self.checkpoint_dir)
+            if kind == "weights_h5":
                 # Root-only load; the broadcast below replicates it, so
                 # ranks whose local filesystem lacks the checkpoint (or
                 # holds a stale one) still resume consistently.
                 self.model.load_weights(latest)
                 self.resumed_from = latest
+            elif kind == "checkpoint":
+                # A jax.train.save_checkpoint artifact — the format an
+                # elastic job's sharded saves leave when it falls below
+                # --min-np and --max-restarts relaunches.  Root-only, so
+                # the sharded read must assemble locally
+                # (collective=False), never enqueue broadcasts the other
+                # ranks are not making.
+                from horovod_tpu.jax.train import load_checkpoint
+
+                weights, problem = None, None
+                try:
+                    _, tree = load_checkpoint(latest, collective=False)
+                    weights = _weights_list(tree)
+                    if weights is None:
+                        problem = ("does not carry a flat weight list "
+                                   "(checkpoint model.get_weights(), or "
+                                   "a dict with a 'weights' entry)")
+                except Exception as exc:  # torn/corrupt artifact
+                    problem = f"is unreadable ({exc})"
+                if weights is not None:
+                    self.model.set_weights(weights)
+                    self.resumed_from = latest
+                else:
+                    # An unusable artifact must not cost the resume a
+                    # usable (if older) .weights.h5 sitting next to it,
+                    # nor crash the relaunch whose whole purpose is
+                    # crash recovery — the pre-sharded-format behavior.
+                    import warnings
+
+                    h5 = _latest_weights_file(self.checkpoint_dir)
+                    if h5 is not None:
+                        self.model.load_weights(h5)
+                        self.resumed_from = h5
+                    warnings.warn(
+                        f"checkpoint {latest} {problem}; "
+                        + (f"resumed from older {h5} instead"
+                           if h5 else "weights-only resume skipped"))
+            if self.resumed_from is not None:
                 print(f"[horovod_tpu] restart epoch "
                       f"{_common.restart_epoch()}: resumed weights from "
-                      f"{latest}")
+                      f"{self.resumed_from}")
         broadcast_global_variables(self.root_rank, model=self.model)
         self.broadcast_done = True
 

@@ -750,6 +750,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "the failure report points at the first-"
                              "failing rank's dump.  Render with "
                              "tools/postmortem_dump.py DIR")
+    parser.add_argument("--state-dir", default=None, metavar="DIR",
+                        help="state plane (docs/fault-tolerance.md"
+                             "#state-plane): spill each rank's async "
+                             "shard snapshots under DIR (sets "
+                             "HVD_TPU_STATE_DIR for every rank and "
+                             "every --max-restarts relaunch); scripts "
+                             "arm with hvd.state.arm().  Pair with "
+                             "HVD_TPU_CKPT_KEEP to bound sharded-"
+                             "checkpoint retention")
     parser.add_argument("--min-np", type=int, default=None,
                         help="elastic membership "
                              "(docs/fault-tolerance.md#elastic-membership): "
@@ -813,6 +822,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.serve_port is not None:
         env = dict(os.environ)
         env["HVD_TPU_SERVE_PORT"] = str(args.serve_port)
+    if args.state_dir:
+        os.makedirs(args.state_dir, exist_ok=True)
+        env = dict(env if env is not None else os.environ)
+        env["HVD_TPU_STATE_DIR"] = args.state_dir
     if args.postmortem_dir:
         os.makedirs(args.postmortem_dir, exist_ok=True)
         env = dict(env if env is not None else os.environ)

@@ -1,0 +1,87 @@
+"""State plane: async sharded checkpoints + peer-replicated shards for
+instant elastic recovery (docs/fault-tolerance.md#state-plane).
+
+Three pieces over one partition contract (``partition.py``: leaf ``i`` of
+the flattened state belongs to rank ``i % size``):
+
+* async shard **snapshots** — a double-buffered device→host capture off
+  the step path, serialization/spill/mirror overlapped with compute
+  (``snapshot.py``);
+* **sharded durable checkpoints** — ``ckpt-<step>/rank-N.pkl`` + an
+  atomically committed rank-0 manifest, O(model/size) per rank instead
+  of O(model) on rank 0 (``checkpoint.py``; surfaced through
+  ``horovod_tpu.jax.train.save_checkpoint(..., sharded=True)``);
+* **peer-replicated redundancy** — every committed snapshot mirrors to
+  the ring neighbor, so an elastic reshape restores lost shards from
+  surviving peer copies instead of a full root broadcast (``peers.py``,
+  ``plane.py``; ``hvd.run_elastic`` routes through the armed plane).
+
+Usage::
+
+    hvd.init()
+    plane = hvd.state.arm()            # every rank, same program point
+    state = hvd.ElasticState(weights=w, step=0)
+
+    def train(state):
+        while state.step < TOTAL:
+            ...collectives...
+            state.step += 1
+            plane.snapshot(state)      # async; ~free on the step path
+        return state.weights
+
+    hvd.run_elastic(train, state)      # reshapes restore via the plane
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from horovod_tpu.state.partition import (  # noqa: F401
+    flatten_state,
+    flatten_tree,
+    owner,
+    shard_indices,
+)
+from horovod_tpu.state.plane import StatePlane  # noqa: F401
+
+_armed_lock = threading.Lock()
+_armed: Optional[StatePlane] = None
+
+
+def arm(state_dir: Optional[str] = None) -> StatePlane:
+    """Arm the process-wide state plane (idempotent: re-arming returns
+    the live plane).  Call on EVERY rank at the same program point, after
+    ``hvd.init()``; ``hvd.run_elastic`` picks the armed plane up
+    automatically.  ``state_dir`` (default ``HVD_TPU_STATE_DIR``) adds
+    the on-disk snapshot spill."""
+    global _armed
+    with _armed_lock:
+        if _armed is None:
+            _armed = StatePlane(state_dir=state_dir)
+        elif state_dir and _armed._state_dir != state_dir:
+            import warnings
+
+            # Re-arming cannot move the spill dir mid-lifetime (the live
+            # worker holds the old one); a silently ignored request would
+            # leave the operator staring at an empty directory.
+            warnings.warn(
+                f"state plane already armed with state_dir="
+                f"{_armed._state_dir!r}; ignoring new state_dir="
+                f"{state_dir!r} (disarm first to change it)")
+        return _armed
+
+
+def current() -> Optional[StatePlane]:
+    """The armed plane, or None (``run_elastic``'s routing hook)."""
+    with _armed_lock:
+        return _armed
+
+
+def disarm() -> None:
+    """Close and forget the armed plane (tests; shutdown paths)."""
+    global _armed
+    with _armed_lock:
+        plane, _armed = _armed, None
+    if plane is not None:
+        plane.close()

@@ -1,0 +1,88 @@
+"""The state plane's partition contract: who owns which leaf.
+
+Everything in ``horovod_tpu/state`` — async shard snapshots, sharded
+durable checkpoints, peer-replicated redundancy — rests on ONE shared
+fact: given a flattened state tree of ``n`` leaves and a job of ``size``
+ranks, leaf ``i`` is owned by rank ``i % size``.  Round-robin by leaf
+index is deterministic (no byte-size heuristics that could drift between
+a writer and a reader), spreads the large trailing leaves of typical
+models across ranks, and — critically — is a pure function of ``(i,
+size)``, so a reader at a different world size (or none at all) can
+reconstruct the exact writer-side layout from the manifest alone.
+
+The flattening itself reuses :func:`horovod_tpu.common.elastic._tree_flatten`
+(jax ``tree_util`` when importable, the deterministic pure-python walk
+otherwise), so the snapshot/checkpoint leaf order is the SAME order
+``ElasticState.sync`` broadcasts in — one named-leaf walk, three
+consumers (docs/fault-tolerance.md#state-plane).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+
+def owner(leaf_index: int, size: int) -> int:
+    """The rank owning leaf ``leaf_index`` in a ``size``-rank job."""
+    if size <= 0:
+        raise ValueError(f"need size >= 1, got {size}")
+    return leaf_index % size
+
+
+def shard_indices(rank: int, size: int, n_leaves: int) -> List[int]:
+    """The leaf indices rank ``rank`` owns out of ``n_leaves``."""
+    if not 0 <= rank < size:
+        raise ValueError(f"need 0 <= rank ({rank}) < size ({size})")
+    return list(range(rank, n_leaves, size))
+
+
+def flatten_tree(tree: Any) -> Tuple[list, Callable[[list], Any]]:
+    """``(leaves, rebuild)`` in the canonical state-plane order (the
+    ``ElasticState.sync`` walk)."""
+    from horovod_tpu.common.elastic import _tree_flatten
+
+    return _tree_flatten(tree)
+
+
+def flatten_state(state) -> Tuple[List[Tuple[str, Any]],
+                                  Callable[[list], None]]:
+    """Flatten an :class:`~horovod_tpu.common.elastic.ElasticState` into the
+    canonical named leaf list, plus an ``assign(new_leaves)`` writing the
+    values back into the state object.
+
+    Names match the ``sync`` broadcast naming (``<key>`` for scalar/array
+    leaves, ``<key>.<i>`` for pytree sub-leaves); scalar leaves round-trip
+    with their Python types preserved (step counters stay ints), exactly
+    like ``sync`` (the re-enterability contract depends on it).
+    """
+    from horovod_tpu.common.elastic import _coerce_like, _tree_flatten
+
+    named: List[Tuple[str, Any]] = []
+    writers: List[Tuple[str, Any, Any]] = []  # (key, rebuild|None, span)
+    for key in state.keys():
+        value = getattr(state, key)
+        if isinstance(value, (dict, list, tuple)):
+            flat, rebuild = _tree_flatten(value)
+            start = len(named)
+            named.extend((f"{key}.{i}", leaf) for i, leaf in enumerate(flat))
+            writers.append((key, rebuild, (start, len(named))))
+        else:
+            start = len(named)
+            named.append((key, value))
+            writers.append((key, None, (start, start + 1)))
+
+    originals = [value for _, value in named]
+
+    def assign(new_leaves: list) -> None:
+        if len(new_leaves) != len(originals):
+            raise ValueError(
+                f"state shape changed: {len(originals)} leaves flattened, "
+                f"{len(new_leaves)} supplied")
+        for key, rebuild, (start, stop) in writers:
+            if rebuild is not None:
+                setattr(state, key, rebuild(list(new_leaves[start:stop])))
+            else:
+                setattr(state, key,
+                        _coerce_like(originals[start], new_leaves[start]))
+
+    return named, assign

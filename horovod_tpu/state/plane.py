@@ -1,0 +1,377 @@
+"""The state plane: async shard snapshots + peer-replicated restore.
+
+One object per rank (``horovod_tpu.state.arm()``), three duties:
+
+* **snapshot** (:meth:`StatePlane.snapshot`): capture this rank's owned
+  1/size shard of the flattened training state (partition.py) as a
+  private host copy, then serialize / spill / mirror it in the
+  background (snapshot.py) — the step path pays the O(model/size) copy
+  and, at most, one double-buffer fence.
+* **mirror**: the background writer pushes every committed snapshot to
+  the ring neighbor ``rank+1 mod size`` (peers.py), so each shard exists
+  on two hosts.
+* **restore** (:meth:`StatePlane.restore`): after an elastic reshape,
+  survivors agree on a fence step every shard can serve (own snapshots
+  or peer copies), then each shard's designated holder broadcasts it —
+  O(model/size) per NIC instead of PR 6's O(model) root broadcast.  A
+  rank lost together with its mirror (neighbor pairs dying at once), a
+  membership that never snapshotted, or a state-shape mismatch all fall
+  back to the classic root broadcast; ``run_elastic`` handles both ends.
+
+The restore *decision* is collective: every rank allgathers its holdings
+(`__state.plan.<epoch>`) and computes the same verdict from the same
+table, so no rank can locally shortcut into a deadlock.  Restore rolls
+state back to the fence step — the re-enterable ``train_fn`` recomputes
+the (at most ``SNAPSHOT_KEEP``) steps since, which is the CheckFreq /
+Gemini trade: a bounded recompute instead of an O(model) stop-the-world
+transfer.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from horovod_tpu.common import metrics as _metrics
+from horovod_tpu.state import partition
+from horovod_tpu.state.peers import PeerMirror
+from horovod_tpu.state.snapshot import ShardSnapshotter
+
+_ENDPOINT_BYTES = 64
+
+
+def _state_signature(named) -> int:
+    """Stable 63-bit digest of the flattened state's SHAPE — leaf names,
+    array shapes, dtypes — identical across ranks running the same SPMD
+    program (Python ``hash()`` is salt-randomized per process, so it
+    cannot cross rank boundaries).  Restore only trusts snapshots and
+    peer copies cut under the current signature: a shape change between
+    capture and restore must fall back to the root broadcast, never tear
+    a fixed-shape shard broadcast mid-resync."""
+    import hashlib
+
+    h = hashlib.blake2s(digest_size=8)
+    for name, leaf in named:
+        arr_like = np.asarray(leaf) if not hasattr(leaf, "shape") else leaf
+        h.update(f"{name}|{tuple(arr_like.shape)}|"
+                 f"{np.dtype(arr_like.dtype).name};".encode())
+    return int.from_bytes(h.digest(), "little") & ((1 << 63) - 1)
+
+
+def _private_host_copy(leaf) -> np.ndarray:
+    """One host copy, guaranteed private: numpy leaves (and any view
+    aliasing caller memory) are copied; an ``__array__``-produced buffer
+    that already owns its data (the jax device->host materialization) is
+    used as-is — the capture pays exactly ONE O(leaf) pass."""
+    arr = np.asarray(leaf)
+    if arr is leaf or arr.base is not None or not arr.flags["OWNDATA"]:
+        arr = arr.copy()
+    return arr
+
+
+class StatePlane:
+    """Per-rank driver of the state plane.  Arm on EVERY rank at the same
+    program point (the restore collectives are symmetric); one plane per
+    engine lifetime."""
+
+    def __init__(self, state_dir: Optional[str] = None):
+        from horovod_tpu import common as _common
+
+        if not _common.is_initialized():
+            raise ValueError("arm the state plane after hvd.init()")
+        self._rank = _common.rank()
+        self._size = _common.size()
+        self._state_dir = (state_dir
+                           or os.environ.get("HVD_TPU_STATE_DIR") or "")
+        if self._state_dir:
+            os.makedirs(self._state_dir, exist_ok=True)
+        self._mirror = PeerMirror()
+        self._neighbor: Optional[str] = None  # set by the peer exchange
+        self._snapshotter = ShardSnapshotter(writer=self._background_write)
+        self._ever_snapshotted = False
+        # step -> state-shape signature at capture time (bounded; restore
+        # only advertises steps whose signature matches the live state).
+        self._sig_by_step: dict = {}
+        self._closed = False
+        _metrics.registry.set_state_armed(True)
+
+    # -- snapshot (step path) ---------------------------------------------
+
+    def snapshot(self, state, step: Optional[int] = None) -> int:
+        """Snapshot this rank's shard of ``state`` (an ``ElasticState``).
+        Returns the snapshot step (default: ``state.step``).  The call
+        captures a private host copy and hands it to the background
+        worker; it blocks only on the double-buffer fence."""
+        from horovod_tpu import common as _common
+
+        if step is None:
+            step = getattr(state, "step", None)
+            if step is None:
+                raise ValueError(
+                    "snapshot(state) needs a step: pass step= or give the "
+                    "ElasticState a 'step' leaf")
+        step = int(step)
+        _common._trace_begin("state.snapshot", "STATE_SNAPSHOT")
+        try:
+            named, _ = partition.flatten_state(state)
+            own = {}
+            for i in partition.shard_indices(self._rank, self._size,
+                                             len(named)):
+                own[i] = _private_host_copy(named[i][1])
+            sig = _state_signature(named)
+            self._sig_by_step[step] = sig
+            if len(self._sig_by_step) > 8:  # bounded; only recent steps
+                for old in sorted(self._sig_by_step)[:-8]:
+                    del self._sig_by_step[old]
+            self._snapshotter.submit(step, own)
+            self._ever_snapshotted = True
+        finally:
+            _common._trace_end("state.snapshot")
+        from horovod_tpu.common import postmortem as _postmortem
+
+        _postmortem.plane_ring.record("state_snapshot", f"step.{step}",
+                                      step)
+        return step
+
+    def _background_write(self, step: int, leaves: dict,
+                          nbytes: int) -> None:
+        """Worker-thread half: disk spill (``HVD_TPU_STATE_DIR``) then the
+        peer push — both overlapped with compute."""
+        if self._state_dir:
+            from horovod_tpu.state.checkpoint import _atomic_write
+
+            doc = {"format": "hvd-tpu-snap-v1", "step": step,
+                   "rank": self._rank, "size": self._size,
+                   "leaves": leaves}
+            path = os.path.join(self._state_dir,
+                                f"snap-rank{self._rank}.pkl")
+            _atomic_write(path, lambda f: pickle.dump(
+                doc, f, protocol=pickle.HIGHEST_PROTOCOL))
+        if self._neighbor is not None and self._size > 1:
+            PeerMirror.push(self._neighbor, self._rank, self._size, step,
+                            leaves, sig=self._sig_by_step.get(step, 0))
+        # Overlap gauges ride the commit (cumulative totals, idempotent).
+        _metrics.registry.set_state_overlap(self._snapshotter.blocked_sec,
+                                            self._snapshotter.async_sec)
+
+    # -- peer wiring ------------------------------------------------------
+
+    def exchange_peers(self, key: str = "arm") -> None:
+        """Allgather every rank's mirror endpoint and pick this rank's
+        ring neighbor.  Collective — call on every rank at the same point
+        (restore() does it per epoch; call it once after arm() for
+        snapshot-only jobs that never enter ``run_elastic``)."""
+        from horovod_tpu import common as _common
+
+        if _common.size() == 1:
+            self._neighbor = None
+            return
+        endpoints = self._allgather_endpoints(key)
+        self._neighbor = endpoints[(_common.rank() + 1) % _common.size()]
+
+    def _allgather_endpoints(self, key: str) -> List[str]:
+        from horovod_tpu import common as _common
+
+        row = np.zeros((1, _ENDPOINT_BYTES), np.uint8)
+        enc = self._mirror.endpoint.encode()
+        if len(enc) > _ENDPOINT_BYTES:
+            raise ValueError(f"state endpoint too long: "
+                             f"{self._mirror.endpoint!r}")
+        row[0, :len(enc)] = np.frombuffer(enc, np.uint8)
+        rows = _common.allgather(row, name=f"__state.peers.{key}")
+        return [bytes(r).rstrip(b"\0").decode() for r in rows]
+
+    # -- restore (reshape path) -------------------------------------------
+
+    def restore(self, state, epoch: int) -> bool:
+        """Collective restore attempt for membership ``epoch`` (call after
+        ``membership_ack``, on every rank).  True: ``state`` now holds the
+        fence-step snapshot, assembled from surviving shard holders —
+        skip the root broadcast.  False: no covering fence step — caller
+        must root-broadcast (``ElasticState.sync``)."""
+        from horovod_tpu import common as _common
+        from horovod_tpu.common import postmortem as _postmortem
+
+        t0 = time.perf_counter()
+        _common._trace_begin("state.restore", "STATE_RESTORE")
+        try:
+            ok, peer_used = self._restore_inner(state, epoch)
+        finally:
+            _common._trace_end("state.restore")
+        if ok:
+            _metrics.registry.record_state_restore(
+                "peer" if peer_used else "local")
+            _metrics.registry.observe("state_restore_sec",
+                                      time.perf_counter() - t0)
+            _postmortem.plane_ring.record(
+                "state_restore", "peer" if peer_used else "local", epoch)
+        return ok
+
+    def _restore_inner(self, state, epoch: int) -> tuple:
+        from horovod_tpu import common as _common
+
+        self._snapshotter.wait(timeout=30.0)  # settle in-flight commits
+        new_rank, new_size = _common.rank(), _common.size()
+        named, assign = partition.flatten_state(state)
+        n = len(named)
+        live_sig = _state_signature(named)
+
+        # Advertise only holdings cut under the CURRENT state shape — a
+        # mismatched snapshot would tear the fixed-shape shard broadcasts
+        # below; the plan's live-signature column catches cross-rank
+        # divergence the same way.
+        own_steps = [s for s in self._snapshotter.committed_steps()
+                     if self._sig_by_step.get(s) == live_sig]
+        # Pin the advertised snapshots NOW: a same-generation commit
+        # landing after the plan allgather (slow peer push held the
+        # worker past the settle above) may evict an advertised step
+        # from the keep-2 window; holding the leaf dicts here keeps the
+        # promise the plan makes regardless.
+        own_map = {s: self._snapshotter.get(s) for s in own_steps}
+        own_steps = [s for s in own_steps if own_map[s] is not None]
+        peer = self._mirror.latest()
+        if peer is not None and peer.get("sig") != live_sig:
+            peer = None
+        row = np.full((1, 10), -1, np.int64)
+        row[0, 0] = self._rank          # rank under the OLD membership
+        row[0, 1] = self._size if own_steps else -1
+        if own_steps:
+            row[0, 2] = own_steps[-1]
+            row[0, 3] = own_steps[0] if len(own_steps) > 1 else -1
+        if peer is not None:
+            row[0, 4] = peer["src"]
+            row[0, 5] = peer["size"]
+            row[0, 6] = peer["step"]
+        row[0, 7] = n
+        row[0, 8] = int(self._ever_snapshotted)
+        row[0, 9] = live_sig
+        table = np.asarray(_common.allgather(
+            row, name=f"__state.plan.{epoch}"))
+        endpoints = (self._allgather_endpoints(str(epoch))
+                     if new_size > 1 else [])
+
+        plan = _plan_restore(table, n)
+        anyone_snapshotted = bool(table[:, 8].any())
+        if plan is None:
+            # No covering fence step: adopt the new membership (stale
+            # shards are useless) and let the caller root-broadcast.
+            self._refresh(new_rank, new_size, endpoints)
+            if anyone_snapshotted:
+                _metrics.registry.record_state_restore("root_broadcast")
+            return False, False
+
+        fence_step, old_size, holders = plan
+        peer_used = any(src == "peer" for _, src in holders.values())
+        new_leaves: List[np.ndarray] = []
+        for i in range(n):
+            shard = i % old_size
+            root, source = holders[shard]
+            if root == new_rank:
+                # `own_map`/`peer` are the copies the plan was built
+                # from — re-reading the snapshotter or mirror here could
+                # pick up (or lose) a late in-flight commit and tear the
+                # fence the plan promised.
+                leaves = (own_map[fence_step]
+                          if source == "own" else peer["leaves"])
+                src_arr = np.ascontiguousarray(leaves[i])
+            else:
+                # Shape/dtype mirror the local live leaf (the SPMD
+                # replicated-state invariant); contents are overwritten,
+                # so an EMPTY buffer suffices — materializing the live
+                # leaf (np.asarray) would force a device->host transfer
+                # of every non-owned leaf and undo the O(model/size)
+                # restore cost.
+                leaf = named[i][1]
+                if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                    src_arr = np.empty(tuple(leaf.shape),
+                                       np.dtype(leaf.dtype))
+                else:  # python scalar leaf: tiny, materialize directly
+                    src_arr = np.ascontiguousarray(np.asarray(leaf))
+            new_leaves.append(_common.broadcast(
+                src_arr, root, name=f"__state.restore.{epoch}.{i}"))
+        assign(new_leaves)
+        self._refresh(new_rank, new_size, endpoints)
+        return True, peer_used
+
+    def _refresh(self, rank: int, size: int,
+                 endpoints: List[str]) -> None:
+        """Adopt a new membership: the old partition's snapshots and peer
+        copies are meaningless under the new leaf ownership."""
+        self._rank, self._size = rank, size
+        self._snapshotter.clear()
+        self._sig_by_step.clear()
+        self._mirror.clear()
+        self._neighbor = (endpoints[(rank + 1) % size]
+                          if size > 1 and endpoints else None)
+
+    # -- reading / lifecycle ----------------------------------------------
+
+    @property
+    def ever_snapshotted(self) -> bool:
+        return self._ever_snapshotted
+
+    def wait(self, timeout: float = 30.0) -> bool:
+        """Drain the background snapshot slot (benches, tests)."""
+        return self._snapshotter.wait(timeout)
+
+    def status(self) -> dict:
+        """Compact view for postmortem dumps and tests."""
+        steps = self._snapshotter.committed_steps()
+        peer = self._mirror.latest()
+        return {
+            "rank": self._rank, "size": self._size,
+            "last_snapshot_step": steps[-1] if steps else -1,
+            "committed_steps": steps,
+            "peer_src": peer["src"] if peer else -1,
+            "peer_step": peer["step"] if peer else -1,
+            "overlap_ratio": self._snapshotter.overlap_ratio(),
+        }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._snapshotter.close()
+        self._mirror.close()
+        _metrics.registry.set_state_armed(False)
+
+
+def _plan_restore(table: np.ndarray, n_leaves: int):
+    """The deterministic restore plan every rank computes from the
+    allgathered plan table (rows indexed by NEW rank):
+    ``(fence_step, old_size, {shard: (new_root_rank, "own"|"peer")})`` or
+    None when no single step covers every old shard."""
+    old_sizes = {int(r[1]) for r in table if r[1] > 0}
+    peer_sizes = {int(r[5]) for r in table if r[5] > 0}
+    if len(old_sizes | peer_sizes) != 1:
+        return None  # nobody has state, or mixed-generation holdings
+    old_size = (old_sizes | peer_sizes).pop()
+    if any(int(r[7]) != n_leaves for r in table):
+        return None  # the state tree changed shape across the reshape
+    if len({int(r[9]) for r in table}) != 1:
+        return None  # per-leaf shape/dtype signatures diverged
+    # availability[shard] = {step: [(priority, new_rank, source), ...]}
+    avail: Dict[int, Dict[int, list]] = {}
+    for new_rank, r in enumerate(table):
+        if r[1] > 0 and r[0] >= 0:
+            for step in (int(r[2]), int(r[3])):
+                if step >= 0:
+                    avail.setdefault(int(r[0]), {}).setdefault(
+                        step, []).append((0, new_rank, "own"))
+        if r[4] >= 0 and r[6] >= 0:
+            avail.setdefault(int(r[4]), {}).setdefault(
+                int(r[6]), []).append((1, new_rank, "peer"))
+    candidate_steps = sorted(
+        {s for per in avail.values() for s in per}, reverse=True)
+    for step in candidate_steps:
+        if all(step in avail.get(shard, {}) for shard in range(old_size)):
+            holders = {}
+            for shard in range(old_size):
+                _, root, source = min(avail[shard][step])
+                holders[shard] = (root, source)
+            return step, old_size, holders
+    return None

@@ -1,0 +1,178 @@
+"""Async shard snapshots: double-buffered device→host capture with the
+serialization + write overlapped with subsequent compute.
+
+The CheckFreq shape (PAPERS.md): the only work on the training-step path
+is the **capture** — a host copy of this rank's owned leaves, O(model/size)
+— plus, at most, a bounded wait for the PREVIOUS snapshot to clear the
+single background slot (the double buffer: one snapshot serializing in the
+background while the next captures).  Pickling, the optional disk spill
+(``HVD_TPU_STATE_DIR``), and the peer-mirror push all run on the worker
+thread, overlapped with compute.
+
+The epoch fence: a snapshot becomes **committed** — visible to
+:meth:`committed_steps` / :meth:`get`, eligible for peer restore — only
+after the worker finished every byte of it, and the capture is a private
+copy, so a torn snapshot is never committable and later training-step
+mutation cannot reach captured state.  The last TWO committed snapshots
+are retained: the peer copy of step ``s`` may still be in flight to the
+neighbor when ``s+1`` commits locally, so restore needs ``s`` available on
+both sides to find a common fence step.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from horovod_tpu.common import metrics as _metrics
+
+# Committed snapshots retained per rank.  Two, not one: the neighbor's
+# copy of the newest snapshot may lag one push, and restore needs one
+# step that EVERY shard (own copies and peer copies alike) can serve.
+SNAPSHOT_KEEP = 2
+
+
+class ShardSnapshotter:
+    """Background serializer for one rank's shard snapshots.
+
+    ``submit(step, leaves)`` captures nothing itself — the caller passes
+    already-copied host arrays — and blocks only while the single
+    background slot is busy (the fence half of the double buffer).
+    ``writer`` is invoked on the worker thread with
+    ``(step, leaves, payload_nbytes)`` after the snapshot committed
+    locally (the plane uses it for the disk spill + peer push).
+    """
+
+    def __init__(self, writer: Optional[Callable[[int, dict, int], None]]
+                 = None):
+        self._writer = writer
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._committed: List[dict] = []  # [{"step", "leaves", "nbytes"}]
+        self._lock = threading.Lock()
+        # Outstanding = submitted but not yet committed/abandoned; the
+        # exact idle predicate (an emptiness+event pair would race the
+        # window between the worker's queue.get() and its first action).
+        self._outstanding = 0
+        # Bumped by clear(): a snapshot submitted under an older
+        # generation must never commit after the clear — it was cut
+        # under a partition the membership change just invalidated.
+        self._generation = 0
+        self._closed = False
+        self.blocked_sec = 0.0   # step-path time spent waiting on the slot
+        self.async_sec = 0.0     # worker time overlapped with compute
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="hvd-tpu-state-snapshot")
+        self._thread.start()
+
+    # -- step path --------------------------------------------------------
+
+    def submit(self, step: int, leaves: Dict[int, np.ndarray]) -> None:
+        """Hand one captured shard to the background worker.  Blocks only
+        while the previous snapshot still occupies the slot."""
+        if self._closed:
+            raise RuntimeError("snapshotter is closed")
+        t0 = time.perf_counter()
+        with self._lock:
+            self._outstanding += 1
+            gen = self._generation
+        self._queue.put({"step": int(step), "leaves": leaves, "gen": gen})
+        self.blocked_sec += time.perf_counter() - t0
+
+    def wait(self, timeout: float = 30.0) -> bool:
+        """Drain the background slot (tests, shutdown, restore entry):
+        True when every submitted snapshot committed (or was abandoned)
+        within ``timeout``."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self._outstanding == 0:
+                    return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.002)
+
+    # -- worker -----------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                nbytes = sum(int(a.nbytes) for a in item["leaves"].values())
+                entry = {"step": item["step"], "leaves": item["leaves"],
+                         "nbytes": nbytes}
+                if self._writer is not None:
+                    try:
+                        self._writer(entry["step"], entry["leaves"], nbytes)
+                    except Exception as exc:  # never kill the worker
+                        import warnings
+
+                        warnings.warn(
+                            f"state snapshot writer failed at step "
+                            f"{entry['step']}: {exc}")
+                # Commit LAST: the snapshot becomes visible (and restore-
+                # eligible) only after spill + peer push finished — the
+                # epoch fence.  A failed writer still commits: the local
+                # arrays are whole regardless of mirror reachability.  A
+                # snapshot from a PRE-clear() generation is abandoned —
+                # its partition died with the old membership, and a late
+                # commit here would poison the next restore plan.
+                with self._lock:
+                    if item["gen"] == self._generation:
+                        self._committed = (
+                            [e for e in self._committed
+                             if e["step"] != entry["step"]] + [entry]
+                        )[-SNAPSHOT_KEEP:]
+                        committed = True
+                    else:
+                        committed = False
+                dt = time.perf_counter() - t0
+                self.async_sec += dt
+                if committed:
+                    _metrics.registry.record_state_snapshot(
+                        entry["step"], nbytes)
+                    _metrics.registry.observe("state_snapshot_sec", dt)
+            finally:
+                with self._lock:
+                    self._outstanding -= 1
+                self._queue.task_done()
+
+    # -- reading ----------------------------------------------------------
+
+    def committed_steps(self) -> List[int]:
+        """Steps of the committed snapshots, oldest first."""
+        with self._lock:
+            return [e["step"] for e in self._committed]
+
+    def get(self, step: int) -> Optional[Dict[int, np.ndarray]]:
+        with self._lock:
+            for entry in self._committed:
+                if entry["step"] == step:
+                    return entry["leaves"]
+        return None
+
+    def clear(self) -> None:
+        """Drop every committed snapshot AND abandon in-flight ones (a
+        reshape invalidates the partition they were all cut under — a
+        submit that commits after this call would otherwise resurface a
+        stale-partition snapshot in the next restore plan)."""
+        with self._lock:
+            self._committed = []
+            self._generation += 1
+
+    def overlap_ratio(self) -> float:
+        total = self.async_sec + self.blocked_sec
+        return self.async_sec / total if total > 0 else 1.0
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._thread.join(timeout=5.0)

@@ -130,6 +130,43 @@ def test_keras_load_model_roundtrip(tmp_path, single_process_hvd):
     loaded.fit(x, y, epochs=1, verbose=0)
 
 
+def test_keras_resume_recognizes_sharded_checkpoints(tmp_path,
+                                                     monkeypatch,
+                                                     single_process_hvd):
+    """BroadcastGlobalVariablesCallback(checkpoint_dir=) resumes from a
+    jax.train sharded checkpoint carrying a model.get_weights() list —
+    the format an elastic job leaves when it falls below --min-np and
+    --max-restarts relaunches (docs/fault-tolerance.md#state-plane)."""
+    import keras
+
+    from horovod_tpu.jax.train import save_checkpoint
+    from horovod_tpu.keras.callbacks import (BroadcastGlobalVariablesCallback,
+                                             _latest_resume_source)
+
+    keras.utils.set_random_seed(7)
+    model = keras.Sequential([keras.layers.Input((3,)),
+                              keras.layers.Dense(2)])
+    model.compile(optimizer=keras.optimizers.SGD(0.01), loss="mse")
+    saved = [np.asarray(w) + 1.5 for w in model.get_weights()]
+    save_checkpoint(str(tmp_path), 6, {"weights": saved}, sharded=True)
+    # An OLDER .weights.h5 must lose to the newer sharded checkpoint.
+    model.save_weights(str(tmp_path / "ckpt-2.weights.h5"))
+    kind, path = _latest_resume_source(str(tmp_path))
+    assert kind == "checkpoint" and path.endswith("ckpt-00000006"), \
+        (kind, path)
+
+    monkeypatch.setenv("HVD_TPU_RESTART_EPOCH", "1")
+    cb = BroadcastGlobalVariablesCallback(0, checkpoint_dir=str(tmp_path))
+    cb.set_model(model)
+    x = np.random.randn(4, 3).astype(np.float32)
+    y = np.random.randn(4, 2).astype(np.float32)
+    cb.on_train_begin()
+    assert cb.resumed_from is not None and "ckpt-00000006" in cb.resumed_from
+    for got, want in zip(model.get_weights(), saved):
+        assert np.allclose(got, want)
+    model.fit(x, y, epochs=1, verbose=0)  # still trainable after resume
+
+
 def test_keras_momentum_correction(single_process_hvd):
     import keras
 

@@ -1,0 +1,637 @@
+"""State plane tests (docs/fault-tolerance.md#state-plane).
+
+The ISSUE acceptance path: a 4-rank CPU job with the state plane armed
+and ``rank=2:crash@op=12`` under elastic membership — survivors restore
+rank 2's shard from its ring-neighbor peer copy (``state.peer_restores
+>= 1``, ZERO root-broadcast fallbacks), weights allgather-identical to
+an uninterrupted run.  Plus the fast 2-rank tier-1 smoke, sharded
+save/load bit-identity against the legacy pickle (single- and
+multi-rank), torn-manifest refusal, legacy-read compat, retention, the
+snapshot fence, and the restore-plan unit matrix.  Larger restore
+matrices (standby rejoin with the plane armed) are slow-tiered with the
+tier-1 smokes as siblings.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env(**overrides):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.setdefault("HVD_TPU_KILL_GRACE_SEC", "3")
+    env.update({k: str(v) for k, v in overrides.items()})
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA", "HVD_TPU_FAULT_SPEC",
+                "HVD_TPU_RESTART_EPOCH", "HVD_TPU_ELASTIC",
+                "HVD_TPU_MIN_NP", "HVD_TPU_REJOIN", "HVD_TPU_STATE_DIR",
+                "HVD_TPU_CKPT_KEEP"):
+        env.setdefault(var, "")
+        if not env[var]:
+            env.pop(var, None)
+    return env
+
+
+# The elastic training script with the state plane armed: averaged
+# allreduce of ones adds 1.0/step regardless of membership, per-step
+# snapshots mirror to the ring neighbor, and the STATE line reports the
+# resync routing (peer restores vs root-broadcast fallbacks) every test
+# asserts on.
+_TRAIN = """\
+import os, sys, time
+import numpy as np
+import horovod_tpu as hvd
+
+TOTAL = int(sys.argv[1])
+PAUSE = float(os.environ.get("TEST_STEP_PAUSE") or 0)
+hvd.init()
+plane = hvd.state.arm()
+state = hvd.ElasticState(weights=np.zeros(8, np.float32), step=0)
+
+def train(state):
+    while state.step < TOTAL:
+        s = state.step
+        g = np.ones(8, np.float32)
+        state.weights = state.weights + hvd.allreduce(
+            g, average=True, name=f"grad.{s}")
+        state.step = s + 1
+        plane.snapshot(state)
+        if PAUSE:
+            time.sleep(PAUSE)
+    return state.weights
+
+w = hvd.run_elastic(train, state)
+assert np.allclose(w, float(TOTAL)), (hvd.rank(), w)
+flat = hvd.allgather(w.reshape(1, -1), name="final.identity")
+assert np.allclose(flat, flat[0]), flat
+snap = hvd.metrics_snapshot()
+m, st = snap["membership"], snap["state"]
+print("STATE", hvd.rank(), hvd.size(), m["epoch"],
+      st["peer_restores"], st["restores"],
+      st["root_broadcast_fallbacks"], st["snapshots"],
+      ",".join(map(str, m["ranks_lost"])) or "-", int(w[0]), flush=True)
+"""
+
+
+def _state_lines(results):
+    """[(rank, size, epoch, peer_restores, restores, fallbacks,
+    snapshots, lost, w0)] from every clean rank."""
+    out = []
+    for r in results:
+        if r.returncode != 0:
+            continue
+        for line in r.stdout.splitlines():
+            if line.startswith("STATE "):
+                tok = line.split()
+                lost = [] if tok[8] == "-" else [int(x) for x in
+                                                 tok[8].split(",")]
+                out.append((int(tok[1]), int(tok[2]), int(tok[3]),
+                            int(tok[4]), int(tok[5]), int(tok[6]),
+                            int(tok[7]), lost, int(tok[9])))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The acceptance path: 4 ranks lose rank 2, survivors restore its shard
+# from the ring-neighbor peer copy — no root broadcast.
+# ---------------------------------------------------------------------------
+
+
+def test_shrink_to_three_restores_from_peer_copies(tmp_path):
+    """rank=2:crash@op=12 on a 4-rank elastic job with the plane armed:
+    the survivors re-negotiate size()==3, restore rank 2's shard from
+    rank 3's peer copy (peer_restores >= 1 on every survivor, zero
+    root-broadcast fallbacks), finish all 30 steps, and end
+    allgather-identical to an uninterrupted run."""
+    from horovod_tpu.common.faults import CRASH_EXIT_CODE
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "train.py"
+    script.write_text(_TRAIN)
+    results = run_membership(
+        [sys.executable, str(script), "30"], 4, min_np=2, max_np=4,
+        max_rejoins=0,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=2:crash@op=12",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20"),
+        timeout=90.0, capture=True, report=lambda msg: None)
+    by_slot = {r.rank: r for r in results}
+    assert by_slot[2].returncode == CRASH_EXIT_CODE, by_slot[2]
+    assert membership_succeeded(results, 2), \
+        [(r.rank, r.returncode, r.stderr[-400:]) for r in results]
+    members = _state_lines(results)
+    assert len(members) == 3, members
+    for _, size_now, epoch, peer, restores, fallbacks, snaps, lost, w0 \
+            in members:
+        assert size_now == 3 and epoch == 1, members
+        assert peer >= 1 and restores >= 1, members
+        assert fallbacks == 0, members      # NO full root broadcast
+        assert snaps > 0, members
+        assert lost == [2], members
+        assert w0 == 30, members            # identical to uninterrupted
+
+
+def test_peer_restore_smoke_two_ranks(tmp_path):
+    """The fast tier-1 smoke: 2 ranks, rank 1 crashes; the survivor holds
+    rank 1's shard as the ring-neighbor peer copy (1+1 mod 2 = 0) and
+    finishes alone via peer restore — zero root broadcasts."""
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "train.py"
+    script.write_text(_TRAIN)
+    results = run_membership(
+        [sys.executable, str(script), "12"], 2, min_np=1, max_np=2,
+        max_rejoins=0,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=1:crash@op=10",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20"),
+        timeout=60.0, capture=True, report=lambda msg: None)
+    assert membership_succeeded(results, 1), \
+        [(r.rank, r.returncode, r.stderr[-400:]) for r in results]
+    members = _state_lines(results)
+    assert len(members) == 1, members
+    _, size_now, epoch, peer, restores, fallbacks, _, lost, w0 = members[0]
+    assert (size_now, epoch) == (1, 1), members
+    assert peer >= 1 and restores >= 1 and fallbacks == 0, members
+    assert lost == [1] and w0 == 12, members
+
+
+@pytest.mark.slow  # grow matrix: shrink + standby rejoin with the plane
+# armed; the shrink-side contract stays tier-1 via the two smokes above
+def test_standby_rejoin_with_state_plane(tmp_path):
+    """2-rank job, rank 1 crashes (peer restore), a standby rejoins
+    (grow barrier → second plane resync); both members finish identical
+    with no root-broadcast fallback on the survivor."""
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "train.py"
+    script.write_text(_TRAIN)
+    results = run_membership(
+        [sys.executable, str(script), "60"], 2, min_np=1, max_np=2,
+        rejoin_delay=0.3,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=1:crash@op=10",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20",
+                 TEST_STEP_PAUSE="0.05"),
+        timeout=90.0, capture=True, report=lambda msg: None)
+    assert membership_succeeded(results, 1), \
+        [(r.rank, r.returncode, r.stderr[-400:]) for r in results]
+    members = _state_lines(results)
+    assert sorted(m[0] for m in members) == [0, 1], members
+    survivor = next(m for m in members if m[0] == 0)
+    _, size_now, epoch, peer, restores, fallbacks, _, lost, w0 = survivor
+    assert size_now == 2 and epoch == 2, members   # shrink, then grow
+    assert peer >= 1 and restores >= 2, members    # both resyncs routed
+    assert fallbacks == 0, members
+    for m in members:
+        assert m[8] == 60, members
+
+
+# ---------------------------------------------------------------------------
+# Sharded durable checkpoints: bit identity, torn refusal, retention.
+# ---------------------------------------------------------------------------
+
+
+def _tree():
+    return {"w": np.arange(24, dtype=np.float32).reshape(4, 6),
+            "opt": [np.full(6, 2.0, np.float64), np.int16([1, 2, 3])],
+            "step_count": 11, "note": "exact"}
+
+
+def _trees_bit_identical(a, b):
+    from horovod_tpu.state.partition import flatten_tree
+
+    fa, _ = flatten_tree(a)
+    fb, _ = flatten_tree(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+            xa, ya = np.asarray(x), np.asarray(y)
+            assert xa.dtype == ya.dtype and xa.shape == ya.shape
+            assert xa.tobytes() == ya.tobytes()
+        else:
+            assert type(x) is type(y) and x == y, (x, y)
+
+
+def test_sharded_save_load_bit_identical_to_legacy(tmp_path,
+                                                   single_process_hvd):
+    from horovod_tpu.jax.train import load_latest_checkpoint, save_checkpoint
+
+    tree = _tree()
+    save_checkpoint(str(tmp_path / "legacy"), 7, tree)
+    path = save_checkpoint(str(tmp_path / "sharded"), 7, tree,
+                           sharded=True)
+    assert os.path.isdir(path)
+    step_l, tree_l = load_latest_checkpoint(str(tmp_path / "legacy"))
+    step_s, tree_s = load_latest_checkpoint(str(tmp_path / "sharded"))
+    assert step_l == step_s == 7
+    _trees_bit_identical(tree_l, tree_s)
+    # Scalar Python types survive the shard round trip (legacy contract).
+    assert isinstance(tree_s["step_count"], int)
+    assert tree_s["note"] == "exact"
+
+
+def test_latest_checkpoint_mixed_formats_and_torn_dirs(tmp_path):
+    """latest_checkpoint orders legacy files and sharded dirs by step and
+    never returns a torn (manifest-less) sharded directory."""
+    from horovod_tpu.jax.train import latest_checkpoint, save_checkpoint
+
+    d = str(tmp_path)
+    save_checkpoint(d, 3, {"w": np.ones(4)})
+    save_checkpoint(d, 5, {"w": np.ones(4)}, sharded=True)
+    assert latest_checkpoint(d).endswith("ckpt-00000005")
+    save_checkpoint(d, 8, {"w": np.ones(4)})
+    assert latest_checkpoint(d).endswith("ckpt-00000008.pkl")
+    # A torn sharded dir at a higher step stays invisible.
+    os.makedirs(os.path.join(d, "ckpt-00000010"))
+    assert latest_checkpoint(d).endswith("ckpt-00000008.pkl")
+
+
+def test_torn_sharded_checkpoint_refused(tmp_path):
+    """Missing manifest, missing shard file, and manifest/shard step
+    mismatch all raise (torn checkpoints must never load quietly)."""
+    from horovod_tpu.jax.train import load_checkpoint, save_checkpoint
+    from horovod_tpu.state import checkpoint as ckpt
+
+    d = str(tmp_path)
+    path = save_checkpoint(d, 5, _tree(), sharded=True)
+    # 1) no manifest
+    torn = os.path.join(d, "ckpt-00000009")
+    os.makedirs(torn)
+    with pytest.raises(ValueError, match="no committed manifest"):
+        load_checkpoint(torn)
+    # 2) missing shard file
+    manifest = ckpt.read_manifest(path)
+    shard = os.path.join(path, ckpt.shard_file(0))
+    backup = shard + ".bak"
+    os.rename(shard, backup)
+    with pytest.raises(ValueError, match="missing shard"):
+        load_checkpoint(path)
+    os.rename(backup, shard)
+    # 3) manifest/shard step mismatch
+    import pickle
+
+    with open(shard, "rb") as f:
+        doc = pickle.load(f)
+    doc["step"] = 99
+    with open(shard, "wb") as f:
+        pickle.dump(doc, f)
+    with pytest.raises(ValueError, match="step 99"):
+        load_checkpoint(path)
+    assert manifest["step"] == 5
+    # 4) truncated/corrupt shard pickle (disk-full, partial copy) is
+    # torn too — a typed refusal, not a raw UnpicklingError.
+    with open(shard, "rb") as f:
+        data = f.read()
+    with open(shard, "wb") as f:
+        f.write(data[: len(data) // 2])
+    with pytest.raises(ValueError, match="unreadable"):
+        load_checkpoint(path)
+
+
+def test_retention_keeps_last_k(tmp_path):
+    """HVD_TPU_CKPT_KEEP / keep= prunes only after the newer checkpoint
+    committed, never the one being written, never torn dirs."""
+    from horovod_tpu.jax.train import latest_checkpoint, save_checkpoint
+    from horovod_tpu.state.checkpoint import scan_checkpoints
+
+    d = str(tmp_path)
+    # A torn dir predating everything must survive pruning untouched.
+    os.makedirs(os.path.join(d, "ckpt-00000000"))
+    for step in (1, 2, 3):
+        save_checkpoint(d, step, {"w": np.ones(4)}, keep=2)
+    save_checkpoint(d, 4, {"w": np.ones(4)}, sharded=True, keep=2)
+    steps = [s for s, _, _ in scan_checkpoints(d)]
+    assert steps == [3, 4], steps
+    assert os.path.isdir(os.path.join(d, "ckpt-00000000"))  # torn kept
+    assert latest_checkpoint(d).endswith("ckpt-00000004")
+
+
+def test_retention_env_knob(tmp_path, monkeypatch):
+    from horovod_tpu.jax.train import save_checkpoint
+    from horovod_tpu.state.checkpoint import scan_checkpoints
+
+    monkeypatch.setenv("HVD_TPU_CKPT_KEEP", "1")
+    d = str(tmp_path)
+    save_checkpoint(d, 1, {"w": np.ones(2)})
+    save_checkpoint(d, 2, {"w": np.ones(2)})
+    assert [s for s, _, _ in scan_checkpoints(d)] == [2]
+    monkeypatch.setenv("HVD_TPU_CKPT_KEEP", "banana")
+    with pytest.raises(ValueError, match="HVD_TPU_CKPT_KEEP"):
+        save_checkpoint(d, 3, {"w": np.ones(2)})
+
+
+def test_ckpt_inspect_reads_both_formats_and_flags_torn(tmp_path,
+                                                        capsys):
+    from horovod_tpu.jax.train import save_checkpoint
+    from tools.ckpt_inspect import inspect
+
+    d = str(tmp_path)
+    save_checkpoint(d, 2, _tree())
+    save_checkpoint(d, 4, _tree(), sharded=True)
+    assert inspect(d, leaves=True) == 0
+    out = capsys.readouterr().out
+    assert "legacy  step 2" in out
+    assert "sharded  step 4" in out
+    assert "['w']" in out or "leaf.0" in out  # manifest leaf names
+    os.makedirs(os.path.join(d, "ckpt-00000006"))
+    assert inspect(d) == 1                    # torn detected -> exit 1
+    assert "TORN" in capsys.readouterr().out
+
+
+def test_multirank_sharded_roundtrip_and_collective_load(tmp_path):
+    """2 ranks: every rank writes its shard, the manifest commits after
+    the barrier, and the collective load leaves every rank holding the
+    full tree bit-identical to the rank-0 legacy pickle."""
+    from horovod_tpu.runner import run_command
+
+    script = tmp_path / "ckpt.py"
+    script.write_text("""\
+import os, sys
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.jax.train import save_checkpoint, load_latest_checkpoint
+
+d = sys.argv[1]
+hvd.init()
+tree = {"w": np.arange(32, dtype=np.float32).reshape(4, 8),
+        "opt": [np.full(8, 3.0), np.int32(7)], "step_count": 9}
+if hvd.rank() == 0:
+    save_checkpoint(os.path.join(d, "legacy"), 4, tree)
+path = save_checkpoint(os.path.join(d, "sharded"), 4, tree, sharded=True)
+assert os.path.isdir(path), path
+step, loaded = load_latest_checkpoint(os.path.join(d, "sharded"))
+assert step == 4
+if hvd.rank() == 0:
+    _, ref = load_latest_checkpoint(os.path.join(d, "legacy"))
+    assert np.asarray(loaded["w"]).tobytes() == \\
+        np.asarray(ref["w"]).tobytes()
+    assert type(loaded["step_count"]) is type(ref["step_count"])
+flat = hvd.allgather(np.asarray(loaded["w"], np.float32).reshape(1, -1),
+                     name="ckpt.identity")
+assert np.allclose(flat, flat[0]), flat
+print("CKPT_OK", hvd.rank(), flush=True)
+""")
+    results = run_command([sys.executable, str(script), str(tmp_path)], 2,
+                          env=_env(), timeout=90.0, capture=True)
+    assert all(r.returncode == 0 for r in results), \
+        [(r.rank, r.returncode, r.stderr[-600:]) for r in results]
+    assert all("CKPT_OK" in r.stdout for r in results)
+
+
+# ---------------------------------------------------------------------------
+# Snapshot fence + capture privacy (in-process units).
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_fence_commits_whole_snapshots_only():
+    """A snapshot is committable only after the worker finished it; the
+    double buffer blocks a third submit while one is in flight; the last
+    two commits are retained."""
+    import threading
+    import time
+
+    from horovod_tpu.state.snapshot import ShardSnapshotter
+
+    gate = threading.Event()
+
+    def slow_writer(step, leaves, nbytes):
+        gate.wait(timeout=10.0)
+
+    snap = ShardSnapshotter(writer=slow_writer)
+    try:
+        snap.submit(1, {0: np.ones(4)})
+        time.sleep(0.05)           # worker picked #1 up, now blocked
+        snap.submit(2, {0: np.ones(4)})  # queued in the free slot
+        assert snap.committed_steps() == []  # nothing committed yet
+        t0 = time.perf_counter()
+        gate.set()
+        snap.submit(3, {0: np.ones(4)})  # must wait for a slot, not drop
+        assert snap.wait(timeout=10.0)
+        assert snap.committed_steps() == [2, 3]  # last two retained
+        assert snap.blocked_sec >= 0.0
+        assert time.perf_counter() - t0 < 10.0
+    finally:
+        snap.close()
+
+
+def test_snapshot_capture_is_private(single_process_hvd):
+    """Mutating the live state after snapshot() returns cannot reach the
+    committed copy (the capture is a private host copy)."""
+    hvd = single_process_hvd
+    plane = hvd.state.arm()
+    try:
+        st = hvd.ElasticState(weights=np.zeros(4, np.float32), step=0)
+        st.step = 1
+        plane.snapshot(st)
+        st.weights += 99.0          # in-place mutation after capture
+        assert plane.wait(10.0)
+        status = plane.status()
+        assert status["last_snapshot_step"] == 1
+        from horovod_tpu.state.partition import flatten_state
+
+        named, _ = flatten_state(st)
+        leaves = plane._snapshotter.get(1)
+        # weights is one of rank 0's owned leaves at size 1.
+        widx = next(i for i, (name, _) in enumerate(named)
+                    if name == "weights")
+        assert np.allclose(leaves[widx], 0.0), leaves[widx]
+    finally:
+        hvd.state.disarm()
+
+
+def test_partition_contract():
+    from horovod_tpu.state.partition import owner, shard_indices
+
+    n, size = 11, 3
+    seen = []
+    for r in range(size):
+        idx = shard_indices(r, size, n)
+        assert all(owner(i, size) == r for i in idx)
+        seen += idx
+    assert sorted(seen) == list(range(n))  # complete, disjoint
+    with pytest.raises(ValueError):
+        shard_indices(3, 3, n)
+
+
+def test_flatten_state_assign_preserves_scalar_types(single_process_hvd):
+    hvd = single_process_hvd
+    from horovod_tpu.state.partition import flatten_state
+
+    st = hvd.ElasticState(weights=np.arange(4.0), step=3, lr=0.5,
+                          done=False, opt={"mu": [np.ones(2)]})
+    named, assign = flatten_state(st)
+    names = [n for n, _ in named]
+    assert "weights" in names and "step" in names and "opt.0" in names
+    assign([np.asarray(v) * 2 if isinstance(v, np.ndarray)
+            else np.asarray(v) for _, v in named])
+    assert isinstance(st.step, int) and st.step == 3
+    assert isinstance(st.lr, float) and st.lr == 0.5
+    assert isinstance(st.done, bool) and st.done is False
+    assert np.allclose(st.weights, np.arange(4.0) * 2)
+    assert np.allclose(st.opt["mu"][0], 2.0)  # array leaves all doubled
+    assert isinstance(st.opt, dict) and isinstance(st.opt["mu"], list)
+
+
+# ---------------------------------------------------------------------------
+# Restore-plan units: the deterministic fence/holder computation.
+# ---------------------------------------------------------------------------
+
+
+def _row(old_rank=-1, old_size=-1, last=-1, prev=-1, peer_src=-1,
+         peer_size=-1, peer_step=-1, n=4, ever=0, sig=1):
+    return [old_rank, old_size, last, prev, peer_src, peer_size,
+            peer_step, n, ever, sig]
+
+
+def test_plan_restore_prefers_own_copies_and_finds_fence():
+    from horovod_tpu.state.plane import _plan_restore
+
+    # 2 survivors of a 3-rank job: shards 0,1 own; shard 2 via rank 0's
+    # peer copy (old ring: 2 -> 0), common step 7.
+    table = np.asarray([
+        _row(0, 3, 7, 6, peer_src=2, peer_size=3, peer_step=7, ever=1),
+        _row(1, 3, 7, 6, peer_src=0, peer_size=3, peer_step=7, ever=1),
+    ])
+    step, old_size, holders = _plan_restore(table, 4)
+    assert (step, old_size) == (7, 3)
+    assert holders[0] == (0, "own")
+    assert holders[1] == (1, "own")
+    assert holders[2] == (0, "peer")
+
+
+def test_plan_restore_falls_back_one_step_for_lagging_peer():
+    from horovod_tpu.state.plane import _plan_restore
+
+    # The peer copy of shard 1 lags one step: fence must drop to 6.
+    table = np.asarray([
+        _row(0, 2, 7, 6, peer_src=1, peer_size=2, peer_step=6, ever=1),
+    ])
+    step, old_size, holders = _plan_restore(table, 4)
+    assert (step, old_size) == (6, 2)
+    assert holders[1] == (0, "peer")
+
+
+def test_plan_restore_refuses_gaps_and_mixed_generations():
+    from horovod_tpu.state.plane import _plan_restore
+
+    # Shard 1 has no holder at any step -> no plan.
+    assert _plan_restore(np.asarray([_row(0, 2, 7, 6, ever=1)]), 4) is None
+    # Mixed old sizes -> no plan.
+    assert _plan_restore(np.asarray([
+        _row(0, 2, 7, -1, ever=1), _row(1, 3, 7, -1, ever=1)]), 4) is None
+    # Leaf-count mismatch (state shape changed) -> no plan.
+    assert _plan_restore(np.asarray([
+        _row(0, 1, 7, -1, n=5, ever=1)]), 4) is None
+    # Divergent per-leaf shape/dtype signatures -> no plan.
+    assert _plan_restore(np.asarray([
+        _row(0, 2, 7, -1, ever=1, sig=1),
+        _row(1, 2, 7, -1, ever=1, sig=2)]), 4) is None
+    # Nobody holds anything -> no plan.
+    assert _plan_restore(np.asarray([_row(), _row()]), 4) is None
+
+
+# ---------------------------------------------------------------------------
+# Metrics: the ungated "state" section and its Prometheus families.
+# ---------------------------------------------------------------------------
+
+
+def test_state_metrics_section_and_prometheus():
+    from horovod_tpu.common.metrics import MetricsRegistry, prometheus_text
+
+    reg = MetricsRegistry()
+    snap = reg.snapshot()
+    assert snap["state"]["snapshots"] == 0
+    assert snap["state"]["overlap_ratio"] == 1.0
+    reg.set_state_armed(True)
+    reg.record_state_snapshot(9, 2048)
+    reg.set_state_overlap(0.1, 0.9)
+    reg.record_state_peer(sent_bytes=2048)
+    reg.record_state_peer(received_step=9)
+    reg.record_state_restore("peer")
+    reg.record_state_restore("root_broadcast")
+    reg.record_state_ckpt("sharded_saves", nbytes=2048)
+    snap = reg.snapshot()
+    st = snap["state"]
+    assert st["armed"] and st["snapshots"] == 1
+    assert st["last_snapshot_step"] == 9 and st["peer_last_step"] == 9
+    assert st["peer_restores"] == 1 and st["restores"] == 1
+    assert st["root_broadcast_fallbacks"] == 1
+    assert abs(st["overlap_ratio"] - 0.9) < 1e-9
+    assert st["ckpt"]["sharded_saves"] == 1
+    text = prometheus_text(snap)
+    assert "hvd_tpu_state_snapshots_total 1" in text
+    assert 'hvd_tpu_state_restores_total{source="peer"} 1' in text
+    assert 'hvd_tpu_state_restores_total{source="root_broadcast"} 1' in text
+    assert ('hvd_tpu_state_checkpoint_events_total{event="sharded_saves"}'
+            ' 1') in text
+    with pytest.raises(ValueError):
+        reg.record_state_restore("carrier_pigeon")
+    with pytest.raises(ValueError):
+        reg.record_state_ckpt("nope")
+
+
+def test_metrics_dump_renders_state_line():
+    from tools.metrics_dump import render
+
+    from horovod_tpu.common.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.record_state_snapshot(4, 1024)
+    reg.record_state_restore("peer")
+    out = render(reg.snapshot())
+    assert "state plane" in out
+    assert "peer 1" in out
+
+
+# ---------------------------------------------------------------------------
+# Launcher plumbing: hvdrun --state-dir.
+# ---------------------------------------------------------------------------
+
+
+def test_hvdrun_state_dir_plumbs_env(tmp_path):
+    """`hvdrun --state-dir DIR` exports HVD_TPU_STATE_DIR to every rank
+    (and creates DIR); the armed plane spills snapshots there."""
+    state_dir = tmp_path / "spool"
+    script = tmp_path / "probe.py"
+    script.write_text("""\
+import os, sys
+import numpy as np
+import horovod_tpu as hvd
+hvd.init()
+assert os.environ["HVD_TPU_STATE_DIR"] == sys.argv[1]
+plane = hvd.state.arm()
+st = hvd.ElasticState(weights=np.zeros(4, np.float32), step=1)
+plane.snapshot(st)
+assert plane.wait(15.0)
+assert os.path.exists(os.path.join(
+    sys.argv[1], f"snap-rank{hvd.rank()}.pkl"))
+print("SPILL_OK", hvd.rank(), flush=True)
+""")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         "--state-dir", str(state_dir), "--timeout", "60", "--",
+         sys.executable, str(script), str(state_dir)],
+        env=_env(), capture_output=True, text=True, timeout=90)
+    assert proc.returncode == 0, proc.stderr[-1200:]
+    assert sorted(os.listdir(state_dir)) == ["snap-rank0.pkl",
+                                             "snap-rank1.pkl"]
+    # The spool is a READABLE diagnostic artifact: ckpt_inspect reports
+    # each rank's last snapshotted step.
+    import io
+    from contextlib import redirect_stdout
+
+    from tools.ckpt_inspect import inspect
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert inspect(str(state_dir)) == 0
+    out = buf.getvalue()
+    assert "snap-rank0.pkl: step 1" in out, out
+    assert "snap-rank1.pkl: step 1" in out, out

@@ -58,6 +58,16 @@ SECTION_FAMILIES = {
                  "hvd_tpu_topology_cross_algo_threshold_bytes",
                  "hvd_tpu_topology_cross_ops_total",
                  "hvd_tpu_topology_bytes_total"),
+    "state": ("hvd_tpu_state_armed",
+              "hvd_tpu_state_snapshots_total",
+              "hvd_tpu_state_snapshot_bytes_total",
+              "hvd_tpu_state_last_snapshot_step",
+              "hvd_tpu_state_overlap_ratio",
+              "hvd_tpu_state_peer_copies_total",
+              "hvd_tpu_state_peer_last_step",
+              "hvd_tpu_state_restores_total",
+              "hvd_tpu_state_checkpoint_events_total",
+              "hvd_tpu_state_checkpoint_shard_bytes_total"),
     "histograms": (),
 }
 
@@ -91,6 +101,18 @@ def populated_registry():
     reg.set_serving_gauges(queue_depth=1, active=2, kv_blocks_in_use=3,
                            kv_blocks_total=8)
     reg.set_flight({"events": {"engine": 5, "xla": 2}, "capacity": 512})
+    reg.set_state_armed(True)
+    reg.record_state_snapshot(7, 4096)
+    reg.set_state_overlap(0.01, 0.4)
+    reg.record_state_peer(sent_bytes=4096)
+    reg.record_state_peer(received_step=7)
+    reg.record_state_restore("peer")
+    reg.record_state_restore("local")
+    reg.record_state_restore("root_broadcast")
+    reg.record_state_ckpt("sharded_saves", nbytes=4096)
+    reg.record_state_ckpt("legacy_saves", nbytes=8192)
+    reg.record_state_ckpt("loads")
+    reg.record_state_ckpt("pruned")
     reg.set_topology({"hierarchical": True, "nodes": 2, "local_size": 2,
                       "cross_algo_threshold": 64 << 10,
                       "cross_ops": {"ring": 3, "tree": 1},

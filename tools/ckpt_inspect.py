@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Inspect checkpoint directories: manifests, shards, torn-state detection.
+
+Reads BOTH checkpoint formats ``horovod_tpu.jax.train.save_checkpoint``
+produces (docs/fault-tolerance.md#state-plane):
+
+* legacy — one atomic ``ckpt-<step>.pkl`` pickle;
+* sharded — ``ckpt-<step>/rank-N.pkl`` per rank plus a rank-0
+  ``manifest.json`` committed after the shard barrier.
+
+For every checkpoint under a directory it prints the step, format, total
+bytes, and (sharded) the per-shard files with their recorded step/size
+and owned leaf names from the manifest.  Torn or partial checkpoints are
+DETECTED, not hidden: a sharded directory without a committed manifest
+(the writer died before the commit point), a manifest whose shard file
+is missing, and a shard whose recorded step/size disagrees with the
+manifest all print as ``TORN`` with the reason, and the tool exits 1 —
+so a CI step or an operator can gate on checkpoint-set health:
+
+    python tools/ckpt_inspect.py /ckpts            # whole directory
+    python tools/ckpt_inspect.py /ckpts/ckpt-00000040   # one checkpoint
+    python tools/ckpt_inspect.py --leaves /ckpts   # per-leaf detail
+
+State-plane snapshot spools (``snap-rank*.pkl`` under
+``HVD_TPU_STATE_DIR`` / ``hvdrun --state-dir``) are reported too: which
+step each rank last snapshotted — the "how much would a death here
+cost?" postmortem question.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.metrics_dump import _fmt_bytes  # noqa: E402  (shared formatter)
+
+
+def inspect_legacy(path: str, lines: list) -> bool:
+    """Append the report for one legacy pickle; True when healthy."""
+    try:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        step = int(payload["step"])
+    except Exception as exc:
+        lines.append(f"{os.path.basename(path)}  TORN legacy pickle: "
+                     f"{type(exc).__name__}: {exc}")
+        return False
+    lines.append(f"{os.path.basename(path)}  legacy  step {step}  "
+                 f"{_fmt_bytes(os.path.getsize(path))}")
+    return True
+
+
+def inspect_sharded(path: str, lines: list, leaves: bool = False) -> bool:
+    """Append the report for one sharded directory; True when healthy."""
+    from horovod_tpu.state import checkpoint as ckpt
+
+    name = os.path.basename(path.rstrip(os.sep))
+    try:
+        manifest = ckpt.read_manifest(path)
+    except ValueError as exc:
+        present = sorted(n for n in os.listdir(path)
+                         if n.startswith("rank-"))
+        lines.append(f"{name}  TORN: {exc}")
+        if present:
+            lines.append(f"  shards present anyway: {', '.join(present)}")
+        return False
+    size, step = manifest["size"], manifest["step"]
+    total = 0
+    healthy = True
+    shard_lines = []
+    for entry in manifest["shards"]:
+        spath = os.path.join(path, entry["file"])
+        try:
+            doc = ckpt._read_shard(path, manifest, entry["rank"])
+        except ValueError as exc:
+            shard_lines.append(f"  {entry['file']}: TORN: {exc}")
+            healthy = False
+            continue
+        nbytes = os.path.getsize(spath)
+        total += nbytes
+        owned = [m for m in manifest["leaves"]
+                 if m["shard"] == entry["rank"] and not m.get("object")]
+        shard_lines.append(
+            f"  {entry['file']}: step {doc['step']} size {doc['size']}, "
+            f"{len(owned)} array leaf(s) "
+            f"(+{len(doc.get('objects', {}))} replicated object(s)), "
+            f"{_fmt_bytes(nbytes)}")
+        if leaves:
+            for m in owned:
+                shard_lines.append(
+                    f"    [{m['index']:>4}] {m['name']}  "
+                    f"{tuple(m['shape'])} {m['dtype']} "
+                    f"{_fmt_bytes(m['nbytes'])}")
+    state = "" if healthy else "  TORN (see shards)"
+    lines.append(f"{name}  sharded  step {step}  {size} shard(s)  "
+                 f"{manifest['leaf_count']} leaf(s)  "
+                 f"{_fmt_bytes(total)}{state}")
+    lines.extend(shard_lines)
+    return healthy
+
+
+def inspect_spool(path: str, names: list, lines: list) -> bool:
+    """Report ``snap-rank*.pkl`` state-plane spill files (the
+    ``HVD_TPU_STATE_DIR`` artifact): which step each rank last
+    snapshotted — the postmortem question "how much work would a death
+    here cost?".  True when every spool file is readable."""
+    healthy = True
+    spools = sorted(n for n in names
+                    if n.startswith("snap-rank") and n.endswith(".pkl"))
+    if spools:
+        lines.append("state-plane snapshot spool:")
+    for nm in spools:
+        full = os.path.join(path, nm)
+        try:
+            with open(full, "rb") as f:
+                doc = pickle.load(f)
+            lines.append(
+                f"  {nm}: step {doc['step']} (rank {doc['rank']} of "
+                f"{doc['size']}), {len(doc['leaves'])} leaf(s), "
+                f"{_fmt_bytes(os.path.getsize(full))}")
+        except Exception as exc:
+            lines.append(f"  {nm}: TORN spool file "
+                         f"({type(exc).__name__}: {exc})")
+            healthy = False
+    return healthy
+
+
+def inspect(path: str, leaves: bool = False) -> int:
+    """Print the report for a checkpoint directory (or one checkpoint);
+    returns the exit code (1 when anything is torn)."""
+    from horovod_tpu.state import checkpoint as ckpt
+
+    lines: list = []
+    healthy = True
+    base = os.path.basename(path.rstrip(os.sep))
+    if os.path.isdir(path) and base.startswith("ckpt-"):
+        healthy = inspect_sharded(path, lines, leaves=leaves)
+    elif os.path.isfile(path):
+        healthy = inspect_legacy(path, lines)
+    else:
+        entries = ckpt.scan_checkpoints(path)
+        seen = {os.path.basename(p) for _, p, _ in entries}
+        for _, cpath, kind in entries:
+            ok = (inspect_sharded(cpath, lines, leaves=leaves)
+                  if kind == "sharded" else inspect_legacy(cpath, lines))
+            healthy = healthy and ok
+        # scan_checkpoints hides torn sharded directories by design (no
+        # committed manifest); an INSPECTOR must surface them instead.
+        try:
+            names = sorted(os.listdir(path))
+        except OSError as exc:
+            print(f"ckpt_inspect: {exc}", file=sys.stderr)
+            return 2
+        for nm in names:
+            full = os.path.join(path, nm)
+            if (nm.startswith("ckpt-") and os.path.isdir(full)
+                    and nm not in seen):
+                healthy = inspect_sharded(full, lines, leaves=leaves) \
+                    and healthy
+        healthy = inspect_spool(path, names, lines) and healthy
+        if not lines:
+            lines.append("(no checkpoints found)")
+    for line in lines:
+        print(line)
+    if not healthy:
+        print("ckpt_inspect: TORN/partial checkpoint(s) detected",
+              file=sys.stderr)
+    return 0 if healthy else 1
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    leaves = "--leaves" in argv
+    if leaves:
+        argv.remove("--leaves")
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 2
+    return inspect(argv[0], leaves=leaves)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -251,6 +251,41 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
             + "; joined "
             + (", ".join(f"rank{r}" for r in joined) or "none"))
 
+    # State plane (docs/fault-tolerance.md#state-plane); only rendered
+    # once a rank armed it (or a checkpoint moved), so pre-state dumps
+    # stay unchanged.  Counters diff in two-file mode; the last-step /
+    # overlap gauges stay absolute — the B dump's live state.
+    st = dict(snap.get("state", {}))
+    if st.get("armed") or st.get("snapshots") \
+            or any(st.get("ckpt", {}).values()):
+        counters = ("snapshots", "snapshot_bytes", "peer_copies_sent",
+                    "peer_copies_received", "restores", "peer_restores",
+                    "root_broadcast_fallbacks")
+        if base:
+            b = base.get("state", {})
+            for k in counters:
+                st[k] = st.get(k, 0) - b.get(k, 0)
+        ck = dict(st.get("ckpt", {}))
+        if base:
+            bck = base.get("state", {}).get("ckpt", {})
+            ck = {k: v - bck.get(k, 0) for k, v in ck.items()}
+        lines.append("== state plane ==")
+        lines.append(
+            f"snapshots {st.get('snapshots', 0)} "
+            f"({_fmt_bytes(st.get('snapshot_bytes', 0))}, last step "
+            f"{st.get('last_snapshot_step', -1)}, overlap "
+            f"{100.0 * st.get('overlap_ratio', 1.0):.1f}%); peer copies "
+            f"sent {st.get('peer_copies_sent', 0)} / received "
+            f"{st.get('peer_copies_received', 0)} (peer last step "
+            f"{st.get('peer_last_step', -1)})")
+        lines.append(
+            f"restores {st.get('restores', 0)} "
+            f"(peer {st.get('peer_restores', 0)}, root-broadcast "
+            f"fallbacks {st.get('root_broadcast_fallbacks', 0)}); ckpt "
+            f"saves sharded {ck.get('sharded_saves', 0)} / legacy "
+            f"{ck.get('legacy_saves', 0)}, loads {ck.get('loads', 0)}, "
+            f"pruned {ck.get('pruned', 0)}")
+
     # Serving plane (docs/inference.md); only rendered when the rank
     # served traffic, so training dumps stay unchanged.  Per-tenant
     # detail lives behind --tenants.  Counters diff in two-file mode
