@@ -150,6 +150,23 @@ def _load_lib():
             ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
             ctypes.c_longlong]
         lib.hvd_tpu_init_error.restype = ctypes.c_char_p
+        lib.hvd_tpu_init_error.argtypes = []
+        # Every export gets an explicit restype/argtypes — including the
+        # void and no-arg ones ctypes would default correctly today —
+        # so the hvdlint C-API parity checker can hold the seam to the
+        # C signatures (docs/contributing.md#c-api-parity).
+        lib.hvd_tpu_shutdown.restype = None
+        lib.hvd_tpu_shutdown.argtypes = []
+        lib.hvd_tpu_initialized.restype = ctypes.c_int
+        lib.hvd_tpu_initialized.argtypes = []
+        lib.hvd_tpu_rank.restype = ctypes.c_int
+        lib.hvd_tpu_rank.argtypes = []
+        lib.hvd_tpu_size.restype = ctypes.c_int
+        lib.hvd_tpu_size.argtypes = []
+        lib.hvd_tpu_local_rank.restype = ctypes.c_int
+        lib.hvd_tpu_local_rank.argtypes = []
+        lib.hvd_tpu_local_size.restype = ctypes.c_int
+        lib.hvd_tpu_local_size.argtypes = []
         lib.hvd_tpu_enqueue.restype = ctypes.c_longlong
         lib.hvd_tpu_enqueue.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -180,6 +197,7 @@ def _load_lib():
         lib.hvd_tpu_copy_result.restype = ctypes.c_int
         lib.hvd_tpu_copy_result.argtypes = [
             ctypes.c_longlong, ctypes.c_void_p, ctypes.c_longlong]
+        lib.hvd_tpu_release.restype = None
         lib.hvd_tpu_release.argtypes = [ctypes.c_longlong]
         lib.hvd_tpu_stall_count.restype = ctypes.c_longlong
         lib.hvd_tpu_stall_count.argtypes = []
@@ -257,17 +275,25 @@ def _load_lib():
         lib.hvd_tpu_membership_info.argtypes = []
         lib.hvd_tpu_membership_ack_pending.restype = ctypes.c_int
         lib.hvd_tpu_membership_ack_pending.argtypes = []
+        lib.hvd_tpu_membership_ack.restype = None
         lib.hvd_tpu_membership_ack.argtypes = []
         lib.hvd_tpu_timeline_enabled.restype = ctypes.c_int
+        lib.hvd_tpu_timeline_enabled.argtypes = []
+        lib.hvd_tpu_timeline_op_start.restype = None
         lib.hvd_tpu_timeline_op_start.argtypes = [ctypes.c_char_p,
                                                   ctypes.c_char_p]
+        lib.hvd_tpu_timeline_activity_start.restype = None
         lib.hvd_tpu_timeline_activity_start.argtypes = [ctypes.c_char_p,
                                                         ctypes.c_char_p]
+        lib.hvd_tpu_timeline_activity_end.restype = None
         lib.hvd_tpu_timeline_activity_end.argtypes = [ctypes.c_char_p]
+        lib.hvd_tpu_timeline_op_end.restype = None
         lib.hvd_tpu_timeline_op_end.argtypes = [ctypes.c_char_p,
                                                 ctypes.c_longlong]
+        lib.hvd_tpu_timeline_instant.restype = None
         lib.hvd_tpu_timeline_instant.argtypes = [ctypes.c_char_p,
                                                  ctypes.c_char_p]
+        lib.hvd_tpu_timeline_flush.restype = None
         lib.hvd_tpu_timeline_flush.argtypes = []
         lib.hvd_tpu_flight_count.restype = ctypes.c_longlong
         lib.hvd_tpu_flight_count.argtypes = []

@@ -5,15 +5,26 @@ Counterpart of the reference's setup.py extension build
 NCCL feature probing is needed because the engine's only system dependencies
 are POSIX sockets and pthreads.  The library is compiled on first import and
 cached next to the sources; rebuilt when any source is newer than the binary.
+
+Sanitized builds (docs/contributing.md#sanitized-engine-builds):
+``HVD_TPU_SANITIZE=thread|address|undefined`` compiles the engine with the
+matching ``-fsanitize=`` runtime into its own ``libhvdtpu.<mode>.so`` next
+to the normal binary, so switching modes never invalidates the regular
+cached build.  Loading a sanitized engine into an uninstrumented python
+needs the sanitizer runtime preloaded — ``sanitizer_preload()`` returns
+the ``LD_PRELOAD`` path (the slow-tier TSan test in tests/test_sanitize.py
+wires this for its rank subprocesses).
 """
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import os
 import shutil
 import subprocess
 import tempfile
+from typing import List, Optional
 
 _CC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cc")
 _SOURCES = ["net.cc", "wire.cc", "timeline.cc", "autotune.cc", "flight.cc",
@@ -28,16 +39,85 @@ _LIB_NAME = "libhvdtpu.so"
 _FLAGS = ["-std=c++17", "-O3", "-march=native", "-g", "-fPIC", "-shared",
           "-pthread", "-Wall", "-Wextra", "-Wno-unused-parameter"]
 
+# Sanitizer modes -> (compile flags, runtime to preload into
+# uninstrumented hosts).  ONE table so a future mode cannot be accepted
+# by the build but unknown to the preload resolver (or vice versa).
+# Flags swap in for the -O3/-march pair (-O1 + frame pointers keep
+# reports readable and the instrumented hot loops tolerable; correctness
+# tools don't want vectorized shuffles anyway).
+_SANITIZERS = {
+    "thread": (["-fsanitize=thread"], "libtsan.so"),
+    "address": (["-fsanitize=address"], "libasan.so"),
+    "undefined": (["-fsanitize=undefined", "-fno-sanitize-recover=all"],
+                  "libubsan.so"),
+}
 
-def lib_path() -> str:
-    return os.path.join(_CC_DIR, _LIB_NAME)
+
+def sanitize_mode() -> str:
+    """The validated ``HVD_TPU_SANITIZE`` mode ('' = normal build)."""
+    mode = (os.environ.get("HVD_TPU_SANITIZE") or "").strip().lower()
+    _check_mode(mode)
+    return mode
 
 
-def _stamp_path() -> str:
-    return os.path.join(_CC_DIR, ".buildstamp")
+def _check_mode(mode: str) -> None:
+    if mode and mode not in _SANITIZERS:
+        raise ValueError(
+            f"HVD_TPU_SANITIZE: unknown sanitizer {mode!r} "
+            f"(want {', '.join(sorted(_SANITIZERS))})")
 
 
-def _build_stamp() -> str:
+def _flags(mode: str) -> List[str]:
+    if not mode:
+        return list(_FLAGS)
+    base = [f for f in _FLAGS if f not in ("-O3", "-march=native")]
+    return base + ["-O1", "-fno-omit-frame-pointer"] + _SANITIZERS[mode][0]
+
+
+def lib_path(mode: Optional[str] = None) -> str:
+    if mode is None:
+        mode = sanitize_mode()
+    name = _LIB_NAME if not mode else f"libhvdtpu.{mode}.so"
+    return os.path.join(_CC_DIR, name)
+
+
+def sanitizer_preload(mode: Optional[str] = None) -> str:
+    """Path of the sanitizer runtime to LD_PRELOAD when dlopen-ing a
+    sanitized engine from an uninstrumented python ('' for normal
+    builds, or when the compiler can't name it).  Raises ``ValueError``
+    on an unknown mode, like :func:`sanitize_mode`."""
+    if mode is None:
+        mode = sanitize_mode()
+    if not mode:
+        return ""
+    _check_mode(mode)
+    return _resolve_preload(mode)
+
+
+@functools.lru_cache(maxsize=None)
+def _resolve_preload(mode: str) -> str:
+    """One compiler subprocess per mode per process: the launcher calls
+    sanitizer_preload once per rank, and the answer never changes."""
+    cxx = os.environ.get("CXX", "g++")
+    try:
+        out = subprocess.run(
+            [cxx, f"-print-file-name={_SANITIZERS[mode][1]}"],
+            capture_output=True, text=True, timeout=30).stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        return ""
+    # An unresolved -print-file-name echoes the bare name back.
+    if not out or os.sep not in out:
+        return ""
+    real = os.path.realpath(out)
+    return real if os.path.exists(real) else ""
+
+
+def _stamp_path(mode: str = "") -> str:
+    suffix = f".{mode}" if mode else ""
+    return os.path.join(_CC_DIR, f".buildstamp{suffix}")
+
+
+def _build_stamp(mode: str = "") -> str:
     """Fingerprint of everything that must invalidate the cached binary
     besides source mtimes: the compile flags and the host CPU's ISA."""
     cpu = ""
@@ -49,17 +129,19 @@ def _build_stamp() -> str:
                     break
     except OSError:
         pass
-    payload = " ".join(_FLAGS) + "|" + cpu
+    payload = " ".join(_flags(mode)) + "|" + cpu
     return hashlib.sha256(payload.encode()).hexdigest()
 
 
-def needs_build() -> bool:
-    lib = lib_path()
+def needs_build(mode: Optional[str] = None) -> bool:
+    if mode is None:
+        mode = sanitize_mode()
+    lib = lib_path(mode)
     if not os.path.exists(lib):
         return True
     try:
-        with open(_stamp_path()) as f:
-            if f.read().strip() != _build_stamp():
+        with open(_stamp_path(mode)) as f:
+            if f.read().strip() != _build_stamp(mode):
                 return True
     except OSError:
         return True
@@ -100,9 +182,12 @@ def _sweep_stale_tmp() -> None:
 
 
 def build(verbose: bool = False) -> str:
-    """Compile the engine; returns the .so path.  Raises on failure."""
-    lib = lib_path()
-    if not needs_build():
+    """Compile the engine; returns the .so path.  Raises on failure.
+    ``HVD_TPU_SANITIZE`` selects a sanitized variant (own lib name, own
+    stamp — the normal cached build is never invalidated by it)."""
+    mode = sanitize_mode()
+    lib = lib_path(mode)
+    if not needs_build(mode):
         return lib
     _sweep_stale_tmp()
     cxx = os.environ.get("CXX", "g++")
@@ -117,12 +202,12 @@ def build(verbose: bool = False) -> str:
     tmpdir = tempfile.mkdtemp(prefix="hvdtpu_build_")
     stage = None
     try:
-        out = os.path.join(tmpdir, _LIB_NAME)
-        cmd = [cxx] + _FLAGS + ["-o", out] + srcs
+        out = os.path.join(tmpdir, os.path.basename(lib))
+        cmd = [cxx] + _flags(mode) + ["-o", out] + srcs
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
-                f"failed to build {_LIB_NAME}:\n{proc.stderr}")
+                f"failed to build {os.path.basename(lib)}:\n{proc.stderr}")
         # prefix "stage_", NOT the mkstemp default "tmp": _sweep_stale_tmp
         # matches tmp* and must never unlink a CONCURRENT builder's live
         # staging file mid-copy.
@@ -132,8 +217,8 @@ def build(verbose: bool = False) -> str:
         shutil.copy(out, stage)  # tmpdir may be another filesystem
         os.replace(stage, lib)
         stage = None
-        with open(_stamp_path(), "w") as f:
-            f.write(_build_stamp())
+        with open(_stamp_path(mode), "w") as f:
+            f.write(_build_stamp(mode))
     finally:
         if stage is not None and os.path.exists(stage):
             os.unlink(stage)

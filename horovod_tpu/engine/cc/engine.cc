@@ -921,6 +921,8 @@ bool Engine::SetupSockets(std::string* err) {
   if (!ClockSync(err)) return false;
   node_id_ = opts_.hierarchical_allreduce ? opts_.rank / opts_.local_size : 0;
   n_nodes_ = opts_.hierarchical_allreduce ? opts_.size / opts_.local_size : 1;
+  topo_hier_.store(opts_.hierarchical_allreduce);
+  topo_nodes_.store(n_nodes_);
 
   // Data-plane connections.  Every outgoing connection announces itself
   // with a 4-byte hello (kind in the high byte, sender id in the low 24
@@ -2435,7 +2437,11 @@ void Engine::ApplyTunedParams(const ResponseList& rl) {
 }
 
 int64_t Engine::AutotuneWindows() {
-  if (opts_.rank == 0 || opts_.size == 1) return tuner_.windows();
+  // API threads call this live; the atomic mirrors, not opts_, are the
+  // reshape-safe identity (elastic reassigns opts_.rank/size mid-run on
+  // the engine thread — a TSan-confirmed race when this read opts_).
+  if (cur_rank_.load() == 0 || cur_size_.load() == 1)
+    return tuner_.windows();
   return applied_window_.load();
 }
 
@@ -2695,6 +2701,7 @@ bool Engine::CoordinatorMaybeReshape(ResponseList* out) {
   out->reshape_cycle_time_us = cur_cycle_us_.load();
   out->reshape_compression = static_cast<uint8_t>(cur_compression_.load());
   out->reshape_compression_min_bytes = opts_.compression_min_bytes;
+  out->reshape_cross_algo_threshold = cur_cross_algo_.load();
   for (int r = 0; r < opts_.size; ++r) {
     if (coord_->rank_dead[r]) {
       out->reshape_lost.push_back(r);
@@ -2784,6 +2791,11 @@ bool Engine::ApplyReshape(const ResponseList& rl) {
   opts_.compression_min_bytes = rl.reshape_compression_min_bytes;
   cur_compression_.store(rl.reshape_compression);
   cur_comp_min_bytes_.store(rl.reshape_compression_min_bytes);
+  // The ring-vs-tree boundary re-agrees like the other tuned axes, so
+  // the applied-parameter view stays identical across the barrier (the
+  // knob itself is dormant until a topology is rebuilt hierarchical).
+  opts_.cross_algo_threshold = rl.reshape_cross_algo_threshold;
+  cur_cross_algo_.store(rl.reshape_cross_algo_threshold);
   residuals_.clear();
   residual_bytes_.store(0);
   residual_tensors_.store(0);
@@ -2895,6 +2907,8 @@ bool Engine::RebuildRing(std::string* err) {
   CloseTopologyFds();
   node_id_ = 0;
   n_nodes_ = 1;
+  topo_hier_.store(false);
+  topo_nodes_.store(1);
   if (opts_.size == 1) return true;
   const double kTimeout = 30.0;
   // Epoch-tagged hellos: a stale connect from a previous membership (or
@@ -3002,9 +3016,12 @@ bool Engine::SetupRejoinSockets(std::string* err) {
   opts_.cycle_time_ms =
       static_cast<double>(rl.reshape_cycle_time_us) / 1000.0;
   // Wire compression comes from the admitting broadcast, not this
-  // standby's env: the live job's agreement wins.
+  // standby's env: the live job's agreement wins.  Same for the
+  // cross-algo boundary (Init stores cur_cross_algo_ from opts_ after
+  // this returns, like fusion/cycle).
   opts_.compression_mode = rl.reshape_compression;
   opts_.compression_min_bytes = rl.reshape_compression_min_bytes;
+  opts_.cross_algo_threshold = rl.reshape_cross_algo_threshold;
   cur_compression_.store(rl.reshape_compression);
   cur_comp_min_bytes_.store(rl.reshape_compression_min_bytes);
   cur_rank_.store(new_rank);
@@ -4119,8 +4136,9 @@ std::string Engine::TopologyInfo() {
     std::lock_guard<std::mutex> lk(topo_mu_);
     log_total = topo_log_total_;
   }
-  bool hier = opts_.hierarchical_allreduce && cur_size_.load() > 1;
-  return std::string(hier ? "1" : "0") + "|" + std::to_string(n_nodes_) +
+  bool hier = topo_hier_.load() && cur_size_.load() > 1;
+  return std::string(hier ? "1" : "0") + "|" +
+         std::to_string(topo_nodes_.load()) +
          "|" + std::to_string(cur_local_size_.load()) + "|" +
          std::to_string(cur_cross_algo_.load()) + "|" +
          std::to_string(topo_ops_ring_.load()) + "|" +

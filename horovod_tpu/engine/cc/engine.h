@@ -788,6 +788,13 @@ class Engine {
   // bounded, with topo_log_total_ letting the Python sync delta-consume
   // it into the phase histograms.  topo_last_algo_ (-1 = none yet)
   // detects ring<->tree switches for the flight recorder.
+  // Atomic mirrors of the topology shape for lock-free API-thread reads
+  // (TopologyInfo): node_id_/n_nodes_/opts_.hierarchical_allreduce are
+  // engine-thread state that RebuildRing resets at a reshape while
+  // Python metric pollers snapshot concurrently (the opts_ mirror
+  // pattern; a TSan-confirmed race before these existed).
+  std::atomic<bool> topo_hier_{false};
+  std::atomic<int> topo_nodes_{1};
   std::atomic<int64_t> topo_ops_ring_{0};
   std::atomic<int64_t> topo_ops_tree_{0};
   std::atomic<int64_t> topo_local_bytes_{0};

@@ -189,6 +189,7 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
     w.I64(rl.reshape_cycle_time_us);
     w.U8(rl.reshape_compression);
     w.I64(rl.reshape_compression_min_bytes);
+    w.I64(rl.reshape_cross_algo_threshold);
     w.U32(static_cast<uint32_t>(rl.member_old_ranks.size()));
     for (size_t i = 0; i < rl.member_old_ranks.size(); ++i) {
       w.I32(rl.member_old_ranks[i]);
@@ -244,6 +245,7 @@ bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
     rl->reshape_cycle_time_us = rd.I64();
     rl->reshape_compression = rd.U8();
     rl->reshape_compression_min_bytes = rd.I64();
+    rl->reshape_cross_algo_threshold = rd.I64();
     uint32_t nm = rd.U32();
     for (uint32_t i = 0; i < nm && rd.ok; ++i) {
       rl->member_old_ranks.push_back(rd.I32());

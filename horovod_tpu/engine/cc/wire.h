@@ -180,6 +180,12 @@ struct ResponseList {
   // own env must not make it pack buckets differently from survivors.
   uint8_t reshape_compression = COMP_NONE;
   int64_t reshape_compression_min_bytes = 0;
+  // The currently applied ring-vs-tree boundary (the fourth autotune
+  // axis) crosses the barrier with the other tuned params: a joiner's
+  // env must not give it a different cross-algo verdict than survivors,
+  // even though reshapes force the flat ring today — the re-agreement
+  // keeps hvd_tpu_autotune_cross_algo_threshold identical everywhere.
+  int64_t reshape_cross_algo_threshold = 0;
   std::vector<int32_t> member_old_ranks;      // index = new dense rank
   std::vector<std::string> member_endpoints;  // index = new dense rank
   std::vector<int32_t> reshape_lost;

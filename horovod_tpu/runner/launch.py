@@ -116,7 +116,55 @@ def make_rank_env(rank: int, size: int, coord: str, data: Sequence[str],
     env["HVD_TPU_DATA"] = ",".join(data)
     if xla_coord:
         env["HVD_TPU_XLA_COORD"] = xla_coord
+    # Sanitized engine builds (docs/contributing.md#sanitized-engine
+    # -builds): the instrumented libhvdtpu.<mode>.so needs the sanitizer
+    # runtime preloaded into the RANK processes — but preloading the
+    # launcher's own python wedges it (TSan interceptors vs the rank
+    # multiplexing), so hvdrun resolves and injects LD_PRELOAD here
+    # instead of asking users to export it job-wide.  A pre-existing
+    # LD_PRELOAD (jemalloc etc.) is composed with, sanitizer first —
+    # skipping it would dlopen the instrumented engine without its
+    # runtime and die in __tsan init.
+    if env.get("HVD_TPU_SANITIZE"):
+        from horovod_tpu.engine.build import sanitizer_preload
+
+        preload = None  # None = bad mode (the rank's build() raises too)
+        try:
+            preload = sanitizer_preload(env["HVD_TPU_SANITIZE"].strip()
+                                        .lower())
+        except ValueError as exc:
+            _warn_sanitize_once(str(exc))
+        existing = env.get("LD_PRELOAD", "")
+        if preload:
+            if preload not in existing.split(":"):
+                env["LD_PRELOAD"] = (f"{preload}:{existing}" if existing
+                                     else preload)
+        elif preload == "" and not any(
+                runtime in existing
+                for runtime in ("tsan", "asan", "ubsan")):
+            # Fail loudly up front: without the runtime every rank would
+            # dlopen the instrumented engine and die in __tsan/__asan
+            # init with N identical cryptic errors.  (A user-supplied
+            # LD_PRELOAD that already names a sanitizer runtime is the
+            # one case resolution failure is fine.)
+            _warn_sanitize_once(
+                f"HVD_TPU_SANITIZE={env['HVD_TPU_SANITIZE']} is set but "
+                f"the sanitizer runtime could not be resolved "
+                f"(g++ -print-file-name); ranks will likely fail to load "
+                f"the instrumented engine. Install the libsanitizer "
+                f"runtime or set LD_PRELOAD yourself.")
     return env
+
+
+# Launch-time sanitizer diagnostics already emitted (make_rank_env runs
+# once PER RANK; the job needs each warning once).
+_sanitize_warned: set = set()
+
+
+def _warn_sanitize_once(msg: str) -> None:
+    if msg not in _sanitize_warned:
+        _sanitize_warned.add(msg)
+        print(f"hvdrun: WARNING: {msg}", file=sys.stderr)
 
 
 def allocate_endpoints(size: int, host: str = "127.0.0.1"):

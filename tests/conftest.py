@@ -59,7 +59,8 @@ def pytest_collection_modifyitems(config, items):
         if item.fspath.basename == "test_examples.py":
             continue
         fn = getattr(item, "function", None)
-        if fn is not None and not hasattr(fn, "__wrapped_rank_fn__"):
+        if (fn is not None and not hasattr(fn, "__wrapped_rank_fn__")
+                and item.get_closest_marker("slow") is None):
             item.add_marker(pytest.mark.quick)
 
 

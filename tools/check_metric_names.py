@@ -1,252 +1,28 @@
 #!/usr/bin/env python
-"""Lint the Prometheus metric names exposed by the collective metrics
-registry (horovod_tpu/common/metrics.py): every family must be
-snake_case, carry the ``hvd_tpu_`` prefix, pair a ``# HELP`` with its
-``# TYPE``, and be unique across registry sections — so new metrics can't
-silently drift from the naming convention.  Runs against a registry with
-one of everything recorded, so every family actually renders.
-
-Tier-1 runs it (tests/test_metrics.py::test_check_metric_names_lint);
-standalone:
+"""Thin compatibility shim: the metric-name lint moved into the hvdlint
+suite (``tools/hvdlint/metrics_check.py``, checker name ``metrics``) —
+run it via ``python -m tools.hvdlint metrics`` or this legacy CLI:
 
     python tools/check_metric_names.py
+
+Everything the old module exported (``lint``, ``lint_sections``,
+``populated_registry``, ``SECTION_FAMILIES``, ``NAME_RE``,
+``HIST_SUFFIXES``, ``main``) re-exports from the new home so existing
+test/doc references keep working.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from collections import Counter
 
-NAME_RE = re.compile(r"^hvd_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
-HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# Section-coverage contract: every metrics_snapshot() top-level section
-# must export at least one Prometheus family AND be documented in
-# docs/metrics.md — a new section missing from this map, a mapped family
-# missing from the exposition, or an undocumented section all fail the
-# lint (this drifted silently in past PRs).  "enabled" is the gate flag,
-# not a section; "histograms" is special-cased (one family per histogram).
-SECTION_FAMILIES = {
-    "ops": ("hvd_tpu_ops_total",),
-    "bytes": ("hvd_tpu_bytes_total",),
-    "batches": ("hvd_tpu_batches_dispatched_total",
-                "hvd_tpu_fused_tensors_total"),
-    "stalls": ("hvd_tpu_stall_events_total", "hvd_tpu_stalled_tensor_total"),
-    "faults": ("hvd_tpu_faults_injected_total", "hvd_tpu_aborts_total",
-               "hvd_tpu_restart_epoch"),
-    "skew": ("hvd_tpu_announce_total", "hvd_tpu_last_to_announce_total"),
-    "cache": ("hvd_tpu_response_cache_events_total",
-              "hvd_tpu_response_cache_size"),
-    "membership": ("hvd_tpu_membership_epoch", "hvd_tpu_membership_size",
-                   "hvd_tpu_membership_reshapes_total"),
-    "autotune": ("hvd_tpu_autotune_enabled",
-                 "hvd_tpu_autotune_windows_total"),
-    "serving": ("hvd_tpu_serving_requests_total",
-                "hvd_tpu_serving_steps_total"),
-    "flight": ("hvd_tpu_flight_events_total",
-               "hvd_tpu_flight_ring_capacity"),
-    "compression": ("hvd_tpu_compression_mode",
-                    "hvd_tpu_compression_wire_bytes_total",
-                    "hvd_tpu_compression_payload_bytes_total",
-                    "hvd_tpu_compression_ops_total",
-                    "hvd_tpu_compression_residual_bytes"),
-    "topology": ("hvd_tpu_topology_hierarchical",
-                 "hvd_tpu_topology_nodes",
-                 "hvd_tpu_topology_local_size",
-                 "hvd_tpu_topology_cross_algo_threshold_bytes",
-                 "hvd_tpu_topology_cross_ops_total",
-                 "hvd_tpu_topology_bytes_total"),
-    "state": ("hvd_tpu_state_armed",
-              "hvd_tpu_state_snapshots_total",
-              "hvd_tpu_state_snapshot_bytes_total",
-              "hvd_tpu_state_last_snapshot_step",
-              "hvd_tpu_state_overlap_ratio",
-              "hvd_tpu_state_peer_copies_total",
-              "hvd_tpu_state_peer_last_step",
-              "hvd_tpu_state_restores_total",
-              "hvd_tpu_state_checkpoint_events_total",
-              "hvd_tpu_state_checkpoint_shard_bytes_total"),
-    "histograms": (),
-}
-
-
-def populated_registry():
-    """A registry with at least one sample in every section, so the
-    exposition renders every family the code can produce."""
-    from horovod_tpu.common import metrics
-
-    reg = metrics.MetricsRegistry()
-    reg.record_enqueue("engine", "allreduce", 1024)
-    reg.record_bytes_out("engine", 1024)
-    reg.record_batch(2)
-    reg.record_stall("lint.tensor", 1.0)
-    reg.record_fault("crash")
-    reg.record_abort("ranks_down")
-    reg.record_last_announce(1, 2)
-    reg.set_restart_epoch(1)
-    reg.record_cache("engine", "hits")
-    reg.record_cache("xla", "misses")
-    reg.set_cache_size("engine", 1)
-    reg.set_membership({"epoch": 1, "size": 3, "reshapes": 1,
-                        "ranks_lost": [1], "ranks_joined": [3]})
-    reg.record_serving("requests", "lint-tenant")
-    reg.record_serving("admitted", "lint-tenant")
-    reg.record_serving("rejected", "lint-tenant")
-    reg.record_serving("retired", "lint-tenant")
-    reg.record_serving_tokens("lint-tenant", "prompt", 8)
-    reg.record_serving_tokens("lint-tenant", "generated", 4)
-    reg.record_serving_step(2, 4)
-    reg.set_serving_gauges(queue_depth=1, active=2, kv_blocks_in_use=3,
-                           kv_blocks_total=8)
-    reg.set_flight({"events": {"engine": 5, "xla": 2}, "capacity": 512})
-    reg.set_state_armed(True)
-    reg.record_state_snapshot(7, 4096)
-    reg.set_state_overlap(0.01, 0.4)
-    reg.record_state_peer(sent_bytes=4096)
-    reg.record_state_peer(received_step=7)
-    reg.record_state_restore("peer")
-    reg.record_state_restore("local")
-    reg.record_state_restore("root_broadcast")
-    reg.record_state_ckpt("sharded_saves", nbytes=4096)
-    reg.record_state_ckpt("legacy_saves", nbytes=8192)
-    reg.record_state_ckpt("loads")
-    reg.record_state_ckpt("pruned")
-    reg.set_topology({"hierarchical": True, "nodes": 2, "local_size": 2,
-                      "cross_algo_threshold": 64 << 10,
-                      "cross_ops": {"ring": 3, "tree": 1},
-                      "bytes": {"local": 4096, "cross": 1024}})
-    reg.set_compression({
-        "mode": "bf16", "min_bytes": 1024,
-        "planes": {"engine": {"wire_bytes": 512, "payload_bytes": 1024,
-                              "ops": {"none": 1, "bf16": 2, "fp8": 0}},
-                   "xla": {"wire_bytes": 0, "payload_bytes": 0,
-                           "ops": {"none": 0, "bf16": 0, "fp8": 0}}},
-        "residual_bytes": 4096, "residual_tensors": 2,
-    })
-    reg.set_autotune({
-        "enabled": True, "frozen": True, "windows": 3,
-        "fusion_threshold": 1 << 20, "cycle_time_ms": 2.5,
-        "best_score": 123.4,
-        "history": [{"window": 1, "fusion_threshold": 1 << 20,
-                     "cycle_time_ms": 2.5, "score": 123.4}],
-        "applied": [{"tick": 7, "fusion_threshold": 1 << 20,
-                     "cycle_time_ms": 2.5, "frozen": True}],
-    })
-    for name in metrics.HISTOGRAMS:
-        reg.observe(name, 0.001)
-    return reg
-
-
-def lint(text: str) -> list:
-    """Return the list of naming-convention violations in a Prometheus
-    text exposition (empty = clean)."""
-    errors = []
-    helps = []
-    families = []
-    for line in text.splitlines():
-        if line.startswith("# HELP "):
-            helps.append(line.split()[2])
-        elif line.startswith("# TYPE "):
-            families.append(line.split()[2])
-        elif line.startswith("#"):
-            errors.append(f"unexpected comment line: {line!r}")
-    for name in families:
-        if not NAME_RE.match(name):
-            errors.append(
-                f"metric family '{name}' violates the naming convention "
-                f"(snake_case with hvd_tpu_ prefix)")
-        if name not in helps:
-            errors.append(f"metric family '{name}' has # TYPE but no "
-                          f"# HELP")
-    for name in helps:
-        if name not in families:
-            errors.append(f"metric family '{name}' has # HELP but no "
-                          f"# TYPE")
-    for name, n in Counter(families).items():
-        if n > 1:
-            errors.append(
-                f"duplicate metric family '{name}': two registry sections "
-                f"export the same name")
-    declared = set(families)
-    for line in text.splitlines():
-        if not line or line.startswith("#"):
-            continue
-        sample = line.split("{")[0].split(" ")[0]
-        base = sample
-        for suffix in HIST_SUFFIXES:
-            if sample.endswith(suffix) and sample[:-len(suffix)] in declared:
-                base = sample[:-len(suffix)]
-                break
-        if base not in declared:
-            errors.append(f"sample '{sample}' has no # TYPE declaration")
-    return errors
-
-
-def _metrics_doc_text() -> str:
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        os.pardir, "docs", "metrics.md")
-    try:
-        with open(path) as f:
-            return f.read().lower()
-    except OSError:
-        return ""
-
-
-def lint_sections(snapshot: dict, text: str, doc_text: str) -> list:
-    """Section-coverage violations: every snapshot top-level section must
-    map to at least one rendered Prometheus family (SECTION_FAMILIES) and
-    appear in docs/metrics.md."""
-    errors = []
-    families = {line.split()[2] for line in text.splitlines()
-                if line.startswith("# TYPE ")}
-    for section, value in snapshot.items():
-        if section == "enabled":
-            continue  # the collection gate, not a metrics section
-        if section not in SECTION_FAMILIES:
-            errors.append(
-                f"snapshot section '{section}' has no SECTION_FAMILIES "
-                f"entry (tools/check_metric_names.py): declare its "
-                f"Prometheus families so the exposition cannot silently "
-                f"drop it")
-            continue
-        expected = SECTION_FAMILIES[section]
-        if section == "histograms":
-            from horovod_tpu.common.metrics import _prom_hist_name
-
-            expected = tuple(_prom_hist_name(name) for name in value)
-        if not expected:
-            errors.append(
-                f"snapshot section '{section}' declares no Prometheus "
-                f"family at all")
-        for family in expected:
-            if family not in families:
-                errors.append(
-                    f"snapshot section '{section}': declared family "
-                    f"'{family}' is missing from the exposition")
-        if section.lower() not in doc_text:
-            errors.append(
-                f"snapshot section '{section}' is not documented in "
-                f"docs/metrics.md")
-    return errors
-
-
-def main() -> int:
-    from horovod_tpu.common import metrics
-
-    snapshot = populated_registry().snapshot()
-    text = metrics.prometheus_text(snapshot)
-    errors = lint(text)
-    errors += lint_sections(snapshot, text, _metrics_doc_text())
-    for err in errors:
-        print(f"check_metric_names: {err}", file=sys.stderr)
-    if not errors:
-        n = len([l for l in text.splitlines() if l.startswith("# TYPE ")])
-        print(f"check_metric_names: OK ({n} metric families, "
-              f"{len(snapshot) - 1} snapshot sections covered)")
-    return 1 if errors else 0
-
+from tools.hvdlint.metrics_check import (  # noqa: E402,F401
+    HIST_SUFFIXES, NAME_RE, SECTION_FAMILIES, _metrics_doc_text, lint,
+    lint_sections, main, populated_registry)
 
 if __name__ == "__main__":
     sys.exit(main())

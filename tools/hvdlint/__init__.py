@@ -1,0 +1,132 @@
+"""hvdlint: project-invariant static analysis for horovod_tpu.
+
+The project's correctness rests on cross-layer contracts no compiler
+checks: every wire field must serialize, parse, and survive a reshape
+re-broadcast identically on all ranks; lockstep state (cache, autotune,
+compression, membership) may mutate only while processing the
+coordinator's broadcast; every ``HVD_TPU_*`` knob must be documented with
+the default the code actually uses; every C symbol must have a ctypes
+binding that matches its signature.  Each checker here machine-checks one
+of those contracts against the source tree, so a violation fails tier-1
+at the PR that introduces it instead of surfacing as a cross-rank
+divergence at pod scale (docs/contributing.md).
+
+Run everything::
+
+    python -m tools.hvdlint            # exit 0 clean, 1 with file:line report
+
+or a subset: ``python -m tools.hvdlint wire capi``.  Checkers take a
+repo-root argument so tests can point them at small synthetic trees
+(tests/test_hvdlint.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Callable, Dict, List
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One contract violation, printable as ``file:line: [checker] msg``."""
+
+    checker: str
+    file: str  # repo-relative path
+    line: int  # 1-based; 0 = whole-file / tree-level finding
+    message: str
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        return f"{loc}: [{self.checker}] {self.message}"
+
+
+def repo_root() -> str:
+    """The tree hvdlint ships in (two levels above this package)."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def strip_cxx_comments(text: str) -> str:
+    """Replace C++ ``//`` and ``/* */`` comment bodies with spaces,
+    preserving line numbers (and the ``hvdlint:`` annotation lines, which
+    callers inspect in the ORIGINAL text)."""
+
+    def _blank(m: re.Match) -> str:
+        return re.sub(r"[^\n]", " ", m.group(0))
+
+    text = re.sub(r"/\*.*?\*/", _blank, text, flags=re.S)
+    return re.sub(r"//[^\n]*", _blank, text)
+
+
+def strip_py_comments(text: str) -> str:
+    """Blank ``#`` comment bodies in Python source, preserving strings
+    and line numbers (tokenize-based) — a commented-out binding or env
+    read must not satisfy (or trip) a text checker.  Returns the text
+    unchanged if it doesn't tokenize."""
+    import io
+    import tokenize
+
+    lines = text.splitlines(keepends=True)
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                row, col = tok.start
+                line = lines[row - 1]
+                end = col + len(tok.string)
+                lines[row - 1] = line[:col] + " " * (end - col) + line[end:]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return text
+    return "".join(lines)
+
+
+def iter_py_files(root: str, subdirs: List[str]) -> List[str]:
+    """Repo-relative paths of every .py file under the given subdirs
+    (sorted; __pycache__ skipped)."""
+    out = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if os.path.isfile(base) and base.endswith(".py"):
+            out.append(sub)
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fname), root))
+    return sorted(out)
+
+
+def read(root: str, rel: str) -> str:
+    with open(os.path.join(root, rel)) as f:
+        return f.read()
+
+
+def checkers() -> Dict[str, Callable[[str], List[Violation]]]:
+    """Name -> check(root) for every registered checker, in report order."""
+    from tools.hvdlint import (capi_check, env_check, errors_check,
+                               lockstep_check, metrics_check, wire_check)
+
+    return {
+        "wire": wire_check.check,
+        "env": env_check.check,
+        "capi": capi_check.check,
+        "lockstep": lockstep_check.check,
+        "errors": errors_check.check,
+        "metrics": metrics_check.check,
+    }
+
+
+def run(root: str, names: List[str] | None = None) -> List[Violation]:
+    """Run the named checkers (default: all) against `root`."""
+    table = checkers()
+    unknown = [n for n in (names or []) if n not in table]
+    if unknown:
+        raise ValueError(f"unknown checker(s) {unknown}; "
+                         f"have {sorted(table)}")
+    out: List[Violation] = []
+    for name in (names or list(table)):
+        out.extend(table[name](root))
+    return out

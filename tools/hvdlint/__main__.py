@@ -1,0 +1,50 @@
+"""CLI entry: ``python -m tools.hvdlint [checker ...] [--root DIR]``.
+
+Runs every registered checker (or the named subset) against the repo and
+prints one ``file:line: [checker] message`` report per violation.  Exit 0
+clean, 1 with violations — tier-1 runs this as a fast test
+(tests/test_hvdlint.py), so wire/env/API drift fails the suite at the PR
+that introduces it.  ``--list`` names the checkers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.hvdlint import checkers, repo_root, run
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.hvdlint",
+        description="horovod_tpu project-invariant static analysis")
+    parser.add_argument("names", nargs="*",
+                        help="checker subset (default: all)")
+    parser.add_argument("--root", default=repo_root(),
+                        help="tree to lint (default: this repo)")
+    parser.add_argument("--list", action="store_true",
+                        help="list checkers and exit")
+    args = parser.parse_args(argv)
+    table = checkers()
+    if args.list:
+        for name in table:
+            print(name)
+        return 0
+    try:
+        violations = run(args.root, args.names or None)
+    except ValueError as exc:
+        parser.error(str(exc))
+    for v in violations:
+        print(v.render(), file=sys.stderr)
+    ran = args.names or list(table)
+    if violations:
+        print(f"hvdlint: {len(violations)} violation(s) from "
+              f"{len(ran)} checker(s)", file=sys.stderr)
+        return 1
+    print(f"hvdlint: OK ({', '.join(ran)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,177 @@
+"""Checker 3: C-API / ctypes parity.
+
+The ctypes seam (horovod_tpu/common/__init__.py _load_lib) re-declares
+every ``hvd_tpu_*`` signature by hand; ctypes checks nothing, so a drifted
+argument count or type truncates silently on x86-64 (a ``long long``
+passed through the default ``c_int`` conversion loses its top 32 bits —
+exactly the class of bug that motivated the PR-9 compression_min_bytes
+review finding).  Rules:
+
+1. every ``hvd_tpu_*`` function c_api.cc exports has an explicit
+   ``lib.<name>.restype`` AND ``lib.<name>.argtypes`` declaration whose
+   types match the C signature (``None``/empty list for void/no-arg);
+2. every ``hvd_tpu_*`` symbol any Python file references exists in
+   c_api.cc (no bindings to dead symbols).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Tuple
+
+from tools.hvdlint import (Violation, iter_py_files, read,
+                           strip_cxx_comments, strip_py_comments)
+
+C_API = os.path.join("horovod_tpu", "engine", "cc", "c_api.cc")
+BINDINGS = os.path.join("horovod_tpu", "common", "__init__.py")
+
+_RET_MAP = {
+    "void": "None",
+    "int": "c_int",
+    "long long": "c_longlong",
+    "double": "c_double",
+    "const char*": "c_char_p",
+    "char*": "c_char_p",
+    "void*": "c_void_p",
+}
+_ARG_MAP = {
+    "int": "c_int",
+    "long long": "c_longlong",
+    "double": "c_double",
+    "const char*": "c_char_p",
+    "char*": "c_char_p",
+    "const void*": "c_void_p",
+    "void*": "c_void_p",
+    "const long long*": "POINTER(c_longlong)",
+    "long long*": "POINTER(c_longlong)",
+}
+
+
+def _norm_ctype(text: str) -> str:
+    return text.replace("ctypes.", "").replace(" ", "").replace("\\", "")
+
+
+def _c_param_type(param: str) -> str:
+    """'const char* coord_endpoint' -> 'const char*' (drop the name,
+    normalize pointer spacing)."""
+    param = re.sub(r"\s*\*\s*", "* ", param.strip())
+    typ = param.rsplit(" ", 1)[0] if " " in param else param
+    return re.sub(r"\s+", " ", typ).replace("* ", "*").strip()
+
+
+def parse_c_exports(text: str) -> Dict[str, Tuple[str, List[str], int]]:
+    """name -> (return type, param types, line) for every hvd_tpu_*
+    definition (comments stripped; params may span lines)."""
+    text = strip_cxx_comments(text)
+    out: Dict[str, Tuple[str, List[str], int]] = {}
+    pat = re.compile(
+        r"(?m)^(const char\s*\*|void\s*\*|void|int|long long|double)\s+"
+        r"(hvd_tpu_\w+)\s*\(([^)]*)\)\s*\{", re.S)
+    for m in pat.finditer(text):
+        ret = re.sub(r"\s*\*", "*", re.sub(r"\s+", " ", m.group(1))).strip()
+        params_text = m.group(3).strip()
+        if params_text in ("", "void"):
+            params: List[str] = []
+        else:
+            params = [_c_param_type(p)
+                      for p in re.sub(r"\s+", " ", params_text).split(",")]
+        out[m.group(2)] = (ret, params,
+                           text.count("\n", 0, m.start()) + 1)
+    return out
+
+
+def parse_bindings(text: str) -> Tuple[Dict[str, Tuple[str, int]],
+                                       Dict[str, Tuple[List[str], int]]]:
+    """(restypes, argtypes) declared via ``lib.<name>.restype = ...`` /
+    ``lib.<name>.argtypes = [...]`` (multiline lists handled)."""
+    restypes: Dict[str, Tuple[str, int]] = {}
+    argtypes: Dict[str, Tuple[List[str], int]] = {}
+    for m in re.finditer(r"lib\.(hvd_tpu_\w+)\.restype\s*=\s*", text):
+        rest = text[m.end():]
+        value = rest.split("\n", 1)[0]
+        while value.rstrip().endswith("\\"):
+            rest = rest.split("\n", 1)[1]
+            value = value.rstrip()[:-1] + rest.split("\n", 1)[0]
+        restypes[m.group(1)] = (_norm_ctype(value.strip()),
+                                text.count("\n", 0, m.start()) + 1)
+    for m in re.finditer(r"lib\.(hvd_tpu_\w+)\.argtypes\s*=\s*\[", text):
+        depth, i = 1, m.end()
+        while i < len(text) and depth:
+            if text[i] == "[":
+                depth += 1
+            elif text[i] == "]":
+                depth -= 1
+            i += 1
+        body = _norm_ctype(text[m.end():i - 1])
+        # POINTER(...) args contain no top-level commas in this codebase's
+        # usage, so a flat split is exact.
+        items = [t for t in body.replace("\n", "").split(",") if t]
+        argtypes[m.group(1)] = (items,
+                                text.count("\n", 0, m.start()) + 1)
+    return restypes, argtypes
+
+
+def check(root: str) -> List[Violation]:
+    out: List[Violation] = []
+    try:
+        exports = parse_c_exports(read(root, C_API))
+        # Comment-stripped: a commented-out binding must not satisfy the
+        # parity check (nor count as a reference below).
+        bindings_text = strip_py_comments(read(root, BINDINGS))
+    except OSError as exc:
+        return [Violation("capi", C_API, 0,
+                          f"cannot read the C API seam: {exc}")]
+    if not exports:
+        return [Violation("capi", C_API, 0,
+                          "no hvd_tpu_* exports found — parser drift?")]
+    restypes, argtypes = parse_bindings(bindings_text)
+    for name in sorted(exports):
+        ret, params, line = exports[name]
+        want_ret = _RET_MAP.get(ret)
+        if name not in restypes:
+            out.append(Violation(
+                "capi", BINDINGS, 0,
+                f"{name} (c_api.cc:{line}) has no explicit "
+                f"lib.{name}.restype declaration (want {want_ret}); "
+                f"ctypes' silent c_int default truncates {ret!r} returns"))
+        elif want_ret and restypes[name][0] != want_ret:
+            out.append(Violation(
+                "capi", BINDINGS, restypes[name][1],
+                f"{name}: restype {restypes[name][0]} does not match the "
+                f"C return type {ret!r} (want {want_ret})"))
+        want_args = [_ARG_MAP.get(p, f"<unmapped:{p}>") for p in params]
+        if name not in argtypes:
+            out.append(Violation(
+                "capi", BINDINGS, 0,
+                f"{name} (c_api.cc:{line}) has no explicit "
+                f"lib.{name}.argtypes declaration (want "
+                f"[{', '.join(want_args)}])"))
+        else:
+            got, bline = argtypes[name]
+            if len(got) != len(params):
+                out.append(Violation(
+                    "capi", BINDINGS, bline,
+                    f"{name}: argtypes declares {len(got)} argument(s) "
+                    f"but the C signature (c_api.cc:{line}) takes "
+                    f"{len(params)}"))
+            else:
+                for i, (g, w) in enumerate(zip(got, want_args)):
+                    if g != w:
+                        out.append(Violation(
+                            "capi", BINDINGS, bline,
+                            f"{name}: argtypes[{i}] is {g} but the C "
+                            f"parameter is {params[i]!r} (want {w})"))
+    # Reverse direction: every referenced symbol must exist in the C API.
+    for rel in iter_py_files(root, ["horovod_tpu"]):
+        try:
+            text = strip_py_comments(read(root, rel))
+        except OSError:
+            continue
+        for m in re.finditer(r"\b\w*lib\.(hvd_tpu_\w+)", text):
+            if m.group(1) not in exports:
+                out.append(Violation(
+                    "capi", rel, text.count("\n", 0, m.start()) + 1,
+                    f"{m.group(1)} is referenced here but c_api.cc "
+                    f"exports no such symbol"))
+    return out

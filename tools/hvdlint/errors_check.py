@@ -1,0 +1,45 @@
+"""Checker 5: typed-error discipline.
+
+Every ``raise`` in horovod_tpu/ must use the ``HorovodInternalError``
+hierarchy (common/__init__.py) or a stdlib exception type — never bare
+``Exception``/``BaseException``.  A bare Exception can't be caught
+selectively: the elastic driver retries ``MembershipChangedError``, the
+launcher maps ``RanksDownError`` to restart policy, and serving maps
+typed errors to HTTP statuses; an untyped raise falls through all of
+those to a job kill.  (AST-based, so strings and comments never
+false-positive.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.hvdlint import Violation, iter_py_files, read
+
+SCOPE = ["horovod_tpu"]
+_BANNED = {"Exception", "BaseException"}
+
+
+def check(root: str) -> List[Violation]:
+    out: List[Violation] = []
+    for rel in iter_py_files(root, SCOPE):
+        try:
+            tree = ast.parse(read(root, rel))
+        except (OSError, SyntaxError) as exc:
+            out.append(Violation("errors", rel, 0,
+                                 f"cannot parse: {exc}"))
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            if isinstance(exc, ast.Call):
+                exc = exc.func
+            if isinstance(exc, ast.Name) and exc.id in _BANNED:
+                out.append(Violation(
+                    "errors", rel, node.lineno,
+                    f"bare `raise {exc.id}`: use the "
+                    f"HorovodInternalError hierarchy or a specific "
+                    f"stdlib type so callers can catch it selectively"))
+    return out

@@ -1,0 +1,125 @@
+"""Checker 4: lockstep-mutation lint over engine.cc.
+
+The engine's replicated state — response cache, applied autotune
+parameters, wire-compression mode, error-feedback residuals, membership
+identity — must mutate ONLY while every rank is processing the same
+coordinator broadcast, in list order (the determinism contract PR 4's
+response cache established and docs/performance.md documents).  A write
+from anywhere else (an API thread, a per-rank heuristic) desynchronizes
+slot numbering or bucket packing across ranks, the class of bug the PR-9
+``compression_min_bytes`` race was.
+
+This is a clang-free heuristic pass: it tracks which ``Engine::``
+member function each line belongs to and flags protected-state writes
+outside the whitelisted lifecycle/broadcast-processing functions.
+Genuinely-safe exceptions carry an inline annotation::
+
+    foo_ = bar;  // hvdlint: lockstep-ok(reason the write is safe)
+
+on the offending line or the line above (grammar in
+docs/contributing.md).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List
+
+from tools.hvdlint import Violation, read, strip_cxx_comments
+
+ENGINE_CC = os.path.join("horovod_tpu", "engine", "cc", "engine.cc")
+
+# Functions allowed to mutate lockstep state, and why.
+WHITELIST = {
+    # Lifecycle: single-threaded bring-up/teardown, no peers in flight.
+    "Engine::Init": "bring-up before the background loop starts",
+    "Engine::SetupSockets": "job-wide agreement exchange during bring-up",
+    "Engine::Shutdown": "teardown after the background loop exits",
+    "Engine::BackgroundLoop": "exit drain after the loop stopped ticking",
+    "Engine::AbortLocal": "abort latch; every rank aborts the same tick",
+    # Broadcast processing: every rank runs these on the identical
+    # coordinator response list, in list order.
+    "Engine::ApplyTunedParams": "applies the lockstep tuned broadcast",
+    "Engine::ApplyReshape": "applies the reshape barrier broadcast",
+    "Engine::SetupRejoinSockets": "adopts the admitting reshape broadcast",
+    "Engine::ProcessCacheHits": "replays broadcast cache hits in order",
+    "Engine::PerformOperation": "cache insert/erase in response-list order",
+    "Engine::ExecuteAllreduce": "residual update while executing the list",
+}
+
+# Protected-state write patterns.  Reads (.load(), lookup methods) are
+# deliberately NOT matched.
+PROTECTED = (
+    # Response cache: mutation methods only (Lookup/SlotByName/Get are
+    # rank-local reads).
+    r"\bcache_\.(set_capacity|Clear|Put|Touch|Erase)\s*\(",
+    # The engine-thread-owned option mirror of lockstep knobs.
+    r"\bopts_\.(fusion_threshold|cycle_time_ms|compression_mode|"
+    r"compression_min_bytes|cross_algo_threshold|cache_capacity|rank|size|"
+    r"local_rank|local_size|min_size|data_endpoints)\s*(=[^=]|\.assign\b)",
+    r"\bopts_\s*=[^=]",
+    # The atomics Python API threads read live.
+    r"\bcur_(fusion|cycle_us|compression|comp_min_bytes|cross_algo|rank|"
+    r"size|local_rank|local_size)_\.(store|exchange|fetch_add|fetch_sub)"
+    r"\s*\(",
+    r"\bmembership_epoch_\.(store|exchange|fetch_add)\s*\(",
+    r"\bautotune_frozen_\.(store|exchange)\s*\(",
+    r"\bapplied_window_\.(store|exchange)\s*\(",
+    # Error-feedback residuals (compression state).
+    r"\bresiduals_\.(clear|emplace|erase|insert|swap)\s*\(|\bresiduals_\[",
+    # Per-tick change-point histories the XLA plane replays.
+    r"\b(fusion_history_|compression_history_)\.(push_back|emplace_back|"
+    r"pop_front|pop_back|clear|assign)\s*\(",
+)
+
+# Definitions start at column 0 (`bool Engine::ApplyReshape(...) {`);
+# indented qualified calls (std::to_string(...)) must not match.
+_FUNC_RE = re.compile(r"^[A-Za-z_][\w:<>,*&\s]*?\b(\w+::\w+)\s*\((?!.*;)")
+# Free/static helpers at column 0 (`static void Helper(...) {`): they
+# must take over from a preceding (possibly whitelisted) member function
+# — a write inside one is NOT broadcast processing.
+_FREE_FUNC_RE = re.compile(r"^[A-Za-z_][\w<>,*&\s]*?\b(\w+)\s*\((?!.*;)")
+_OK_RE = re.compile(r"hvdlint:\s*lockstep-ok\(([^)]*)\)")
+
+
+def check(root: str) -> List[Violation]:
+    out: List[Violation] = []
+    try:
+        raw = read(root, ENGINE_CC)
+    except OSError as exc:
+        return [Violation("lockstep", ENGINE_CC, 0,
+                          f"cannot read engine.cc: {exc}")]
+    stripped = strip_cxx_comments(raw)
+    raw_lines = raw.splitlines()
+    current = ""
+    protected = [re.compile(p) for p in PROTECTED]
+    for lineno, line in enumerate(stripped.splitlines(), 1):
+        if line.startswith("}"):
+            current = ""  # a column-0 close ends the current function
+        fm = _FUNC_RE.match(line)
+        if fm and "::" in fm.group(1):
+            current = fm.group(1)
+        elif _FREE_FUNC_RE.match(line):
+            current = _FREE_FUNC_RE.match(line).group(1)
+        for pat in protected:
+            m = pat.search(line)
+            if not m:
+                continue
+            if current in WHITELIST:
+                continue
+            annotated = any(
+                _OK_RE.search(raw_lines[i])
+                for i in (lineno - 1, lineno - 2)
+                if 0 <= i < len(raw_lines))
+            if annotated:
+                continue
+            out.append(Violation(
+                "lockstep", ENGINE_CC, lineno,
+                f"write to lockstep state ({m.group(0).strip()}) in "
+                f"{current or '<file scope>'}, which is not a "
+                f"whitelisted broadcast-processing function — mutate it "
+                f"while processing the coordinator broadcast, or "
+                f"annotate with '// hvdlint: lockstep-ok(reason)'"))
+            break  # one report per line is enough
+    return out

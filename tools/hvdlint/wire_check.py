@@ -1,0 +1,157 @@
+"""Checker 1: wire-protocol roundtrip completeness.
+
+The coordinator protocol (engine/cc/wire.{h,cc}) is hand-rolled: a struct
+field added to wire.h but forgotten in SerializeResponseList or
+ParseResponseList silently truncates on the wire and desynchronizes ranks
+— the class of bug a FlatBuffers schema would have made impossible.  This
+checker parses the struct definitions out of wire.h and verifies:
+
+1. every field of Request / RequestList / Response / ResponseList is
+   referenced in BOTH the serialize and the parse function that carries
+   that struct;
+2. reshape-carried lockstep state is complete: every ``tuned_<knob>``
+   field of ResponseList (the online-autotune broadcast) has a matching
+   ``reshape_<knob>`` field, and the explicit barrier baseline fields
+   (cache capacity, compression floor) exist — a knob broadcast in
+   lockstep mid-run but not re-broadcast at the reshape barrier would
+   leave admitted standbys running the env default while survivors run
+   the tuned value (the divergence class docs/fault-tolerance.md's
+   re-agreement contract exists to prevent).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Tuple
+
+from tools.hvdlint import Violation, read, strip_cxx_comments
+
+WIRE_H = os.path.join("horovod_tpu", "engine", "cc", "wire.h")
+WIRE_CC = os.path.join("horovod_tpu", "engine", "cc", "wire.cc")
+
+# struct -> the (serialize, parse) function pair whose bodies must
+# reference every one of its fields.  Request/Response ride inside their
+# list's functions (the wire format has no standalone per-item codec).
+STRUCT_FUNCS = {
+    "Request": ("SerializeRequestList", "ParseRequestList"),
+    "RequestList": ("SerializeRequestList", "ParseRequestList"),
+    "Response": ("SerializeResponseList", "ParseResponseList"),
+    "ResponseList": ("SerializeResponseList", "ParseResponseList"),
+}
+
+# ResponseList fields that are bookkeeping for an optional block, not
+# re-broadcastable knobs (rule 2 skips them when deriving reshape_*
+# counterparts from tuned_*).
+_TUNED_BOOKKEEPING = {"tuned_present", "tuned_frozen", "tuned_window"}
+# Barrier baseline fields with no tuned_* twin that must still exist:
+# joiners adopt these from the admitting broadcast, never from their env.
+_REQUIRED_RESHAPE = ("reshape_cache_capacity",
+                     "reshape_compression_min_bytes")
+
+
+def parse_struct_fields(header: str,
+                        struct: str) -> List[Tuple[str, int]]:
+    """(field, line) members of ``struct <name> { ... };`` in header text
+    (comments already stripped)."""
+    m = re.search(rf"\bstruct\s+{struct}\s*\{{", header)
+    if not m:
+        return []
+    body_start = m.end()
+    depth = 1
+    i = body_start
+    while i < len(header) and depth:
+        if header[i] == "{":
+            depth += 1
+        elif header[i] == "}":
+            depth -= 1
+        i += 1
+    body = header[body_start:i - 1]
+    line0 = header.count("\n", 0, body_start)
+    fields = []
+    # Member declarations: `type name;` or `type name = default;` where
+    # type may be templated (std::vector<int64_t>).  Methods/ctors have
+    # parens before the terminating ';' and don't match.
+    for fm in re.finditer(
+            r"^\s*(?:[\w:]+(?:<[^<>]*>)?[&*\s]+)(\w+)\s*(?:=[^;()]*)?;",
+            body, flags=re.M):
+        fields.append((fm.group(1),
+                       line0 + body.count("\n", 0, fm.start()) + 1))
+    return fields
+
+
+def function_body(source: str, name: str) -> str:
+    """Body text of the first definition of `name` (empty if absent)."""
+    m = re.search(rf"\b{name}\s*\([^;{{]*\)\s*\{{", source)
+    if not m:
+        return ""
+    depth = 1
+    i = m.end()
+    while i < len(source) and depth:
+        if source[i] == "{":
+            depth += 1
+        elif source[i] == "}":
+            depth -= 1
+        i += 1
+    return source[m.end():i - 1]
+
+
+def check(root: str) -> List[Violation]:
+    out: List[Violation] = []
+    try:
+        header = strip_cxx_comments(read(root, WIRE_H))
+        source = strip_cxx_comments(read(root, WIRE_CC))
+    except OSError as exc:
+        return [Violation("wire", WIRE_H, 0, f"cannot read wire files: "
+                          f"{exc}")]
+    bodies: Dict[str, str] = {}
+    all_fields: Dict[str, List[Tuple[str, int]]] = {}
+    for struct, (ser, par) in STRUCT_FUNCS.items():
+        fields = parse_struct_fields(header, struct)
+        all_fields[struct] = fields
+        if not fields:
+            out.append(Violation(
+                "wire", WIRE_H, 0,
+                f"struct {struct} not found (or has no parseable fields) "
+                f"— the roundtrip check cannot see the wire schema"))
+            continue
+        for fn in (ser, par):
+            if fn not in bodies:
+                bodies[fn] = function_body(source, fn)
+                if not bodies[fn]:
+                    out.append(Violation(
+                        "wire", WIRE_CC, 0, f"function {fn} not found"))
+        for field, line in fields:
+            for fn, side in ((ser, "serialize"), (par, "parse")):
+                body = bodies.get(fn, "")
+                if body and not re.search(rf"\b{field}\b", body):
+                    out.append(Violation(
+                        "wire", WIRE_H, line,
+                        f"{struct}.{field} is missing from the {side} "
+                        f"path ({fn} in wire.cc): the field would "
+                        f"silently drop on the wire"))
+    # Rule 2: reshape re-broadcast completeness over ResponseList.
+    rl_names = {f for f, _ in all_fields.get("ResponseList", [])}
+    rl_lines = dict(all_fields.get("ResponseList", []))
+    if rl_names:
+        for field in sorted(rl_names):
+            if not field.startswith("tuned_") or field in _TUNED_BOOKKEEPING:
+                continue
+            want = "reshape_" + field[len("tuned_"):]
+            if want not in rl_names:
+                out.append(Violation(
+                    "wire", WIRE_H, rl_lines[field],
+                    f"lockstep knob ResponseList.{field} has no "
+                    f"ResponseList.{want}: the value is broadcast in "
+                    f"lockstep mid-run but not re-broadcast at the "
+                    f"reshape barrier, so an admitted standby would run "
+                    f"its env default while survivors run the tuned "
+                    f"value"))
+        for want in _REQUIRED_RESHAPE:
+            if want not in rl_names:
+                out.append(Violation(
+                    "wire", WIRE_H, 0,
+                    f"ResponseList.{want} is missing: joiners must adopt "
+                    f"this barrier baseline from the admitting broadcast, "
+                    f"not from their own environment"))
+    return out
