@@ -18,15 +18,18 @@ BENCH_EXTRA_CONFIGS="seq:batch,..." overrides the sweep.
 
 Env knobs: BENCH_MODEL (resnet101|resnet50|resnet18|vgg16|inception_v3|
 mnist|transformer|allreduce|small_allreduce|big_allreduce|hier_allreduce|
-serve_decode|checkpoint|scaling), BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
+negotiation_scale|serve_decode|checkpoint|scaling), BENCH_BATCH,
+BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
 length); transformer adds BENCH_SEQ/BENCH_VOCAB/BENCH_D_MODEL/BENCH_LAYERS/
 BENCH_HEADS; allreduce adds BENCH_NP/BENCH_BYTES/BENCH_ITERS;
 small_allreduce (the negotiation-bound cache microbench) adds
 BENCH_NP/BENCH_TENSORS/BENCH_STEPS; big_allreduce (the bandwidth-bound
 wire-compression sweep, docs/performance.md#wire-compression) adds
-BENCH_NP/BENCH_BYTES/BENCH_ITERS; serve_decode (the serving-plane
-continuous-batching bench, docs/inference.md) adds
-BENCH_NP/BENCH_REQUESTS.
+BENCH_NP/BENCH_BYTES/BENCH_ITERS; negotiation_scale (the simulated-scale
+control-plane bench, docs/performance.md#control-plane-scaling) adds
+BENCH_SCALE_RANKS/BENCH_OPS/BENCH_WARM_CYCLES/BENCH_STEADY_CYCLES;
+serve_decode (the serving-plane continuous-batching bench,
+docs/inference.md) adds BENCH_NP/BENCH_REQUESTS.
 """
 
 from __future__ import annotations
@@ -665,6 +668,114 @@ hvd.shutdown()
     }))
 
 
+def bench_negotiation_scale() -> None:
+    """Simulated-scale control-plane bench (docs/performance.md
+    #control-plane-scaling): hundreds of engine-plane ranks IN ONE
+    PROCESS over loopback (the C++ simscale harness — every rank a full
+    Engine with its own sockets and background thread), driving OP_NOOP
+    negotiation cycles so the measured latency is pure control plane.
+
+    Four measured cells: {small, large} ranks x {star baseline,
+    tree+steady}.  The headline is steady-state cycles/sec at the LARGE
+    size; extras carry the per-cell p50s, the steady-vs-small flatness
+    ratio (the acceptance bar: within 1.5x of the small size, where the
+    star grows superlinearly), and the steady-window control-frame delta
+    (the zero-frames-per-cycle contract, asserted via the same counters
+    metrics_snapshot()["control"] exposes).
+
+    BENCH_SCALE_RANKS="16,256" overrides the sizes; BENCH_OPS /
+    BENCH_WARM_CYCLES / BENCH_STEADY_CYCLES the per-cycle shape."""
+    import ctypes
+    import resource
+
+    from horovod_tpu.common import _load_lib
+
+    lib = _load_lib()
+    sizes = [int(s) for s in os.environ.get(
+        "BENCH_SCALE_RANKS", "16,256").split(",") if s]
+    small, large = sizes[0], sizes[-1]
+    ops = int(os.environ.get("BENCH_OPS", "2"))
+    warm = int(os.environ.get("BENCH_WARM_CYCLES", "40"))
+    steady = int(os.environ.get("BENCH_STEADY_CYCLES", "30"))
+    threshold = 8
+    # The harness opens ~5 fds per simulated rank (listener, ring pair,
+    # control, transient rendezvous); lift the soft NOFILE limit so the
+    # large cell fits.
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want = max(soft, 8 * large + 512)
+    if soft < want:
+        resource.setrlimit(resource.RLIMIT_NOFILE,
+                           (min(hard, want), hard))
+
+    def local_size(n: int) -> int:
+        # ~n/16 ranks per simulated host, floored at 2 so the tree has
+        # real fan-in at the small size too.
+        for cand in (max(2, n // 16), 4, 2):
+            if n % cand == 0 and cand >= 2:
+                return cand
+        return 1
+
+    def run(size: int, use_tree: bool, use_steady: bool, port: int) -> dict:
+        buf = ctypes.create_string_buffer(2048)
+        for attempt in range(3):  # port collisions retry on a new base
+            rc = lib.hvd_tpu_simscale_run(
+                size, local_size(size), ops, warm, steady,
+                threshold if use_steady else 0, int(use_tree),
+                port + attempt * (size + 16), 60.0, buf, 2048)
+            rep = json.loads(buf.value.decode() or "{}")
+            if rc == 0 and rep.get("ok"):
+                return rep
+        raise RuntimeError(f"simscale run failed: {rep}")
+
+    base_port = 45000 + (os.getpid() % 400) * 16
+    cells = {}
+    for size in (small, large):
+        cells[(size, "star")] = run(size, False, False, base_port)
+        base_port += size + 64
+        cells[(size, "tree")] = run(size, True, True, base_port)
+        base_port += size + 64
+
+    t_small, t_large = cells[(small, "tree")], cells[(large, "tree")]
+    s_small, s_large = cells[(small, "star")], cells[(large, "star")]
+    steady_p50 = t_large["steady_p50_us"]
+    value = 1e6 / steady_p50 if steady_p50 > 0 else 0.0
+    extras = {
+        "ranks_small": small,
+        "ranks_large": large,
+        f"star_p50_us_{small}": s_small["steady_p50_us"],
+        f"star_p50_us_{large}": s_large["steady_p50_us"],
+        f"steady_p50_us_{small}": t_small["steady_p50_us"],
+        f"steady_p50_us_{large}": t_large["steady_p50_us"],
+        f"warm_tree_p50_us_{large}": t_large["warm_p50_us"],
+        # The acceptance bar: steady-state cost flat in ranks, against
+        # the star's growth in the same run.  The 300µs floor absorbs
+        # the co-located simulator's thread-wake quantum (the real
+        # signal is µs-scale local replay — docs/performance.md
+        # #control-plane-scaling).  "inflation" keys gate
+        # lower-is-better in tools/bench_compare.py.
+        "steady_scale_inflation": (
+            t_large["steady_p50_us"] / max(t_small["steady_p50_us"], 300.0)),
+        "star_scale_inflation": (
+            s_large["steady_p50_us"] / s_small["steady_p50_us"]
+            if s_small["steady_p50_us"] > 0 else 0.0),
+        "steady_entered": int(t_small["steady_entered"]
+                              and t_large["steady_entered"]),
+        # Control frames sent during the steady window (max over ranks):
+        # the decentralized steady state's contract is ZERO.
+        "steady_frames_delta": max(t_small["steady_frames_delta"],
+                                   t_large["steady_frames_delta"]),
+        f"coord_children_{large}": t_large["coord_children"],
+    }
+    print(json.dumps({
+        "metric": "negotiation_scale_steady_cycles_per_sec",
+        "value": round(value, 1),
+        "unit": "cycles/sec",
+        "vs_baseline": round(value / (1e6 / s_large["steady_p50_us"]), 2)
+        if s_large["steady_p50_us"] > 0 else 0.0,
+        "extra_metrics": extras,
+    }), flush=True)
+
+
 def bench_serve_decode() -> None:
     """Serving-plane bench (docs/inference.md): a synthetic multi-tenant
     request stream against the continuous-batching engine over BENCH_NP
@@ -998,6 +1109,8 @@ def main() -> None:
         return bench_big_allreduce()
     if model_name == "hier_allreduce":
         return bench_hier_allreduce()
+    if model_name == "negotiation_scale":
+        return bench_negotiation_scale()
     if model_name == "serve_decode":
         return bench_serve_decode()
     if model_name == "checkpoint":

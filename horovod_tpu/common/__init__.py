@@ -148,6 +148,7 @@ def _load_lib():
             ctypes.c_longlong, ctypes.c_double, ctypes.c_int,
             ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
             ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_int, ctypes.c_longlong,
             ctypes.c_longlong]
         lib.hvd_tpu_init_error.restype = ctypes.c_char_p
         lib.hvd_tpu_init_error.argtypes = []
@@ -227,6 +228,15 @@ def _load_lib():
         lib.hvd_tpu_cache_eviction_count.argtypes = []
         lib.hvd_tpu_cache_size.restype = ctypes.c_longlong
         lib.hvd_tpu_cache_size.argtypes = []
+        lib.hvd_tpu_control_info.restype = ctypes.c_char_p
+        lib.hvd_tpu_control_info.argtypes = []
+        lib.hvd_tpu_steady_active.restype = ctypes.c_int
+        lib.hvd_tpu_steady_active.argtypes = []
+        lib.hvd_tpu_simscale_run.restype = ctypes.c_int
+        lib.hvd_tpu_simscale_run.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.c_char_p, ctypes.c_longlong]
         lib.hvd_tpu_autotune_enabled.restype = ctypes.c_int
         lib.hvd_tpu_autotune_enabled.argtypes = []
         lib.hvd_tpu_autotune_frozen.restype = ctypes.c_int
@@ -397,7 +407,8 @@ def init(comm: Union[Sequence[int], Any, None] = None) -> None:
         fix_fusion, fix_cycle, int(cfg.elastic or cfg.rejoin),
         cfg.min_np, int(cfg.rejoin), compression_code,
         cfg.compression_min_bytes, fix_comp, cfg.cross_algo_threshold,
-        fix_algo)
+        fix_algo, int(cfg.coord_tree), cfg.steady_threshold,
+        cfg.steady_max_period)
     if rc != 0:
         raise HorovodInternalError(
             "engine initialization failed: "
@@ -917,6 +928,37 @@ def _sync_engine_topology() -> None:
             metrics.registry.observe("topology_local_ag_sec", ag_us / 1e6)
 
 
+def _sync_engine_control() -> None:
+    """Mirror the engine's control-plane state into the registry's
+    ungated ``"control"`` section (docs/performance.md
+    #control-plane-scaling): the coordinator-tree shape, the
+    decentralized steady-state counters, and the control-frame totals.
+    A state copy like the topology sync — the C counters are cumulative,
+    so overwriting is idempotent."""
+    if _lib is None:
+        return
+    with _stall_sync_lock:
+        parts = _lib.hvd_tpu_control_info().decode().split("|")
+        try:
+            (tree, children, hosts, active, pattern_len, threshold,
+             entries, exits, replays, cycles, negotiated, sent,
+             received) = (int(p) for p in parts[:13])
+        except ValueError:
+            return
+        metrics.registry.set_control({
+            "tree": bool(tree),
+            "depth": 2 if tree else 1,
+            "children": children,
+            "hosts": hosts,
+            "steady": {"active": bool(active), "pattern_len": pattern_len,
+                       "threshold": threshold, "entries": entries,
+                       "exits": exits, "replays": replays,
+                       "cycles": cycles},
+            "negotiated_ticks": negotiated,
+            "frames": {"sent": sent, "received": received},
+        })
+
+
 def _sync_engine_autotune() -> None:
     """Mirror the engine's autotuning state into the registry's ungated
     ``"autotune"`` section (docs/performance.md#autotuning).  Unlike the
@@ -950,6 +992,7 @@ def metrics_snapshot() -> dict:
     _sync_engine_flight()
     _sync_engine_compression()
     _sync_engine_topology()
+    _sync_engine_control()
     return metrics.registry.snapshot()
 
 
@@ -1161,6 +1204,7 @@ class Handle:
         try:
             if code != ST_OK:
                 msg = _lib.hvd_tpu_error(self._raw).decode()
+                code, msg = _promote_transport_failure(code, msg)
                 raise _status_error(code, msg, self._name)
             self.completion_tick = int(
                 _lib.hvd_tpu_completion_tick(self._raw))
@@ -1223,6 +1267,30 @@ def _parse_down_ranks(msg: str) -> list:
     if not m:
         return []
     return [int(tok) for tok in m.group(1).split(",") if tok.strip()]
+
+
+def _promote_transport_failure(code: int, msg: str):
+    """A mid-collective transport failure racing a coordinated abort:
+    prefer the typed verdict.  Under the decentralized steady state
+    (docs/performance.md#control-plane-scaling) survivors enter the data
+    plane WITHOUT a negotiation round, so a peer's crash surfaces as a
+    broken ring (ST_UNKNOWN) on them a beat before the coordinator's
+    RanksDown broadcast lands — wait briefly for the control plane's
+    verdict so the caller still gets the typed error naming the dead
+    rank (the star had the same race with a much narrower window).
+    ST_ABORTED drains check the latch once, without waiting: a clean
+    shutdown also drains with that status and must not stall."""
+    if _lib is None:
+        return code, msg
+    transport = code == ST_UNKNOWN and "failed" in msg
+    deadline = time.monotonic() + (2.0 if transport else 0.0)
+    while True:
+        ac = int(_lib.hvd_tpu_abort_code())
+        if ac in (ST_RANKS_DOWN, ST_TIMEOUT):
+            return ac, _lib.hvd_tpu_abort_message().decode()
+        if not transport or time.monotonic() >= deadline:
+            return code, msg
+        time.sleep(0.01)
 
 
 def _status_error(code: int, msg: str, name: str) -> Exception:

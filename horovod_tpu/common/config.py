@@ -145,6 +145,23 @@ class Config:
     # plane's Python ring alike); 0 disables recording.
     postmortem_dir: str = ""
     flight_events: int = 512
+    # Control-plane scaling (docs/performance.md#control-plane-scaling).
+    # coord_tree (HVD_TPU_COORD_TREE, default on): multi-host jobs
+    # restructure the rank-0 coordinator star into a two-level tree —
+    # each host's local-rank-0 aggregates its node's announces into one
+    # frame per tick and relays broadcasts back down, so rank 0 holds
+    # O(hosts) sockets instead of O(ranks).  Single-host layouts keep the
+    # degenerate one-level star either way.  steady_threshold
+    # (HVD_TPU_STEADY_THRESHOLD): once a negotiation cycle's cache-hit
+    # pattern repeats identically this many times, the coordinator
+    # broadcasts a STEADY verdict and every rank self-clocks on an epoch
+    # counter, replaying the cached responses with ZERO control-plane
+    # messages per cycle (any miss falls back to full negotiation); 0
+    # disables.  steady_max_period (HVD_TPU_STEADY_MAX_PERIOD) bounds the
+    # detectable cycle length in collectives.
+    coord_tree: bool = True
+    steady_threshold: int = 32
+    steady_max_period: int = 256
 
     @property
     def compression_code(self) -> int:
@@ -216,4 +233,9 @@ class Config:
             postmortem_dir=os.environ.get("HVD_TPU_POSTMORTEM_DIR", ""),
             flight_events=int(os.environ.get(
                 "HVD_TPU_FLIGHT_EVENTS") or 512),
+            coord_tree=_flag(os.environ.get("HVD_TPU_COORD_TREE", "1")),
+            steady_threshold=int(os.environ.get(
+                "HVD_TPU_STEADY_THRESHOLD") or 32),
+            steady_max_period=int(os.environ.get(
+                "HVD_TPU_STEADY_MAX_PERIOD") or 256),
         )

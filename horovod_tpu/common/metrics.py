@@ -235,6 +235,20 @@ class MetricsRegistry:
             "cross_ops": {"ring": 0, "tree": 0},
             "bytes": {"local": 0, "cross": 0},
         }
+        # Control plane (docs/performance.md#control-plane-scaling): the
+        # coordinator-tree shape this rank sees, the decentralized
+        # steady-state counters, and the control-frame totals the
+        # zero-frames-per-steady-cycle contract is asserted against.
+        # Ungated, like stalls: the scale harness and control tests
+        # assert frame deltas without enabling full metrics.
+        self._control = {
+            "tree": False, "depth": 1, "children": 0, "hosts": 1,
+            "steady": {"active": False, "pattern_len": 0, "threshold": 0,
+                       "entries": 0, "exits": 0, "replays": 0,
+                       "cycles": 0},
+            "negotiated_ticks": 0,
+            "frames": {"sent": 0, "received": 0},
+        }
         # State plane (docs/fault-tolerance.md#state-plane): snapshot /
         # peer-copy / restore counters and the checkpoint lifecycle.
         # Ungated, like stalls: the elastic acceptance path asserts
@@ -375,6 +389,31 @@ class MetricsRegistry:
                               for a in ("ring", "tree")},
                 "bytes": {h: int(state.get("bytes", {}).get(h, 0))
                           for h in ("local", "cross")},
+            }
+
+    def set_control(self, state: dict) -> None:
+        """Mirror the engine's control-plane state (a state copy — the
+        underlying counters are cumulative, so overwriting is idempotent,
+        like the topology mirror).  Ungated."""
+        with self._lock:
+            steady = state.get("steady", {})
+            self._control = {
+                "tree": bool(state.get("tree", False)),
+                "depth": int(state.get("depth", 1)),
+                "children": int(state.get("children", 0)),
+                "hosts": int(state.get("hosts", 1)),
+                "steady": {
+                    "active": bool(steady.get("active", False)),
+                    "pattern_len": int(steady.get("pattern_len", 0)),
+                    "threshold": int(steady.get("threshold", 0)),
+                    "entries": int(steady.get("entries", 0)),
+                    "exits": int(steady.get("exits", 0)),
+                    "replays": int(steady.get("replays", 0)),
+                    "cycles": int(steady.get("cycles", 0)),
+                },
+                "negotiated_ticks": int(state.get("negotiated_ticks", 0)),
+                "frames": {d: int(state.get("frames", {}).get(d, 0))
+                           for d in ("sent", "received")},
             }
 
     def set_autotune(self, report: dict) -> None:
@@ -575,6 +614,12 @@ class MetricsRegistry:
                        if k not in ("cross_ops", "bytes")},
                     "cross_ops": dict(self._topology["cross_ops"]),
                     "bytes": dict(self._topology["bytes"]),
+                },
+                "control": {
+                    **{k: v for k, v in self._control.items()
+                       if k not in ("steady", "frames")},
+                    "steady": dict(self._control["steady"]),
+                    "frames": dict(self._control["frames"]),
                 },
                 "state": {
                     **{k: v for k, v in self._state.items()
@@ -873,6 +918,48 @@ def prometheus_text(snapshot: dict) -> str:
     out.append("# TYPE hvd_tpu_topology_bytes_total counter")
     for hop, n in topo.get("bytes", {}).items():
         out.append(f'hvd_tpu_topology_bytes_total{{hop="{hop}"}} {n}')
+
+    ctrl = snapshot.get("control", {})
+    steady = ctrl.get("steady", {})
+    out.append("# HELP hvd_tpu_control_tree_depth coordinator levels in "
+               "the control plane (1 = star, 2 = per-host "
+               "sub-coordinator tree; docs/performance.md"
+               "#control-plane-scaling)")
+    out.append("# TYPE hvd_tpu_control_tree_depth gauge")
+    out.append(f"hvd_tpu_control_tree_depth {ctrl.get('depth', 1)}")
+    out.append("# HELP hvd_tpu_control_children control sockets this "
+               "rank reads each negotiation tick (fan-in at its tree "
+               "level)")
+    out.append("# TYPE hvd_tpu_control_children gauge")
+    out.append(f"hvd_tpu_control_children {ctrl.get('children', 0)}")
+    out.append("# HELP hvd_tpu_control_steady_active this rank is "
+               "self-clocking in the decentralized steady state (zero "
+               "control-plane frames per cycle)")
+    out.append("# TYPE hvd_tpu_control_steady_active gauge")
+    out.append("hvd_tpu_control_steady_active "
+               f"{int(steady.get('active', False))}")
+    out.append("# HELP hvd_tpu_control_steady_cycles_total negotiation "
+               "cycles replayed self-clocked (no coordinator traffic)")
+    out.append("# TYPE hvd_tpu_control_steady_cycles_total counter")
+    out.append("hvd_tpu_control_steady_cycles_total "
+               f"{steady.get('cycles', 0)}")
+    out.append("# HELP hvd_tpu_control_steady_transitions_total steady-"
+               "state entries and exits on this rank")
+    out.append("# TYPE hvd_tpu_control_steady_transitions_total counter")
+    for kind in ("entries", "exits"):
+        out.append("hvd_tpu_control_steady_transitions_total"
+                   f'{{kind="{kind}"}} {steady.get(kind, 0)}')
+    out.append("# HELP hvd_tpu_control_negotiated_ticks_total broadcast "
+               "response lists processed that carried negotiated work")
+    out.append("# TYPE hvd_tpu_control_negotiated_ticks_total counter")
+    out.append("hvd_tpu_control_negotiated_ticks_total "
+               f"{ctrl.get('negotiated_ticks', 0)}")
+    out.append("# HELP hvd_tpu_control_frames_total control-plane frames "
+               "this rank sent/received (flat during steady-state "
+               "cycles)")
+    out.append("# TYPE hvd_tpu_control_frames_total counter")
+    for d, n in ctrl.get("frames", {}).items():
+        out.append(f'hvd_tpu_control_frames_total{{dir="{d}"}} {n}')
 
     state = snapshot.get("state", {})
     out.append("# HELP hvd_tpu_state_armed state plane armed on this "

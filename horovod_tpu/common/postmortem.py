@@ -43,7 +43,8 @@ from typing import List, Optional
 # (FlightEventName) — the engine serializes names, so Python only needs
 # this list for tools/docs, not for parsing.
 EVENTS = ("enqueue", "announce", "cache_hit", "execute", "error", "tick",
-          "stall", "abort", "reshape", "tune")
+          "stall", "abort", "reshape", "tune", "compress", "topology",
+          "steady")
 
 DEFAULT_RING_EVENTS = 512
 

@@ -28,7 +28,7 @@ from typing import List, Optional
 
 _CC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cc")
 _SOURCES = ["net.cc", "wire.cc", "timeline.cc", "autotune.cc", "flight.cc",
-            "engine.cc", "c_api.cc"]
+            "engine.cc", "simscale.cc", "c_api.cc"]
 _LIB_NAME = "libhvdtpu.so"
 
 # -O3 + native SIMD for the AccumulateSum / half-conversion hot loops.

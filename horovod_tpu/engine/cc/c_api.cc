@@ -9,6 +9,7 @@
 #include <vector>
 
 #include "engine.h"
+#include "simscale.h"
 
 using hvdtpu::Engine;
 using hvdtpu::EngineOptions;
@@ -51,7 +52,8 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
                  long long compression_min_bytes,
                  long long autotune_fix_compression,
                  long long cross_algo_threshold,
-                 long long autotune_fix_cross_algo) {
+                 long long autotune_fix_cross_algo, int coord_tree,
+                 long long steady_threshold, long long steady_max_period) {
   EngineOptions opts;
   opts.rank = rank;
   opts.size = size;
@@ -81,6 +83,10 @@ int hvd_tpu_init(int rank, int size, int local_rank, int local_size,
   opts.cross_algo_threshold =
       cross_algo_threshold >= 0 ? cross_algo_threshold : 64 * 1024;
   opts.autotune_fix_cross_algo = autotune_fix_cross_algo;
+  opts.coord_tree = coord_tree != 0;
+  opts.steady_threshold = steady_threshold >= 0 ? steady_threshold : 0;
+  opts.steady_max_period =
+      steady_max_period > 0 ? steady_max_period : 256;
   std::string err;
   int rc = GlobalEngine()->Init(opts, &err);
   if (rc != 0) {
@@ -429,6 +435,47 @@ int hvd_tpu_membership_ack_pending() {
 }
 
 void hvd_tpu_membership_ack() { GlobalEngine()->MembershipAck(); }
+
+// Control-plane observability (docs/performance.md
+// #control-plane-scaling): "tree|children|hosts|steady_active|
+// pattern_len|steady_threshold|entries|exits|replays|steady_cycles|
+// negotiated_ticks|frames_sent|frames_recv" — the tree shape this rank
+// sees, the decentralized-steady-state counters (process-cumulative),
+// and the control-frame counters the zero-frames-per-steady-cycle
+// contract is asserted against.
+const char* hvd_tpu_control_info() {
+  static thread_local std::string tl_control_info;
+  tl_control_info = GlobalEngine()->ControlInfo();
+  return tl_control_info.c_str();
+}
+
+// Whether this rank is currently self-clocking in the decentralized
+// steady state (zero control-plane frames per replay cycle).
+int hvd_tpu_steady_active() {
+  return GlobalEngine()->SteadyActive() ? 1 : 0;
+}
+
+// Simulated-scale negotiation harness (bench.py
+// BENCH_MODEL=negotiation_scale): run `size` in-process engine ranks
+// over loopback and measure per-cycle negotiation latency star-vs-tree
+// and negotiated-vs-steady.  Writes a one-line JSON report into `out`
+// (truncated to out_len); returns 0 on success, 1 when the report
+// signals a setup/driver failure.
+int hvd_tpu_simscale_run(int size, int local_size, int ops_per_cycle,
+                         int warm_cycles, int steady_cycles,
+                         long long steady_threshold, int coord_tree,
+                         int base_port, double timeout_sec, char* out,
+                         long long out_len) {
+  std::string rep = hvdtpu::SimScaleRun(
+      size, local_size, ops_per_cycle, warm_cycles, steady_cycles,
+      steady_threshold, coord_tree, base_port, timeout_sec);
+  if (out && out_len > 0) {
+    size_t n = std::min(static_cast<size_t>(out_len - 1), rep.size());
+    memcpy(out, rep.data(), n);
+    out[n] = '\0';
+  }
+  return rep.compare(0, 8, "{\"ok\":1,") == 0 ? 0 : 1;
+}
 
 // Timeline hooks for the XLA data plane (jax/eager_mesh.py): plane-side
 // execution phases land in the same Chrome-tracing file as the engine's

@@ -463,6 +463,14 @@ struct Engine::Coordinator {
     std::vector<Request> requests;  // one per rank that announced, any order
     std::chrono::steady_clock::time_point first_seen;
     uint64_t order = 0;
+    // Announce-time accounting on rank 0's clock (µs since epoch): under
+    // the coordinator tree the sub-coordinators forward each rank's TRUE
+    // announce timestamp in the aggregate frame, so the last-to-announce
+    // straggler verdict names the rank that was actually late, not the
+    // sub-coordinator whose aggregate closed the count.
+    int64_t first_us = -1;
+    int64_t last_us = -1;
+    int last_rank = -1;
     // Set when a cross-transport mismatch is detected (one camp announced
     // the bare name over the engine, another the "__xp."-prefixed
     // metadata op for the SAME logical tensor over the XLA plane): the
@@ -507,6 +515,10 @@ struct Engine::Coordinator {
     std::vector<bool> ranks;
     int count = 0;
     std::chrono::steady_clock::time_point first_seen;
+    // Same per-rank announce-time accounting as PendingTensor.
+    int64_t first_us = -1;
+    int64_t last_us = -1;
+    int last_rank = -1;
   };
   std::unordered_map<uint32_t, PendingBits> cache_pending;
   // Slots every rank announced, in agreement order; broadcast as
@@ -552,6 +564,21 @@ struct Engine::Coordinator {
   // collectives get the same retryable ST_RESHAPE a shrink hands out),
   // so standby admission cannot starve behind steady traffic.
   std::chrono::steady_clock::time_point join_wait_since;
+  // Decentralized steady state (docs/performance.md
+  // #control-plane-scaling): the pattern detector's recent cache-hit
+  // slot stream, each entry flagged when it opened a new broadcast list
+  // (the per-tick grouping replayed buckets must reproduce).  Reset by
+  // any non-hit broadcast (fresh response, tuned params, reshape, abort)
+  // so the window only ever contains a pure steady-state hit stream.
+  std::deque<std::pair<uint32_t, bool>> slot_history;
+  // A STEADY verdict is in force: the coordinator broadcasts nothing
+  // (beyond abort/shutdown) until EVERY rank has fallen back — an
+  // earlier broadcast would double-execute replays on ranks still
+  // self-clocking.
+  bool steady = false;
+  std::vector<bool> steady_exited;
+  // Stamp the first post-steady broadcast with the revoke marker.
+  bool steady_revoke_next = false;
 };
 
 // Control-plane hello a standby sends instead of a rank number when
@@ -565,6 +592,8 @@ Engine* GlobalEngine() {
   static Engine* engine = new Engine();
   return engine;
 }
+
+Engine::Engine() = default;
 
 Engine::~Engine() { Shutdown(); }
 
@@ -615,6 +644,36 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   coord_->last_frame_tick.assign(opts_.size, -1);
   coord_->last_announce_tick.assign(opts_.size, -1);
   coord_->last_announce_name.assign(opts_.size, "");
+  // Control-plane tree + steady state start each lifetime cold; the
+  // entry/exit/replay/frame counters stay process-cumulative (the
+  // metrics contract StallEvents set).
+  tree_enabled_ = false;
+  is_sub_coord_ = false;
+  sub_holding_ = false;
+  tree_child_fds_.clear();
+  tree_child_ranks_.clear();
+  tree_child_dead_.clear();
+  coord_children_.clear();
+  pending_dead_reports_.clear();
+  steady_active_.store(false);
+  steady_pattern_.clear();
+  steady_groups_.clear();
+  steady_pos_ = steady_group_idx_ = 0;
+  steady_epoch_ = 0;
+  steady_pending_group_.clear();
+  steady_pending_reqs_.clear();
+  steady_exit_pending_ = false;
+  steady_pattern_len_.store(0);
+  ctrl_children_.store(0);
+  ctrl_hosts_.store(1);
+  if (opts_.elastic || opts_.rejoin) {
+    // Elastic jobs keep the star and the per-tick cache path: membership
+    // reshapes rebuild only the star, and a coordinator-initiated
+    // reshape barrier cannot interrupt ranks that are self-clocking with
+    // their control sockets dark.
+    opts_.coord_tree = false;
+    opts_.steady_threshold = 0;
+  }
   {
     std::lock_guard<std::mutex> lk(coord_info_mu_);
     coord_pending_info_.clear();
@@ -840,23 +899,27 @@ bool Engine::SetupSockets(std::string* err) {
         std::max<int64_t>(opts_.cache_capacity, 0), 0x7fffffff));
     uint32_t cmin32 = static_cast<uint32_t>(std::min<int64_t>(
         std::max<int64_t>(opts_.compression_min_bytes, 0), 0x7fffffff));
-    uint32_t mine[6] = {(uint32_t)opts_.local_rank, (uint32_t)opts_.local_size,
+    uint32_t mine[7] = {(uint32_t)opts_.local_rank, (uint32_t)opts_.local_size,
                         opts_.hierarchical_allreduce ? 1u : 0u, cap32,
-                        (uint32_t)opts_.compression_mode, cmin32};
-    // {hierarchical decision, capacity, compression mismatch flag, pad}
-    uint32_t reply[4] = {0, cap32, 0, 0};
+                        (uint32_t)opts_.compression_mode, cmin32,
+                        opts_.coord_tree ? 1u : 0u};
+    // {hierarchical decision, capacity, compression mismatch flag,
+    //  coordinator-tree decision, pad}
+    uint32_t reply[5] = {0, cap32, 0, 0, 0};
     if (opts_.rank == 0) {
       std::vector<uint32_t> lr(opts_.size), ls(opts_.size), hr(opts_.size);
       lr[0] = mine[0]; ls[0] = mine[1]; hr[0] = mine[2];
+      bool tree_want = mine[6] != 0;
       uint32_t agreed_cap = cap32;
       std::string comp_mismatch;
       for (int r = 1; r < opts_.size; ++r) {
-        uint32_t peer[6];
+        uint32_t peer[7];
         if (!RecvAll(coord_fds_[r], peer, sizeof peer)) {
           *err = "topology agreement recv failed";
           return false;
         }
         lr[r] = peer[0]; ls[r] = peer[1]; hr[r] = peer[2];
+        tree_want = tree_want && peer[6] != 0;
         agreed_cap = std::min(agreed_cap, peer[3]);
         if (comp_mismatch.empty() &&
             (peer[4] != mine[4] || peer[5] != mine[5]))
@@ -887,6 +950,16 @@ bool Engine::SetupSockets(std::string* err) {
       reply[0] = (want && valid) ? 1 : 0;
       reply[1] = agreed_cap;
       reply[2] = comp_mismatch.empty() ? 0 : 1;
+      // Coordinator-tree verdict (docs/performance.md
+      // #control-plane-scaling): same contiguous-block layout contract
+      // as the data topology, and only meaningful with >= 2 nodes of
+      // >= 2 ranks — otherwise the star IS the degenerate one-level
+      // tree.  Job-wide so every rank rewires (or keeps) its control
+      // socket identically.
+      reply[3] = (tree_want && valid && !opts_.elastic &&
+                  opts_.size / (int)L >= 2)
+                     ? 1
+                     : 0;
       for (int r = 1; r < opts_.size; ++r) {
         if (!SendAll(coord_fds_[r], reply, sizeof reply)) {
           *err = "topology agreement send failed";
@@ -915,14 +988,53 @@ bool Engine::SetupSockets(std::string* err) {
     }
     opts_.hierarchical_allreduce = reply[0] != 0;
     opts_.cache_capacity = static_cast<int64_t>(reply[1]);
+    opts_.coord_tree = reply[3] != 0;
   }
   // Clock alignment for the per-rank timelines: NTP-style probes over the
-  // control sockets just established (docs/timeline.md).
+  // control sockets just established (docs/timeline.md).  Runs over the
+  // full init-time star, BEFORE the tree restructure below — the offsets
+  // are exactly what sub-coordinators later use to map their nodes'
+  // announce times onto rank 0's clock.
   if (!ClockSync(err)) return false;
   node_id_ = opts_.hierarchical_allreduce ? opts_.rank / opts_.local_size : 0;
   n_nodes_ = opts_.hierarchical_allreduce ? opts_.size / opts_.local_size : 1;
   topo_hier_.store(opts_.hierarchical_allreduce);
   topo_nodes_.store(n_nodes_);
+
+  // Control-plane coordinator tree restructure (docs/performance.md
+  // #control-plane-scaling).  The init rendezvous above is a transient
+  // O(ranks) star (one bounded round — agreement + clock sync); the
+  // STEADY-STATE control plane is what scales, so non-lead workers of
+  // nodes >= 1 now re-home their control socket to their node's
+  // local-rank-0, which accepts them over its DATA listener with a typed
+  // hello (no new endpoints).  Rank 0 keeps one socket per
+  // sub-coordinator plus its own node's workers: O(hosts + local_size).
+  tree_enabled_ = opts_.coord_tree && opts_.size > 1;
+  const int Lc = opts_.local_size;
+  const int ctrl_nodes = tree_enabled_ ? opts_.size / Lc : 1;
+  is_sub_coord_ =
+      tree_enabled_ && opts_.local_rank == 0 && opts_.rank >= Lc;
+  ctrl_hosts_.store(ctrl_nodes);
+  if (opts_.rank == 0) {
+    coord_children_.clear();
+    for (int r = 1; r < opts_.size; ++r) {
+      bool direct = !tree_enabled_ || r < Lc || r % Lc == 0;
+      if (direct) {
+        coord_children_.push_back(r);
+      } else {
+        CloseFd(coord_fds_[r]);
+        coord_fds_[r] = -1;
+      }
+    }
+    ctrl_children_.store(static_cast<int>(coord_children_.size()));
+  } else if (is_sub_coord_) {
+    tree_child_fds_.assign(Lc - 1, -1);
+    tree_child_ranks_.clear();
+    for (int i = 1; i < Lc; ++i)
+      tree_child_ranks_.push_back(opts_.rank + i);
+    tree_child_dead_.assign(Lc - 1, false);
+    ctrl_children_.store(Lc - 1);
+  }
 
   // Data-plane connections.  Every outgoing connection announces itself
   // with a 4-byte hello (kind in the high byte, sender id in the low 24
@@ -933,6 +1045,9 @@ bool Engine::SetupSockets(std::string* err) {
   const uint32_t kHelloRing = 0u << 24;
   const uint32_t kHelloLocal = 1u << 24;
   const uint32_t kHelloCross = 2u << 24;
+  // Control-plane tree: a non-lead worker's hello to its node's
+  // sub-coordinator (id = the worker's global rank).
+  const uint32_t kHelloCtrl = 5u << 24;
   auto connect_hello = [&](const std::string& ep, uint32_t hello,
                            std::string* err) -> int {
     std::string h;
@@ -960,6 +1075,19 @@ bool Engine::SetupSockets(std::string* err) {
   if (hier && n_nodes_ > 1 && (n_nodes_ & (n_nodes_ - 1)) == 0)
     for (int m = n_nodes_; m > 1; m >>= 1) ++tree_levels;
   const uint32_t kHelloTree = 4u << 24;
+  // Control-tree re-home: a non-lead worker of a node >= 1 drops its
+  // init-star socket to rank 0 and connects to its sub-coordinator's
+  // data listener instead (rank 0 closed its end above symmetrically).
+  if (tree_enabled_ && opts_.rank >= Lc && opts_.local_rank != 0) {
+    CloseFd(coord_fd_);
+    int lead = opts_.rank - opts_.local_rank;
+    coord_fd_ = connect_hello(opts_.data_endpoints[lead],
+                              kHelloCtrl | (uint32_t)opts_.rank, err);
+    if (coord_fd_ < 0) {
+      *err = "control-tree connect to the sub-coordinator failed: " + *err;
+      return false;
+    }
+  }
   // Connect to the right global-ring neighbour.
   int right = (opts_.rank + 1) % opts_.size;
   right_fd_ = connect_hello(opts_.data_endpoints[right],
@@ -1008,6 +1136,7 @@ bool Engine::SetupSockets(std::string* err) {
         if (node_id_ & (1 << k)) expected += 1;  // tree partner connects
     }
   }
+  if (is_sub_coord_) expected += Lc - 1;  // this node's control sockets
   for (int i = 0; i < expected; ++i) {
     int fd = AcceptOne(data_listen_fd_, kTimeout, err);
     if (fd < 0) return false;
@@ -1036,6 +1165,14 @@ bool Engine::SetupSockets(std::string* err) {
         return false;
       }
       cross_tree_fds_[k] = fd;
+    } else if (kind == kHelloCtrl && is_sub_coord_) {
+      int child = static_cast<int>(id) - opts_.rank - 1;
+      if (child < 0 || child >= Lc - 1 || tree_child_fds_[child] >= 0) {
+        *err = "unexpected control-tree hello " + std::to_string(hello);
+        CloseFd(fd);
+        return false;
+      }
+      tree_child_fds_[child] = fd;
     } else {
       *err = "unexpected data-plane hello " + std::to_string(hello);
       CloseFd(fd);
@@ -1050,6 +1187,13 @@ bool Engine::SetupSockets(std::string* err) {
     *err = "node-local ring left neighbour never connected";
     return false;
   }
+  if (is_sub_coord_)
+    for (int i = 0; i < Lc - 1; ++i)
+      if (tree_child_fds_[i] < 0) {
+        *err = "control-tree worker rank " +
+               std::to_string(tree_child_ranks_[i]) + " never connected";
+        return false;
+      }
   return true;
 }
 
@@ -1068,6 +1212,11 @@ void Engine::TeardownSockets() {
     for (const auto& hs : coord_->handshaking) CloseFd(hs.fd);
     coord_->handshaking.clear();
   }
+  for (int fd : tree_child_fds_) CloseFd(fd);
+  tree_child_fds_.clear();
+  tree_child_ranks_.clear();
+  tree_child_dead_.clear();
+  coord_children_.clear();
   CloseFd(data_listen_fd_);
   CloseFd(left_fd_);
   CloseFd(right_fd_);
@@ -1163,11 +1312,8 @@ bool Engine::ClockSync(std::string* err) {
   return true;
 }
 
-void Engine::RecordAnnounce(
-    int last_rank, std::chrono::steady_clock::time_point first_seen) {
-  int64_t skew_us = std::chrono::duration_cast<std::chrono::microseconds>(
-                        std::chrono::steady_clock::now() - first_seen)
-                        .count();
+void Engine::RecordAnnounce(int last_rank, int64_t skew_us) {
+  if (skew_us < 0) skew_us = 0;
   std::lock_guard<std::mutex> lk(announce_mu_);
   ++announce_events_;
   if (last_rank >= 0 &&
@@ -1326,12 +1472,68 @@ int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
     req.dims = dims;
     queue_.push_back(std::move(req));
   }
+  // Wake a steady-state idle wait (no-op otherwise: nothing waits on
+  // this cv while the per-tick frame protocol paces the loop).
+  queue_cv_.notify_one();
   return handle;
 }
 
 // ---------------------------------------------------------------------------
 // Negotiation tick.
 // ---------------------------------------------------------------------------
+
+namespace {
+
+// Per-aggregate slot -> bit_groups index, threaded through the merge so
+// the per-tick fold stays linear in announced bits (a plain scan made
+// the sub-coordinator's fold quadratic in distinct slots — wasted work
+// on exactly the path this tree exists to flatten).
+using SlotIndex = std::unordered_map<uint32_t, size_t>;
+
+// Fold one rank's cache-bit announcement into an aggregate's per-slot
+// groups (docs/performance.md#control-plane-scaling).
+void AddBitToGroups(RequestList* agg, SlotIndex* idx, uint32_t slot,
+                    int rank, int64_t ts) {
+  auto it = idx->find(slot);
+  if (it == idx->end())
+    it = idx->emplace(slot, agg->bit_groups.size()).first;
+  if (it->second == agg->bit_groups.size()) {
+    BitGroup g;
+    g.slot = slot;
+    agg->bit_groups.push_back(std::move(g));
+  }
+  BitGroup& g = agg->bit_groups[it->second];
+  g.ranks.push_back(rank);
+  g.announce_us.push_back(ts);
+}
+
+// Fold one per-rank frame (the sub-coordinator's own, or a leaf child's)
+// into the aggregate forwarded to rank 0.  `ts` is the announce time on
+// rank 0's clock for entries that carry none of their own.
+void MergeFrameIntoAggregate(const RequestList& frame, int rank, int64_t ts,
+                             RequestList* agg, SlotIndex* idx) {
+  agg->shutdown = agg->shutdown || frame.shutdown;
+  for (size_t i = 0; i < frame.requests.size(); ++i) {
+    agg->requests.push_back(frame.requests[i]);
+    agg->announce_us.push_back(
+        i < frame.announce_us.size() && frame.announce_us[i] >= 0
+            ? frame.announce_us[i]
+            : ts);
+  }
+  for (uint32_t bit : frame.cache_bits)
+    AddBitToGroups(agg, idx, bit, rank, ts);
+  for (const auto& g : frame.bit_groups)
+    for (size_t j = 0; j < g.ranks.size(); ++j)
+      AddBitToGroups(agg, idx, g.slot, g.ranks[j],
+                     j < g.announce_us.size() ? g.announce_us[j] : ts);
+  agg->frames_from.push_back(rank);
+  for (int32_t r : frame.frames_from) agg->frames_from.push_back(r);
+  for (int32_t r : frame.dead_ranks) agg->dead_ranks.push_back(r);
+  if (frame.steady_exit) agg->steady_exits.push_back(rank);
+  for (int32_t r : frame.steady_exits) agg->steady_exits.push_back(r);
+}
+
+}  // namespace
 
 bool Engine::RunLoopOnce() {
   auto tick_start = std::chrono::steady_clock::now();
@@ -1345,8 +1547,22 @@ bool Engine::RunLoopOnce() {
     std::vector<char>().swap(fusion_buffer_);
   }
 
+  // Decentralized steady state (docs/performance.md
+  // #control-plane-scaling): the control plane is dark; replay the
+  // broadcast pattern self-clocked with zero frames per cycle.
+  if (steady_active_.load()) return SteadyLoopOnce();
+
   RequestList my_requests;
   my_requests.shutdown = shut_down_.load();
+  if (steady_exit_pending_) {
+    // First frame after a steady exit carries the fallback marker (and
+    // the miss position, for postmortem dumps): rank 0 resumes
+    // broadcasting only once every rank has sent one of these.
+    my_requests.steady_exit = 1;
+    my_requests.steady_epoch = steady_exit_epoch_;
+    my_requests.steady_pos = steady_exit_pos_;
+    steady_exit_pending_ = false;
+  }
   {
     std::lock_guard<std::mutex> lk(mu_);
     while (!queue_.empty()) {
@@ -1377,51 +1593,90 @@ bool Engine::RunLoopOnce() {
     // accepting standbys).
     CoordinatorAcceptJoiners();
     coord_->shutdown_requested |= my_requests.shutdown;
+    if (my_requests.steady_exit) NoteSteadyExit(0);
     CoordinatorHandle(my_requests, 0);
-    for (int r = 1; r < opts_.size; ++r) {
-      if (coord_->rank_dead[r]) continue;
-      // Liveness: a healthy worker's engine thread sends a frame every
-      // cycle (~5ms), so with a hard deadline configured, a deadline of
-      // control-plane silence means the worker PROCESS is frozen
-      // (SIGSTOP, OOM thrash) or partitioned — a state socket EOF never
-      // reports, and one that would otherwise block this recv (and with
-      // it the timeout sweep below) forever.
-      if (opts_.collective_timeout_sec > 0 &&
-          !WaitReadable(coord_fds_[r], opts_.collective_timeout_sec)) {
-        char why[96];
-        snprintf(why, sizeof(why),
-                 "no control-plane traffic for %.0fs; process frozen or "
-                 "network partitioned",
-                 opts_.collective_timeout_sec);
-        MarkRankDead(r, why);
-        continue;
+    if (coord_->steady) {
+      // Post-steady holding pattern: some ranks may still be
+      // self-clocking with their control sockets dark, so (a) expect no
+      // per-tick frames — poll instead of the blocking liveness recv,
+      // and (b) broadcast NOTHING (beyond abort/shutdown, which the poll
+      // handles) until every rank has fallen back, or ranks still
+      // replaying would double-execute the ops a broadcast list carries.
+      if (!CoordinatorSteadyPoll()) return false;
+      if (!AllSteadyExited()) {
+        UpdateCoordPendingInfo();
+        std::this_thread::sleep_for(std::chrono::microseconds(200));
+        return true;
       }
-      std::vector<uint8_t> buf;
-      if (!RecvFrame(coord_fds_[r], &buf)) {
-        // A worker died (control-socket EOF): escalate to a coordinated
-        // ABORT naming the missing rank and the tensors it left pending
-        // (sharpens the reference's SHUT_DOWN_ERROR path,
-        // operations.cc:1579-1605, into a structured status).
-        MarkRankDead(r, "connection lost at the coordinator");
-        continue;
-      }
-      RequestList rl;
-      if (ParseRequestList(buf, &rl)) {
-        coord_->last_frame_tick[r] = ticks_done_.load();
-        coord_->shutdown_requested |= rl.shutdown;
-        CoordinatorHandle(rl, r);
+      coord_->steady = false;
+      coord_->steady_revoke_next = true;
+      coord_->slot_history.clear();
+      // Fall through: THIS pass builds and broadcasts the resume list —
+      // frames already polled above, so skip the per-child recv loop.
+    } else {
+      for (int r : coord_children_) {
+        if (coord_->rank_dead[r]) continue;
+        // Liveness: a healthy child's engine thread sends a frame every
+        // cycle (~5ms), so with a hard deadline configured, a deadline
+        // of control-plane silence means the child PROCESS is frozen
+        // (SIGSTOP, OOM thrash) or partitioned — a state socket EOF
+        // never reports, and one that would otherwise block this recv
+        // (and with it the timeout sweep below) forever.
+        bool sub_lead = tree_enabled_ && r >= opts_.local_size;
+        // A healthy sub-coordinator may itself block up to one deadline
+        // probing a frozen LEAF before its aggregate (naming the true
+        // dead rank) goes out — give it the same widened bound the
+        // workers give the coordinator, or rank 0 would misattribute a
+        // leaf freeze to the whole node.
+        double wait_sec = sub_lead ? 2 * opts_.collective_timeout_sec + 5.0
+                                   : opts_.collective_timeout_sec;
+        if (opts_.collective_timeout_sec > 0 &&
+            !WaitReadable(coord_fds_[r], wait_sec)) {
+          char why[112];
+          snprintf(why, sizeof(why),
+                   "no control-plane traffic for %.0fs; %s frozen or "
+                   "network partitioned",
+                   opts_.collective_timeout_sec,
+                   sub_lead ? "sub-coordinator" : "process");
+          MarkRankDead(r, why);
+          continue;
+        }
+        std::vector<uint8_t> buf;
+        if (!RecvFrame(coord_fds_[r], &buf)) {
+          // A child died (control-socket EOF): escalate to a coordinated
+          // ABORT naming the missing rank and the tensors it left
+          // pending (sharpens the reference's SHUT_DOWN_ERROR path,
+          // operations.cc:1579-1605, into a structured status).
+          MarkRankDead(r, sub_lead
+                              ? "sub-coordinator connection lost (its "
+                                "node is unreachable)"
+                              : "connection lost at the coordinator");
+          continue;
+        }
+        ctrl_frames_recv_.fetch_add(1);
+        RequestList rl;
+        if (ParseRequestList(buf, &rl)) {
+          coord_->last_frame_tick[r] = ticks_done_.load();
+          coord_->shutdown_requested |= rl.shutdown;
+          CoordinatorHandle(rl, r);
+        }
       }
     }
     CheckCollectiveTimeout();
     responses = CoordinatorTick();
     AttachTunedParams(&responses);
     CoordinatorMaybeReshape(&responses);
+    CoordinatorMaybeSteady(&responses);
+    if (coord_->steady_revoke_next && responses.abort_code == 0) {
+      responses.steady_revoke = true;
+      coord_->steady_revoke_next = false;
+    }
     UpdateCoordPendingInfo();
     if (opts_.size > 1 || responses.reshape_present) {
       std::vector<uint8_t> out = SerializeResponseList(responses);
-      for (int r = 1; r < opts_.size; ++r) {
+      for (int r : coord_children_) {
         if (coord_->rank_dead[r]) continue;
-        SendFrame(coord_fds_[r], out);
+        if (SendFrame(coord_fds_[r], out)) ctrl_frames_sent_.fetch_add(1);
       }
       // Admitted standbys receive the same barrier frame over the control
       // socket they registered on; ApplyReshape below then folds their
@@ -1429,19 +1684,77 @@ bool Engine::RunLoopOnce() {
       if (responses.reshape_present)
         for (int fd : coord_->pending_join_fds) SendFrame(fd, out);
     }
-  } else {
-    if (!SendFrame(coord_fd_, SerializeRequestList(my_requests))) {
+  } else if (is_sub_coord_) {
+    if (sub_holding_) {
+      // Between this sub-coordinator's own steady exit and the next
+      // parent broadcast: children may still be self-clocking, so never
+      // block on them — forward own announcements upward as they drain,
+      // keep relaying children's fallback frames, and let SubRelayPass
+      // consume the resume broadcast.
+      if (!my_requests.requests.empty() || !my_requests.cache_bits.empty() ||
+          my_requests.steady_exit || my_requests.shutdown) {
+        RequestList agg;
+        SlotIndex idx;
+        MergeFrameIntoAggregate(my_requests, opts_.rank,
+                                EpochNowUs() - clock_offset_us_.load(),
+                                &agg, &idx);
+        if (!SendFrame(coord_fd_, SerializeRequestList(agg))) {
+          AbortLocal(ST_RANKS_DOWN,
+                     "ranks down: 0 (coordinator connection lost); this "
+                     "job cannot continue and should be restarted.");
+          return false;
+        }
+        ctrl_frames_sent_.fetch_add(1);
+      }
+      if (!SubRelayPass()) return false;
+      std::this_thread::sleep_for(std::chrono::microseconds(200));
+      return true;
+    }
+    // Strict per-tick aggregation: one frame from each live child, one
+    // aggregate up, one broadcast down (relayed raw before local
+    // processing — the sub's own data-plane execution blocks on its
+    // children's participation).
+    RequestList agg;
+    SlotIndex idx;
+    MergeFrameIntoAggregate(my_requests, opts_.rank,
+                            EpochNowUs() - clock_offset_us_.load(), &agg,
+                            &idx);
+    for (size_t i = 0; i < tree_child_fds_.size(); ++i) {
+      if (tree_child_dead_[i]) continue;
+      int fd = tree_child_fds_[i];
+      int crank = tree_child_ranks_[i];
+      if (opts_.collective_timeout_sec > 0 &&
+          !WaitReadable(fd, opts_.collective_timeout_sec)) {
+        tree_child_dead_[i] = true;
+        agg.dead_ranks.push_back(crank);
+        continue;
+      }
+      std::vector<uint8_t> buf;
+      if (!RecvFrame(fd, &buf)) {
+        tree_child_dead_[i] = true;
+        agg.dead_ranks.push_back(crank);
+        continue;
+      }
+      ctrl_frames_recv_.fetch_add(1);
+      RequestList child;
+      if (ParseRequestList(buf, &child)) {
+        NoteChildSteadyExit(child, crank);
+        MergeFrameIntoAggregate(child, crank,
+                                EpochNowUs() - clock_offset_us_.load(),
+                                &agg, &idx);
+      }
+    }
+    for (int32_t r : pending_dead_reports_) agg.dead_ranks.push_back(r);
+    pending_dead_reports_.clear();
+    if (!SendFrame(coord_fd_, SerializeRequestList(agg))) {
       responses.abort_code = ST_RANKS_DOWN;
       responses.abort_message =
           "ranks down: 0 (coordinator connection lost); this job cannot "
           "continue and should be restarted.";
     } else {
-      // Bound the response wait too: 2x the deadline plus slack, because
-      // a healthy coordinator may itself block up to one deadline probing
-      // a frozen THIRD rank before it aborts and responds.
-      bool alive =
-          opts_.collective_timeout_sec <= 0 ||
-          WaitReadable(coord_fd_, 2 * opts_.collective_timeout_sec + 5.0);
+      ctrl_frames_sent_.fetch_add(1);
+      bool alive = opts_.collective_timeout_sec <= 0 ||
+                   WaitReadable(coord_fd_, ParentWaitSec());
       std::vector<uint8_t> buf;
       if (!alive) {
         responses.abort_code = ST_RANKS_DOWN;
@@ -1456,10 +1769,51 @@ bool Engine::RunLoopOnce() {
         responses.abort_message =
             "ranks down: 0 (coordinator connection lost); this job cannot "
             "continue and should be restarted.";
+      } else {
+        ctrl_frames_recv_.fetch_add(1);
+        for (size_t i = 0; i < tree_child_fds_.size(); ++i)
+          if (!tree_child_dead_[i] && SendFrame(tree_child_fds_[i], buf))
+            ctrl_frames_sent_.fetch_add(1);
+      }
+    }
+  } else {
+    if (!SendFrame(coord_fd_, SerializeRequestList(my_requests))) {
+      responses.abort_code = ST_RANKS_DOWN;
+      responses.abort_message =
+          "ranks down: 0 (coordinator connection lost); this job cannot "
+          "continue and should be restarted.";
+    } else {
+      ctrl_frames_sent_.fetch_add(1);
+      // Bound the response wait too: 2x the deadline plus slack, because
+      // a healthy coordinator may itself block up to one deadline probing
+      // a frozen THIRD rank before it aborts and responds.
+      bool alive = opts_.collective_timeout_sec <= 0 ||
+                   WaitReadable(coord_fd_, ParentWaitSec());
+      std::vector<uint8_t> buf;
+      if (!alive) {
+        responses.abort_code = ST_RANKS_DOWN;
+        responses.abort_message =
+            "ranks down: 0 (coordinator unresponsive: no control-plane "
+            "traffic within the deadline; process frozen or network "
+            "partitioned); this job cannot continue and should be "
+            "restarted.";
+      } else if (!RecvFrame(coord_fd_, &buf) ||
+                 !ParseResponseList(buf, &responses)) {
+        responses.abort_code = ST_RANKS_DOWN;
+        responses.abort_message =
+            "ranks down: 0 (coordinator connection lost); this job cannot "
+            "continue and should be restarted.";
+      } else {
+        ctrl_frames_recv_.fetch_add(1);
       }
     }
   }
+  return ProcessResponseList(responses, my_requests, tick_start);
+}
 
+bool Engine::ProcessResponseList(
+    ResponseList& responses, const RequestList& my_requests,
+    std::chrono::steady_clock::time_point tick_start) {
   // Elastic reshape barrier: the list carries no op payload (the
   // coordinator cleared it), so adopting the membership IS this tick's
   // work.  A rebuild failure latched a local abort — exit and drain.
@@ -1476,6 +1830,8 @@ bool Engine::RunLoopOnce() {
   // the tick.  Completions stamped with tick t are all visible once
   // ticks_done_ > t, on every rank.
   ticks_done_.fetch_add(1);
+  if (!responses.responses.empty() || !responses.cache_hits.empty())
+    negotiated_ticks_.fetch_add(1);
 
   if (opts_.rank == 0) CheckForStalledTensors();
 
@@ -1486,6 +1842,13 @@ bool Engine::RunLoopOnce() {
     return false;
   }
   if (responses.shutdown) return false;
+  if (responses.steady_present) {
+    // Arm self-clocked replay AFTER this list's hits replayed: every
+    // rank processed the identical list, so every rank starts the
+    // pattern at position 0 of the same cycle boundary.
+    ApplySteady(responses);
+    return true;
+  }
 
   // Adaptive tick (docs/performance.md): with requests PENDING, the
   // fixed cycle sleep — not the negotiation itself — dominated latency
@@ -1539,6 +1902,543 @@ bool Engine::RunLoopOnce() {
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// Decentralized steady state (docs/performance.md#control-plane-scaling).
+//
+// The PR-4 response cache made repeats cheap (a few bytes per op); this
+// makes them FREE: once the coordinator observes the cache-hit slot
+// stream repeat an identical cycle HVD_TPU_STEADY_THRESHOLD times at
+// quiesced boundaries, it broadcasts the pattern and every rank
+// self-clocks on an epoch counter, replaying the stored responses with
+// zero control-plane frames per cycle.  Any miss (signature change, new
+// tensor, shutdown) falls back to full negotiation; the signature-change
+// revocation points all flow through the normal lockstep machinery once
+// every rank has fallen back.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Smallest period P of `w` in the sliding-window sense (w[i] == w[i-P]
+// for all i >= P), via the KMP prefix function.  O(|w|).
+size_t SmallestPeriod(const std::vector<uint32_t>& w) {
+  if (w.empty()) return 0;
+  std::vector<size_t> pi(w.size(), 0);
+  for (size_t i = 1; i < w.size(); ++i) {
+    size_t k = pi[i - 1];
+    while (k > 0 && w[i] != w[k]) k = pi[k - 1];
+    if (w[i] == w[k]) ++k;
+    pi[i] = k;
+  }
+  return w.size() - pi.back();
+}
+
+}  // namespace
+
+void Engine::CoordinatorMaybeSteady(ResponseList* out) {
+  if (!coord_ || opts_.steady_threshold <= 0) return;
+  // Any non-pure-hit broadcast resets the detector: the window must
+  // contain nothing but the steady-state hit stream, so a pattern found
+  // in it is a pattern of the WHOLE control plane, not a lull between
+  // fresh negotiations.
+  if (out->abort_code != 0 || out->shutdown || out->reshape_present ||
+      out->tuned_present || !out->responses.empty()) {
+    coord_->slot_history.clear();
+    return;
+  }
+  if (out->cache_hits.empty()) return;  // idle ticks are neutral
+  for (size_t i = 0; i < out->cache_hits.size(); ++i)
+    coord_->slot_history.emplace_back(out->cache_hits[i], i == 0);
+  const size_t cap = static_cast<size_t>(opts_.steady_threshold) *
+                         static_cast<size_t>(opts_.steady_max_period) +
+                     static_cast<size_t>(opts_.steady_max_period);
+  while (coord_->slot_history.size() > cap) coord_->slot_history.pop_front();
+  // Eligibility: a quiesced cycle boundary with every lockstep mutation
+  // source at rest.  The autotune search must be frozen (a tuned-param
+  // broadcast cannot reach ranks whose control sockets are dark), and
+  // elastic jobs never arm (Init zeroed the threshold).
+  if (coord_->steady || opts_.size <= 1 || !cache_.enabled() ||
+      tuner_.active() || !coord_->message_table.empty() ||
+      !coord_->cache_pending.empty() ||
+      !coord_->pending_join_fds.empty() || !coord_->handshaking.empty() ||
+      reshape_ack_pending_.load())
+    return;
+  std::vector<uint32_t> w;
+  std::vector<bool> starts;
+  w.reserve(coord_->slot_history.size());
+  for (const auto& e : coord_->slot_history) {
+    w.push_back(e.first);
+    starts.push_back(e.second);
+  }
+  size_t P = SmallestPeriod(w);
+  if (P == 0 || P > static_cast<size_t>(opts_.steady_max_period)) return;
+  if (w.size() < static_cast<size_t>(opts_.steady_threshold) * P) return;
+  // The window must END at a cycle boundary by construction (cycles are
+  // periodic), and the final cycle must START at a broadcast-list
+  // boundary so the observed per-tick grouping cuts cleanly into replay
+  // groups.
+  size_t base = w.size() - P;
+  if (!starts[base]) return;
+  std::vector<uint32_t> pattern(w.begin() + base, w.end());
+  // Patterns that include the XLA plane's "__xp." metadata agreements
+  // never arm: the plane's dispatch-ordering contract waits on tick
+  // closure, which self-clocked cycles advance only per wrap — the plane
+  // already has its own zero-roundtrip replay (PR-4/PR-7).
+  for (uint32_t slot : pattern) {
+    const CacheSlot* s = cache_.Get(static_cast<int>(slot));
+    if (s == nullptr || s->name.compare(0, 5, "__xp.") == 0) return;
+  }
+  std::vector<uint32_t> groups;
+  for (size_t i = base; i < w.size(); ++i) {
+    if (starts[i])
+      groups.push_back(1);
+    else
+      ++groups.back();
+  }
+  out->steady_present = true;
+  out->steady_pattern = std::move(pattern);
+  out->steady_groups = std::move(groups);
+  coord_->steady = true;
+  coord_->steady_exited.assign(opts_.size, false);
+  coord_->slot_history.clear();
+}
+
+void Engine::ApplySteady(const ResponseList& rl) {
+  steady_pattern_ = rl.steady_pattern;
+  steady_groups_.assign(rl.steady_groups.begin(), rl.steady_groups.end());
+  // Defensive: groups must tile the pattern exactly; fall back to
+  // per-slot groups (always safe — every rank received the same list,
+  // so every rank falls back identically).
+  uint64_t total = 0;
+  for (uint32_t g : steady_groups_) total += g;
+  if (steady_groups_.empty() || total != steady_pattern_.size())
+    steady_groups_.assign(steady_pattern_.size(), 1);
+  steady_pos_ = 0;
+  steady_group_idx_ = 0;
+  steady_epoch_ = 0;
+  steady_idle_passes_ = 0;
+  steady_last_poll_ = std::chrono::steady_clock::now();
+  steady_pending_group_.clear();
+  steady_pending_reqs_.clear();
+  steady_exit_pending_ = false;
+  steady_pattern_len_.store(static_cast<int64_t>(steady_pattern_.size()));
+  steady_active_.store(true);
+  steady_entries_.fetch_add(1);
+  if (flight_.Enabled())
+    flight_.Record(FL_STEADY, "enter",
+                   static_cast<int64_t>(steady_pattern_.size()));
+  timeline_.Instant("steady", "STEADY_ENTER");
+}
+
+void Engine::ExitSteadyLocal(const std::string& reason) {
+  if (!steady_active_.load()) return;
+  steady_active_.store(false);
+  steady_exits_.fetch_add(1);
+  steady_exit_pending_ = true;
+  steady_exit_epoch_ = steady_epoch_;
+  steady_exit_pos_ = static_cast<int64_t>(steady_pos_);
+  steady_pattern_len_.store(0);
+  if (is_sub_coord_) sub_holding_ = true;
+  if (opts_.rank == 0 && coord_) NoteSteadyExit(0);
+  if (flight_.Enabled()) flight_.Record(FL_STEADY, reason, steady_epoch_);
+  timeline_.Instant("steady", "STEADY_EXIT");
+}
+
+void Engine::NoteSteadyExit(int r) {
+  if (!coord_ || !coord_->steady) return;
+  if (r >= 0 && r < static_cast<int>(coord_->steady_exited.size()))
+    coord_->steady_exited[r] = true;
+}
+
+void Engine::NoteChildSteadyExit(const RequestList& frame, int child_rank) {
+  if (!frame.steady_exit || !flight_.Enabled()) return;
+  flight_.Record(FL_STEADY,
+                 "peer-exit:" + std::to_string(child_rank) + "@" +
+                     std::to_string(frame.steady_epoch) + "/" +
+                     std::to_string(frame.steady_pos),
+                 frame.steady_epoch);
+}
+
+double Engine::ParentWaitSec() const {
+  if (opts_.collective_timeout_sec <= 0) return 0.0;
+  // Star / node-0 worker: the coordinator may block one deadline probing
+  // a frozen third rank (2T+5).  Under the tree, rank 0 probes a frozen
+  // SUB for 2T+5 before the abort goes out, so a healthy sub waits
+  // 3T+10; a leaf sits one relay below its sub and waits 4T+15.
+  double T = opts_.collective_timeout_sec;
+  if (is_sub_coord_) return 3 * T + 10.0;
+  if (tree_enabled_ && opts_.rank >= opts_.local_size) return 4 * T + 15.0;
+  return 2 * T + 5.0;
+}
+
+bool Engine::AllSteadyExited() const {
+  if (!coord_ || !coord_->steady) return true;
+  for (size_t r = 0; r < coord_->steady_exited.size(); ++r)
+    if (!coord_->steady_exited[r] && !coord_->rank_dead[r]) return false;
+  return true;
+}
+
+bool Engine::CoordinatorSteadyPoll() {
+  // Rank 0 while steady (or holding): frames are exceptional — fallback
+  // announcements, steady-exit markers, EOFs.  Drain whatever arrived
+  // without blocking; the collective-timeout sweep still covers
+  // announced-but-incomplete negotiations (the mid-steady divergence
+  // story), and socket EOF still covers crashes.
+  for (int r : coord_children_) {
+    if (coord_->rank_dead[r]) continue;
+    int fd = coord_fds_[r];
+    if (fd < 0) continue;
+    bool dead = false;
+    while (WaitReadable(fd, 0.0)) {
+      std::vector<uint8_t> buf;
+      if (!RecvFrame(fd, &buf)) {
+        dead = true;
+        break;
+      }
+      ctrl_frames_recv_.fetch_add(1);
+      RequestList rl;
+      if (ParseRequestList(buf, &rl)) {
+        coord_->last_frame_tick[r] = ticks_done_.load();
+        coord_->shutdown_requested |= rl.shutdown;
+        CoordinatorHandle(rl, r);
+      }
+    }
+    // EOF makes the socket readable, so a dead child always lands in
+    // the RecvFrame failure path above — no extra probe per pass.
+    if (dead) {
+      bool sub_lead = tree_enabled_ && r >= opts_.local_size;
+      MarkRankDead(r, sub_lead ? "sub-coordinator connection lost (its "
+                                 "node is unreachable)"
+                               : "connection lost at the coordinator");
+    }
+  }
+  CheckCollectiveTimeout();
+  CheckForStalledTensors();
+  if (coord_->abort_code != 0 || coord_->shutdown_requested) {
+    // Abort/shutdown broadcasts go out IMMEDIATELY, steady or not: ranks
+    // still self-clocking poll their parent socket every pass, and both
+    // verdicts drain everything position-independently.  Strip any op
+    // payload CoordinatorTick may carry on the shutdown path — ranks at
+    // different replay positions must not execute it.
+    ResponseList out = CoordinatorTick();
+    out.responses.clear();
+    out.cache_hits.clear();
+    out.shutdown = out.shutdown || coord_->shutdown_requested;
+    std::vector<uint8_t> bytes = SerializeResponseList(out);
+    for (int r : coord_children_) {
+      if (coord_->rank_dead[r] || coord_fds_[r] < 0) continue;
+      if (SendFrame(coord_fds_[r], bytes)) ctrl_frames_sent_.fetch_add(1);
+    }
+    if (steady_active_.load())
+      ExitSteadyLocal(out.abort_code != 0 ? "abort" : "shutdown");
+    if (out.abort_code != 0) AbortLocal(out.abort_code, out.abort_message);
+    return false;
+  }
+  return true;
+}
+
+bool Engine::SubRelayPass() {
+  // Sub-coordinator while steady (or holding): poll children for
+  // fallback frames and forward them upward; poll the parent for
+  // broadcasts and relay them down.  Never blocks — children still
+  // self-clocking are silent by design.
+  RequestList agg;
+  SlotIndex idx;
+  for (size_t i = 0; i < tree_child_fds_.size(); ++i) {
+    if (tree_child_dead_[i]) continue;
+    int fd = tree_child_fds_[i];
+    int crank = tree_child_ranks_[i];
+    bool dead = false;
+    while (WaitReadable(fd, 0.0)) {
+      std::vector<uint8_t> buf;
+      if (!RecvFrame(fd, &buf)) {
+        dead = true;
+        break;
+      }
+      ctrl_frames_recv_.fetch_add(1);
+      RequestList child;
+      if (ParseRequestList(buf, &child)) {
+        NoteChildSteadyExit(child, crank);
+        MergeFrameIntoAggregate(child, crank,
+                                EpochNowUs() - clock_offset_us_.load(),
+                                &agg, &idx);
+      }
+    }
+    if (dead) {
+      tree_child_dead_[i] = true;
+      pending_dead_reports_.push_back(crank);
+    }
+  }
+  if (!pending_dead_reports_.empty()) {
+    for (int32_t r : pending_dead_reports_) agg.dead_ranks.push_back(r);
+    pending_dead_reports_.clear();
+  }
+  if (!agg.requests.empty() || !agg.bit_groups.empty() ||
+      !agg.dead_ranks.empty() || !agg.steady_exits.empty() ||
+      agg.shutdown) {
+    if (!SendFrame(coord_fd_, SerializeRequestList(agg))) {
+      ExitSteadyLocal("coordinator-lost");
+      AbortLocal(ST_RANKS_DOWN,
+                 "ranks down: 0 (coordinator connection lost); this job "
+                 "cannot continue and should be restarted.");
+      return false;
+    }
+    ctrl_frames_sent_.fetch_add(1);
+  }
+  while (coord_fd_ >= 0 && WaitReadable(coord_fd_, 0.0)) {
+    std::vector<uint8_t> buf;
+    if (!RecvFrame(coord_fd_, &buf)) {
+      ExitSteadyLocal("coordinator-lost");
+      AbortLocal(ST_RANKS_DOWN,
+                 "ranks down: 0 (coordinator connection lost); this job "
+                 "cannot continue and should be restarted.");
+      return false;
+    }
+    ctrl_frames_recv_.fetch_add(1);
+    // Relay raw bytes down first: whatever this frame is, the children
+    // need it too (they are all blocked or polling).
+    for (size_t i = 0; i < tree_child_fds_.size(); ++i)
+      if (!tree_child_dead_[i] && SendFrame(tree_child_fds_[i], buf))
+        ctrl_frames_sent_.fetch_add(1);
+    ResponseList rl;
+    if (!ParseResponseList(buf, &rl)) continue;
+    if (rl.abort_code != 0) {
+      ExitSteadyLocal("abort");
+      AbortLocal(rl.abort_code, rl.abort_message);
+      return false;
+    }
+    if (rl.shutdown) {
+      ExitSteadyLocal("shutdown");
+      return false;
+    }
+    // The resume broadcast (or, defensively, any payload list): leave
+    // steady/holding and process it like a normal tick.
+    if (steady_active_.load()) ExitSteadyLocal("broadcast-resumed");
+    sub_holding_ = false;
+    RequestList none;
+    return ProcessResponseList(rl, none, std::chrono::steady_clock::now());
+  }
+  return true;
+}
+
+bool Engine::SteadyLoopOnce() {
+  // 1. Control-socket duty: rank 0 polls its children (fallback frames,
+  // EOFs, deadline sweeps); everyone else polls the parent for
+  // abort/shutdown frames; sub-coordinators additionally relay.  The
+  // duty rides the IDLE cadence: frames are exceptional in steady state
+  // (that is the point), so burning O(children) poll syscalls inside
+  // every replay burst would put the fan-in term back into the replay
+  // path this mode exists to remove — the idle wait (1-10ms) bounds
+  // abort/fallback latency instead, with a ~5ms time floor so a
+  // pipeline that keeps the queue non-empty on every pass (no idle
+  // passes at all) still reads abort/shutdown frames promptly.
+  auto duty_now = std::chrono::steady_clock::now();
+  if (steady_idle_passes_ > 0 ||
+      duty_now - steady_last_poll_ > std::chrono::milliseconds(5)) {
+  steady_last_poll_ = duty_now;
+  if (opts_.rank == 0) {
+    if (!CoordinatorSteadyPoll()) return false;
+  } else {
+    if (is_sub_coord_) {
+      if (!SubRelayPass()) return false;
+      // SubRelayPass may have exited steady (abort consumed elsewhere);
+      // fall through so the normal loop takes over next pass.
+      if (!steady_active_.load()) return true;
+    } else {
+      while (coord_fd_ >= 0 && WaitReadable(coord_fd_, 0.0)) {
+        std::vector<uint8_t> buf;
+        if (!RecvFrame(coord_fd_, &buf)) {
+          ExitSteadyLocal("coordinator-lost");
+          AbortLocal(ST_RANKS_DOWN,
+                     "ranks down: 0 (coordinator connection lost); this "
+                     "job cannot continue and should be restarted.");
+          return false;
+        }
+        ctrl_frames_recv_.fetch_add(1);
+        ResponseList rl;
+        if (!ParseResponseList(buf, &rl)) continue;
+        if (rl.abort_code != 0) {
+          ExitSteadyLocal("abort");
+          AbortLocal(rl.abort_code, rl.abort_message);
+          return false;
+        }
+        if (rl.shutdown) {
+          ExitSteadyLocal("shutdown");
+          return false;
+        }
+        // Defensively treat any payload broadcast as a revocation.
+        ExitSteadyLocal("broadcast-resumed");
+        RequestList none;
+        return ProcessResponseList(rl, none,
+                                   std::chrono::steady_clock::now());
+      }
+      // (EOF makes the socket readable, so the RecvFrame failure path
+      // above already covers a dead parent — no extra probe needed.)
+    }
+  }
+  }
+  // 2. A Python-initiated shutdown must reach the coordinator: exit
+  // steady so the next (normal) pass sends the shutdown frame.
+  if (shut_down_.load()) {
+    ExitSteadyLocal("shutdown");
+    return true;
+  }
+  // 3. Drain the queue and replay pattern matches group by group.  A
+  // group replays only once COMPLETE (all its slots drained), and a
+  // drained request may match ANY not-yet-drained slot of the CURRENT
+  // group, not just the next position: a group's slots co-arrived in
+  // one negotiation tick, so their per-rank enqueue order carries no
+  // cross-rank meaning (async/threaded submitters legitimately differ),
+  // and a strict positional match would miss — and fall back — on one
+  // rank while its peers replay the fused bucket into the data plane.
+  // Replay always executes the group in PATTERN order, so fusion
+  // boundaries and execution order stay identical on every rank
+  // regardless of local drain order.
+  std::deque<Request> drained;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    drained.swap(queue_);
+  }
+  bool replayed = false;
+  while (!drained.empty()) {
+    Request req = std::move(drained.front());
+    drained.pop_front();
+    int slot = cache_.Lookup(req);
+    // Remaining slots of the current group = pattern[group_base + n]
+    // for n in [pending, group_size) where drained slots are tracked in
+    // steady_pending_group_ (a multiset of the group's already-drained
+    // slots).
+    bool match = false;
+    if (slot >= 0 && steady_group_idx_ < steady_groups_.size()) {
+      size_t group_size = steady_groups_[steady_group_idx_];
+      size_t group_base = steady_pos_ - steady_pending_group_.size();
+      for (size_t n = 0; n < group_size && !match; ++n) {
+        if (steady_pattern_[group_base + n] !=
+            static_cast<uint32_t>(slot))
+          continue;
+        // Slot appears in the group; unmatched iff its multiplicity in
+        // the group exceeds its count among already-drained slots.
+        size_t in_group = 0, drained_n = 0;
+        for (size_t m = 0; m < group_size; ++m)
+          if (steady_pattern_[group_base + m] ==
+              static_cast<uint32_t>(slot))
+            ++in_group;
+        for (uint32_t d : steady_pending_group_)
+          if (d == static_cast<uint32_t>(slot)) ++drained_n;
+        match = drained_n < in_group;
+      }
+    }
+    if (!match) {
+      // Miss: fall back to full negotiation for this and everything
+      // after it (and everything drained-but-unreplayed before it).
+      // Steady state assumes SPMD: under it every rank misses at the
+      // same pattern position and the fallback converges (the tests pin
+      // this).  A rank whose PROGRAM diverged — it alone misses while
+      // peers keep matching — is already a broken job; peers block in
+      // the data plane on its missing participation and the failure
+      // surfaces through the exchange-silence timeout / EOF cascade as
+      // a typed abort, the same quality the star gave mismatched
+      // submissions.
+      ExitSteadyLocal("miss:" + req.name);
+      std::lock_guard<std::mutex> lk(mu_);
+      // Requeue in original order AT THE FRONT (entries enqueued after
+      // the swap above must stay behind these).
+      for (size_t i = drained.size(); i-- > 0;)
+        queue_.push_front(std::move(drained[i]));
+      queue_.push_front(std::move(req));
+      for (size_t i = steady_pending_reqs_.size(); i-- > 0;)
+        queue_.push_front(std::move(steady_pending_reqs_[i]));
+      steady_pending_reqs_.clear();
+      steady_pending_group_.clear();
+      return true;
+    }
+    if (steady_pending_group_.empty())
+      steady_group_wait_ = std::chrono::steady_clock::now();
+    steady_pending_group_.push_back(static_cast<uint32_t>(slot));
+    steady_pending_reqs_.push_back(std::move(req));
+    ++steady_pos_;
+    cache_hits_.fetch_add(1);
+    if (flight_.Enabled())
+      flight_.Record(FL_CACHE_HIT, steady_pending_reqs_.back().name, slot);
+    if (steady_pending_group_.size() ==
+        static_cast<size_t>(steady_groups_[steady_group_idx_])) {
+      // Complete replay group: execute exactly like a broadcast list's
+      // cache_hits (same fusion walk, same LRU touches — lockstep), in
+      // the PATTERN'S canonical slot order — never the local drain
+      // order, which may differ per rank within a group.
+      std::vector<uint32_t> canonical(
+          steady_pattern_.begin() + (steady_pos_ -
+                                     steady_pending_group_.size()),
+          steady_pattern_.begin() + steady_pos_);
+      ProcessCacheHits(canonical);
+      steady_replays_.fetch_add(
+          static_cast<int64_t>(steady_pending_group_.size()));
+      steady_pending_group_.clear();
+      steady_pending_reqs_.clear();
+      ++steady_group_idx_;
+      replayed = true;
+      if (steady_group_idx_ == steady_groups_.size()) {
+        // Pattern wrap = one full self-clocked cycle.  ticks_done_
+        // advances HERE (identically on every rank, since the replay
+        // stream is identical) so completion stamps and the per-tick
+        // lockstep lookups stay cross-rank consistent while the control
+        // plane is dark.
+        steady_group_idx_ = 0;
+        steady_pos_ = 0;
+        ++steady_epoch_;
+        steady_cycles_.fetch_add(1);
+        ticks_done_.fetch_add(1);
+        timeline_.Instant("steady", "STEADY_EPOCH");
+      }
+    }
+  }
+  // 4. A partial group can starve only if the program's enqueue style
+  // changed (the grouping was OBSERVED from real broadcast lists);
+  // rather than risk a silent stall, fall back to negotiation.
+  if (!steady_pending_group_.empty() &&
+      std::chrono::steady_clock::now() - steady_group_wait_ >
+          std::chrono::duration<double>(2.0)) {
+    ExitSteadyLocal("group-timeout");
+    std::lock_guard<std::mutex> lk(mu_);
+    for (size_t i = steady_pending_reqs_.size(); i-- > 0;)
+      queue_.push_front(std::move(steady_pending_reqs_[i]));
+    steady_pending_reqs_.clear();
+    steady_pending_group_.clear();
+    return true;
+  }
+  if (!replayed) {
+    // Idle: block on the enqueue cv (µs-latency wake when work arrives)
+    // with a bounded timeout so the parent-socket poll above still runs
+    // for abort/shutdown frames.  The timeout backs off after a few
+    // empty passes — enqueues wake the cv directly, so a long timeout
+    // costs nothing on the replay path, while hundreds of in-process
+    // simulated ranks ticking short timers would entrain the scheduler
+    // and show up as milliseconds of wake latency in every cycle.
+    int wait_ms = ++steady_idle_passes_ < 4 ? 1 : 10;
+    std::unique_lock<std::mutex> lk(mu_);
+    if (queue_.empty())
+      queue_cv_.wait_for(lk, std::chrono::milliseconds(wait_ms));
+  } else {
+    steady_idle_passes_ = 0;
+  }
+  return true;
+}
+
+std::string Engine::ControlInfo() {
+  return std::string(tree_enabled_ ? "1" : "0") + "|" +
+         std::to_string(ctrl_children_.load()) + "|" +
+         std::to_string(ctrl_hosts_.load()) + "|" +
+         (steady_active_.load() ? "1" : "0") + "|" +
+         std::to_string(steady_pattern_len_.load()) + "|" +
+         std::to_string(opts_.steady_threshold) + "|" +
+         std::to_string(steady_entries_.load()) + "|" +
+         std::to_string(steady_exits_.load()) + "|" +
+         std::to_string(steady_replays_.load()) + "|" +
+         std::to_string(steady_cycles_.load()) + "|" +
+         std::to_string(negotiated_ticks_.load()) + "|" +
+         std::to_string(ctrl_frames_sent_.load()) + "|" +
+         std::to_string(ctrl_frames_recv_.load());
+}
+
 // The XLA plane negotiates each collective via a "__xp.<name>" metadata
 // allreduce through this engine (jax/eager_mesh.py).  Transport choice is
 // dtype-deterministic, so a rank whose dtype is plane-ineligible (f64,
@@ -1562,7 +2462,10 @@ static std::string BaseName(const std::string& name) {
 }
 
 void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
-  for (const auto& req : rl.requests) {
+  int64_t now_us = EpochNowUs();
+  bool have_ts = rl.announce_us.size() == rl.requests.size();
+  for (size_t i = 0; i < rl.requests.size(); ++i) {
+    const Request& req = rl.requests[i];
     // A full string request for a name whose slot (or whose
     // cross-transport sibling's slot) has outstanding cache bits means
     // some rank fell back to full negotiation — a signature change, or a
@@ -1571,9 +2474,36 @@ void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
     // rank and the PR-2 mismatch/typed-error contract still fires.
     CoordinatorDrainBitsFor(req.name);
     CoordinatorDrainBitsFor(SiblingName(req.name));
-    HandleOneRequest(req, from_rank);
+    // Aggregate frames carry requests from several ranks (each Request
+    // names its true rank) plus their announce timestamps on rank 0's
+    // clock; direct frames stamp on arrival.
+    HandleOneRequest(req, req.rank, have_ts ? rl.announce_us[i] : now_us);
   }
   CoordinatorHandleBits(rl.cache_bits, from_rank);
+  for (const auto& g : rl.bit_groups)
+    for (size_t j = 0; j < g.ranks.size(); ++j)
+      HandleOneBit(g.slot, g.ranks[j],
+                   j < g.announce_us.size() && g.announce_us[j] >= 0
+                       ? g.announce_us[j]
+                       : now_us);
+  // Liveness/postmortem accounting for ranks whose frames this aggregate
+  // folds in (the per-rank last-frame story must survive aggregation).
+  for (int32_t r : rl.frames_from)
+    if (r >= 0 && r < static_cast<int>(coord_->last_frame_tick.size()))
+      coord_->last_frame_tick[r] = ticks_done_.load();
+  // Worker deaths the sub-coordinator observed (control-socket EOF).
+  for (int32_t r : rl.dead_ranks)
+    if (r > 0 && r < opts_.size)
+      MarkRankDead(r, "connection lost at its sub-coordinator");
+  if (rl.steady_exit) {
+    // The direct-frame exit marker carries the miss coordinates: land
+    // them in rank 0's flight ring so the postmortem can say WHERE in
+    // the pattern the fallback happened (sub-coordinators do the same
+    // for their leaves as the marker passes through).
+    NoteChildSteadyExit(rl, from_rank);
+    NoteSteadyExit(from_rank);
+  }
+  for (int32_t r : rl.steady_exits) NoteSteadyExit(r);
 }
 
 Request Engine::SynthesizeFromSlot(const CacheSlot& slot, int rank) const {
@@ -1614,75 +2544,89 @@ void Engine::CoordinatorDrainSlot(int slot, const CacheSlot& contents) {
 
 void Engine::CoordinatorHandleBits(const std::vector<uint32_t>& bits,
                                    int from_rank) {
-  for (uint32_t bit : bits) {
-    const CacheSlot* s = cache_.Get(static_cast<int>(bit));
-    if (s == nullptr) {
-      // Unreachable when every rank runs the same cache state — which
-      // Init enforces by agreeing on one job-wide capacity over the
-      // coordinator star and the lockstep mutation contract maintains.
-      // If it happens anyway, DROPPING the bit would leave the
-      // announcing rank waiting forever; abort the job with a crisp
-      // status instead.
-      if (coord_->abort_code == 0) {
-        coord_->abort_code = ST_INVALID;
-        coord_->abort_message =
-            "response-cache protocol error: rank " +
-            std::to_string(from_rank) + " announced cache slot " +
-            std::to_string(bit) +
-            ", unknown to the coordinator (the ranks disagree on the "
-            "negotiation response cache state); this job cannot continue "
-            "and should be restarted.";
-      }
-      continue;
+  int64_t now_us = EpochNowUs();
+  for (uint32_t bit : bits) HandleOneBit(bit, from_rank, now_us);
+}
+
+void Engine::HandleOneBit(uint32_t bit, int from_rank, int64_t announce_ts) {
+  const CacheSlot* s = cache_.Get(static_cast<int>(bit));
+  if (s == nullptr) {
+    // Unreachable when every rank runs the same cache state — which
+    // Init enforces by agreeing on one job-wide capacity over the
+    // coordinator star and the lockstep mutation contract maintains.
+    // If it happens anyway, DROPPING the bit would leave the
+    // announcing rank waiting forever; abort the job with a crisp
+    // status instead.
+    if (coord_->abort_code == 0) {
+      coord_->abort_code = ST_INVALID;
+      coord_->abort_message =
+          "response-cache protocol error: rank " +
+          std::to_string(from_rank) + " announced cache slot " +
+          std::to_string(bit) +
+          ", unknown to the coordinator (the ranks disagree on the "
+          "negotiation response cache state); this job cannot continue "
+          "and should be restarted.";
     }
-    if (coord_->message_table.count(s->name)) {
-      // A full (re-)negotiation of this name is in flight: fold the bit
-      // in as its equivalent full request so validation sees this rank.
-      HandleOneRequest(SynthesizeFromSlot(*s, from_rank), from_rank);
-      continue;
+    return;
+  }
+  if (coord_->message_table.count(s->name)) {
+    // A full (re-)negotiation of this name is in flight: fold the bit
+    // in as its equivalent full request so validation sees this rank.
+    HandleOneRequest(SynthesizeFromSlot(*s, from_rank), from_rank,
+                     announce_ts);
+    return;
+  }
+  auto& pb = coord_->cache_pending[bit];
+  if (pb.ranks.empty()) {
+    pb.ranks.assign(opts_.size, false);
+    pb.first_seen = std::chrono::steady_clock::now();
+    timeline_.NegotiateStart(s->name, s->op);
+  }
+  if (!pb.ranks[from_rank]) {
+    pb.ranks[from_rank] = true;
+    ++pb.count;
+    if (announce_ts < 0) announce_ts = EpochNowUs();
+    if (pb.first_us < 0 || announce_ts < pb.first_us)
+      pb.first_us = announce_ts;
+    if (announce_ts >= pb.last_us) {
+      pb.last_us = announce_ts;
+      pb.last_rank = from_rank;
     }
-    auto& pb = coord_->cache_pending[bit];
-    if (pb.ranks.empty()) {
-      pb.ranks.assign(opts_.size, false);
-      pb.first_seen = std::chrono::steady_clock::now();
-      timeline_.NegotiateStart(s->name, s->op);
+    timeline_.NegotiateRankReady(s->name, from_rank, announce_ts);
+    if (from_rank <
+        static_cast<int>(coord_->last_announce_tick.size())) {
+      coord_->last_announce_tick[from_rank] = ticks_done_.load();
+      coord_->last_announce_name[from_rank] = s->name;
     }
-    if (!pb.ranks[from_rank]) {
-      pb.ranks[from_rank] = true;
-      ++pb.count;
-      timeline_.NegotiateRankReady(s->name, from_rank);
-      if (from_rank <
-          static_cast<int>(coord_->last_announce_tick.size())) {
-        coord_->last_announce_tick[from_rank] = ticks_done_.load();
-        coord_->last_announce_name[from_rank] = s->name;
-      }
-    }
-    if (pb.count == opts_.size) {
-      // Agreement by pure bit intersection: no strings were parsed, no
-      // Requests rebuilt.  Keep the announce/straggler accounting live in
-      // steady state, and mark the NEGOTIATE row as a cache hit.
-      if (opts_.size > 1) RecordAnnounce(from_rank, pb.first_seen);
-      timeline_.Instant(s->name, "NEGOTIATE_CACHED");
-      timeline_.NegotiateEnd(s->name);
-      // Autotune window accounting: a bit agreement is one negotiated
-      // collective of the slot's payload size (the steady-state path the
-      // tuner mostly scores).  NOOP slots score zero bytes, matching the
-      // fresh-negotiation path — their dims are metadata geometry, not
-      // payload, and mixed scoring would bias windows by cache-hit mix.
-      if (tuner_.active())
-        tuner_.Record(
-            s->op == OP_NOOP
-                ? 0
-                : NumElements(s->dims) *
-                      static_cast<int64_t>(DataTypeSize(s->dtype)),
-            1);
-      coord_->cached_ready.push_back(bit);
-      coord_->cache_pending.erase(bit);
-    }
+  }
+  if (pb.count == opts_.size) {
+    // Agreement by pure bit intersection: no strings were parsed, no
+    // Requests rebuilt.  Keep the announce/straggler accounting live in
+    // steady state, and mark the NEGOTIATE row as a cache hit.
+    if (opts_.size > 1)
+      RecordAnnounce(pb.last_rank, pb.last_us - pb.first_us);
+    timeline_.Instant(s->name, "NEGOTIATE_CACHED");
+    timeline_.NegotiateEnd(s->name);
+    // Autotune window accounting: a bit agreement is one negotiated
+    // collective of the slot's payload size (the steady-state path the
+    // tuner mostly scores).  NOOP slots score zero bytes, matching the
+    // fresh-negotiation path — their dims are metadata geometry, not
+    // payload, and mixed scoring would bias windows by cache-hit mix.
+    if (tuner_.active())
+      tuner_.Record(
+          s->op == OP_NOOP
+              ? 0
+              : NumElements(s->dims) *
+                    static_cast<int64_t>(DataTypeSize(s->dtype)),
+          1);
+    coord_->cached_ready.push_back(bit);
+    coord_->cache_pending.erase(bit);
   }
 }
 
-void Engine::HandleOneRequest(const Request& req, int from_rank) {
+void Engine::HandleOneRequest(const Request& req, int from_rank,
+                              int64_t announce_ts) {
+  if (announce_ts < 0) announce_ts = EpochNowUs();
   if (from_rank >= 0 &&
       from_rank < static_cast<int>(coord_->last_announce_tick.size())) {
     coord_->last_announce_tick[from_rank] = ticks_done_.load();
@@ -1747,7 +2691,13 @@ void Engine::HandleOneRequest(const Request& req, int from_rank) {
         coord_->ready.push_back(sib->first);
       }
     }
-    timeline_.NegotiateRankReady(req.name, from_rank);
+    timeline_.NegotiateRankReady(req.name, from_rank, announce_ts);
+    if (pt.first_us < 0 || announce_ts < pt.first_us)
+      pt.first_us = announce_ts;
+    if (announce_ts >= pt.last_us) {
+      pt.last_us = announce_ts;
+      pt.last_rank = from_rank;
+    }
     pt.requests.push_back(req);
     // forced_error entries were already pushed to ready at detection; a
     // second push here would double-build (and double-erase) the entry.
@@ -1759,10 +2709,13 @@ void Engine::HandleOneRequest(const Request& req, int from_rank) {
         coord_->poisoned.erase(BaseName(req.name));
         pt.poison_deadline_tick = 0;
       }
-      // Straggler attribution: `from_rank`'s request list completed the
-      // count, so it announced last; skew = first -> last announce.  At
-      // size 1 every count completes instantly — pure noise, skip.
-      if (opts_.size > 1) RecordAnnounce(from_rank, pt.first_seen);
+      // Straggler attribution: the rank with the LATEST announce
+      // timestamp announced last; skew = first -> last announce.  Tree
+      // aggregates forward the true per-rank times, so this names the
+      // real straggler rank, not the sub-coordinator.  At size 1 every
+      // count completes instantly — pure noise, skip.
+      if (opts_.size > 1)
+        RecordAnnounce(pt.last_rank, pt.last_us - pt.first_us);
       timeline_.NegotiateEnd(req.name);
       coord_->ready.push_back(req.name);
     }
@@ -2846,6 +3799,11 @@ bool Engine::ApplyReshape(const ResponseList& rl) {
     }
     for (int fd : coord_fds_) CloseFd(fd);  // dead ranks' sockets
     coord_fds_ = std::move(new_fds);
+    // Elastic jobs run the one-level star: every worker is a direct
+    // child of the rebuilt coordinator.
+    coord_children_.clear();
+    for (int r = 1; r < new_size; ++r) coord_children_.push_back(r);
+    ctrl_children_.store(new_size - 1);
     coord_->pending_join_fds.clear();
     coord_->pending_join_endpoints.clear();
     coord_->rank_dead.assign(new_size, false);

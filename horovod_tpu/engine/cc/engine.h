@@ -117,6 +117,27 @@ struct EngineOptions {
   // the membership from the reshape broadcast.  rank/size/data_endpoints
   // in these options are placeholders until then.
   bool rejoin = false;
+  // Control-plane coordinator tree (docs/performance.md
+  // #control-plane-scaling, HVD_TPU_COORD_TREE): each host's
+  // local-rank-0 becomes a sub-coordinator that accepts its node's
+  // control sockets, folds announce bitsets / request lists into ONE
+  // aggregate frame per tick, and relays rank 0's broadcasts back down —
+  // rank 0 holds O(hosts) steady-state sockets and processes O(hosts)
+  // frames per tick instead of O(ranks).  AUTO: the tree is built only
+  // for multi-node contiguous layouts (the same job-wide agreement the
+  // two-level data topology validates); single-host jobs keep the
+  // degenerate one-level star, and elastic jobs force it (membership
+  // reshapes rebuild the star only).
+  bool coord_tree = true;
+  // Decentralized steady state (HVD_TPU_STEADY_THRESHOLD): once the
+  // coordinator sees the cache-hit slot stream repeat an identical cycle
+  // this many times at quiesced boundaries, it broadcasts the pattern
+  // and every rank self-clocks on an epoch counter, replaying the cached
+  // responses with ZERO control-plane frames per cycle; any miss falls
+  // back to full negotiation.  0 disables.  `steady_max_period` bounds
+  // the detectable cycle length (slots per cycle).
+  int64_t steady_threshold = 32;
+  int64_t steady_max_period = 256;
 };
 
 struct HandleStatus {
@@ -220,6 +241,9 @@ struct TableEntry {
 
 class Engine {
  public:
+  // Out-of-line so translation units that instantiate an Engine (the
+  // simscale harness) never need the private Coordinator definition.
+  Engine();
   ~Engine();
 
   // Starts the background thread and blocks until sockets are connected (or
@@ -381,6 +405,19 @@ class Engine {
   std::string TopologyInfo();
   std::string TopologyLog();
 
+  // Control-plane observability (docs/performance.md
+  // #control-plane-scaling).  ControlInfo serializes
+  // "tree|children|hosts|steady_active|pattern_len|steady_threshold|
+  //  entries|exits|replays|steady_cycles|negotiated_ticks|frames_sent|
+  //  frames_recv" for the Python metrics sync: the tree shape this rank
+  // sees (children = control sockets it reads each tick), the
+  // decentralized-steady-state counters (process-cumulative, like
+  // StallEvents), and the control-frame counters the zero-frames-per-
+  // steady-cycle contract is asserted against.
+  std::string ControlInfo();
+  bool SteadyActive() const { return steady_active_.load(); }
+  int64_t CtrlFramesSent() const { return ctrl_frames_sent_.load(); }
+
   // Elastic-membership observability (docs/fault-tolerance.md).  The
   // epoch counts reshapes survived by THIS engine lifetime (0 until the
   // first); reshape/lost/joined totals are process-cumulative like
@@ -477,15 +514,18 @@ class Engine {
   bool ClockSync(std::string* err);
   int64_t EpochNowUs() const;
   // Rank 0: one negotiation reached full count; `last_rank` announced
-  // last, `first_seen` when the first announce arrived.
-  void RecordAnnounce(int last_rank,
-                      std::chrono::steady_clock::time_point first_seen);
+  // last, `skew_us` first -> last announce (tree aggregates forward the
+  // true per-rank announce timestamps, so the verdict names the true
+  // straggler, not the sub-coordinator whose frame closed the count).
+  void RecordAnnounce(int last_rank, int64_t skew_us);
 
   // Coordinator (rank 0) helpers.
   void CoordinatorHandle(const RequestList& rl, int from_rank);
   // One full string request (shared by wire requests and the synthesized
-  // ones below).
-  void HandleOneRequest(const Request& req, int from_rank);
+  // ones below).  `announce_ts` is the announce time on rank 0's clock
+  // (µs since epoch); < 0 stamps on arrival (the direct-star form).
+  void HandleOneRequest(const Request& req, int from_rank,
+                        int64_t announce_ts = -1);
   // Response-cache coordination: count one rank's cache-bit announcements
   // (full count -> a broadcast hit); convert any bits still pending for
   // `name`'s slot back into full synthesized requests (a peer fell back
@@ -494,6 +534,9 @@ class Engine {
   // orphaned bits the same way.
   void CoordinatorHandleBits(const std::vector<uint32_t>& bits,
                              int from_rank);
+  // One cache-bit announcement from one rank (the per-rank granule the
+  // wire bits and the tree's aggregated BitGroups both decompose into).
+  void HandleOneBit(uint32_t bit, int from_rank, int64_t announce_ts);
   void CoordinatorDrainBitsFor(const std::string& name);
   void CoordinatorDrainSlot(int slot, const CacheSlot& contents);
   // The request rank `rank` would have sent for the cached collective
@@ -504,6 +547,53 @@ class Engine {
   void ProcessCacheHits(const std::vector<uint32_t>& hits);
   ResponseList CoordinatorTick();
   Response BuildResponse(const std::string& name);
+  // Decentralized steady state (docs/performance.md
+  // #control-plane-scaling).  CoordinatorMaybeSteady runs after the tick
+  // built its outgoing list: it feeds the cache-hit slot stream into the
+  // pattern detector and, at a quiesced cycle boundary with the pattern
+  // repeated `steady_threshold` times, stamps the STEADY verdict onto
+  // the list.  ApplySteady arms self-clocked replay on every rank while
+  // processing that (identical) list.
+  void CoordinatorMaybeSteady(ResponseList* out);
+  void ApplySteady(const ResponseList& rl);
+  // One self-clocked pass of the engine loop while steady state is
+  // armed: replay pattern-matching queue entries group by group with
+  // zero control-plane frames, poll the parent socket for abort/shutdown
+  // frames, and fall back to full negotiation on any miss.  Returns
+  // false when the loop must exit (abort/shutdown).
+  bool SteadyLoopOnce();
+  // Leave steady state locally (miss, shutdown, defensive broadcast):
+  // requeue un-replayed requests and resume per-tick frames.
+  void ExitSteadyLocal(const std::string& reason);
+  // Rank 0: note a rank's steady exit (frames resume only once ALL ranks
+  // exited — broadcasting earlier would double-execute replays on ranks
+  // still self-clocking).
+  void NoteSteadyExit(int r);
+  // Flight-record a steady-exit marker's miss coordinates (epoch/pos) as
+  // the frame passes this node — the per-rank postmortem rings locate
+  // the miss even though the aggregate's exit list carries only ranks.
+  void NoteChildSteadyExit(const RequestList& frame, int child_rank);
+  // Bounded wait for the parent's next broadcast, cascaded by tree
+  // depth: rank 0 may legitimately block ~2T+5 probing a frozen
+  // sub-coordinator before its verdict goes out, a sub must outwait
+  // that, and a leaf must outwait its sub — equal bounds at every level
+  // would expire downstream just before the true verdict arrives and
+  // misblame the parent.
+  double ParentWaitSec() const;
+  bool AllSteadyExited() const;
+  // Rank 0, steady/holding mode: drain whatever control frames arrived
+  // without blocking (fallback announcements, steady exits, EOFs),
+  // escalate deadline breaches, and broadcast an armed abort
+  // immediately.  Returns false when the loop must exit.
+  bool CoordinatorSteadyPoll();
+  // Sub-coordinator, steady/holding mode: forward children's fallback
+  // frames upward as aggregates and relay any parent broadcast down.
+  // Returns false when the loop must exit.
+  bool SubRelayPass();
+  // Common tail every rank runs on a received/built broadcast list.
+  bool ProcessResponseList(ResponseList& responses,
+                           const RequestList& my_requests,
+                           std::chrono::steady_clock::time_point tick_start);
   void CheckForStalledTensors();
   // Every-tick deadline sweep (rank 0): escalates a stall beyond
   // opts_.collective_timeout_sec to a coordinated abort.
@@ -619,6 +709,12 @@ class Engine {
 
   std::mutex mu_;  // guards queue_, table_, handles_ map shape
   std::deque<Request> queue_;
+  // Wakes the engine thread's steady-state idle wait the moment work
+  // arrives: with the control plane dark there is no frame round trip
+  // pacing the loop, and a blind poll cadence would either burn CPU
+  // (hundreds of simulated ranks in one process) or add its period to
+  // every replay cycle's latency.
+  std::condition_variable queue_cv_;
   std::unordered_map<std::string, TableEntry> table_;
 
   std::mutex handles_mu_;
@@ -631,6 +727,55 @@ class Engine {
   int coord_listen_fd_ = -1;                 // rank 0
   std::vector<int> coord_fds_;               // rank 0: fd per worker rank
   int coord_fd_ = -1;                        // workers: fd to rank 0
+  // Control-plane coordinator tree (docs/performance.md
+  // #control-plane-scaling).  Built by SetupSockets after the job-wide
+  // layout agreement: non-lead workers of nodes >= 1 re-home their
+  // control socket from rank 0 to their node's local-rank-0
+  // (sub-coordinator), which accepted them over its DATA listener with a
+  // typed hello — no extra endpoints.  Rank 0 keeps sockets only for its
+  // own node's workers plus one per sub-coordinator.
+  bool tree_enabled_ = false;   // this job agreed on the two-level tree
+  bool is_sub_coord_ = false;   // local_rank 0 of a node >= 1
+  std::vector<int> tree_child_fds_;    // sub: fd per local worker (1..L-1)
+  std::vector<int> tree_child_ranks_;  // global rank per child fd
+  std::vector<bool> tree_child_dead_;
+  std::vector<int> coord_children_;    // rank 0: global ranks it reads
+  // Sub-coordinator relay bookkeeping: deaths observed but not yet
+  // forwarded, and whether this sub is in the steady/holding relay mode
+  // (between its own steady exit and the next parent broadcast).
+  std::vector<int32_t> pending_dead_reports_;
+  bool sub_holding_ = false;
+
+  // Decentralized steady state (engine-thread state unless atomic).
+  std::atomic<bool> steady_active_{false};
+  std::vector<uint32_t> steady_pattern_;
+  std::vector<uint32_t> steady_groups_;   // per-replay-group sizes
+  size_t steady_pos_ = 0;                 // next expected pattern index
+  size_t steady_group_idx_ = 0;           // current group
+  int64_t steady_epoch_ = 0;              // completed cycles this window
+  std::vector<uint32_t> steady_pending_group_;  // drained, not yet replayed
+  std::vector<Request> steady_pending_reqs_;    // their Requests (for requeue)
+  std::chrono::steady_clock::time_point steady_group_wait_{};
+  bool steady_exit_pending_ = false;  // next frame carries the exit flag
+  int steady_idle_passes_ = 0;        // backoff state for the idle wait
+  // Last control-socket duty pass: the duty rides the idle cadence, but
+  // a pipeline that keeps the queue non-empty on every pass must still
+  // see abort/shutdown frames within a bounded interval.
+  std::chrono::steady_clock::time_point steady_last_poll_{};
+  int64_t steady_exit_epoch_ = 0;
+  int64_t steady_exit_pos_ = 0;
+  // Control-plane metrics (process-cumulative, like StallEvents; the
+  // atomics are read live by Python API threads).
+  std::atomic<int> ctrl_children_{0};
+  std::atomic<int> ctrl_hosts_{1};
+  std::atomic<int64_t> ctrl_frames_sent_{0};
+  std::atomic<int64_t> ctrl_frames_recv_{0};
+  std::atomic<int64_t> steady_entries_{0};
+  std::atomic<int64_t> steady_exits_{0};
+  std::atomic<int64_t> steady_replays_{0};
+  std::atomic<int64_t> steady_cycles_{0};
+  std::atomic<int64_t> steady_pattern_len_{0};
+  std::atomic<int64_t> negotiated_ticks_{0};
   int data_listen_fd_ = -1;
   int left_fd_ = -1, right_fd_ = -1;         // ring neighbours
   // Two-level topology (only when opts_.hierarchical_allreduce):

@@ -25,6 +25,7 @@ const char* FlightEventName(uint8_t event) {
     case FL_TUNE:      return "tune";
     case FL_COMPRESS:  return "compress";
     case FL_TOPOLOGY:  return "topology";
+    case FL_STEADY:    return "steady";
     default:           return "unknown";
   }
 }

@@ -40,6 +40,11 @@ enum FlightEventType : uint8_t {
   FL_COMPRESS = 10,  // wire-compression mode armed / changed (arg: mode)
   FL_TOPOLOGY = 11,  // two-level cross-node algorithm switched
                      // (arg: 1 = tree, 0 = ring; name = first bucket name)
+  FL_STEADY = 12,    // decentralized steady state entered/exited
+                     // (name: "enter" with arg = pattern length, or the
+                     // exit reason with arg = the epoch it happened at) —
+                     // the record that explains why a postmortem shows
+                     // zero coordinator traffic before a hang
 };
 
 const char* FlightEventName(uint8_t event);

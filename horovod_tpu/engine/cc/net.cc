@@ -79,8 +79,14 @@ int Listen(const std::string& host, int port, std::string* err) {
   }
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  // Backlog sized for pod-scale rendezvous: at init every rank connects
+  // to the rank-0 coordinator at once, and with the old backlog of 64 a
+  // few-hundred-rank job hit accept-queue overflow — syncookies let the
+  // client think it connected, then the server's unanswered final-ACK
+  // retries RST it mid-handshake ("topology agreement exchange failed").
+  // The kernel clamps to net.core.somaxconn.
   if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
-      listen(fd, 64) != 0) {
+      listen(fd, 4096) != 0) {
     *err = std::string("bind/listen ") + host + ":" + std::to_string(port) +
            ": " + strerror(errno);
     close(fd);
@@ -131,11 +137,31 @@ int ConnectRetry(const std::string& host, int port, double timeout_sec,
     }
     ++attempts;
     if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
-      SetCommonOpts(fd);
-      return fd;
+      // TCP self-connect guard: when the target port sits in the
+      // ephemeral range and the peer is not listening YET, the kernel
+      // can pick the destination port as this socket's source port and
+      // "succeed" via simultaneous open — the socket is connected to
+      // ITSELF, the rendezvous hello echoes back, and the real peer
+      // never hears from us (seen as one-in-N init failures of the
+      // simulated-scale harness's loopback rendezvous storm).  Detect
+      // the loop and retry; the self-connection's teardown frees the
+      // port for the real listener.
+      sockaddr_in self{}, peer{};
+      socklen_t slen = sizeof(self), plen = sizeof(peer);
+      if (getsockname(fd, reinterpret_cast<sockaddr*>(&self), &slen) == 0 &&
+          getpeername(fd, reinterpret_cast<sockaddr*>(&peer), &plen) == 0 &&
+          self.sin_port == peer.sin_port &&
+          self.sin_addr.s_addr == peer.sin_addr.s_addr) {
+        last_errno = ECONNREFUSED;
+        close(fd);
+      } else {
+        SetCommonOpts(fd);
+        return fd;
+      }
+    } else {
+      last_errno = errno;
+      close(fd);
     }
-    last_errno = errno;
-    close(fd);
     if (NowSec() >= deadline) {
       *err = std::string("connect ") + host + ":" + std::to_string(port) +
              " timed out after " + std::to_string(attempts) +

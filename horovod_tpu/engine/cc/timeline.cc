@@ -42,6 +42,7 @@ void Timeline::Initialize(const std::string& path, int rank,
   // run's pid rows so every tensor re-emits its process_name metadata.
   tensor_pids_.clear();
   open_labels_.clear();
+  last_ts_by_pid_.clear();
   start_ = epoch;
   last_flush_ = std::chrono::steady_clock::now();
   file_ << "[\n";
@@ -65,7 +66,9 @@ int64_t Timeline::TensorPid(const std::string& name) {
   int64_t pid = static_cast<int64_t>(tensor_pids_.size()) + 1;
   tensor_pids_[name] = pid;
   // Metadata event labels the pid row with the tensor name.
-  file_ << "{\"name\":\"process_name\",\"ph\":\"M\",\"ts\":" << NowUs()
+  int64_t now = NowUs();
+  last_ts_by_pid_[pid] = now;
+  file_ << "{\"name\":\"process_name\",\"ph\":\"M\",\"ts\":" << now
         << ",\"pid\":" << pid << ",\"args\":{\"name\":\"" << JsonEscape(name)
         << "\"}},\n";
   return pid;
@@ -73,7 +76,7 @@ int64_t Timeline::TensorPid(const std::string& name) {
 
 void Timeline::WriteEvent(const std::string& name, char phase,
                           const std::string& args,
-                          const std::string& category) {
+                          const std::string& category, int64_t ts_us) {
   int64_t pid = TensorPid(name);
   // 'E' events repeat their opener's label (popped from the per-row
   // stack) so every event carries a name.
@@ -87,7 +90,11 @@ void Timeline::WriteEvent(const std::string& name, char phase,
       stack.pop_back();
     }
   }
-  file_ << "{\"ph\":\"" << phase << "\",\"ts\":" << NowUs()
+  int64_t ts = ts_us >= 0 ? ts_us : NowUs();
+  int64_t& row_ts = last_ts_by_pid_[pid];
+  if (ts < row_ts) ts = row_ts;  // per-row monotonicity clamp
+  row_ts = ts;
+  file_ << "{\"ph\":\"" << phase << "\",\"ts\":" << ts
         << ",\"pid\":" << pid << ",\"tid\":0"
         << ",\"name\":\"" << JsonEscape(label) << "\"";
   if (!args.empty()) file_ << ",\"args\":{" << args << "}";
@@ -105,11 +112,12 @@ void Timeline::NegotiateStart(const std::string& name, uint8_t op) {
   WriteEvent(name, 'B', "", "NEGOTIATE");
 }
 
-void Timeline::NegotiateRankReady(const std::string& name, int rank) {
+void Timeline::NegotiateRankReady(const std::string& name, int rank,
+                                  int64_t ts_us) {
   if (!enabled_) return;
   std::lock_guard<std::mutex> lk(mu_);
   WriteEvent(name, 'i',
-             "\"rank\":" + std::to_string(rank), "RANK_READY");
+             "\"rank\":" + std::to_string(rank), "RANK_READY", ts_us);
 }
 
 void Timeline::NegotiateEnd(const std::string& name) {

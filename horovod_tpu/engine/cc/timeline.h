@@ -32,7 +32,14 @@ class Timeline {
   bool Enabled() const { return enabled_; }
 
   void NegotiateStart(const std::string& name, uint8_t op);
-  void NegotiateRankReady(const std::string& name, int rank);
+  // `ts_us` >= 0 stamps the instant at that epoch-time instead of "now":
+  // under the coordinator tree, rank 0 receives announce timestamps
+  // forwarded (clock-mapped) by the sub-coordinators, and the RANK_READY
+  // instants must carry the TRUE announce times or the straggler report
+  // (tools/timeline_merge.py) would attribute every skew to the
+  // aggregate frame's arrival.
+  void NegotiateRankReady(const std::string& name, int rank,
+                          int64_t ts_us = -1);
   void NegotiateEnd(const std::string& name);
   void Start(const std::string& name, const std::string& op_name);
   void ActivityStart(const std::string& name, const std::string& activity);
@@ -52,7 +59,7 @@ class Timeline {
 
  private:
   void WriteEvent(const std::string& name, char phase, const std::string& args,
-                  const std::string& category);
+                  const std::string& category, int64_t ts_us = -1);
   int64_t TensorPid(const std::string& name);
   int64_t NowUs() const;
 
@@ -60,6 +67,11 @@ class Timeline {
   std::ofstream file_;
   std::mutex mu_;
   std::unordered_map<std::string, int64_t> tensor_pids_;
+  // Per-row monotonicity clamp: explicit timestamps (forwarded announce
+  // times under the coordinator tree) may precede a row's last written
+  // event by microseconds; Chrome-trace consumers (and the structural
+  // validator) want non-decreasing ts per row.
+  std::unordered_map<int64_t, int64_t> last_ts_by_pid_;
   // Per-row stack of open 'B' labels so every 'E' event can repeat its
   // opener's name — the structural-validation contract (tests require
   // ph/ts/pid/name on every row) without breaking Chrome's B/E pairing.

@@ -129,6 +129,29 @@ std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
   }
   w.U32(static_cast<uint32_t>(rl.cache_bits.size()));
   for (uint32_t b : rl.cache_bits) w.U32(b);
+  // Coordinator-tree aggregate section.  announce_us is either empty or
+  // parallel to requests; serialize the actual length so the parse side
+  // can restore the "no timestamps" (direct-star) form exactly.
+  w.U32(static_cast<uint32_t>(rl.announce_us.size()));
+  for (int64_t ts : rl.announce_us) w.I64(ts);
+  w.U32(static_cast<uint32_t>(rl.bit_groups.size()));
+  for (const auto& g : rl.bit_groups) {
+    w.U32(g.slot);
+    w.U32(static_cast<uint32_t>(g.ranks.size()));
+    for (size_t i = 0; i < g.ranks.size(); ++i) {
+      w.I32(g.ranks[i]);
+      w.I64(i < g.announce_us.size() ? g.announce_us[i] : -1);
+    }
+  }
+  w.U32(static_cast<uint32_t>(rl.frames_from.size()));
+  for (int32_t r : rl.frames_from) w.I32(r);
+  w.U32(static_cast<uint32_t>(rl.dead_ranks.size()));
+  for (int32_t r : rl.dead_ranks) w.I32(r);
+  w.U32(static_cast<uint32_t>(rl.steady_exits.size()));
+  for (int32_t r : rl.steady_exits) w.I32(r);
+  w.U8(rl.steady_exit);
+  w.I64(rl.steady_epoch);
+  w.I64(rl.steady_pos);
   return std::move(w.buf);
 }
 
@@ -153,6 +176,37 @@ bool ParseRequestList(const std::vector<uint8_t>& buf, RequestList* rl) {
   uint32_t nb = rd.U32();
   for (uint32_t i = 0; i < nb && rd.ok; ++i)
     rl->cache_bits.push_back(rd.U32());
+  rl->announce_us.clear();
+  uint32_t nts = rd.U32();
+  for (uint32_t i = 0; i < nts && rd.ok; ++i)
+    rl->announce_us.push_back(rd.I64());
+  rl->bit_groups.clear();
+  uint32_t ng = rd.U32();
+  for (uint32_t i = 0; i < ng && rd.ok; ++i) {
+    BitGroup g;
+    g.slot = rd.U32();
+    uint32_t nr = rd.U32();
+    for (uint32_t j = 0; j < nr && rd.ok; ++j) {
+      g.ranks.push_back(rd.I32());
+      g.announce_us.push_back(rd.I64());
+    }
+    rl->bit_groups.push_back(std::move(g));
+  }
+  rl->frames_from.clear();
+  uint32_t nf = rd.U32();
+  for (uint32_t i = 0; i < nf && rd.ok; ++i)
+    rl->frames_from.push_back(rd.I32());
+  rl->dead_ranks.clear();
+  uint32_t nd = rd.U32();
+  for (uint32_t i = 0; i < nd && rd.ok; ++i)
+    rl->dead_ranks.push_back(rd.I32());
+  rl->steady_exits.clear();
+  uint32_t nse = rd.U32();
+  for (uint32_t i = 0; i < nse && rd.ok; ++i)
+    rl->steady_exits.push_back(rd.I32());
+  rl->steady_exit = rd.U8();
+  rl->steady_epoch = rd.I64();
+  rl->steady_pos = rd.I64();
   return rd.ok;
 }
 
@@ -197,6 +251,13 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
     }
     w.U32(static_cast<uint32_t>(rl.reshape_lost.size()));
     for (int32_t r : rl.reshape_lost) w.I32(r);
+  }
+  w.U8((rl.steady_present ? 1 : 0) | (rl.steady_revoke ? 2 : 0));
+  if (rl.steady_present) {
+    w.U32(static_cast<uint32_t>(rl.steady_pattern.size()));
+    for (uint32_t s : rl.steady_pattern) w.U32(s);
+    w.U32(static_cast<uint32_t>(rl.steady_groups.size()));
+    for (uint32_t g : rl.steady_groups) w.U32(g);
   }
   return std::move(w.buf);
 }
@@ -254,6 +315,19 @@ bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
     uint32_t nl = rd.U32();
     for (uint32_t i = 0; i < nl && rd.ok; ++i)
       rl->reshape_lost.push_back(rd.I32());
+  }
+  rl->steady_pattern.clear();
+  rl->steady_groups.clear();
+  uint8_t steady_flags = rd.U8();
+  rl->steady_present = (steady_flags & 1) != 0;
+  rl->steady_revoke = (steady_flags & 2) != 0;
+  if (rl->steady_present) {
+    uint32_t np = rd.U32();
+    for (uint32_t i = 0; i < np && rd.ok; ++i)
+      rl->steady_pattern.push_back(rd.U32());
+    uint32_t ngr = rd.U32();
+    for (uint32_t i = 0; i < ngr && rd.ok; ++i)
+      rl->steady_groups.push_back(rd.U32());
   }
   return rd.ok;
 }

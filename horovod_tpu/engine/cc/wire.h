@@ -87,6 +87,18 @@ struct Request {
   std::vector<int64_t> dims;
 };
 
+// One cache slot's announcements folded across a node by its
+// sub-coordinator (docs/performance.md#control-plane-scaling): the ranks
+// that announced the slot this tick, each with its announce timestamp
+// (µs, mapped onto rank 0's clock by the sub-coordinator's PR-3 clock
+// offset) so rank 0's last-to-announce straggler verdicts still name the
+// true rank behind the aggregation, not the sub-coordinator.
+struct BitGroup {
+  uint32_t slot = 0;
+  std::vector<int32_t> ranks;
+  std::vector<int64_t> announce_us;  // parallel to ranks
+};
+
 struct RequestList {
   bool shutdown = false;
   std::vector<Request> requests;
@@ -96,6 +108,32 @@ struct RequestList {
   // fast path.  Caches mutate in broadcast response-list order on every
   // rank, so a slot index names the same collective everywhere.
   std::vector<uint32_t> cache_bits;
+  // --- Coordinator-tree aggregate extensions (docs/performance.md
+  // #control-plane-scaling).  A sub-coordinator (each host's
+  // local-rank-0) folds its node's per-rank frames into ONE aggregate
+  // frame per tick; rank 0 therefore holds O(hosts) control sockets and
+  // processes O(hosts) frames per tick instead of O(ranks).  Leaf frames
+  // leave all of these empty.
+  // Announce timestamps parallel to `requests` (rank-0 clock µs); empty
+  // = stamp on arrival (the direct/star behavior).
+  std::vector<int64_t> announce_us;
+  // Cache-bit announcements folded per slot across the node.
+  std::vector<BitGroup> bit_groups;
+  // Ranks whose frame this aggregate folds in (liveness accounting: rank
+  // 0's last-frame-tick postmortem bookkeeping stays per TRUE rank).
+  std::vector<int32_t> frames_from;
+  // Worker deaths observed at the sub-coordinator (control-socket EOF):
+  // forwarded so rank 0's coordinated abort names the true dead rank.
+  std::vector<int32_t> dead_ranks;
+  // Ranks of this node that left the decentralized steady state this
+  // frame (miss fallback) — rank 0 resumes broadcasting only once every
+  // rank has exited.
+  std::vector<int32_t> steady_exits;
+  // THIS sender left steady state with this frame (leaf form of
+  // steady_exits); epoch/pos locate the miss for postmortem dumps.
+  uint8_t steady_exit = 0;
+  int64_t steady_epoch = 0;
+  int64_t steady_pos = 0;
 };
 
 enum ResponseType : uint8_t {
@@ -189,6 +227,24 @@ struct ResponseList {
   std::vector<int32_t> member_old_ranks;      // index = new dense rank
   std::vector<std::string> member_endpoints;  // index = new dense rank
   std::vector<int32_t> reshape_lost;
+  // Decentralized steady state (docs/performance.md
+  // #control-plane-scaling): when present, the coordinator observed the
+  // cache-hit slot stream repeat `steady_pattern` identically
+  // HVD_TPU_STEADY_THRESHOLD times at quiesced cycle boundaries.  Every
+  // rank arms self-clocked replay after processing this list: it replays
+  // the pattern's stored responses locally, epoch by epoch, with ZERO
+  // control-plane frames per cycle, falling back to full negotiation on
+  // any miss.  `steady_groups` carries the observed per-tick grouping of
+  // the last cycle (sizes summing to the pattern length) so replayed
+  // buckets fuse identically on every rank regardless of local drain
+  // timing.
+  bool steady_present = false;
+  std::vector<uint32_t> steady_pattern;
+  std::vector<uint32_t> steady_groups;
+  // The first broadcast after a steady window closed (all ranks fell
+  // back): informational marker for flight/timeline symmetry — the
+  // coordinator's pattern detector restarts at this list.
+  bool steady_revoke = false;
 };
 
 std::vector<uint8_t> SerializeRequestList(const RequestList& rl);

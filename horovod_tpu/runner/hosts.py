@@ -2,9 +2,14 @@
 replacement, /root/reference/docs/running.md).
 
 A host spec assigns ranks to hosts in contiguous blocks (host order, then
-slot order), which both defines local_rank/local_size and satisfies the
-engine's two-level-topology layout contract
-(docs/performance.md#two-level-topology): with
+slot order), which defines local_rank/local_size and satisfies BOTH
+engine layout contracts that key off it: the two-level data topology
+(docs/performance.md#two-level-topology) and the control-plane
+coordinator tree (docs/performance.md#control-plane-scaling), under
+which each host's local-rank-0 becomes the sub-coordinator for its
+block — its node's control sockets multiplex over the same per-rank
+data listen port via a typed hello, so no extra ports are planned here.
+With
 HOROVOD_HIERARCHICAL_ALLREDUCE, every local rank drives its OWN
 cross-node (DCN) stream to its same-local-rank peers — rank
 ``node*L + r`` connects to ``(node±1)*L + r`` and, for the tree

@@ -47,9 +47,14 @@ struct Request {
   int32_t rank = 0;
   std::string name;
 };
+struct BitGroup {
+  uint32_t slot = 0;
+  std::vector<int32_t> ranks;
+};
 struct RequestList {
   bool shutdown = false;
   std::vector<Request> requests;
+  std::vector<BitGroup> bit_groups;
 };
 struct Response {
   uint8_t type = 0;
@@ -62,6 +67,8 @@ struct ResponseList {
   int64_t reshape_knob = 0;
   int64_t reshape_cache_capacity = 0;
   int64_t reshape_compression_min_bytes = 0;
+  bool steady_present = false;
+  std::vector<uint32_t> steady_pattern;
 };
 }
 """
@@ -72,10 +79,13 @@ namespace hvdtpu {
 std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
   w.U8(rl.shutdown); w.U32(rl.requests.size());
   for (const auto& r : rl.requests) { w.I32(r.rank); w.Str(r.name); }
+  for (const auto& g : rl.bit_groups) { w.U32(g.slot); w.I32(g.ranks[0]); }
 }
 bool ParseRequestList(const std::vector<uint8_t>& buf, RequestList* rl) {
   rl->shutdown = rd.U8(); rl->requests.clear();
   { r.rank = rd.I32(); r.name = rd.Str(); }
+  rl->bit_groups.clear();
+  { g.slot = rd.U32(); g.ranks.push_back(rd.I32()); }
 }
 std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   w.U8(rl.shutdown);
@@ -83,6 +93,8 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   w.U8(rl.tuned_present); w.I64(rl.tuned_knob); w.I64(rl.reshape_knob);
   w.I64(rl.reshape_cache_capacity);
   w.I64(rl.reshape_compression_min_bytes);
+  w.U8(rl.steady_present);
+  for (uint32_t s : rl.steady_pattern) w.U32(s);
 }
 bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
   rl->shutdown = rd.U8();
@@ -91,6 +103,8 @@ bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
   rl->reshape_knob = rd.I64();
   rl->reshape_cache_capacity = rd.I64();
   rl->reshape_compression_min_bytes = rd.I64();
+  rl->steady_present = rd.U8();
+  { rl->steady_pattern.push_back(rd.U32()); }
 }
 }
 """
@@ -118,6 +132,29 @@ def test_wire_field_missing_from_serialize(tmp_path):
     source = _WIRE_CC.replace("w.Str(r.name);", "")
     violations = wire_check.check(_wire_tree(tmp_path, source=source))
     assert any("Request.name" in v.message and "serialize" in v.message
+               for v in violations), violations
+
+
+def test_wire_steady_field_missing_from_parse(tmp_path):
+    """PR-13 satellite: the STEADY broadcast fields are roundtrip-checked
+    like every other wire field — a steady_pattern dropped from the parse
+    side would silently truncate the pattern and desynchronize the
+    self-clocked replay."""
+    source = _WIRE_CC.replace("{ rl->steady_pattern.push_back(rd.U32()); }",
+                              "")
+    violations = wire_check.check(_wire_tree(tmp_path, source=source))
+    assert any("ResponseList.steady_pattern" in v.message
+               and "parse" in v.message for v in violations), violations
+
+
+def test_wire_bitgroup_field_missing_from_serialize(tmp_path):
+    """PR-13 satellite: the coordinator-tree aggregate's BitGroup rides
+    the RequestList codec and its fields are coverage-checked — a
+    dropped `ranks` vector would strip the per-rank announce attribution
+    the straggler report depends on."""
+    source = _WIRE_CC.replace("w.I32(g.ranks[0]);", "")
+    violations = wire_check.check(_wire_tree(tmp_path, source=source))
+    assert any("BitGroup.ranks" in v.message and "serialize" in v.message
                for v in violations), violations
 
 

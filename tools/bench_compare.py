@@ -70,14 +70,19 @@ def _numeric(value) -> Optional[float]:
 # Sign convention for extras: every headline metric in this repo is a
 # throughput (higher is better), but some extras are the opposite — a
 # time-unit token marks latencies (`ttft_p99_ms`,
-# `negotiation_p50_us_cached`), and a `bytes` / `inflation` token marks
+# `negotiation_p50_us_cached`), a `bytes` / `inflation` token marks
 # wire-byte counters (`bf16_wire_bytes`, `half_wire_inflation` — the
-# compression bench, docs/performance.md#wire-compression): growth past
-# the threshold is the regression, not shrinkage.  A unit preceded by
-# "per" is a rate (`ops_per_sec`, `bytes_per_sec`), which stays
-# higher-is-better.
+# compression bench, docs/performance.md#wire-compression), and a
+# `frames` token marks control-plane frame counts
+# (`steady_frames_delta` — the negotiation_scale bench's
+# zero-frames-per-steady-cycle contract,
+# docs/performance.md#control-plane-scaling): growth past the threshold
+# is the regression, not shrinkage.  The scale bench's `_inflation`
+# ratios (`steady_scale_inflation` — the flat-in-ranks acceptance bar)
+# gate the same way.  A unit preceded by "per" is a rate (`ops_per_sec`,
+# `bytes_per_sec`), which stays higher-is-better.
 LOWER_IS_BETTER_TOKENS = frozenset(
-    ("ms", "us", "sec", "seconds", "bytes", "inflation"))
+    ("ms", "us", "sec", "seconds", "bytes", "inflation", "frames"))
 
 
 def lower_is_better(name: str) -> bool:

@@ -59,6 +59,13 @@ SECTION_FAMILIES = {
                  "hvd_tpu_topology_cross_algo_threshold_bytes",
                  "hvd_tpu_topology_cross_ops_total",
                  "hvd_tpu_topology_bytes_total"),
+    "control": ("hvd_tpu_control_tree_depth",
+                "hvd_tpu_control_children",
+                "hvd_tpu_control_steady_active",
+                "hvd_tpu_control_steady_cycles_total",
+                "hvd_tpu_control_steady_transitions_total",
+                "hvd_tpu_control_negotiated_ticks_total",
+                "hvd_tpu_control_frames_total"),
     "state": ("hvd_tpu_state_armed",
               "hvd_tpu_state_snapshots_total",
               "hvd_tpu_state_snapshot_bytes_total",
@@ -118,6 +125,12 @@ def populated_registry():
                       "cross_algo_threshold": 64 << 10,
                       "cross_ops": {"ring": 3, "tree": 1},
                       "bytes": {"local": 4096, "cross": 1024}})
+    reg.set_control({"tree": True, "depth": 2, "children": 3, "hosts": 2,
+                     "steady": {"active": True, "pattern_len": 4,
+                                "threshold": 32, "entries": 1, "exits": 0,
+                                "replays": 40, "cycles": 10},
+                     "negotiated_ticks": 12,
+                     "frames": {"sent": 24, "received": 24}})
     reg.set_compression({
         "mode": "bf16", "min_bytes": 1024,
         "planes": {"engine": {"wire_bytes": 512, "payload_bytes": 1024,

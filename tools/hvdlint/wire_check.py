@@ -36,6 +36,11 @@ WIRE_CC = os.path.join("horovod_tpu", "engine", "cc", "wire.cc")
 STRUCT_FUNCS = {
     "Request": ("SerializeRequestList", "ParseRequestList"),
     "RequestList": ("SerializeRequestList", "ParseRequestList"),
+    # The coordinator-tree aggregate's per-slot bit groups ride inside
+    # the RequestList codec (PR-13); a BitGroup field dropped from either
+    # side would silently desynchronize rank 0's per-rank announce
+    # accounting.
+    "BitGroup": ("SerializeRequestList", "ParseRequestList"),
     "Response": ("SerializeResponseList", "ParseResponseList"),
     "ResponseList": ("SerializeResponseList", "ParseResponseList"),
 }

@@ -236,6 +236,36 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
             f"wire local {_fmt_bytes(tbytes.get('local', 0))}, cross "
             f"{_fmt_bytes(tbytes.get('cross', 0))}")
 
+    # Control plane (docs/performance.md#control-plane-scaling); only
+    # rendered when the job ran the coordinator tree or entered the
+    # decentralized steady state, so plain star dumps stay unchanged.
+    # Frame/cycle counters diff in two-file mode; the shape stays
+    # absolute.
+    ctrl = snap.get("control", {})
+    steady = ctrl.get("steady", {})
+    if ctrl.get("tree") or steady.get("entries") or steady.get("cycles"):
+        frames = dict(ctrl.get("frames", {}))
+        cycles = steady.get("cycles", 0)
+        negotiated = ctrl.get("negotiated_ticks", 0)
+        if base:
+            b = base.get("control", {})
+            for d in frames:
+                frames[d] -= b.get("frames", {}).get(d, 0)
+            cycles -= b.get("steady", {}).get("cycles", 0)
+            negotiated -= b.get("negotiated_ticks", 0)
+        lines.append("== control ==")
+        lines.append(
+            f"{'tree depth 2' if ctrl.get('tree') else 'star'}, "
+            f"{ctrl.get('hosts', 1)} host(s), fan-in "
+            f"{ctrl.get('children', 0)}; steady "
+            f"{'ACTIVE' if steady.get('active') else 'off'} "
+            f"(pattern {steady.get('pattern_len', 0)}, threshold "
+            f"{steady.get('threshold', 0)}), cycles {cycles} steady / "
+            f"{negotiated} negotiated, entries "
+            f"{steady.get('entries', 0)} / exits {steady.get('exits', 0)}; "
+            f"frames sent {frames.get('sent', 0)}, received "
+            f"{frames.get('received', 0)}")
+
     # Elastic membership (docs/fault-tolerance.md#elastic-membership);
     # only rendered once the job reshaped, so pre-elastic dumps stay
     # unchanged.
