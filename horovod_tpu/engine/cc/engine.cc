@@ -667,12 +667,14 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   ctrl_children_.store(0);
   ctrl_hosts_.store(1);
   if (opts_.elastic || opts_.rejoin) {
-    // Elastic jobs keep the star and the per-tick cache path: membership
-    // reshapes rebuild only the star, and a coordinator-initiated
-    // reshape barrier cannot interrupt ranks that are self-clocking with
-    // their control sockets dark.
+    // Elastic jobs keep the one-level star: membership reshapes rebuild
+    // only the star, never a tree.  Steady state STAYS available
+    // (hvdmodel's reshape-mid-steady interleavings pinned the protocol):
+    // a barrier arming mid-steady is broadcast as a revocation first —
+    // self-clocking ranks poll their parent socket every pass and treat
+    // any payload broadcast as a revocation — and the barrier then
+    // fires on the next regular tick (MaybeRevokeSteadyForReshape).
     opts_.coord_tree = false;
-    opts_.steady_threshold = 0;
   }
   {
     std::lock_guard<std::mutex> lk(coord_info_mu_);
@@ -973,10 +975,35 @@ bool Engine::SetupSockets(std::string* err) {
         return false;
       }
     } else {
-      if (!SendAll(coord_fd_, mine, sizeof mine) ||
-          !RecvAll(coord_fd_, reply, sizeof reply)) {
-        *err = "topology agreement exchange failed";
-        return false;
+      // A fresh init can race a PREVIOUS engine's teardown on rank 0
+      // (shutdown -> re-init, e.g. the compression convergence test's
+      // back-to-back jobs): a running non-elastic coordinator never
+      // accepts on its control listener, so this worker's connect can
+      // land in the OLD listener's kernel backlog — the hello and the
+      // agreement report buffer fine — and die with an RST only when
+      // rank 0 finally tears down, while rank 0's NEW init waits for a
+      // hello that will never arrive and the job deadlocks until the
+      // accept timeout.  The handshake therefore retries WHOLE
+      // (reconnect + hello + agreement) until the deadline: a reply can
+      // only come from a live init, because replying requires accept().
+      auto hs_deadline = std::chrono::steady_clock::now() +
+                         std::chrono::duration<double>(kTimeout);
+      while (!SendAll(coord_fd_, mine, sizeof mine) ||
+             !RecvAll(coord_fd_, reply, sizeof reply)) {
+        CloseFd(coord_fd_);
+        coord_fd_ = -1;
+        double left =
+            std::chrono::duration<double>(
+                hs_deadline - std::chrono::steady_clock::now())
+                .count();
+        if (left <= 0.0) {
+          *err = "topology agreement exchange failed";
+          return false;
+        }
+        coord_fd_ = ConnectRetry(host, port, left, err);
+        if (coord_fd_ < 0) return false;
+        uint32_t my_rank = static_cast<uint32_t>(opts_.rank);
+        if (!SendAll(coord_fd_, &my_rank, 4)) continue;
       }
       if (reply[2] != 0) {
         *err = "HVD_TPU_COMPRESSION mismatch: the ranks disagree on the "
@@ -1554,6 +1581,9 @@ bool Engine::RunLoopOnce() {
 
   RequestList my_requests;
   my_requests.shutdown = shut_down_.load();
+  // Frames are epoch-stamped so the coordinator can reject one built
+  // against a previous membership (wire.h RequestList.membership_epoch).
+  my_requests.membership_epoch = membership_epoch_.load();
   if (steady_exit_pending_) {
     // First frame after a steady exit carries the fallback marker (and
     // the miss position, for postmortem dumps): rank 0 resumes
@@ -1561,6 +1591,7 @@ bool Engine::RunLoopOnce() {
     my_requests.steady_exit = 1;
     my_requests.steady_epoch = steady_exit_epoch_;
     my_requests.steady_pos = steady_exit_pos_;
+    // hvdlint: lockstep-ok(one-shot send latch set by ExitSteadyLocal)
     steady_exit_pending_ = false;
   }
   {
@@ -1603,6 +1634,11 @@ bool Engine::RunLoopOnce() {
       // handles) until every rank has fallen back, or ranks still
       // replaying would double-execute the ops a broadcast list carries.
       if (!CoordinatorSteadyPoll()) return false;
+      {
+        int rv = MaybeRevokeSteadyForReshape();
+        if (rv < 0) return false;
+        if (rv > 0) return true;  // revoked: next pass is a normal tick
+      }
       if (!AllSteadyExited()) {
         UpdateCoordPendingInfo();
         std::this_thread::sleep_for(std::chrono::microseconds(200));
@@ -1695,6 +1731,7 @@ bool Engine::RunLoopOnce() {
           my_requests.steady_exit || my_requests.shutdown) {
         RequestList agg;
         SlotIndex idx;
+        agg.membership_epoch = membership_epoch_.load();
         MergeFrameIntoAggregate(my_requests, opts_.rank,
                                 EpochNowUs() - clock_offset_us_.load(),
                                 &agg, &idx);
@@ -1716,6 +1753,7 @@ bool Engine::RunLoopOnce() {
     // children's participation).
     RequestList agg;
     SlotIndex idx;
+    agg.membership_epoch = membership_epoch_.load();
     MergeFrameIntoAggregate(my_requests, opts_.rank,
                             EpochNowUs() - clock_offset_us_.load(), &agg,
                             &idx);
@@ -1954,8 +1992,10 @@ void Engine::CoordinatorMaybeSteady(ResponseList* out) {
   while (coord_->slot_history.size() > cap) coord_->slot_history.pop_front();
   // Eligibility: a quiesced cycle boundary with every lockstep mutation
   // source at rest.  The autotune search must be frozen (a tuned-param
-  // broadcast cannot reach ranks whose control sockets are dark), and
-  // elastic jobs never arm (Init zeroed the threshold).
+  // broadcast cannot reach ranks whose control sockets are dark); an
+  // elastic job may arm too — a barrier arming mid-steady goes out as a
+  // revocation broadcast first (MaybeRevokeSteadyForReshape), so dark
+  // sockets never strand a reshape.
   if (coord_->steady || opts_.size <= 1 || !cache_.enabled() ||
       tuner_.active() || !coord_->message_table.empty() ||
       !coord_->cache_pending.empty() ||
@@ -2136,6 +2176,57 @@ bool Engine::CoordinatorSteadyPoll() {
   return true;
 }
 
+int Engine::MaybeRevokeSteadyForReshape() {
+  if (!opts_.elastic || !coord_ || !coord_->steady) return 0;
+  if (coord_->abort_code != 0 || coord_->shutdown_requested) return 0;
+  // The normal loop's joiner accept never runs while rank 0 is steady,
+  // so drain the listen backlog here — non-blocking — or a standby
+  // registering mid-steady would sit unseen until some rank missed.
+  CoordinatorAcceptJoiners();
+  // Shrink: a death just armed the barrier.  Grow: a standby is waiting
+  // and steady state means the control plane is quiesced by construction
+  // — no normal tick is coming to host the barrier, so without this the
+  // admission would starve until some rank happens to miss.
+  if (!coord_->reshape_pending && coord_->pending_join_fds.empty())
+    return 0;
+  // Broadcast a bare revocation list (no ops, no hits, no reshape):
+  // ranks still self-clocking poll their parent socket every pass and
+  // treat any payload broadcast as a revocation; ranks that already fell
+  // back are blocked on a response and consume it as an empty tick.  The
+  // barrier itself then fires on the NEXT regular tick through
+  // CoordinatorMaybeReshape, which re-establishes the one-frame-per-
+  // child alternation the barrier broadcast relies on (a barrier sent
+  // directly from here could cross an in-flight fallback frame; the
+  // epoch stamp on RequestList is the backstop for exactly that race).
+  ResponseList out;
+  out.steady_revoke = true;
+  std::vector<uint8_t> bytes = SerializeResponseList(out);
+  for (int r : coord_children_) {
+    if (coord_->rank_dead[r] || coord_fds_[r] < 0) continue;
+    if (SendFrame(coord_fds_[r], bytes)) ctrl_frames_sent_.fetch_add(1);
+  }
+  coord_->steady = false;
+  coord_->steady_revoke_next = false;
+  coord_->slot_history.clear();
+  if (steady_active_.load()) ExitSteadyLocal("reshape-revoke");
+  if (!steady_pending_reqs_.empty()) {
+    // Requeue the drained-but-unreplayed partial group: its handles are
+    // still in table_, and a dropped announce would strand them forever
+    // (hvdmodel's no-deadlock invariant over the bare drop).
+    std::lock_guard<std::mutex> lk(mu_);
+    for (size_t i = steady_pending_reqs_.size(); i-- > 0;)
+      queue_.push_front(std::move(steady_pending_reqs_[i]));
+    steady_pending_reqs_.clear();
+    steady_pending_group_.clear();
+  }
+  if (flight_.Enabled())
+    flight_.Record(FL_STEADY, "reshape-revoke", steady_epoch_);
+  RequestList none;
+  return ProcessResponseList(out, none, std::chrono::steady_clock::now())
+             ? 1
+             : -1;
+}
+
 bool Engine::SubRelayPass() {
   // Sub-coordinator while steady (or holding): poll children for
   // fallback frames and forward them upward; poll the parent for
@@ -2143,6 +2234,7 @@ bool Engine::SubRelayPass() {
   // self-clocking are silent by design.
   RequestList agg;
   SlotIndex idx;
+  agg.membership_epoch = membership_epoch_.load();
   for (size_t i = 0; i < tree_child_fds_.size(); ++i) {
     if (tree_child_dead_[i]) continue;
     int fd = tree_child_fds_[i];
@@ -2211,8 +2303,18 @@ bool Engine::SubRelayPass() {
       return false;
     }
     // The resume broadcast (or, defensively, any payload list): leave
-    // steady/holding and process it like a normal tick.
+    // steady/holding and process it like a normal tick.  Requeue any
+    // drained-but-unreplayed partial group first — a mid-steady reshape
+    // revocation legitimately lands here with one pending, and the bare
+    // drop stranded those handles forever (hvdmodel caught it).
     if (steady_active_.load()) ExitSteadyLocal("broadcast-resumed");
+    if (!steady_pending_reqs_.empty()) {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (size_t i = steady_pending_reqs_.size(); i-- > 0;)
+        queue_.push_front(std::move(steady_pending_reqs_[i]));
+      steady_pending_reqs_.clear();
+      steady_pending_group_.clear();
+    }
     sub_holding_ = false;
     RequestList none;
     return ProcessResponseList(rl, none, std::chrono::steady_clock::now());
@@ -2237,6 +2339,9 @@ bool Engine::SteadyLoopOnce() {
   steady_last_poll_ = duty_now;
   if (opts_.rank == 0) {
     if (!CoordinatorSteadyPoll()) return false;
+    int rv = MaybeRevokeSteadyForReshape();
+    if (rv < 0) return false;
+    if (rv > 0) return true;  // revoked: next pass is a normal tick
   } else {
     if (is_sub_coord_) {
       if (!SubRelayPass()) return false;
@@ -2266,7 +2371,17 @@ bool Engine::SteadyLoopOnce() {
           return false;
         }
         // Defensively treat any payload broadcast as a revocation.
+        // Requeue any drained-but-unreplayed partial group first — a
+        // mid-steady reshape revocation legitimately lands here with one
+        // pending, and the bare drop stranded those handles forever.
         ExitSteadyLocal("broadcast-resumed");
+        if (!steady_pending_reqs_.empty()) {
+          std::lock_guard<std::mutex> lk(mu_);
+          for (size_t i = steady_pending_reqs_.size(); i-- > 0;)
+            queue_.push_front(std::move(steady_pending_reqs_[i]));
+          steady_pending_reqs_.clear();
+          steady_pending_group_.clear();
+        }
         RequestList none;
         return ProcessResponseList(rl, none,
                                    std::chrono::steady_clock::now());
@@ -2369,9 +2484,15 @@ bool Engine::SteadyLoopOnce() {
           steady_pattern_.begin() + (steady_pos_ -
                                      steady_pending_group_.size()),
           steady_pattern_.begin() + steady_pos_);
-      ProcessCacheHits(canonical);
+      // Count the group BEFORE executing it: CompleteEntry inside
+      // ProcessCacheHits wakes data-plane waiters, and a metrics
+      // snapshot taken the instant wait() returns must already include
+      // the group (and cycle) that completed it.
       steady_replays_.fetch_add(
           static_cast<int64_t>(steady_pending_group_.size()));
+      if (steady_group_idx_ + 1 == steady_groups_.size())
+        steady_cycles_.fetch_add(1);
+      ProcessCacheHits(canonical);
       steady_pending_group_.clear();
       steady_pending_reqs_.clear();
       ++steady_group_idx_;
@@ -2385,7 +2506,6 @@ bool Engine::SteadyLoopOnce() {
         steady_group_idx_ = 0;
         steady_pos_ = 0;
         ++steady_epoch_;
-        steady_cycles_.fetch_add(1);
         ticks_done_.fetch_add(1);
         timeline_.Instant("steady", "STEADY_EPOCH");
       }
@@ -2462,6 +2582,19 @@ static std::string BaseName(const std::string& name) {
 }
 
 void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
+  if (rl.membership_epoch < membership_epoch_.load()) {
+    // Stale-epoch frame: built against a membership a reshape barrier
+    // already replaced (possible only around a mid-steady revocation,
+    // which breaks the send-one-wait-one alternation).  Its cache bits
+    // name slots the barrier cleared and its announces would pollute the
+    // new membership's table, so the whole frame is dropped — the sender
+    // re-announces everything after its own ApplyReshape anyway
+    // (hvdmodel invariant: no stale-epoch frame is ever accepted).
+    if (flight_.Enabled())
+      flight_.Record(FL_RESHAPE, "stale-frame:" + std::to_string(from_rank),
+                     rl.membership_epoch);
+    return;
+  }
   int64_t now_us = EpochNowUs();
   bool have_ts = rl.announce_us.size() == rl.requests.size();
   for (size_t i = 0; i < rl.requests.size(); ++i) {
@@ -3754,6 +3887,11 @@ bool Engine::ApplyReshape(const ResponseList& rl) {
   residual_tensors_.store(0);
   autotune_frozen_.store(false);
   applied_window_.store(0);
+  // A stale steady-exit marker must not cross the barrier: it would
+  // report miss coordinates in a pattern whose slots the cache clear
+  // above just renumbered.  (Steady replay itself cannot be active here
+  // — every path into ApplyReshape exits steady first.)
+  steady_exit_pending_ = false;
   {
     std::lock_guard<std::mutex> lk(autotune_mu_);
     applied_log_.clear();
@@ -3817,6 +3955,13 @@ bool Engine::ApplyReshape(const ResponseList& rl) {
     coord_->ready.clear();
     coord_->cache_pending.clear();
     coord_->cached_ready.clear();
+    // Steady-state bookkeeping resets with the membership: the old
+    // pattern named cache slots the clear above renumbered, and the
+    // exit-barrier accounting must match the new size.
+    coord_->steady = false;
+    coord_->steady_revoke_next = false;
+    coord_->steady_exited.assign(new_size, false);
+    coord_->slot_history.clear();
     // Reshapes force the flat ring, so the cross-algo axis pins (the
     // knob is dead in the new membership).
     tuner_.Configure(opts_.autotune, opts_.autotune_warmup,

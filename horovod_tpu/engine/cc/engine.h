@@ -586,6 +586,15 @@ class Engine {
   // escalate deadline breaches, and broadcast an armed abort
   // immediately.  Returns false when the loop must exit.
   bool CoordinatorSteadyPoll();
+  // Rank 0, steady/holding mode, elastic only: when a death armed the
+  // reshape barrier (or a standby is waiting) while ranks self-clock
+  // with the control plane dark, broadcast an empty revocation list —
+  // self-clocking ranks treat any payload broadcast as a revocation —
+  // and fall back to the normal loop so the barrier fires on the next
+  // regular tick through the tested CoordinatorMaybeReshape path.
+  // Returns 0 (nothing to do), 1 (revoked; end this steady pass), or
+  // -1 (fatal; exit the loop).
+  int MaybeRevokeSteadyForReshape();
   // Sub-coordinator, steady/holding mode: forward children's fallback
   // frames upward as aggregates and relay any parent broadcast down.
   // Returns false when the loop must exit.

@@ -152,6 +152,7 @@ std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
   w.U8(rl.steady_exit);
   w.I64(rl.steady_epoch);
   w.I64(rl.steady_pos);
+  w.I64(rl.membership_epoch);
   return std::move(w.buf);
 }
 
@@ -207,6 +208,7 @@ bool ParseRequestList(const std::vector<uint8_t>& buf, RequestList* rl) {
   rl->steady_exit = rd.U8();
   rl->steady_epoch = rd.I64();
   rl->steady_pos = rd.I64();
+  rl->membership_epoch = rd.I64();
   return rd.ok;
 }
 

@@ -134,6 +134,14 @@ struct RequestList {
   uint8_t steady_exit = 0;
   int64_t steady_epoch = 0;
   int64_t steady_pos = 0;
+  // Elastic membership epoch this frame was built against
+  // (docs/fault-tolerance.md#elastic-membership).  A mid-steady reshape
+  // revocation breaks the strict send-one-wait-one alternation, so a
+  // fallback frame built before the barrier can arrive after it; the
+  // coordinator drops any frame whose epoch is older than its own
+  // (cache bits would name cleared slots, announces would double-count
+  // into the new membership's table).  Static jobs stay at 0 == 0.
+  int64_t membership_epoch = 0;
 };
 
 enum ResponseType : uint8_t {

@@ -374,6 +374,8 @@ def test_crash_mid_cached_steady_state_aborts():
             (r, by_rank[r].returncode, by_rank[r].stderr[-800:])
 
 
+@pytest.mark.slow  # ~13s (sleeps through the deadline sweep); the sweep
+# itself stays tier-1 via the message_table timeout tests
 def test_cached_negotiation_hits_collective_timeout():
     """A cache-bit announcement that never reaches full count (one rank
     stops submitting) trips the HVD_TPU_COLLECTIVE_TIMEOUT_SEC sweep with

@@ -319,6 +319,138 @@ def test_steady_crash_aborts_typed():
             (r, by_rank[r].returncode, by_rank[r].stderr[-800:])
 
 
+# Elastic x steady: the revocation protocol (engine.cc
+# MaybeRevokeSteadyForReshape, model-checked by tools/hvdmodel's
+# quick-elastic / quick-revoke-only configs).  One re-enterable training
+# script with a FIXED tensor name so every negotiation cycle is
+# identical and the job actually arms steady state mid-run.
+_STEADY_ELASTIC_TRAIN = """\
+import os, sys, time
+import numpy as np
+import horovod_tpu as hvd
+
+TOTAL = int(sys.argv[1])
+PAUSE = float(os.environ.get("TEST_STEP_PAUSE") or 0)
+hvd.init()
+state = hvd.ElasticState(weights=np.zeros(8, np.float32), step=0)
+saw_steady_epoch0 = False
+
+def train(state):
+    global saw_steady_epoch0
+    while state.step < TOTAL:
+        g = np.ones(8, np.float32)
+        state.weights = state.weights + hvd.allreduce(
+            g, average=True, name="se.g")
+        state.step += 1
+        snap = hvd.metrics_snapshot()
+        if (snap["membership"]["epoch"] == 0
+                and snap["control"]["steady"]["active"]):
+            saw_steady_epoch0 = True
+        if PAUSE:
+            time.sleep(PAUSE)
+    return state.weights
+
+w = hvd.run_elastic(train, state)
+assert np.allclose(w, float(TOTAL)), (hvd.rank(), w)
+snap = hvd.metrics_snapshot()
+c, m = snap["control"]["steady"], snap["membership"]
+print("STEADYX", hvd.rank(), hvd.size(), m["epoch"], c["entries"],
+      c["exits"], int(saw_steady_epoch0), int(w[0]), flush=True)
+"""
+
+
+def _steadyx(results):
+    """[(rank, size, epoch, entries, exits, saw_steady_epoch0, w0)] from
+    every clean rank's STEADYX line."""
+    out = []
+    for r in results:
+        if r.returncode != 0:
+            continue
+        for line in r.stdout.splitlines():
+            if line.startswith("STEADYX "):
+                out.append(tuple(int(t) for t in line.split()[1:]))
+    return out
+
+
+def test_steady_elastic_crash_revokes_and_renegotiates(tmp_path):
+    """A crash MID-STEADY on an elastic 4-rank job: rank 0 revokes the
+    armed pattern (bare broadcast, no waiting on the dark control
+    plane), every survivor exits steady and falls back to negotiation,
+    the reshape admits the 3-survivor membership, and steady re-arms
+    from tick one under the new membership — the job completes instead
+    of aborting, which is the whole point of steady x elastic."""
+    from horovod_tpu.common.faults import CRASH_EXIT_CODE
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "train.py"
+    script.write_text(_STEADY_ELASTIC_TRAIN)
+    results = run_membership(
+        [sys.executable, str(script), "48"], 4, min_np=2, max_np=4,
+        max_rejoins=0,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=2:crash@op=16",
+                 HVD_TPU_STEADY_THRESHOLD="3",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20"),
+        timeout=120.0, capture=True, report=lambda msg: None)
+    by_slot = {r.rank: r for r in results}
+    assert by_slot[2].returncode == CRASH_EXIT_CODE, by_slot[2]
+    for slot in (0, 1, 3):
+        assert by_slot[slot].returncode == 0, \
+            (slot, by_slot[slot].returncode, by_slot[slot].stderr[-800:])
+    assert membership_succeeded(results, 2)
+    members = _steadyx(results)
+    assert len(members) == 3, (members, results)
+    for rank, size, epoch, entries, exits, saw0, w0 in members:
+        assert size == 3 and epoch >= 1, members
+        assert w0 == 48, members
+        # Pattern armed before the crash (epoch 0) on every survivor...
+        assert saw0 == 1, members
+        # ...then revoked (an exit) and re-negotiated from scratch under
+        # the new membership (a second entry: the history reset means it
+        # took `threshold` fresh identical cycles to re-arm).
+        assert exits >= 1, members
+        assert entries >= 2, members
+
+
+@pytest.mark.slow
+def test_steady_elastic_standby_grow_mid_steady(tmp_path):
+    """Standby admission MID-STEADY: after the shrink the lone survivor
+    re-arms its pattern; the standby's registration is a join pending
+    against a dark control plane, so rank 0 revokes, negotiates the grow
+    barrier, and both members finish with identical weights.  Exercises
+    the join arm of MaybeRevokeSteadyForReshape (the crash test above
+    exercises the death arm; the join arm's model-level twin runs every
+    tier-1 pass inside `python -m tools.hvdmodel --quick`)."""
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "train.py"
+    script.write_text(_STEADY_ELASTIC_TRAIN)
+    results = run_membership(
+        [sys.executable, str(script), "60"], 2, min_np=1, max_np=2,
+        rejoin_delay=0.3,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=1:crash@op=10",
+                 HVD_TPU_STEADY_THRESHOLD="2",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20",
+                 TEST_STEP_PAUSE="0.05"),
+        timeout=120.0, capture=True, report=lambda msg: None)
+    assert membership_succeeded(results, 1), \
+        [(r.rank, r.returncode, r.stderr[-400:]) for r in results]
+    by_slot = {r.rank: r for r in results}
+    assert 2 in by_slot and by_slot[2].returncode == 0, \
+        by_slot.get(2) and by_slot[2].stderr[-800:]
+    members = _steadyx(results)
+    assert len(members) == 2, (members, results)
+    survivor = next(m for m in members if m[0] == 0)
+    rank, size, epoch, entries, exits, saw0, w0 = survivor
+    assert size == 2, members
+    assert epoch == 2, members          # shrink, then grow
+    # The survivor armed steady at least once and every arm that a
+    # reshape interrupted was revoked cleanly (exits pair with entries
+    # except a final still-active pattern).
+    assert entries >= 1 and exits >= 1, members
+    for m in members:
+        assert m[6] == 60, members      # both trained to the end
+
+
 @distributed_test(np_=4)
 def test_steady_under_tree_with_flight_events():
     """Tree + steady compose: a 2-node layout enters steady, replays

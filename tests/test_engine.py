@@ -474,3 +474,24 @@ def test_leader_death_mid_hierarchical_aborts_all():
         hvd.allreduce(np.zeros(4, np.float32), name="hier_sweep")
     with pytest.raises(HorovodInternalError):
         hvd.allgather(np.zeros((1, 2), np.float32), name="hier_after")
+
+
+@distributed_test(np_=2)
+def test_reinit_races_previous_teardown():
+    """Back-to-back shutdown -> init cycles with NO pause: a worker's
+    reconnect can land in the PREVIOUS engine's listen backlog on rank 0
+    (a running non-elastic coordinator never accepts on its control
+    listener), where the hello buffers fine and dies with an RST only at
+    teardown — while the new init on rank 0 waits for a hello that will
+    never arrive.  The init handshake must retry whole (reconnect +
+    hello + agreement) instead of failing the job; before that fix this
+    loop deadlocked roughly every other run."""
+    hvd = _init()
+    for cycle in range(4):
+        r, n = hvd.rank(), hvd.size()
+        out = hvd.allreduce(np.full(64, float(r + 1), np.float32),
+                            average=False, name=f"reinit.{cycle}")
+        assert abs(out[0] - n * (n + 1) / 2.0) < 1e-5, out[0]
+        hvd.shutdown()
+        hvd.init()
+    hvd.shutdown()

@@ -37,6 +37,8 @@ def test_pytorch_mnist_example():
     assert "Test set:" in out
 
 
+@pytest.mark.slow  # ~16s; the TF binding keeps tier-1 coverage in
+# test_tensorflow.py and the example surface in test_pytorch_mnist
 def test_tensorflow_mnist_example():
     out = _run_example("tensorflow_mnist.py",
                        ["--steps", "12", "--train-samples", "256"])
@@ -70,6 +72,8 @@ def test_jax_mnist_example():
     assert "test accuracy" in out.stdout
 
 
+@pytest.mark.slow  # ~11s; the sparse/IndexedSlices path keeps tier-1
+# coverage in test_tensorflow.py (v1 sparse gradients)
 def test_word2vec_example_sparse_path():
     out = _run_example("tensorflow_word2vec.py",
                        ["--steps", "20", "--corpus-words", "2000"])

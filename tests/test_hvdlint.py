@@ -483,7 +483,7 @@ def _scratch_copy(tmp_path):
     return root
 
 
-_TEXT_CHECKERS = ["wire", "env", "capi", "lockstep", "errors"]
+_TEXT_CHECKERS = ["wire", "env", "capi", "lockstep", "errors", "model"]
 
 
 def test_real_tree_copy_is_clean(tmp_path):
@@ -594,3 +594,58 @@ def test_sanitize_lib_paths_and_flags():
     # cached build.
     assert build_mod._stamp_path("thread") != build_mod._stamp_path("")
     assert build_mod._build_stamp("thread") != build_mod._build_stamp("")
+
+
+# ---------------------------------------------------------------------------
+# model: hvdmodel <-> wire.h protocol sync (checker 7).
+# ---------------------------------------------------------------------------
+
+
+def test_model_checker_flags_uncovered_wire_field(tmp_path):
+    """Adding a protocol-family field to wire.h without teaching the
+    model about it must fail at the introducing PR — the model would
+    otherwise keep verifying a stale protocol."""
+    root = _scratch_copy(tmp_path)
+    wire_h = os.path.join(root, "horovod_tpu", "engine", "cc", "wire.h")
+    with open(wire_h) as f:
+        text = f.read()
+    anchor = "struct ResponseList {\n"
+    assert anchor in text
+    with open(wire_h, "w") as f:
+        f.write(text.replace(anchor,
+                             anchor + "  int64_t steady_bogus = 0;\n"))
+    violations = run(root, ["model"])
+    assert any("steady_bogus" in v.message for v in violations), violations
+
+
+def test_model_checker_flags_dropped_status_code(tmp_path):
+    """The other direction: a StatusCode the C++ still carries may not
+    vanish from the model's coverage declaration."""
+    root = _scratch_copy(tmp_path)
+    cov = os.path.join(root, "tools", "hvdmodel", "coverage.py")
+    with open(cov) as f:
+        text = f.read()
+    assert '"ST_RESHAPE",' in text
+    with open(cov, "w") as f:
+        f.write(text.replace('"ST_RESHAPE",', ""))
+    violations = run(root, ["model"])
+    assert any("ST_RESHAPE" in v.message for v in violations), violations
+
+
+def test_model_checker_flags_unreferenced_coverage_name(tmp_path):
+    """A name declared as covered must actually appear in the model
+    source — coverage.py cannot drift into aspirational documentation.
+    Renaming the model's only references to a field (without touching
+    the declaration or the C++) must be flagged."""
+    root = _scratch_copy(tmp_path)
+    base = os.path.join(root, "tools", "hvdmodel")
+    for fname in os.listdir(base):
+        if not fname.endswith(".py") or fname == "coverage.py":
+            continue
+        path = os.path.join(base, fname)
+        with open(path) as f:
+            text = f.read()
+        with open(path, "w") as f:
+            f.write(text.replace("dead_ranks", "defunct_ranks"))
+    violations = run(root, ["model"])
+    assert any("dead_ranks" in v.message for v in violations), violations

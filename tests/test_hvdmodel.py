@@ -1,0 +1,101 @@
+"""hvdmodel: the control-plane model checker (tools/hvdmodel).
+
+Three layers:
+
+* the tier-1 CLI contract: ``python -m tools.hvdmodel --quick`` explores
+  the three quick configs exhaustively (>= 50k states, < 60s), covers
+  every required protocol event, and exits 0 — so a protocol change that
+  deadlocks, diverges membership, or accepts a stale-epoch frame fails
+  the suite at the PR that introduces it;
+* the seeded historical bugs: each ``--bug`` variant re-introduces a
+  real protocol mistake (skipping the steady revocation before a
+  reshape, accepting stale-epoch frames, dropping the exit requeue) and
+  MUST be caught with a readable shortest-path trace — a checker that
+  passes everything would let the protocol drift silently;
+* in-process spot checks of the explorer API the CLI wraps.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.hvdmodel import configs, explorer  # noqa: E402
+
+
+def _run_cli(*args, timeout=120):
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    return subprocess.run(
+        [sys.executable, "-m", "tools.hvdmodel", *args],
+        capture_output=True, text=True, env=env, cwd=REPO,
+        timeout=timeout)
+
+
+def test_quick_is_clean_and_exhaustive():
+    proc = _run_cli("--quick")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.rstrip().endswith("OK"), proc.stdout
+    m = re.search(r"total: (\d+) states", proc.stdout)
+    assert m, proc.stdout
+    # The acceptance floor: the quick tier must stay a real exploration,
+    # not shrink into a smoke test as configs are tuned.
+    assert int(m.group(1)) >= 50000, proc.stdout
+    # No config may hit the state cap — quick is EXHAUSTIVE by contract.
+    assert "truncated" not in proc.stdout, proc.stdout
+    for event in ("steady_enter", "steady_exit", "reshape_shrink",
+                  "reshape_grow", "crash", "freeze", "stale_drop"):
+        assert event in proc.stdout, (event, proc.stdout)
+
+
+@pytest.mark.parametrize("bug", ["skip-revoke", "stale-epoch",
+                                 "no-requeue"])
+def test_seeded_bug_is_caught_with_trace(bug):
+    proc = _run_cli("--bug", bug)
+    assert proc.returncode == 1, (bug, proc.stdout, proc.stderr)
+    assert "VIOLATION" in proc.stdout, (bug, proc.stdout)
+    # Counterexamples render as file:line steps into the model source.
+    assert re.search(r"tools/hvdmodel/model\.py:\d+", proc.stdout), \
+        proc.stdout
+
+
+def test_unknown_bug_is_rejected():
+    proc = _run_cli("--bug", "made-up")
+    assert proc.returncode != 0
+    assert "made-up" in (proc.stdout + proc.stderr)
+
+
+def test_explorer_finds_shortest_deadlock_in_process():
+    """The skip-revoke seed runs under ``group_timeout=False`` (no
+    data-plane backstop): survivors stay self-clocked forever once the
+    revocation is skipped, and the BFS must report that as a deadlock
+    whose trace starts from the initial state."""
+    res = explorer.explore(configs.seeded("skip-revoke"),
+                           max_states=100000)
+    assert not res.ok
+    codes = {code for code, _, _ in res.violations}
+    assert "deadlock" in codes, res.violations
+    code, detail, trace = res.violations[0]
+    assert trace, "counterexample trace must be non-empty"
+    assert all(isinstance(line, int) and line > 0
+               for _, line in trace), trace
+
+
+def test_quick_configs_declare_distinct_regimes():
+    """quick() pins three regimes: the coordinator tree, the elastic
+    star, and the revoke-only liveness config (group_timeout disabled —
+    the revocation broadcast alone must keep survivors live)."""
+    cfgs = {c.name: c for c in configs.quick()}
+    assert set(cfgs) == {"quick-tree", "quick-elastic",
+                         "quick-revoke-only"}
+    assert not cfgs["quick-tree"].elastic
+    assert cfgs["quick-elastic"].elastic
+    assert cfgs["quick-revoke-only"].elastic
+    assert cfgs["quick-revoke-only"].group_timeout is False
+    assert cfgs["quick-tree"].group_timeout is True

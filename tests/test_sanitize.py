@@ -161,3 +161,59 @@ def test_asan_three_rank_smoke_clean():
             f"rank {r.rank} exited {r.returncode} under ASan\n"
             f"--- stderr ---\n{r.stderr[-8000:]}")
         assert "SANITIZED_OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_ubsan_three_rank_two_level_clean():
+    """UBSan variant: 3 single-rank nodes under the two-level
+    (hierarchical) allreduce with wire compression — the shift/index-
+    heavy bit packing in the compression codec and the cross-node
+    reduce-scatter offset math run with ``-fno-sanitize-recover=all``,
+    so ANY undefined behavior (signed overflow, misaligned load, bad
+    shift) aborts the rank and fails this test.  Zero ``runtime
+    error:`` reports allowed."""
+    preload = build_mod.sanitizer_preload("undefined")
+    if not preload:
+        pytest.skip("libubsan runtime not available on this toolchain")
+    with _sanitize_env("undefined"):
+        build_mod.build()
+    from horovod_tpu.runner import run_command
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = (
+        "import os\n"
+        "import numpy as np\n"
+        "rank = int(os.environ['HVD_TPU_RANK'])\n"
+        "os.environ['HVD_TPU_LOCAL_SIZE'] = '1'\n"
+        "os.environ['HVD_TPU_LOCAL_RANK'] = '0'\n"
+        "os.environ['HVD_TPU_HIERARCHICAL_ALLREDUCE'] = '1'\n"
+        "os.environ['HVD_TPU_COMPRESSION'] = 'bf16'\n"
+        "import horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "for step in range(12):\n"
+        "    out = hvd.allreduce(np.full(80000, float(rank + 1),\n"
+        "                                np.float32), average=False,\n"
+        "                        name=f'g.{step % 3}')\n"
+        "    assert abs(out[0] - 6.0) < 1e-2, out[0]\n"
+        "    hvd.allreduce(np.full(63, 2.0, np.float32),\n"
+        "                  name=f's.{step % 3}')\n"
+        "hvd.allgather(np.arange(rank + 1, dtype=np.int32), name='ag')\n"
+        "hvd.shutdown()\n"
+        "print('SANITIZED_OK')\n")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+        "HVD_TPU_SANITIZE": "undefined",
+        "LD_PRELOAD": preload,
+        "UBSAN_OPTIONS": "print_stacktrace=1",
+    })
+    results = run_command([sys.executable, "-c", child], 3, env=env,
+                          timeout=300, capture=True)
+    for r in results:
+        assert r.returncode == 0, (
+            f"rank {r.rank} exited {r.returncode} under UBSan\n"
+            f"--- stderr ---\n{r.stderr[-8000:]}")
+        assert "runtime error:" not in r.stderr, (
+            f"rank {r.rank} hit undefined behavior:\n{r.stderr[-8000:]}")
+        assert "SANITIZED_OK" in r.stdout

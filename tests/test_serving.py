@@ -440,6 +440,8 @@ def test_serve_smoke_single_process(single_process_hvd):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~22s; the 2-rank serving suite keeps the tenancy,
+# batching, and preemption contracts in tier-1
 @distributed_test(np_=4, timeout=300)
 def test_four_rank_two_tenant_acceptance():
     """The ISSUE acceptance core on 4 ranks: two tenants' overlapping

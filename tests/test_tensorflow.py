@@ -64,6 +64,8 @@ def test_tf_allgather_and_broadcast():
     assert np.all(out.numpy() == 6.0)
 
 
+@pytest.mark.slow  # ~10s; sparse allreduce keeps tier-1 coverage in
+# test_tf_v1_optimizer_sparse_gradients
 @distributed_test(np_=2, timeout=300)
 def test_tf_indexed_slices_allreduce():
     import tensorflow as tf
@@ -125,6 +127,8 @@ def test_tf_distributed_gradient_tape_matches_full_batch():
     assert np.allclose(grad.numpy(), want.numpy(), atol=1e-5), r
 
 
+@pytest.mark.slow  # ~17s; TF broadcast keeps tier-1 coverage in
+# test_tf_allgather_and_broadcast
 @distributed_test(np_=3, timeout=300)
 def test_tf_broadcast_variables():
     import tensorflow as tf
@@ -136,6 +140,8 @@ def test_tf_broadcast_variables():
     assert np.all(v.numpy() == 0.0)
 
 
+@pytest.mark.slow  # ~24s; the v1 graph path keeps tier-1 coverage in
+# test_tf_v1_optimizer_sparse_gradients
 @distributed_test(np_=3, timeout=300)
 def test_tf_v1_distributed_optimizer():
     import tensorflow as tf
@@ -205,6 +211,8 @@ def test_estimator_warm_start_without_model_dir():
     assert len(preds) == 4 and np.isclose(preds[0]["p"], 3.0), preds
 
 
+@pytest.mark.slow  # ~18s; the async-group tick contract keeps tier-1
+# coverage in test_torch_async_poll_synchronize + the engine suite
 @distributed_test(np_=3, timeout=300)
 def test_tf_async_group_completes_in_few_ticks():
     """VERDICT r2 #1: N small TF collectives issued as one
@@ -249,6 +257,8 @@ def test_tf_async_group_completes_in_few_ticks():
         assert np.allclose(out.numpy(), want), (i, out.numpy(), want)
 
 
+@pytest.mark.slow  # ~20s; fused v1 gradient groups keep tier-1 coverage
+# in test_tf_distributed_gradient_tape_matches_full_batch
 @distributed_test(np_=3, timeout=300)
 def test_tf_v1_optimizer_grads_fuse():
     """The v1 DistributedOptimizer's gradients ride ONE
@@ -287,6 +297,8 @@ def test_tf_v1_optimizer_grads_fuse():
     assert len(ticks) <= 2, f"optimizer grads spread over ticks {sorted(ticks)}"
 
 
+@pytest.mark.slow  # ~10s; first-order tape coverage stays tier-1 in
+# test_tf_distributed_gradient_tape_matches_full_batch
 @distributed_test(np_=2, timeout=300)
 def test_tf_tape_gradient_is_differentiable():
     """Differentiating THROUGH a DistributedGradientTape result (gradient

@@ -121,6 +121,8 @@ def test_torch_allgather_grad():
     assert torch.allclose(x.grad, torch.full((r + 1, 2), want)), x.grad
 
 
+@pytest.mark.slow  # ~12s; the same contract stays tier-1 in
+# test_torch_backward_passes_per_step_matches_fused_batch
 @distributed_test()
 def test_torch_distributed_optimizer_matches_full_batch():
     import torch
@@ -231,6 +233,8 @@ def test_torch_reentrant_backward_without_accumulation_raises():
         model(x).sum().backward()
 
 
+@pytest.mark.slow  # ~12s; broadcast sync stays tier-1 in
+# test_torch_broadcast + the optimizer-state resume-asymmetry test
 @distributed_test()
 def test_torch_broadcast_parameters_and_optimizer_state():
     import torch

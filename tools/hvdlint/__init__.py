@@ -107,7 +107,8 @@ def read(root: str, rel: str) -> str:
 def checkers() -> Dict[str, Callable[[str], List[Violation]]]:
     """Name -> check(root) for every registered checker, in report order."""
     from tools.hvdlint import (capi_check, env_check, errors_check,
-                               lockstep_check, metrics_check, wire_check)
+                               lockstep_check, metrics_check, model_check,
+                               wire_check)
 
     return {
         "wire": wire_check.check,
@@ -116,6 +117,7 @@ def checkers() -> Dict[str, Callable[[str], List[Violation]]]:
         "lockstep": lockstep_check.check,
         "errors": errors_check.check,
         "metrics": metrics_check.check,
+        "model": model_check.check,
     }
 
 

@@ -66,6 +66,11 @@ PLANE_FIELDS = {
     "compression_min_bytes": "compression_min_bytes",
     "cross_algo_threshold": "cross_algo_threshold",
     "min_np": "min_size",
+    # Control-plane topology / steady state (PR 13): a Python/C++ default
+    # split here silently changes which protocol a bare-C++ caller runs.
+    "coord_tree": "coord_tree",
+    "steady_threshold": "steady_threshold",
+    "steady_max_period": "steady_max_period",
 }
 
 # Doc-table default column -> dataclass default.  ("config", f) reads
@@ -84,6 +89,8 @@ DOC_DEFAULTS: Dict[str, Tuple[str, str]] = {
     "HVD_TPU_FLIGHT_EVENTS": ("config", "flight_events"),
     "HVD_TPU_MIN_NP": ("config", "min_np"),
     "HVD_TPU_RESTART_EPOCH": ("config", "restart_epoch"),
+    "HVD_TPU_STEADY_THRESHOLD": ("config", "steady_threshold"),
+    "HVD_TPU_STEADY_MAX_PERIOD": ("config", "steady_max_period"),
     "HVD_TPU_SERVE_PORT": ("serve", "port"),
     "HVD_TPU_SERVE_MAX_BATCH": ("serve", "max_batch"),
     "HVD_TPU_SERVE_PREFILL_CHUNK": ("serve", "prefill_chunk"),
@@ -104,11 +111,15 @@ _EXPR_RE = re.compile(r"^[-+*\s().\d_]+$")
 
 def _safe_eval(expr: str,
                names: Dict[str, float]) -> Optional[float]:
-    """Evaluate a default expression: arithmetic over numbers and
-    already-resolved constant names; None for anything else (enum
-    values, strings, bools — those are out of scope for the numeric
+    """Evaluate a default expression: arithmetic over numbers,
+    already-resolved constant names, and bool literals (Python
+    ``True``/``False`` and C++ ``true``/``false`` normalize to 1/0 so
+    flag defaults like ``coord_tree`` compare across planes); None for
+    anything else (enum values, strings — out of scope for the numeric
     agreement check)."""
     expr = expr.strip()
+    expr = re.sub(r"\b[Tt]rue\b", "1", expr)
+    expr = re.sub(r"\b[Ff]alse\b", "0", expr)
     for name, value in names.items():
         expr = re.sub(rf"\b{name}\b", repr(value), expr)
     if not expr or not _EXPR_RE.match(expr):

@@ -46,6 +46,17 @@ WHITELIST = {
     "Engine::ProcessCacheHits": "replays broadcast cache hits in order",
     "Engine::PerformOperation": "cache insert/erase in response-list order",
     "Engine::ExecuteAllreduce": "residual update while executing the list",
+    # Steady state (PR 13): the pattern is installed by a broadcast and
+    # replayed self-clocked; its cursors move in canonical slot order on
+    # every rank, so the replay loop IS the lockstep.
+    "Engine::ApplySteady": "applies the steady-pattern broadcast",
+    "Engine::ExitSteadyLocal": "exit latch; miss coordinates are "
+                               "re-agreed through the coordinator",
+    "Engine::SteadyLoopOnce": "replays the agreed pattern in slot order",
+    "Engine::SubRelayPass": "relay-side exit/requeue of the same pattern",
+    "Engine::MaybeRevokeSteadyForReshape": "rank-0 revocation broadcast; "
+                                           "survivors re-negotiate from "
+                                           "tick one",
 }
 
 # Protected-state write patterns.  Reads (.load(), lookup methods) are
@@ -71,6 +82,19 @@ PROTECTED = (
     # Per-tick change-point histories the XLA plane replays.
     r"\b(fusion_history_|compression_history_)\.(push_back|emplace_back|"
     r"pop_front|pop_back|clear|assign)\s*\(",
+    # Steady-replay state: the pattern/groups install only from the
+    # coordinator's steady broadcast, and the cursors/pending buffers
+    # advance only inside the slot-ordered replay loop (reads —
+    # .size()/.empty()/.begin()/[] — are deliberately not matched).
+    r"\b(steady_pattern_|steady_groups_|steady_pending_group_|"
+    r"steady_pending_reqs_)\.(clear|assign|push_back|emplace_back|"
+    r"resize|swap)\s*\(",
+    r"\b(steady_pattern_|steady_groups_)\s*=[^=]",
+    r"\b(steady_pos_|steady_group_idx_|steady_epoch_|"
+    r"steady_exit_epoch_)\s*(=[^=]|\+=)",
+    r"\+\+\s*(steady_pos_|steady_group_idx_|steady_epoch_)",
+    r"\bsteady_exit_pending_\s*=[^=]",
+    r"\b(steady_active_|steady_pattern_len_)\.(store|exchange)\s*\(",
 )
 
 # Definitions start at column 0 (`bool Engine::ApplyReshape(...) {`);

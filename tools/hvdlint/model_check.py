@@ -1,0 +1,179 @@
+"""Checker 7: model-checker / C++ protocol sync (tools/hvdmodel).
+
+The hvdmodel explorer is only worth its CI minutes while it models the
+protocol the engine actually speaks.  This checker pins the two ends
+together BIDIRECTIONALLY:
+
+1. ``tools/hvdmodel/coverage.py`` declares, as plain set literals, the
+   status codes and the steady/reshape wire fields the model covers;
+2. ``engine/cc/wire.h`` is the ground truth: its ``StatusCode`` enum and
+   the steady/membership family of ``RequestList`` fields (``steady_*``,
+   ``dead_ranks``, ``membership_epoch``) plus the steady/reshape family
+   of ``ResponseList`` fields (``steady_*``, ``reshape_*``, ``member_*``,
+   ``membership_epoch``) must EQUAL the declared sets.
+
+A field added to wire.h without extending the model fails here at the
+introducing PR (the model would silently verify a stale protocol);
+a name deleted from the model while the C++ still carries it fails the
+same way in the other direction.  Each declared name must additionally
+be referenced somewhere in the model source itself, so the coverage
+file cannot drift into aspirational documentation
+(docs/contributing.md "Extending the protocol").
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.hvdlint import Violation, read, strip_cxx_comments
+from tools.hvdlint.wire_check import WIRE_H, parse_struct_fields
+
+COVERAGE_PY = os.path.join("tools", "hvdmodel", "coverage.py")
+MODEL_DIR = os.path.join("tools", "hvdmodel")
+
+# wire.h struct -> (coverage.py set name, family regex).  A field whose
+# name matches the family participates in the control-plane protocol the
+# model abstracts; everything else (payload routing, autotune lockstep)
+# is covered by checkers 1-6 instead.
+FAMILIES = {
+    "RequestList": (
+        "MODELED_REQUEST_FIELDS",
+        re.compile(r"^(steady_.*|dead_ranks|membership_epoch)$")),
+    "ResponseList": (
+        "MODELED_RESPONSE_FIELDS",
+        re.compile(r"^(steady_.*|reshape_.*|member_.*|membership_epoch)$")),
+}
+
+STATUS_SET = "MODELED_STATUS_CODES"
+_ENUM_RE = re.compile(r"enum\s+StatusCode\s*:[^{]*\{(.*?)\}", re.S)
+_CODE_RE = re.compile(r"\b(ST_[A-Z_]+)\s*=")
+
+
+def _status_codes(header: str) -> Dict[str, int]:
+    """ST_* name -> 1-based line from wire.h's StatusCode enum."""
+    m = _ENUM_RE.search(header)
+    if not m:
+        return {}
+    out: Dict[str, int] = {}
+    base = header[:m.start(1)].count("\n")
+    for cm in _CODE_RE.finditer(m.group(1)):
+        out[cm.group(1)] = base + m.group(1)[:cm.start(1)].count("\n") + 1
+    return out
+
+
+def _declared_sets(root: str) -> Tuple[Dict[str, Set[str]],
+                                       Dict[str, int], List[Violation]]:
+    """Parse coverage.py's module-level set literals with the AST so a
+    syntax-valid but computed value (comprehension, union) is rejected —
+    the sets must stay ``ast.literal_eval``-able by design."""
+    vios: List[Violation] = []
+    sets: Dict[str, Set[str]] = {}
+    lines: Dict[str, int] = {}
+    try:
+        tree = ast.parse(read(root, COVERAGE_PY))
+    except (OSError, SyntaxError) as exc:
+        return {}, {}, [Violation("model", COVERAGE_PY, 0,
+                                  f"cannot parse: {exc}")]
+    wanted = {STATUS_SET} | {s for s, _ in FAMILIES.values()}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name) or tgt.id not in wanted:
+            continue
+        lines[tgt.id] = node.lineno
+        try:
+            val = ast.literal_eval(node.value)
+        except ValueError:
+            vios.append(Violation(
+                "model", COVERAGE_PY, node.lineno,
+                f"{tgt.id} must be a literal set of strings (it is "
+                f"cross-checked against wire.h by eye and by tool)"))
+            continue
+        if (not isinstance(val, (set, frozenset))
+                or not all(isinstance(x, str) for x in val)):
+            vios.append(Violation(
+                "model", COVERAGE_PY, node.lineno,
+                f"{tgt.id} must be a set of strings"))
+            continue
+        sets[tgt.id] = set(val)
+    for name in sorted(wanted - set(sets)):
+        if not any(v.message.startswith(name) for v in vios):
+            vios.append(Violation("model", COVERAGE_PY, 0,
+                                  f"missing declaration {name}"))
+    return sets, lines, vios
+
+
+def _model_source(root: str) -> str:
+    """Concatenated source of every hvdmodel module except coverage.py
+    itself (a name only present in its own declaration is dead)."""
+    base = os.path.join(root, MODEL_DIR)
+    chunks = []
+    for fname in sorted(os.listdir(base)):
+        if not fname.endswith(".py") or fname == "coverage.py":
+            continue
+        chunks.append(read(root, os.path.join(MODEL_DIR, fname)))
+    return "\n".join(chunks)
+
+
+def check(root: str) -> List[Violation]:
+    sets, set_lines, out = _declared_sets(root)
+    try:
+        header = strip_cxx_comments(read(root, WIRE_H))
+    except OSError as exc:
+        out.append(Violation("model", WIRE_H, 0, f"cannot read: {exc}"))
+        return out
+
+    # -- 1. StatusCode enum <-> MODELED_STATUS_CODES -------------------
+    codes = _status_codes(header)
+    if not codes:
+        out.append(Violation("model", WIRE_H, 0,
+                             "StatusCode enum not found"))
+    declared = sets.get(STATUS_SET, set())
+    for name in sorted(set(codes) - declared):
+        out.append(Violation(
+            "model", WIRE_H, codes[name],
+            f"status {name} is not modeled: add it to "
+            f"{COVERAGE_PY}:{STATUS_SET} and give it a transition in "
+            f"tools/hvdmodel/model.py"))
+    for name in sorted(declared - set(codes)):
+        out.append(Violation(
+            "model", COVERAGE_PY, set_lines.get(STATUS_SET, 0),
+            f"{STATUS_SET} lists {name} which wire.h's StatusCode "
+            f"enum no longer defines"))
+
+    # -- 2. wire-field families <-> MODELED_*_FIELDS -------------------
+    for struct, (set_name, family) in sorted(FAMILIES.items()):
+        fields = {f: ln for f, ln in parse_struct_fields(header, struct)}
+        if not fields:
+            out.append(Violation("model", WIRE_H, 0,
+                                 f"struct {struct} not found"))
+            continue
+        in_family = {f for f in fields if family.match(f)}
+        declared = sets.get(set_name, set())
+        for name in sorted(in_family - declared):
+            out.append(Violation(
+                "model", WIRE_H, fields[name],
+                f"{struct}.{name} is control-plane state the model "
+                f"does not cover: add it to {COVERAGE_PY}:{set_name} "
+                f"and bind it in model.WIRE_BINDING"))
+        for name in sorted(declared - in_family):
+            out.append(Violation(
+                "model", COVERAGE_PY, set_lines.get(set_name, 0),
+                f"{set_name} lists {name} which {struct} in wire.h "
+                f"no longer carries"))
+
+    # -- 3. every declared name is live in the model source ------------
+    src = _model_source(root)
+    for set_name, names in sorted(sets.items()):
+        for name in sorted(names):
+            if name not in src:
+                out.append(Violation(
+                    "model", COVERAGE_PY, set_lines.get(set_name, 0),
+                    f"{set_name} declares {name} but nothing in "
+                    f"tools/hvdmodel/ references it — the model does "
+                    f"not actually cover it"))
+    return out

@@ -1,0 +1,25 @@
+"""hvdmodel: bounded exhaustive model checker for the control-plane protocol.
+
+An executable abstract model of the engine's control plane — coordinator
+tick, announce aggregation through the PR-13 sub-coordinator tree,
+cache-bit agreement and steady-state replay, the elastic reshape barrier
+(shrink, grow, standby admission), and the abort/timeout cascade — plus
+a breadth-first explorer that enumerates every interleaving of frame
+delivery, tick boundaries, and injected faults up to a bound, checking:
+
+  1. no deadlock (every non-terminal state has an enabled action);
+  2. live ranks agree on membership epoch and steady pattern at
+     quiesced boundaries;
+  3. every injected fault reaches a *typed* abort or a completed
+     reshape (never a silent stall), modulo documented xfails;
+  4. no stale-epoch frame is ever accepted by the coordinator.
+
+The model is kept in sync with the C++ by hvdlint checker #7
+(``model_check``): the coverage sets in ``coverage.py`` must match the
+``ST_*`` enum and the steady/reshape wire fields in
+``engine/cc/wire.h`` bidirectionally.
+
+Run ``python -m tools.hvdmodel --quick`` (tier-1) or ``--deep``.
+"""
+
+__all__ = ["model", "invariants", "explorer", "coverage", "configs", "trace"]

@@ -1,0 +1,79 @@
+"""CLI: ``python -m tools.hvdmodel --quick`` (tier-1) / ``--deep``.
+
+Exit status 0 when every explored configuration satisfies the
+invariants, 1 otherwise (shortest counterexample traces printed).
+``--bug NAME`` runs a seeded-bug configuration that MUST fail — used by
+the test-suite to prove the explorer actually catches each class of
+bug the engine defends against.
+"""
+
+import argparse
+import sys
+import time
+
+from . import configs, explorer, trace
+from .model import BUGS
+
+REQUIRED_QUICK_COVERAGE = (
+    "steady_enter", "steady_exit", "reshape_shrink", "reshape_grow",
+    "crash", "freeze", "stale_drop",
+)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.hvdmodel",
+        description="bounded exhaustive model checker for the "
+                    "control-plane protocol")
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--quick", action="store_true",
+                      help="tier-1 bound (2 hosts x 2 ranks + elastic "
+                           "star, <60s)")
+    mode.add_argument("--deep", action="store_true",
+                      help="slow-tier bound (3 hosts, 2-fault budget)")
+    mode.add_argument("--bug", choices=BUGS,
+                      help="run a seeded-bug config (expected to FAIL)")
+    ap.add_argument("--max-states", type=int, default=None,
+                    help="override the per-config expansion cap")
+    args = ap.parse_args(argv)
+
+    if args.bug:
+        cfgs = [configs.seeded(args.bug)]
+    elif args.deep:
+        cfgs = configs.deep()
+    else:
+        cfgs = configs.quick()
+    cap = args.max_states or (2000000 if args.deep else 500000)
+
+    total_states = 0
+    coverage = set()
+    failed = False
+    t0 = time.time()
+    for cfg in cfgs:
+        res = explorer.explore(cfg, max_states=cap)
+        total_states += res.states
+        coverage |= res.coverage
+        print(trace.summarize(res))
+        for code, detail, steps in res.violations:
+            failed = True
+            print(trace.render(cfg, code, detail, steps))
+    dt = time.time() - t0
+    print("total: %d states across %d config(s) in %.1fs"
+          % (total_states, len(cfgs), dt))
+
+    if args.quick and not failed:
+        missing = [c for c in REQUIRED_QUICK_COVERAGE
+                   if c not in coverage]
+        if missing:
+            failed = True
+            print("COVERAGE GAP: --quick never exercised: %s"
+                  % ", ".join(missing))
+    if failed:
+        print("FAIL")
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

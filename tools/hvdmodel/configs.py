@@ -1,0 +1,67 @@
+"""Bounded configurations for the explorer.
+
+QUICK runs in tier-1 (<60 s, >=50k states) and must cover steady
+enter/exit, reshape shrink+grow, and crash/freeze faults.  DEEP widens
+to three hosts and a two-fault budget (crash at every reachable state)
+and runs in the slow tier.  Elastic configs use the star topology — the
+engine forces ``coord_tree=false`` under elastic (engine.cc Init).
+"""
+
+from .model import BUGS, Config
+
+
+def quick():
+    return [
+        # Coordinator tree, 2 hosts x 2 ranks, non-elastic: steady
+        # enter/exit through the sub-coordinator relay, EOF cascade,
+        # frozen-rank timeout, pattern miss on a new tensor.
+        Config("quick-tree", hosts=((0, 1), (2, 3)),
+               threshold=2, ticks=4, fault_budget=1,
+               faults=("crash:1", "crash:3", "freeze:3", "newt")),
+        # Elastic star + one standby: shrink, grow, steady x elastic
+        # revocation, stale-epoch machinery, undersized abort.
+        Config("quick-elastic", hosts=((0,), (1,), (2,), (3,)),
+               elastic=True, min_size=2, standby=(3,),
+               threshold=2, ticks=4, fault_budget=1,
+               faults=("crash:1", "crash:2", "freeze:2", "join",
+                       "newt")),
+        # Same protocol with the data-plane group timeout disabled: a
+        # crash mid-steady must be resolved by the revocation broadcast
+        # ALONE (MaybeRevokeSteadyForReshape) — the control plane may
+        # not lean on the backstop for liveness.
+        Config("quick-revoke-only", hosts=((0,), (1,), (2,)),
+               elastic=True, min_size=1, threshold=1, ticks=3,
+               fault_budget=1, faults=("crash:1", "crash:2"),
+               group_timeout=False),
+    ]
+
+
+def deep():
+    return [
+        Config("deep-tree", hosts=((0, 1), (2, 3), (4, 5)),
+               threshold=2, ticks=5, fault_budget=2,
+               faults=("crash:1", "crash:3", "crash:5", "freeze:1",
+                       "freeze:5", "newt")),
+        Config("deep-elastic", hosts=((0,), (1,), (2,), (3,), (4,)),
+               elastic=True, min_size=1, standby=(4,),
+               threshold=2, ticks=5, fault_budget=2,
+               faults=("crash:1", "crash:2", "crash:3", "freeze:3",
+                       "join", "newt")),
+    ]
+
+
+def seeded(bug):
+    """A small elastic config with one engine defense disabled; the
+    explorer must find a violation for every seeded bug.
+
+    ``skip-revoke`` runs with the group-timeout backstop off: with the
+    timeout on, survivors eventually exit steady on their own and the
+    coordinator's AllSteadyExited hold keeps the reshape safe — the
+    revocation's whole job is that the control plane does not DEPEND on
+    the data-plane timeout, so that is the environment in which its
+    removal must (and does) deadlock."""
+    assert bug in BUGS, bug
+    return Config("seeded-%s" % bug, hosts=((0,), (1,), (2,)),
+                  elastic=True, min_size=1, threshold=1, ticks=4,
+                  fault_budget=1, faults=("crash:2",), bug=bug,
+                  group_timeout=(bug != "skip-revoke"))

@@ -1,0 +1,60 @@
+"""Declared model-coverage sets, cross-checked against the C++ by
+hvdlint checker #7 (``model_check``).
+
+The literals below are parsed with ``ast.literal_eval`` by the checker
+and compared BIDIRECTIONALLY with ``engine/cc/wire.h``:
+
+  * ``MODELED_STATUS_CODES`` must equal the ``StatusCode`` enum;
+  * ``MODELED_REQUEST_FIELDS`` must equal the steady/membership family
+    of ``RequestList`` fields (``steady_*``, ``dead_ranks``,
+    ``membership_epoch``);
+  * ``MODELED_RESPONSE_FIELDS`` must equal the steady/reshape family of
+    ``ResponseList`` fields (``steady_*``, ``reshape_*``, ``member_*``,
+    ``membership_epoch``).
+
+Every name must also be referenced somewhere in the model source (see
+``model.STATUS`` / ``model.WIRE_BINDING``) — deleting a modeled status
+or field here, or adding one to ``wire.h`` without extending the model,
+fails ``python -m tools.hvdlint`` at the introducing PR.  The
+``docs/contributing.md`` "Extending the protocol" section walks through
+the required steps.
+"""
+
+MODELED_STATUS_CODES = {
+    "ST_OK",
+    "ST_UNKNOWN",
+    "ST_PRECONDITION",
+    "ST_ABORTED",
+    "ST_INVALID",
+    "ST_PENDING",
+    "ST_RANKS_DOWN",
+    "ST_TIMEOUT",
+    "ST_RESHAPE",
+}
+
+MODELED_REQUEST_FIELDS = {
+    "steady_exits",
+    "steady_exit",
+    "steady_epoch",
+    "steady_pos",
+    "dead_ranks",
+    "membership_epoch",
+}
+
+MODELED_RESPONSE_FIELDS = {
+    "steady_present",
+    "steady_pattern",
+    "steady_groups",
+    "steady_revoke",
+    "reshape_present",
+    "membership_epoch",
+    "reshape_cache_capacity",
+    "reshape_fusion_threshold",
+    "reshape_cycle_time_us",
+    "reshape_compression",
+    "reshape_compression_min_bytes",
+    "reshape_cross_algo_threshold",
+    "member_old_ranks",
+    "member_endpoints",
+    "reshape_lost",
+}

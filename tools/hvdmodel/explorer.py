@@ -1,0 +1,75 @@
+"""Bounded exhaustive breadth-first exploration of the protocol model.
+
+BFS guarantees the first counterexample found for each violation class
+is a *shortest* failing interleaving, which keeps the rendered traces
+readable.  States are hashed structurally (they are plain tuples);
+parent pointers reconstruct traces on demand.
+"""
+
+from collections import deque
+
+from . import invariants, model
+
+
+class Result:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.states = 0
+        self.transitions = 0
+        self.terminals = 0
+        self.truncated = False
+        self.coverage = set()
+        self.xfails = {}          # tag -> count
+        self.violations = []      # (code, detail, trace) shortest-first
+
+    @property
+    def ok(self):
+        return not self.violations
+
+
+def _trace(parents, key):
+    steps = []
+    while key is not None:
+        key, label, line = parents[key]
+        if label is not None:
+            steps.append((label, line))
+    steps.reverse()
+    return steps
+
+
+def explore(cfg, max_states=500000):
+    """Exhaustively explore ``cfg`` up to ``max_states`` expansions."""
+    res = Result(cfg)
+    init = model.initial_state(cfg)
+    parents = {init: (None, None, None)}
+    seen_violation = set()
+    frontier = deque([init])
+    while frontier:
+        if res.states >= max_states:
+            res.truncated = True
+            break
+        st = frontier.popleft()
+        res.states += 1
+        for code, detail in invariants.check_state(cfg, st):
+            if code not in seen_violation:
+                seen_violation.add(code)
+                res.violations.append((code, detail,
+                                       _trace(parents, st)))
+        succ = model.successors(cfg, st)
+        if not succ:
+            res.terminals += 1
+            ok, xfail, detail = invariants.classify_terminal(cfg, st)
+            if xfail:
+                res.xfails[xfail] = res.xfails.get(xfail, 0) + 1
+            if not ok and "deadlock" not in seen_violation:
+                seen_violation.add("deadlock")
+                res.violations.append(("deadlock", detail,
+                                       _trace(parents, st)))
+            continue
+        for label, line, nst, events in succ:
+            res.transitions += 1
+            res.coverage |= events
+            if nst not in parents:
+                parents[nst] = (st, label, line)
+                frontier.append(nst)
+    return res

@@ -1,0 +1,729 @@
+"""Abstract model of the control-plane protocol.
+
+Each model state is an immutable tuple; ``successors()`` enumerates every
+enabled transition (frame send/delivery, tick boundary, steady replay,
+injected fault, time-abstracted timeout).  The mapping to the C++ is
+documented per action and in WIRE_BINDING below; hvdlint checker #7
+(``model_check``) keeps the binding honest against ``wire.h``.
+
+Abstractions (deliberate, documented):
+  * Tensor payloads, fusion, and slot *contents* are abstracted away;
+    agreement is tracked through the membership epoch and the steady
+    pattern's negotiation epoch.  One implicit tensor list per tick.
+  * Time is abstracted to enabledness: a timeout that WOULD eventually
+    fire is an always-enabled action once its trigger condition holds
+    (frozen rank blocking progress, partial steady group starved).
+  * TCP gives per-connection FIFO: the up channel delivers the oldest
+    frame per sender; down channels are per-rank FIFOs.  Cross-rank
+    delivery order is fully interleaved (models delay + reorder).
+  * Elastic jobs run the star topology (engine Init forces
+    ``coord_tree=false`` under elastic); the coordinator tree is
+    explored in non-elastic configurations.
+  * Rank 0 / sub-coordinator crash is out of scope (host failure is the
+    state plane's job, PR 11); crash/freeze faults target leaf ranks.
+"""
+
+# Rank modes.
+R_RUN = "R"      # has work for the current tick, will send a frame
+R_WAIT = "W"     # frame sent, blocked on the response (RunLoopOnce)
+R_STEADY = "S"   # self-clocked replay, zero frames (SteadyLoopOnce)
+R_CRASH = "C"    # process died; parent sees EOF
+R_FROZEN = "F"   # alive socket, no progress (no EOF, no frames)
+R_ABORT = "A"    # consumed a typed abort broadcast
+R_DONE = "D"     # consumed the shutdown broadcast
+R_STUCK = "X"    # bug mode only: dropped a pending op (no-requeue bug)
+R_STANDBY = "B"  # standby: connected but not yet admitted by a reshape
+
+# Typed status codes mirrored from engine/cc/wire.h (model_check enforces
+# the full ST_* enum is listed here; see also coverage.py).  The protocol
+# transitions below use the abort-family codes; the request/response
+# plumbing codes are bound to model concepts they abstract.
+STATUS = {
+    "ST_OK": 0,            # normal tick response (act_coord_tick)
+    "ST_UNKNOWN": 1,       # unmodeled internal error (no transition)
+    "ST_PRECONDITION": 2,  # API misuse; pre-protocol, no transition
+    "ST_ABORTED": 3,       # EOF cascade, non-elastic (act_coord_abort)
+    "ST_INVALID": 4,       # malformed frame; abstracted away (parser)
+    "ST_PENDING": 5,       # the R_WAIT rank state is this code's dual
+    "ST_RANKS_DOWN": 6,    # alive < min_size at the barrier
+    "ST_TIMEOUT": 7,       # frozen rank, exchange-silence timeout
+    "ST_RESHAPE": 8,       # in-flight poison at ApplyReshape ('reshape')
+}
+
+# Wire-field binding: every steady/reshape field of RequestList and
+# ResponseList (wire.h) and the model concept that covers it.  hvdlint
+# model_check cross-checks these names against the struct definitions.
+WIRE_BINDING = {
+    # RequestList
+    "steady_exit": "exitm flag carried by act_send after a steady exit",
+    "steady_exits": "relayed exit set in 'agg' frames / coord exits",
+    "steady_epoch": "abstracted into the rank tick counter (replay pos)",
+    "steady_pos": "abstracted into the rank tick counter (replay pos)",
+    "dead_ranks": "sub dead set piggybacked on 'agg' frames",
+    "membership_epoch": "frame epoch; stale guard in act_coord_recv",
+    # ResponseList
+    "steady_present": "'steady' broadcast kind (enter self-clocked mode)",
+    "steady_pattern": "pattern identity == negotiation epoch (rank pat)",
+    "steady_groups": "abstracted: one replay group per cycle",
+    "steady_revoke": "'revoke' broadcast kind (resume / reshape-revoke)",
+    "reshape_present": "'reshape' broadcast kind (barrier commit)",
+    "reshape_lost": "alive-set delta carried by the 'reshape' frame",
+    "member_old_ranks": "new alive tuple carried by the 'reshape' frame",
+    "member_endpoints": "abstracted: rewiring is instantaneous",
+    "reshape_cache_capacity": "abstracted: autotune payload reset",
+    "reshape_fusion_threshold": "abstracted: autotune payload reset",
+    "reshape_cycle_time_us": "abstracted: autotune payload reset",
+    "reshape_compression": "abstracted: autotune payload reset",
+    "reshape_compression_min_bytes": "abstracted: autotune payload reset",
+    "reshape_cross_algo_threshold": "abstracted: autotune payload reset",
+}
+
+# Seeded-bug switches (each disables one of the engine's defenses so the
+# explorer demonstrably catches the class of bug it guards against).
+BUGS = ("skip-revoke", "stale-epoch", "no-requeue")
+
+
+class Config:
+    """Bounded model configuration (immutable after construction)."""
+
+    def __init__(self, name, hosts, elastic=False, min_size=1, standby=(),
+                 threshold=2, ticks=4, fault_budget=0, faults=(), bug=None,
+                 group_timeout=True):
+        self.name = name
+        self.hosts = tuple(tuple(h) for h in hosts)
+        self.elastic = elastic
+        self.min_size = min_size
+        self.standby = tuple(standby)     # rank ids living in their own host
+        self.threshold = threshold        # identical ticks before steady
+        self.ticks = ticks                # program length per rank
+        self.fault_budget = fault_budget
+        self.faults = tuple(faults)       # subset of ('crash:N','freeze:N','join','newt')
+        # The data-plane group timeout (HVD_TPU_STEADY_GROUP_TIMEOUT) is
+        # a backstop, not part of the control plane: configs with
+        # ``group_timeout=False`` prove the protocol stays live when the
+        # backstop never fires (the revocation broadcast alone must
+        # unblock every survivor).
+        self.group_timeout = group_timeout
+        self.bug = bug
+        assert bug in (None,) + BUGS, bug
+        self.nranks = max(max(h) for h in self.hosts) + 1
+        self.host_of = {}
+        for h, members in enumerate(self.hosts):
+            for r in members:
+                self.host_of[r] = h
+        self.leaders = tuple(h[0] for h in self.hosts)
+
+    def initial_alive(self):
+        return tuple(sorted(r for h in self.hosts for r in h
+                            if r not in self.standby))
+
+
+def initial_state(cfg):
+    ranks = tuple(
+        (R_STANDBY if r in cfg.standby else R_RUN, 0, 0, 0, -1)
+        for r in range(cfg.nranks))
+    subs = tuple(((), ()) for _ in cfg.hosts)
+    coord = (0, (), False, (), (), 0, False, cfg.initial_alive(), 0, False)
+    down = tuple(() for _ in range(cfg.nranks))
+    return (ranks, subs, coord, (), down, -1, cfg.fault_budget, False)
+
+
+# -- tuple accessors (kept as plain indices for hashing speed) ----------
+# rank: (mode, epoch, tick, exitm, pat)
+# coord: (epoch, got, shut, exits, dead, hist, steady, alive, abort, joinp)
+
+def _rank(ranks, r, **kw):
+    m, e, t, x, p = ranks[r]
+    vals = {"mode": m, "epoch": e, "tick": t, "exitm": x, "pat": p}
+    vals.update(kw)
+    out = list(ranks)
+    out[r] = (vals["mode"], vals["epoch"], vals["tick"], vals["exitm"],
+              vals["pat"])
+    return tuple(out)
+
+
+def _coord(c, **kw):
+    keys = ("epoch", "got", "shut", "exits", "dead", "hist", "steady",
+            "alive", "abort", "joinp")
+    vals = dict(zip(keys, c))
+    vals.update(kw)
+    return tuple(vals[k] for k in keys)
+
+
+def _push_down(down, r, frame):
+    out = list(down)
+    out[r] = out[r] + (frame,)
+    return tuple(out)
+
+
+def _live_members(cfg, h, alive, dead_known):
+    """Host members the gatherer still expects a frame from."""
+    return tuple(r for r in cfg.hosts[h]
+                 if r in alive and r not in dead_known)
+
+
+# -- frame application on a rank (response consumption) -----------------
+
+def _apply_down(cfg, ranks, r, frame, events):
+    """Model of the worker side of ProcessResponseList + the
+    broadcast-resumed branches (SteadyLoopOnce / SubRelayPass)."""
+    kind, fep, payload = frame
+    mode, epoch, tick, exitm, pat = ranks[r]
+    if mode in (R_CRASH, R_ABORT, R_DONE):
+        return ranks  # dropped on the floor; this rank is gone
+    if kind == "abort":
+        return _rank(ranks, r, mode=R_ABORT)
+    if kind == "shut":
+        return _rank(ranks, r, mode=R_DONE)
+    if mode == R_WAIT:
+        if kind == "resp":
+            return _rank(ranks, r, mode=R_RUN, tick=tick + 1)
+        if kind == "steady":
+            events.add("steady_enter")
+            return _rank(ranks, r, mode=R_STEADY, tick=tick + 1, pat=fep)
+        if kind == "revoke":
+            # Bare revocation consumed as an empty-tick response: the
+            # pending op is requeued and resent (ticks_done_ stays
+            # symmetric: every rank consumes exactly one revoke).
+            return _rank(ranks, r, mode=R_RUN)
+        if kind == "reshape":
+            events.add("reshape_adopt")
+            return _rank(ranks, r, mode=R_RUN, epoch=fep)
+    if mode == R_STEADY:
+        if kind == "revoke":
+            events.add("steady_exit")
+            if cfg.bug == "no-requeue":
+                # Seeded bug: the drained-but-unreplayed partial group is
+                # dropped instead of requeued -> the op is stranded.
+                return _rank(ranks, r, mode=R_STUCK, pat=-1)
+            return _rank(ranks, r, mode=R_RUN, pat=-1)
+        if kind == "reshape":
+            # Only reachable with bug == 'skip-revoke': the barrier fired
+            # while the pattern was armed.  The rank keeps replaying a
+            # pattern negotiated under the old membership.
+            events.add("reshape_under_steady")
+            return ranks
+    if mode == R_RUN and kind == "revoke":
+        return ranks  # straggler revoke: empty-tick, op resent anyway
+    # Anything else is a protocol error surfaced by the invariants.
+    events.add("unexpected_frame:%s:%s" % (mode, kind))
+    return ranks
+
+
+# -- broadcast helper (rank 0 consumes its own response in-process) -----
+
+def _broadcast(cfg, ranks, down, alive, frame, events, skip=()):
+    for r in alive:
+        if r in skip or ranks[r][0] == R_CRASH:
+            continue
+        if r == 0:
+            ranks = _apply_down(cfg, ranks, 0, frame, events)
+        else:
+            down = _push_down(down, r, frame)
+    return ranks, down
+
+
+# -- coordinator gathering merge (CoordinatorHandle) --------------------
+
+def _coord_merge(cfg, st, agg, events):
+    """Merge an aggregate into rank 0's gathering.  The stale-epoch guard
+    and the duplicate-host guard live here (engine: CoordinatorHandle)."""
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp) = coord
+    _, h, fep, fshut, fexits, fdead = agg
+    if fep < cep and cfg.bug != "stale-epoch":
+        events.add("stale_drop")
+        return st
+    if fep < cep:
+        events.add("stale_accept")
+        stale = True
+    if h in got:
+        # Duplicate aggregate for this gathering cycle (a post-revocation
+        # resend racing its own original, one tick of pipeline lag).  The
+        # engine still PARSES the frame — shutdown/exit/dead markers are
+        # persistent latches updated by every frame (CoordinatorHandle),
+        # only the per-tick frame accounting ignores it.  Dropping the
+        # latches too would lose a steady-exit marker carried by the
+        # lagged frame and hold the resume barrier forever.
+        events.add("dup_latch")
+        coord = _coord(coord, shut=shut or fshut,
+                       exits=tuple(sorted(set(exits) | set(fexits))),
+                       dead=tuple(sorted(set(dead) | set(fdead))))
+        return (ranks, subs, coord, up, down, newt, fb, stale)
+    members = set(r for r in cfg.hosts[h] if r in alive)
+    if not members:
+        events.add("stray_drop")  # post-shrink straggler from a lost host
+        return st
+    coord = _coord(coord, got=tuple(sorted(got + (h,))),
+                   shut=shut or fshut,
+                   exits=tuple(sorted(set(exits) | set(fexits))),
+                   dead=tuple(sorted(set(dead) | set(fdead))))
+    return (ranks, subs, coord, up, down, newt, fb, stale)
+
+
+# ======================================================================
+# Actions.  Each act_* returns a list of (label, newstate, events).
+# ======================================================================
+
+def act_send(cfg, st):
+    """A rank with work builds and sends its frame (RunLoopOnce): announce
+    + shutdown bit at end-of-program + steady_exit marker if it just left
+    steady.  Leaders merge in-process; leaves put a frame on the wire."""
+    out = []
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    alive = coord[7]
+    for r in range(cfg.nranks):
+        mode, epoch, tick, exitm, pat = ranks[r]
+        if mode != R_RUN or r not in alive or coord[8]:
+            continue
+        h = cfg.host_of[r]
+        fshut = tick >= cfg.ticks
+        nranks = _rank(ranks, r, mode=R_WAIT, exitm=0)
+        ev = set()
+        if r == cfg.leaders[h]:
+            gathered, sdead = subs[h]
+            if any(g[0] == r for g in gathered):
+                continue  # already merged (shouldn't happen; guard)
+            nsubs = list(subs)
+            nsubs[h] = (tuple(sorted(gathered
+                                     + ((r, epoch, fshut, exitm),))),
+                        sdead)
+            out.append(("send(%d)" % r,
+                        (nranks, tuple(nsubs), coord, up, down, newt, fb,
+                         stale), ev))
+        else:
+            frame = ("leaf", h, r, epoch, fshut, exitm)
+            out.append(("send(%d)" % r,
+                        (nranks, subs, coord, up + (frame,), down, newt,
+                         fb, stale), ev))
+    return out
+
+
+def act_deliver_up(cfg, st):
+    """Deliver the oldest in-flight frame per sender: leaf frames merge
+    into the host sub-coordinator's gathering, aggregates into rank 0's
+    (per-connection FIFO; cross-sender order is free)."""
+    out = []
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    seen = set()
+    for i, frame in enumerate(up):
+        key = (frame[0], frame[2] if frame[0] == "leaf" else frame[1])
+        if key in seen:
+            continue
+        seen.add(key)
+        nup = up[:i] + up[i + 1:]
+        ev = set()
+        if frame[0] == "leaf":
+            _, h, r, fep, fshut, fexitm = frame
+            gathered, sdead = subs[h]
+            if r in sdead or ranks[r][0] == R_CRASH and r in sdead:
+                ev.add("dead_drop")
+                out.append(("deliver_up(leaf:%d)" % r,
+                            (ranks, subs, coord, nup, down, newt, fb,
+                             stale), ev))
+                continue
+            if any(g[0] == r for g in gathered):
+                ev.add("dup_drop")
+                out.append(("deliver_up(leaf:%d)" % r,
+                            (ranks, subs, coord, nup, down, newt, fb,
+                             stale), ev))
+                continue
+            nsubs = list(subs)
+            nsubs[h] = (tuple(sorted(gathered
+                                     + ((r, fep, fshut, fexitm),))),
+                        sdead)
+            out.append(("deliver_up(leaf:%d)" % r,
+                        (ranks, tuple(nsubs), coord, nup, down, newt, fb,
+                         stale), ev))
+        else:
+            nst = _coord_merge(cfg, (ranks, subs, coord, nup, down, newt,
+                                     fb, stale), frame, ev)
+            out.append(("deliver_up(agg:h%d)" % frame[1], nst, ev))
+    return out
+
+
+def act_sub_flush(cfg, st):
+    """A sub-coordinator whose gathering covers every live local rank
+    flushes the aggregate upward (MergeFrameIntoAggregate + relay).  The
+    rank-0 host's aggregate merges in-process."""
+    out = []
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    alive = coord[7]
+    for h in range(len(cfg.hosts)):
+        gathered, sdead = subs[h]
+        if not gathered:
+            continue
+        need = _live_members(cfg, h, alive, sdead)
+        have = tuple(g[0] for g in gathered)
+        if not need or set(have) != set(need):
+            continue
+        fshut = any(g[2] for g in gathered)
+        fexits = tuple(sorted(r for r, _, _, x in gathered if x))
+        # The aggregate's epoch is stamped when the sub BUILDS it
+        # (membership_epoch_.load() at the agg sites), i.e. the sub's
+        # epoch when its own frame joined the gathering — captured at
+        # send time, never restamped in flight.
+        leader_ep = max((e for r, e, _, _ in gathered
+                         if r == cfg.leaders[h]),
+                        default=max(e for _, e, _, _ in gathered))
+        agg = ("agg", h, leader_ep, fshut, fexits, sdead)
+        nsubs = list(subs)
+        nsubs[h] = ((), sdead)
+        nst = (ranks, tuple(nsubs), coord, up, down, newt, fb, stale)
+        ev = set()
+        if cfg.leaders[h] == 0:
+            nst = _coord_merge(cfg, nst, agg, ev)
+        else:
+            nst = nst[:3] + (nst[3] + (agg,),) + nst[4:]
+        out.append(("sub_flush(h%d)" % h, nst, ev))
+    return out
+
+
+def act_coord_tick(cfg, st):
+    """Rank 0 has every live host's aggregate: close the tick.  Branch
+    order mirrors ProcessResponseList/CoordinatorMaybeReshape: reshape
+    barrier first, then shutdown, then steady entry / normal response."""
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp) = coord
+    if abort:
+        return []
+    if ranks[0][0] != R_WAIT:
+        # The tick is computed on rank 0's own thread, after it merged
+        # its in-process frame and finished the per-child recv loop —
+        # never while rank 0 is between passes (RunLoopOnce structure).
+        return []
+    need_hosts = set(cfg.host_of[r] for r in alive if r not in dead)
+    if not need_hosts or not set(got) >= need_hosts:
+        return []
+    live = tuple(r for r in alive if r not in dead)
+    if steady and not set(exits) >= set(live):
+        return []  # CoordinatorSteadyPoll: hold until AllSteadyExited
+    ev = set()
+    label = "coord_tick"
+    if cfg.elastic and (dead or joinp):
+        survivors = tuple(r for r in alive if r not in dead)
+        if len(survivors) < cfg.min_size:
+            ev.add("abort:ST_RANKS_DOWN")
+            ncoord = _coord(coord, abort=STATUS["ST_RANKS_DOWN"],
+                            got=(), steady=False, exits=())
+            nranks, ndown = _broadcast(cfg, ranks, down, alive,
+                                       ("abort", cep, "ST_RANKS_DOWN"),
+                                       ev)
+            return [(label + "(ranks_down)",
+                     (nranks, subs, ncoord, up, ndown, newt, fb, stale),
+                     ev)]
+        newalive = survivors
+        nranks = ranks
+        if joinp:
+            j = cfg.standby[0]
+            newalive = tuple(sorted(newalive + (j,)))
+            # Joiner adopts the survivors' program position (elastic
+            # state broadcast; abstracted to the tick counter).
+            jtick = max((ranks[r][2] for r in survivors), default=0)
+            nranks = _rank(ranks, j, mode=R_RUN, epoch=cep + 1,
+                           tick=jtick)
+            ev.add("reshape_grow")
+        if dead:
+            ev.add("reshape_shrink")
+        ncoord = _coord(coord, epoch=cep + 1, got=(), shut=False,
+                        exits=(), dead=(), hist=0, steady=False,
+                        alive=newalive, joinp=False)
+        # Sub dead-marks are consumed by the barrier (membership reset).
+        nsubs = tuple(((), ()) for _ in cfg.hosts)
+        frame = ("reshape", cep + 1, newalive)
+        # The joiner does NOT also get the frame queued: the admitting
+        # broadcast IS the standby's admission message, consumed while
+        # it blocks in the rejoin wait (SetupRejoinSockets) — modeled by
+        # the _rank() adoption above.  Queueing it again would wedge a
+        # later abort behind an undeliverable frame (found by the deep
+        # config: freeze after a grow left the joiner stranded in 'R'
+        # behind its own admission frame while everyone else aborted
+        # ST_TIMEOUT).
+        skip = {cfg.standby[0]} if joinp else set()
+        nranks, ndown = _broadcast(cfg, nranks, down, newalive, frame,
+                                   ev, skip=skip)
+        return [(label + "(reshape)",
+                 (nranks, nsubs, ncoord, up, ndown, newt, fb, stale), ev)]
+    if not cfg.elastic and dead:
+        return []  # handled by act_coord_abort (EOF cascade)
+    if shut:
+        ev.add("shutdown")
+        ncoord = _coord(coord, got=(), steady=False, exits=(), shut=True)
+        nranks, ndown = _broadcast(cfg, ranks, down, alive,
+                                   ("shut", cep, 0), ev)
+        return [(label + "(shutdown)",
+                 (nranks, subs, ncoord, up, ndown, newt, fb, stale), ev)]
+    resumed = steady
+    nhist = 0 if resumed else hist + 1
+    if (cfg.threshold and not resumed and nhist >= cfg.threshold):
+        ev.add("steady_enter")
+        ncoord = _coord(coord, got=(), hist=0, steady=True, exits=())
+        nranks, ndown = _broadcast(cfg, ranks, down, alive,
+                                   ("steady", cep, 0), ev)
+        return [(label + "(steady)",
+                 (nranks, subs, ncoord, up, ndown, newt, fb, stale), ev)]
+    if resumed:
+        ev.add("steady_resume")
+    ncoord = _coord(coord, got=(), hist=nhist, steady=False, exits=())
+    nranks, ndown = _broadcast(cfg, ranks, down, alive, ("resp", cep, 0),
+                               ev)
+    return [(label, (nranks, subs, ncoord, up, ndown, newt, fb, stale),
+             ev)]
+
+
+def act_deliver_down(cfg, st):
+    """Deliver the head of a rank's response FIFO.  Frozen ranks never
+    read their socket; crashed ranks drop frames on the floor.  A rank
+    in R_RUN is between ticks — it sends its next frame BEFORE reading,
+    so ordinary responses wait in the FIFO until it blocks again (only
+    the abort/shutdown cascade reaches it out of band)."""
+    out = []
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    for r in range(cfg.nranks):
+        if not down[r]:
+            continue
+        mode = ranks[r][0]
+        if mode == R_FROZEN:
+            continue
+        head = down[r][0][0]
+        if (mode in (R_RUN, R_STANDBY, R_STUCK)
+                and head not in ("abort", "shut")
+                and not (mode == R_RUN and head == "revoke")):
+            # A straggler revoke IS deliverable to a running rank: the
+            # engine drains and discards it at the rank's next socket
+            # read no matter what it sent first.  Leaving it queued
+            # would head-block the abort cascade behind an undeliverable
+            # frame (found by the deep config: a rank that exited steady
+            # via group-timeout just before the revoke broadcast).
+            continue
+        frame, rest = down[r][0], down[r][1:]
+        ndown = list(down)
+        ndown[r] = rest
+        ev = set()
+        nranks = _apply_down(cfg, ranks, r, frame, ev)
+        out.append(("deliver_down(%d:%s)" % (r, frame[0]),
+                    (nranks, subs, coord, up, tuple(ndown), newt, fb,
+                     stale), ev))
+    return out
+
+
+def act_steady_replay(cfg, st):
+    """Self-clocked replay of one pattern cycle (SteadyLoopOnce): no
+    frames.  Data-plane coupling: a cycle cannot complete while a
+    crashed/frozen member never reaches it."""
+    out = []
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    alive = coord[7]
+    for r in alive:
+        mode, epoch, tick, exitm, pat = ranks[r]
+        if mode != R_STEADY or tick >= cfg.ticks:
+            continue
+        if newt >= 0 and tick >= newt:
+            continue  # the new tensor is a miss, not a replay
+        blocked = any(ranks[p][0] in (R_CRASH, R_FROZEN)
+                      and ranks[p][2] <= tick
+                      for p in alive if p != r)
+        if blocked:
+            continue
+        ev = {"steady_replay"}
+        out.append(("steady_replay(%d)" % r,
+                    (_rank(ranks, r, tick=tick + 1), subs, coord, up,
+                     down, newt, fb, stale), ev))
+    return out
+
+
+def act_steady_exit(cfg, st):
+    """Leave self-clocked mode and fall back to negotiation
+    (ExitSteadyLocal + requeue): on a pattern miss (new tensor), at end
+    of program, or when the data plane starves the group (2s group
+    timeout) because a member is dead/frozen."""
+    out = []
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    alive = coord[7]
+    for r in alive:
+        mode, epoch, tick, exitm, pat = ranks[r]
+        if mode != R_STEADY:
+            continue
+        reason = None
+        if newt >= 0 and tick >= newt:
+            reason = "miss"
+        elif tick >= cfg.ticks:
+            reason = "shutdown"
+        elif (cfg.group_timeout
+              and any(ranks[p][0] in (R_CRASH, R_FROZEN)
+                      and ranks[p][2] <= tick
+                      for p in alive if p != r)):
+            reason = "group-timeout"
+        if reason is None:
+            continue
+        ev = {"steady_exit"}
+        out.append(("steady_exit(%d:%s)" % (r, reason),
+                    (_rank(ranks, r, mode=R_RUN, exitm=1, pat=-1), subs,
+                     coord, up, down, newt, fb, stale), ev))
+    return out
+
+
+def act_coord_revoke_reshape(cfg, st):
+    """Rank 0, steady, elastic, reshape pending (death or standby):
+    broadcast a bare revocation so every survivor falls back to
+    negotiation, then let the barrier fire on the next regular tick
+    (MaybeRevokeSteadyForReshape)."""
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp) = coord
+    if (not cfg.elastic or not steady or abort
+            or cfg.bug == "skip-revoke"):
+        return []
+    if not dead and not joinp:
+        return []
+    ev = {"steady_revoke_reshape"}
+    # The gathering resets: announces/shutdown/exit markers already
+    # latched persist (shut/exits/dead fields), but the next regular
+    # tick needs one FRESH liveness frame from every live rank — the
+    # engine's next RunLoopOnce pass runs a full per-child recv round,
+    # and every revoked rank resends after consuming the revocation.
+    # An old frame still in flight counts toward the new round and the
+    # resend lags one tick (frames carry deltas, so that is harmless);
+    # the model's dup-drop at merge is the same abstraction.  Rank 0's
+    # own frame is different: it is an in-process merge rebuilt on every
+    # RunLoopOnce pass, so the revocation discards the current one.
+    ncoord = _coord(coord, steady=False, exits=(), hist=0, got=())
+    h0 = cfg.host_of[0]
+    gathered, sdead = subs[h0]
+    nsubs = list(subs)
+    nsubs[h0] = (tuple(g for g in gathered if g[0] != 0), sdead)
+    frame = ("revoke", cep, 0)
+    nranks, ndown = _broadcast(cfg, ranks, down, alive, frame, ev,
+                               skip=set(dead))
+    return [("coord_revoke_reshape",
+             (nranks, tuple(nsubs), ncoord, up, ndown, newt, fb, stale),
+             ev)]
+
+
+def act_eof_detect(cfg, st):
+    """A crashed rank's parent observes EOF and marks it dead: the sub
+    excludes it from gathering and piggybacks dead_ranks on the next
+    aggregate; rank 0's own children mark straight into the barrier
+    bookkeeping (MarkRankDead)."""
+    out = []
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    for r in range(cfg.nranks):
+        if ranks[r][0] != R_CRASH or r not in coord[7]:
+            continue
+        h = cfg.host_of[r]
+        gathered, sdead = subs[h]
+        if r in sdead:
+            continue
+        ev = {"eof"}
+        nsubs = list(subs)
+        # The dead rank's queued frames die with the connection.
+        ngathered = tuple(g for g in gathered if g[0] != r)
+        nsubs[h] = (ngathered, tuple(sorted(sdead + (r,))))
+        nst = (ranks, tuple(nsubs), coord, up, down, newt, fb, stale)
+        if r == cfg.leaders[h] or cfg.leaders[h] == 0:
+            # Leaders (every rank, in the star) hold a connection to
+            # rank 0 itself, so their EOF lands straight in the barrier
+            # bookkeeping; a leaf's EOF is seen by its sub-coordinator
+            # and piggybacks on the next aggregate's dead_ranks.
+            ncoord = _coord(coord,
+                            dead=tuple(sorted(set(coord[4]) | {r})))
+            nst = nst[:2] + (ncoord,) + nst[3:]
+        out.append(("eof_detect(%d)" % r, nst, ev))
+    return out
+
+
+def act_coord_abort(cfg, st):
+    """Non-elastic EOF cascade: peer death is unrecoverable, broadcast a
+    typed ST_ABORTED so every survivor exits the same way."""
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp) = coord
+    if cfg.elastic or not dead or abort:
+        return []
+    ev = {"abort:ST_ABORTED"}
+    ncoord = _coord(coord, abort=STATUS["ST_ABORTED"], got=(),
+                    steady=False, exits=())
+    nranks, ndown = _broadcast(cfg, ranks, down, alive,
+                               ("abort", cep, "ST_ABORTED"), ev,
+                               skip=set(dead))
+    return [("coord_abort(eof)",
+             (nranks, subs, ncoord, up, ndown, newt, fb, stale), ev)]
+
+
+def act_timeout(cfg, st):
+    """Time-abstracted exchange-silence timeout: a frozen rank blocks
+    progress (no frame, no EOF) until CheckCollectiveTimeout fires a
+    typed ST_TIMEOUT.  Model limitation, pinned as xfail in
+    invariants.py: under elastic the desirable end state would be
+    evict-and-reshape, which needs the control-plane heartbeat of
+    ROADMAP item 1 — the engine today aborts, and so does the model."""
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp) = coord
+    if abort:
+        return []
+    if not any(ranks[r][0] == R_FROZEN for r in alive):
+        return []
+    ev = {"abort:ST_TIMEOUT"}
+    ncoord = _coord(coord, abort=STATUS["ST_TIMEOUT"], got=(),
+                    steady=False, exits=())
+    nranks, ndown = _broadcast(cfg, ranks, down, alive,
+                               ("abort", cep, "ST_TIMEOUT"), ev)
+    return [("timeout_fire",
+             (nranks, subs, ncoord, up, ndown, newt, fb, stale), ev)]
+
+
+def act_fault(cfg, st):
+    """Inject one fault from the configured set (budget-bounded)."""
+    out = []
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    if fb <= 0 or coord[8]:
+        return out
+    alive = coord[7]
+    for spec in cfg.faults:
+        ev = {spec.split(":")[0]}
+        if spec.startswith("crash:") or spec.startswith("freeze:"):
+            kind, r = spec.split(":")
+            r = int(r)
+            if r not in alive or ranks[r][0] not in (R_RUN, R_WAIT,
+                                                     R_STEADY):
+                continue
+            nmode = R_CRASH if kind == "crash" else R_FROZEN
+            out.append(("fault(%s)" % spec,
+                        (_rank(ranks, r, mode=nmode), subs, coord, up,
+                         down, newt, fb - 1, stale), ev))
+        elif spec == "join":
+            if (not cfg.elastic or coord[9] or not cfg.standby
+                    or cfg.standby[0] in alive):
+                continue
+            ncoord = _coord(coord, joinp=True)
+            out.append(("fault(join)",
+                        (ranks, subs, ncoord, up, down, newt, fb - 1,
+                         stale), ev))
+        elif spec == "newt":
+            if newt >= 0:
+                continue
+            steady_ticks = [ranks[r][2] for r in alive
+                            if ranks[r][0] == R_STEADY]
+            if not steady_ticks:
+                continue
+            at = max(steady_ticks) + 1
+            if at >= cfg.ticks:
+                continue
+            out.append(("fault(newt@%d)" % at,
+                        (ranks, subs, coord, up, down, at, fb - 1,
+                         stale), ev))
+    return out
+
+
+ACTIONS = (act_send, act_deliver_up, act_sub_flush, act_coord_tick,
+           act_deliver_down, act_steady_replay, act_steady_exit,
+           act_coord_revoke_reshape, act_eof_detect, act_coord_abort,
+           act_timeout, act_fault)
+
+
+def successors(cfg, st):
+    """Every enabled transition from ``st``: (label, line, state, events)."""
+    out = []
+    for act in ACTIONS:
+        line = act.__code__.co_firstlineno
+        for label, nst, ev in act(cfg, st):
+            out.append((label, line, nst, ev))
+    return out

@@ -1,0 +1,35 @@
+"""Render counterexample traces as readable event scripts.
+
+Each step points at the model action that produced it in
+``tools/hvdmodel/model.py`` (file:line of the ``act_*`` function), so a
+trace doubles as an index into the modeled protocol — and, through the
+comments on each action, into the corresponding ``engine.cc`` code.
+"""
+
+_MODEL_FILE = "tools/hvdmodel/model.py"
+
+
+def render(cfg, code, detail, steps):
+    lines = [
+        "VIOLATION %s in config '%s': %s" % (code, cfg.name, detail),
+        "shortest failing interleaving (%d steps):" % len(steps),
+    ]
+    if not steps:
+        lines.append("  (violated in the initial state)")
+    for i, (label, line) in enumerate(steps, 1):
+        lines.append("  %2d. %s:%-4d %s" % (i, _MODEL_FILE, line, label))
+    return "\n".join(lines)
+
+
+def summarize(res):
+    cov = ", ".join(sorted(res.coverage)) or "(none)"
+    lines = [
+        "config '%s': %d states, %d transitions, %d terminals%s"
+        % (res.cfg.name, res.states, res.transitions, res.terminals,
+           " (truncated)" if res.truncated else ""),
+        "  coverage: %s" % cov,
+    ]
+    for tag, n in sorted(res.xfails.items()):
+        lines.append("  xfail %s: %d terminal(s) (documented in "
+                     "invariants.py)" % (tag, n))
+    return "\n".join(lines)
