@@ -214,6 +214,8 @@ def _load_lib():
         lib.hvd_tpu_clock_offset_us.argtypes = []
         lib.hvd_tpu_clock_rtt_us.restype = ctypes.c_longlong
         lib.hvd_tpu_clock_rtt_us.argtypes = []
+        lib.hvd_tpu_liveness_info.restype = ctypes.c_char_p
+        lib.hvd_tpu_liveness_info.argtypes = []
         lib.hvd_tpu_announce_count.restype = ctypes.c_longlong
         lib.hvd_tpu_announce_count.argtypes = []
         lib.hvd_tpu_announce_log.restype = ctypes.c_char_p
@@ -959,6 +961,46 @@ def _sync_engine_control() -> None:
         })
 
 
+def _sync_engine_liveness() -> None:
+    """Mirror the engine's data-plane heartbeat detector into the
+    registry's ungated ``"liveness"`` section (docs/fault-tolerance.md
+    #failure-detection): the configured cadence and miss limit, beacon
+    frame totals, miss/eviction events, per-peer last-seen ages, and the
+    init clock-sync fan-in.  A state copy like the control sync — the C
+    counters are cumulative, so overwriting is idempotent."""
+    if _lib is None:
+        return
+    with _stall_sync_lock:
+        info = _lib.hvd_tpu_liveness_info().decode()
+        parts = info.split("|")
+        if len(parts) < 8:
+            return
+        try:
+            (interval_ms, miss_limit, sent, recv, miss_events, evictions,
+             fanin) = (int(p) for p in parts[:7])
+        except ValueError:
+            return
+        peers = {}
+        for tok in parts[7].split():
+            fields = tok.split(":")
+            if len(fields) != 3:
+                continue
+            try:
+                peers[int(fields[0])] = {"age_us": int(fields[1]),
+                                         "misses": int(fields[2])}
+            except ValueError:
+                continue
+        metrics.registry.set_liveness({
+            "interval_ms": interval_ms,
+            "miss_limit": miss_limit,
+            "frames": {"sent": sent, "received": recv},
+            "miss_events": miss_events,
+            "evictions": evictions,
+            "clock_fanin": fanin,
+            "peers": peers,
+        })
+
+
 def _sync_engine_autotune() -> None:
     """Mirror the engine's autotuning state into the registry's ungated
     ``"autotune"`` section (docs/performance.md#autotuning).  Unlike the
@@ -993,6 +1035,7 @@ def metrics_snapshot() -> dict:
     _sync_engine_compression()
     _sync_engine_topology()
     _sync_engine_control()
+    _sync_engine_liveness()
     return metrics.registry.snapshot()
 
 

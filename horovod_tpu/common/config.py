@@ -162,6 +162,24 @@ class Config:
     coord_tree: bool = True
     steady_threshold: int = 32
     steady_max_period: int = 256
+    # Data-plane heartbeat failure detector (docs/fault-tolerance.md
+    # #failure-detection).  heartbeat_ms (HVD_TPU_HEARTBEAT_MS, default
+    # 100): every rank's monitor thread beacons tiny typed frames to its
+    # ring neighbours over dedicated data-plane sockets on this cadence,
+    # entirely off the engine tick; 0 disables the detector.
+    # heartbeat_miss (HVD_TPU_HEARTBEAT_MISS, default 10): consecutive
+    # silent intervals before a neighbour is flagged frozen — elastic
+    # jobs evict it through the reshape barrier, non-elastic jobs reach
+    # a coordinated RanksDownError naming it, in O(heartbeat window)
+    # instead of O(collective timeout).  net_fault_spec
+    # (HVD_TPU_NET_FAULT_SPEC, common chaos grammar): deterministic
+    # link-fault injection, e.g. "link=0-1:drop@after=2" or
+    # "partition=0,1/2,3@after=1" or "link=1-2:delay=5|jitter=3" or
+    # "link=0-3:flaky=0.05"; parsed by the engine at init (a bad spec is
+    # a typed init error) and composable with HVD_TPU_FAULT_SPEC.
+    heartbeat_ms: int = 100
+    heartbeat_miss: int = 10
+    net_fault_spec: str = ""
 
     @property
     def compression_code(self) -> int:
@@ -238,4 +256,10 @@ class Config:
                 "HVD_TPU_STEADY_THRESHOLD") or 32),
             steady_max_period=int(os.environ.get(
                 "HVD_TPU_STEADY_MAX_PERIOD") or 256),
+            heartbeat_ms=int(os.environ.get("HVD_TPU_HEARTBEAT_MS")
+                             if os.environ.get("HVD_TPU_HEARTBEAT_MS")
+                             not in (None, "") else 100),
+            heartbeat_miss=int(os.environ.get(
+                "HVD_TPU_HEARTBEAT_MISS") or 10),
+            net_fault_spec=os.environ.get("HVD_TPU_NET_FAULT_SPEC", ""),
         )

@@ -249,6 +249,19 @@ class MetricsRegistry:
             "negotiated_ticks": 0,
             "frames": {"sent": 0, "received": 0},
         }
+        # Data-plane liveness (docs/fault-tolerance.md#failure-detection):
+        # the heartbeat detector's configuration, beacon frame totals,
+        # miss/eviction events, per-peer last-seen ages for the directly
+        # monitored beacon neighbours, and the init clock-sync fan-in
+        # (rank 0: peers probed directly — O(hosts) under the tree
+        # relay).  Ungated, like stalls: fault tests assert eviction
+        # counts without enabling full metrics.
+        self._liveness = {
+            "interval_ms": 0, "miss_limit": 0,
+            "frames": {"sent": 0, "received": 0},
+            "miss_events": 0, "evictions": 0, "clock_fanin": 0,
+            "peers": {},
+        }
         # State plane (docs/fault-tolerance.md#state-plane): snapshot /
         # peer-copy / restore counters and the checkpoint lifecycle.
         # Ungated, like stalls: the elastic acceptance path asserts
@@ -414,6 +427,24 @@ class MetricsRegistry:
                 "negotiated_ticks": int(state.get("negotiated_ticks", 0)),
                 "frames": {d: int(state.get("frames", {}).get(d, 0))
                            for d in ("sent", "received")},
+            }
+
+    def set_liveness(self, state: dict) -> None:
+        """Mirror the engine's heartbeat-detector state (a state copy —
+        the underlying counters are cumulative, so overwriting is
+        idempotent, like the control mirror).  Ungated."""
+        with self._lock:
+            self._liveness = {
+                "interval_ms": int(state.get("interval_ms", 0)),
+                "miss_limit": int(state.get("miss_limit", 0)),
+                "frames": {d: int(state.get("frames", {}).get(d, 0))
+                           for d in ("sent", "received")},
+                "miss_events": int(state.get("miss_events", 0)),
+                "evictions": int(state.get("evictions", 0)),
+                "clock_fanin": int(state.get("clock_fanin", 0)),
+                "peers": {int(r): {"age_us": int(v.get("age_us", 0)),
+                                   "misses": int(v.get("misses", 0))}
+                          for r, v in state.get("peers", {}).items()},
             }
 
     def set_autotune(self, report: dict) -> None:
@@ -620,6 +651,13 @@ class MetricsRegistry:
                        if k not in ("steady", "frames")},
                     "steady": dict(self._control["steady"]),
                     "frames": dict(self._control["frames"]),
+                },
+                "liveness": {
+                    **{k: v for k, v in self._liveness.items()
+                       if k not in ("frames", "peers")},
+                    "frames": dict(self._liveness["frames"]),
+                    "peers": {r: dict(v) for r, v in
+                              self._liveness["peers"].items()},
                 },
                 "state": {
                     **{k: v for k, v in self._state.items()
@@ -960,6 +998,42 @@ def prometheus_text(snapshot: dict) -> str:
     out.append("# TYPE hvd_tpu_control_frames_total counter")
     for d, n in ctrl.get("frames", {}).items():
         out.append(f'hvd_tpu_control_frames_total{{dir="{d}"}} {n}')
+
+    live = snapshot.get("liveness", {})
+    out.append("# HELP hvd_tpu_liveness_interval_ms data-plane heartbeat "
+               "interval (0 = detector disabled; docs/fault-tolerance.md"
+               "#failure-detection)")
+    out.append("# TYPE hvd_tpu_liveness_interval_ms gauge")
+    out.append(f"hvd_tpu_liveness_interval_ms {live.get('interval_ms', 0)}")
+    out.append("# HELP hvd_tpu_liveness_miss_limit consecutive missed "
+               "beacon intervals before a peer is flagged")
+    out.append("# TYPE hvd_tpu_liveness_miss_limit gauge")
+    out.append(f"hvd_tpu_liveness_miss_limit {live.get('miss_limit', 0)}")
+    out.append("# HELP hvd_tpu_liveness_frames_total heartbeat beacons "
+               "this rank sent/received on the data plane")
+    out.append("# TYPE hvd_tpu_liveness_frames_total counter")
+    for d, n in live.get("frames", {}).items():
+        out.append(f'hvd_tpu_liveness_frames_total{{dir="{d}"}} {n}')
+    out.append("# HELP hvd_tpu_liveness_miss_events_total peers flagged "
+               "silent past the miss window by this rank's detector")
+    out.append("# TYPE hvd_tpu_liveness_miss_events_total counter")
+    out.append("hvd_tpu_liveness_miss_events_total "
+               f"{live.get('miss_events', 0)}")
+    out.append("# HELP hvd_tpu_liveness_evictions_total ranks the "
+               "coordinator marked down from heartbeat evidence")
+    out.append("# TYPE hvd_tpu_liveness_evictions_total counter")
+    out.append(f"hvd_tpu_liveness_evictions_total {live.get('evictions', 0)}")
+    out.append("# HELP hvd_tpu_liveness_clock_fanin peers this rank "
+               "probed directly during init clock sync (rank 0 under the "
+               "sub-coordinator tree: O(hosts), not O(ranks))")
+    out.append("# TYPE hvd_tpu_liveness_clock_fanin gauge")
+    out.append(f"hvd_tpu_liveness_clock_fanin {live.get('clock_fanin', 0)}")
+    out.append("# HELP hvd_tpu_liveness_peer_age_us microseconds since "
+               "the last beacon from a directly monitored neighbour")
+    out.append("# TYPE hvd_tpu_liveness_peer_age_us gauge")
+    for r, v in live.get("peers", {}).items():
+        out.append(f'hvd_tpu_liveness_peer_age_us{{peer="{r}"}} '
+                   f'{v.get("age_us", 0)}')
 
     state = snapshot.get("state", {})
     out.append("# HELP hvd_tpu_state_armed state plane armed on this "

@@ -272,6 +272,17 @@ long long hvd_tpu_clock_offset_us() {
 
 long long hvd_tpu_clock_rtt_us() { return GlobalEngine()->ClockRttUs(); }
 
+// Data-plane liveness (docs/fault-tolerance.md#failure-detection):
+// "interval_ms|miss_limit|sent|recv|miss_events|evictions|clock_fanin|"
+// followed by space-separated "peer:last_seen_age_us:misses" entries for
+// the directly monitored beacon neighbours.  interval_ms 0 = detector
+// disabled.
+const char* hvd_tpu_liveness_info() {
+  static thread_local std::string tl_liveness;
+  tl_liveness = GlobalEngine()->LivenessInfo();
+  return tl_liveness.c_str();
+}
+
 // Announce-order observability for the Python metrics registry (straggler
 // attribution, rank-0 coordinator view): cumulative negotiation count, a
 // bounded log of the most recent ones as

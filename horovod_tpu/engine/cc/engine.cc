@@ -691,6 +691,36 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
     if (cap < 0) cap = 0;
     flight_.Initialize(cap, epoch_);
   }
+  // Data-plane heartbeat detector + link-fault injection
+  // (docs/fault-tolerance.md#failure-detection).  Env-read here like the
+  // flight recorder: every rank reads the same launcher environment, and
+  // the knobs must be known BEFORE SetupSockets (which dials the beat
+  // sockets only when the detector is on).
+  {
+    const char* hb_env = getenv("HVD_TPU_HEARTBEAT_MS");
+    hb_interval_ms_ = (hb_env && *hb_env) ? atoi(hb_env) : 100;
+    if (hb_interval_ms_ < 0) hb_interval_ms_ = 0;
+    const char* miss_env = getenv("HVD_TPU_HEARTBEAT_MISS");
+    hb_miss_limit_ = (miss_env && *miss_env) ? atoi(miss_env) : 10;
+    if (hb_miss_limit_ < 1) hb_miss_limit_ = 1;
+    const char* fault_env = getenv("HVD_TPU_NET_FAULT_SPEC");
+    std::string fault_err;
+    if (!NetFaultInit(fault_env ? fault_env : "", opts_.rank, &fault_err)) {
+      *err = "bad HVD_TPU_NET_FAULT_SPEC: " + fault_err;
+      return 1;
+    }
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    hb_last_seen_us_.clear();
+    hb_miss_counts_.clear();
+    pending_hb_dead_.clear();
+    pending_hb_report_.clear();
+    hb_wake_fds_.clear();
+    hb_ctrl_wake_fd_ = -1;
+    hb_epoch_ = 0;
+    hb_local_abort_msg_.clear();
+    hb_local_abort_.store(false);
+    hb_stop_.store(false);
+  }
   fast_ticks_ = 0;
   last_fusion_use_ = epoch_;
   // Every rank writes its own trace; the Python side resolves
@@ -794,6 +824,11 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   last_stall_check_ = std::chrono::steady_clock::now();
   initialized_.store(true);
   background_ = std::thread([this]() { BackgroundLoop(); });
+  // Liveness monitor: off the engine tick by construction, so a busy (or
+  // blocked) local ring never starves the beats.  An elastic solo rank
+  // starts it too — the first grow's RebuildRing hands it beat sockets.
+  if (hb_interval_ms_ > 0 && (opts_.size > 1 || opts_.elastic))
+    hb_thread_ = std::thread([this]() { HeartbeatLoop(); });
   return 0;
 }
 
@@ -1017,12 +1052,12 @@ bool Engine::SetupSockets(std::string* err) {
     opts_.cache_capacity = static_cast<int64_t>(reply[1]);
     opts_.coord_tree = reply[3] != 0;
   }
-  // Clock alignment for the per-rank timelines: NTP-style probes over the
-  // control sockets just established (docs/timeline.md).  Runs over the
-  // full init-time star, BEFORE the tree restructure below — the offsets
-  // are exactly what sub-coordinators later use to map their nodes'
-  // announce times onto rank 0's clock.
-  if (!ClockSync(err)) return false;
+  // (Clock alignment runs at the END of socket setup, AFTER the tree
+  // restructure and the data-plane accept loop: under the coordinator
+  // tree the probes are RELAYED through the sub-coordinators — rank 0
+  // probes only its direct children, each sub composes its own verdict
+  // with per-child probes over the tree sockets built below — so rank
+  // 0's init fan-in stays O(hosts) instead of the old O(ranks) star.)
   node_id_ = opts_.hierarchical_allreduce ? opts_.rank / opts_.local_size : 0;
   n_nodes_ = opts_.hierarchical_allreduce ? opts_.size / opts_.local_size : 1;
   topo_hier_.store(opts_.hierarchical_allreduce);
@@ -1120,6 +1155,25 @@ bool Engine::SetupSockets(std::string* err) {
   right_fd_ = connect_hello(opts_.data_endpoints[right],
                             kHelloRing | (uint32_t)opts_.rank, err);
   if (right_fd_ < 0) return false;
+  // Heartbeat beacon sockets (docs/fault-tolerance.md#failure-detection):
+  // rank r dials (r+1)%size and accepts (r-1+size)%size over the same
+  // data listener, typed hello kind 6 with the membership epoch in bits
+  // 16-23 (init epoch 0) and the sender rank in the low 16.  Dedicated
+  // fds, full-duplex, owned by the monitor thread — never the ring's.
+  const uint32_t kHelloBeat = 6u << 24;
+  const bool want_beats = hb_interval_ms_ > 0;
+  if (want_beats) {
+    int bfd = connect_hello(
+        opts_.data_endpoints[right],
+        kHelloBeat | ((uint32_t)opts_.rank & 0xffffu), err);
+    if (bfd < 0) {
+      *err = "heartbeat beacon connect failed: " + *err;
+      return false;
+    }
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    beat_out_fd_ = bfd;
+    beat_out_peer_ = right;
+  }
   if (hier) {
     // Node-local ring: every rank connects to its right local neighbour
     // (same node, local_rank+1 mod L) — the hop the local reduce-scatter
@@ -1164,6 +1218,8 @@ bool Engine::SetupSockets(std::string* err) {
     }
   }
   if (is_sub_coord_) expected += Lc - 1;  // this node's control sockets
+  if (want_beats) expected += 1;          // left neighbour's beat socket
+  const int beat_left = (opts_.rank + opts_.size - 1) % opts_.size;
   for (int i = 0; i < expected; ++i) {
     int fd = AcceptOne(data_listen_fd_, kTimeout, err);
     if (fd < 0) return false;
@@ -1200,6 +1256,17 @@ bool Engine::SetupSockets(std::string* err) {
         return false;
       }
       tree_child_fds_[child] = fd;
+    } else if (kind == kHelloBeat && want_beats &&
+               (id & 0xffffu) == (uint32_t)beat_left &&
+               ((id >> 16) & 0xff) == 0) {
+      std::lock_guard<std::mutex> lk(hb_mu_);
+      if (beat_in_fd_ >= 0) {
+        *err = "duplicate heartbeat hello " + std::to_string(hello);
+        CloseFd(fd);
+        return false;
+      }
+      beat_in_fd_ = fd;
+      beat_in_peer_ = beat_left;
     } else {
       *err = "unexpected data-plane hello " + std::to_string(hello);
       CloseFd(fd);
@@ -1221,10 +1288,95 @@ bool Engine::SetupSockets(std::string* err) {
                std::to_string(tree_child_ranks_[i]) + " never connected";
         return false;
       }
+  if (want_beats && beat_in_fd_ < 0) {
+    *err = "heartbeat beacon left neighbour never connected";
+    return false;
+  }
+  // Link-fault registry (net.h): every data/control/beat fd maps to the
+  // rank at its far end, so HVD_TPU_NET_FAULT_SPEC clauses naming ranks
+  // resolve to sockets.  The beat fds register too — a partitioned link
+  // MUST also silence its beacons, or the detector could never see the
+  // partition it exists to detect.
+  if (NetFaultActive()) {
+    NetFaultRegister(right_fd_, right);
+    NetFaultRegister(left_fd_, beat_left);
+    if (hier) {
+      int node_base = opts_.rank - opts_.local_rank;
+      NetFaultRegister(local_right_fd_,
+                       node_base + (opts_.local_rank + 1) % L);
+      NetFaultRegister(local_left_fd_,
+                       node_base + (opts_.local_rank + L - 1) % L);
+      if (n_nodes_ > 1) {
+        NetFaultRegister(cross_right_fd_,
+                         ((node_id_ + 1) % n_nodes_) * L + opts_.local_rank);
+        NetFaultRegister(cross_left_fd_, ((node_id_ + n_nodes_ - 1) %
+                                          n_nodes_) * L + opts_.local_rank);
+        for (int k = 0; k < tree_levels; ++k)
+          if (cross_tree_fds_[k] >= 0)
+            NetFaultRegister(cross_tree_fds_[k],
+                             (node_id_ ^ (1 << k)) * L + opts_.local_rank);
+      }
+    }
+    if (opts_.rank == 0) {
+      for (int r : coord_children_) NetFaultRegister(coord_fds_[r], r);
+    } else {
+      NetFaultRegister(coord_fd_, (tree_enabled_ && opts_.rank >= Lc &&
+                                   opts_.local_rank != 0)
+                                      ? opts_.rank - opts_.local_rank
+                                      : 0);
+      for (size_t i = 0; i < tree_child_fds_.size(); ++i)
+        NetFaultRegister(tree_child_fds_[i], tree_child_ranks_[i]);
+    }
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    NetFaultRegister(beat_out_fd_, beat_out_peer_);
+    NetFaultRegister(beat_in_fd_, beat_in_peer_);
+  }
+  // Arm the monitor's wake registry: the data-plane fds the engine thread
+  // can block in (ring exchanges), shut down by the monitor when it
+  // flags a silent peer so a survivor wakes in O(heartbeat) instead of
+  // stalling transitively behind the frozen rank.  NEVER the beat fds
+  // (the gossip must keep flowing) and never the control fds (only
+  // hb_ctrl_wake_fd_, at the local-abort escalation).
+  {
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    hb_wake_fds_.clear();
+    hb_wake_fds_.push_back(left_fd_);
+    hb_wake_fds_.push_back(right_fd_);
+    if (local_left_fd_ >= 0) hb_wake_fds_.push_back(local_left_fd_);
+    if (local_right_fd_ >= 0) hb_wake_fds_.push_back(local_right_fd_);
+    if (cross_left_fd_ >= 0) hb_wake_fds_.push_back(cross_left_fd_);
+    if (cross_right_fd_ >= 0) hb_wake_fds_.push_back(cross_right_fd_);
+    for (int fd : cross_tree_fds_)
+      if (fd >= 0) hb_wake_fds_.push_back(fd);
+    hb_ctrl_wake_fd_ = opts_.rank == 0 ? -1 : coord_fd_;
+    // Monitored peers start "just seen": the first miss window opens at
+    // init, not at the epoch of the clock.
+    int64_t now_us = EpochNowUs();
+    if (beat_in_peer_ >= 0) hb_last_seen_us_[beat_in_peer_] = now_us;
+    if (beat_out_peer_ >= 0) hb_last_seen_us_[beat_out_peer_] = now_us;
+  }
+  // Clock alignment for the per-rank timelines (docs/timeline.md),
+  // relayed through the coordinator tree when one was just built.
+  if (!ClockSync(err)) return false;
   return true;
 }
 
 void Engine::TeardownSockets() {
+  {
+    // The monitor is already joined (Shutdown) or was never started
+    // (init failure); clear its wake registry BEFORE any CloseFd below
+    // so no path can ever shut down a recycled fd number, and reap any
+    // beat fds it never got to.
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    hb_wake_fds_.clear();
+    hb_ctrl_wake_fd_ = -1;
+    CloseFd(beat_in_fd_);
+    CloseFd(beat_out_fd_);
+    beat_in_fd_ = beat_out_fd_ = -1;
+    beat_in_peer_ = beat_out_peer_ = -1;
+    for (int fd : hb_graveyard_) CloseFd(fd);
+    hb_graveyard_.clear();
+  }
   CloseFd(coord_listen_fd_);
   CloseFd(coord_fd_);
   for (int fd : coord_fds_) CloseFd(fd);
@@ -1277,56 +1429,70 @@ int64_t Engine::EpochNowUs() const {
 }
 
 bool Engine::ClockSync(std::string* err) {
-  // K round trips per worker; the minimum-RTT sample gives the best
-  // offset estimate (symmetric-path assumption: the worker's timestamp
-  // was taken at the probe's midpoint), its RTT the error bound.  The
+  // K round trips per probed peer; the minimum-RTT sample gives the best
+  // offset estimate (symmetric-path assumption: the peer's timestamp was
+  // taken at the probe's midpoint), its RTT the error bound.  The
   // verdict is sent back so each rank knows its own offset — each rank's
   // timeline records it for tools/timeline_merge.py.
+  //
+  // Under the coordinator tree the sync RELAYS: rank 0 probes only its
+  // direct children — O(hosts + local_size), not the O(ranks) star this
+  // replaced — and each sub-coordinator, once it holds its own verdict
+  // (offset o_s, error r_s), probes its leaves against ITS clock
+  // (offset o_c) and hands them the composed verdict {o_s + o_c,
+  // r_s + r_c}: leaf_clock = rank0_clock + o_s + o_c, with the error
+  // bounds summing along the relay path.
   const int kProbes = 8;
   if (opts_.size == 1) return true;
-  if (opts_.rank == 0) {
-    for (int r = 1; r < opts_.size; ++r) {
-      int64_t best_rtt = -1, best_off = 0;
-      for (int k = 0; k < kProbes; ++k) {
-        uint8_t probe = 1;
-        int64_t t0 = EpochNowUs();
-        if (!SendAll(coord_fds_[r], &probe, 1)) {
-          *err = "clock sync probe send failed (rank " + std::to_string(r) +
-                 ")";
-          return false;
-        }
-        int64_t worker_ts;
-        if (!RecvAll(coord_fds_[r], &worker_ts, 8)) {
-          *err = "clock sync reply recv failed (rank " + std::to_string(r) +
-                 ")";
-          return false;
-        }
-        int64_t t1 = EpochNowUs();
-        int64_t rtt = t1 - t0;
-        if (best_rtt < 0 || rtt < best_rtt) {
-          best_rtt = rtt;
-          best_off = worker_ts - (t0 + t1) / 2;
-        }
+  auto probe_peer = [&](int fd, int64_t* best_off,
+                        int64_t* best_rtt) -> bool {
+    *best_rtt = -1;
+    *best_off = 0;
+    for (int k = 0; k < kProbes; ++k) {
+      uint8_t probe = 1;
+      int64_t t0 = EpochNowUs();
+      if (!SendAll(fd, &probe, 1)) return false;
+      int64_t peer_ts;
+      if (!RecvAll(fd, &peer_ts, 8)) return false;
+      int64_t t1 = EpochNowUs();
+      int64_t rtt = t1 - t0;
+      if (*best_rtt < 0 || rtt < *best_rtt) {
+        *best_rtt = rtt;
+        *best_off = peer_ts - (t0 + t1) / 2;
       }
-      int64_t verdict[2] = {best_off, best_rtt};
+    }
+    return true;
+  };
+  auto serve_probes = [&](int fd) -> bool {
+    for (int k = 0; k < kProbes; ++k) {
+      uint8_t probe;
+      if (!RecvAll(fd, &probe, 1)) return false;
+      int64_t now = EpochNowUs();
+      if (!SendAll(fd, &now, 8)) return false;
+    }
+    return true;
+  };
+  if (opts_.rank == 0) {
+    int probed = 0;
+    for (int r : coord_children_) {
+      int64_t off, rtt;
+      if (!probe_peer(coord_fds_[r], &off, &rtt)) {
+        *err = "clock sync probe failed (rank " + std::to_string(r) + ")";
+        return false;
+      }
+      ++probed;
+      int64_t verdict[2] = {off, rtt};
       if (!SendAll(coord_fds_[r], verdict, sizeof verdict)) {
         *err = "clock sync verdict send failed (rank " + std::to_string(r) +
                ")";
         return false;
       }
     }
+    clock_fanin_.store(probed);
   } else {
-    for (int k = 0; k < kProbes; ++k) {
-      uint8_t probe;
-      if (!RecvAll(coord_fd_, &probe, 1)) {
-        *err = "clock sync probe recv failed";
-        return false;
-      }
-      int64_t now = EpochNowUs();
-      if (!SendAll(coord_fd_, &now, 8)) {
-        *err = "clock sync reply send failed";
-        return false;
-      }
+    if (!serve_probes(coord_fd_)) {
+      *err = "clock sync probe recv failed";
+      return false;
     }
     int64_t verdict[2];
     if (!RecvAll(coord_fd_, verdict, sizeof verdict)) {
@@ -1335,6 +1501,24 @@ bool Engine::ClockSync(std::string* err) {
     }
     clock_offset_us_.store(verdict[0]);
     clock_rtt_us_.store(verdict[1]);
+    // Sub-coordinator relay leg (tree_child_fds_ is empty off the tree).
+    int probed = 0;
+    for (size_t i = 0; i < tree_child_fds_.size(); ++i) {
+      int64_t off, rtt;
+      if (!probe_peer(tree_child_fds_[i], &off, &rtt)) {
+        *err = "clock sync relay probe failed (rank " +
+               std::to_string(tree_child_ranks_[i]) + ")";
+        return false;
+      }
+      ++probed;
+      int64_t composed[2] = {verdict[0] + off, verdict[1] + rtt};
+      if (!SendAll(tree_child_fds_[i], composed, sizeof composed)) {
+        *err = "clock sync relay verdict send failed (rank " +
+               std::to_string(tree_child_ranks_[i]) + ")";
+        return false;
+      }
+    }
+    if (probed > 0) clock_fanin_.store(probed);
   }
   return true;
 }
@@ -1389,9 +1573,323 @@ void Engine::Shutdown() {
   // after join there is nothing left to complete (new Enqueues are rejected
   // once loop_exited_ flips under mu_).
   if (background_.joinable()) background_.join();
+  StopHeartbeatMonitor();
   timeline_.Shutdown();
   TeardownSockets();
   initialized_.store(false);
+}
+
+void Engine::StopHeartbeatMonitor() {
+  hb_stop_.store(true);
+  {
+    // Wake the monitor out of any beat-socket poll; the fds stay
+    // allocated (shutdown, not close) until after the join.
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    ShutdownFd(beat_in_fd_);
+    ShutdownFd(beat_out_fd_);
+  }
+  if (hb_thread_.joinable()) hb_thread_.join();
+  // TeardownSockets reaps the beat fds and the graveyard.
+}
+
+void Engine::HeartbeatLoop() {
+  // Monitor thread contract (docs/fault-tolerance.md#failure-detection):
+  // beacons out and liveness in over the two dedicated beat fds, NEVER a
+  // control or ring socket, and no engine state beyond the hb_mu_-guarded
+  // block — escalation is queued for the engine thread (MarkRankDead and
+  // AbortLocal clear coordinator tables and the response cache, which
+  // only that thread may touch).  The one cross-thread action it takes
+  // is ShutdownFd on registered fds, which is the wake primitive.
+  const int64_t interval_us = static_cast<int64_t>(hb_interval_ms_) * 1000;
+  const int64_t window_us = interval_us * hb_miss_limit_;
+  std::vector<uint8_t> bufs[2];
+  bool eofs[2] = {false, false};
+  int cached_fds[2] = {-2, -2};
+  int cached_peers[2] = {-1, -1};
+  int64_t cached_epoch = -1;
+  std::vector<int> suspects;       // flagged this epoch (local + gossip)
+  int64_t grace_deadline_us = -1;  // -1 unarmed, -2 fired
+  int64_t last_beat_us = 0;
+  uint32_t seq = 0;
+
+  auto flagged = [&](int peer) {
+    for (int s : suspects)
+      if (s == peer) return true;
+    return false;
+  };
+  auto flag = [&](int peer) {
+    if (flagged(peer)) return;
+    suspects.push_back(peer);
+    hb_miss_events_.fetch_add(1);
+    if (flight_.Enabled()) flight_.Record(FL_HEARTBEAT_MISS, "flag", peer);
+    {
+      std::lock_guard<std::mutex> lk(hb_mu_);
+      if (cur_rank_.load() == 0)
+        pending_hb_dead_.push_back(peer);
+      else
+        pending_hb_report_.push_back(peer);
+      // Wake the engine thread out of any ring exchange: with a frozen
+      // participant the whole ring stalls transitively, so the job is
+      // headed for a reshape (elastic) or an abort either way — breaking
+      // the data links now converts an O(collective-timeout) hang into
+      // an O(heartbeat) typed verdict.  The registry is cleared by the
+      // engine (under this same mutex) before any of these fds is
+      // closed, so a recycled fd number can never be hit.
+      for (int fd : hb_wake_fds_) ShutdownFd(fd);
+    }
+    if (grace_deadline_us == -1) {
+      // One more miss window for the coordinated path (reports up, typed
+      // abort or reshape broadcast back) before concluding the
+      // coordinator itself is unreachable (partition) and escalating
+      // locally.  Elastic jobs get extra slack: a reshape needs a full
+      // revoke + barrier round trip.
+      int64_t extra = opts_.elastic ? 2000000 : 0;
+      grace_deadline_us = EpochNowUs() + window_us + extra;
+    }
+    queue_cv_.notify_all();
+  };
+
+  while (!hb_stop_.load()) {
+    int fds[2], peers[2];
+    int64_t ep;
+    {
+      std::lock_guard<std::mutex> lk(hb_mu_);
+      for (int fd : hb_graveyard_) CloseFd(fd);
+      hb_graveyard_.clear();
+      fds[0] = beat_in_fd_;
+      fds[1] = beat_out_fd_;
+      peers[0] = beat_in_peer_;
+      peers[1] = beat_out_peer_;
+      ep = hb_epoch_;
+    }
+    if (ep != cached_epoch) {
+      cached_epoch = ep;
+      suspects.clear();
+      grace_deadline_us = -1;
+    }
+    for (int i = 0; i < 2; ++i)
+      if (fds[i] != cached_fds[i]) {
+        cached_fds[i] = fds[i];
+        cached_peers[i] = peers[i];
+        bufs[i].clear();
+        eofs[i] = false;
+      }
+    if (fds[0] < 0 && fds[1] < 0) {
+      // Solo (or between reshapes): nothing to monitor yet.
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(hb_interval_ms_));
+      continue;
+    }
+    int64_t now = EpochNowUs();
+    if (now - last_beat_us >= interval_us) {
+      last_beat_us = now;
+      HeartbeatFrame hb;
+      hb.sender_rank = static_cast<uint32_t>(cur_rank_.load());
+      hb.epoch = static_cast<uint32_t>(ep);
+      hb.seq = seq++;
+      uint8_t frame[kHeartbeatFrameBytes];
+      SerializeHeartbeat(hb, frame);
+      for (int i = 0; i < 2; ++i)
+        if (fds[i] >= 0 && SendAll(fds[i], frame, sizeof frame))
+          hb_sent_.fetch_add(1);
+      // Suspect gossip: repeat every accusation each interval so it hops
+      // rank to rank even when the frozen rank sits between the accuser
+      // and rank 0 (the mid-steady partition story).
+      for (int s : suspects) {
+        HeartbeatFrame g;
+        g.magic = kSuspectMagic;
+        g.sender_rank = hb.sender_rank;
+        g.epoch = hb.epoch;
+        g.seq = static_cast<uint32_t>(s);
+        SerializeHeartbeat(g, frame);
+        for (int i = 0; i < 2; ++i)
+          if (fds[i] >= 0) SendAll(fds[i], frame, sizeof frame);
+      }
+      // Miss accounting for the (up to two) directly monitored peers.
+      std::vector<int> to_flag;
+      {
+        std::lock_guard<std::mutex> lk(hb_mu_);
+        for (int i = 0; i < 2; ++i) {
+          int peer = cached_peers[i];
+          if (peer < 0 || (i == 1 && peer == cached_peers[0])) continue;
+          auto it = hb_last_seen_us_.find(peer);
+          if (it == hb_last_seen_us_.end())
+            it = hb_last_seen_us_.emplace(peer, now).first;
+          int misses = static_cast<int>((now - it->second) / interval_us);
+          hb_miss_counts_[peer] = misses;
+          if (misses >= hb_miss_limit_) to_flag.push_back(peer);
+        }
+      }
+      for (int peer : to_flag) flag(peer);
+    }
+    if (grace_deadline_us >= 0 && now > grace_deadline_us) {
+      grace_deadline_us = -2;
+      if (abort_code_.load() == 0 && !suspects.empty()) {
+        // The coordinated escalation never came back: the path to rank 0
+        // is itself dead (network partition).  Latch the typed local
+        // verdict for the engine thread and break its parent wait.
+        std::vector<int> sorted = suspects;
+        std::sort(sorted.begin(), sorted.end());
+        std::string csv;
+        for (int s : sorted)
+          csv += (csv.empty() ? "" : ", ") + std::to_string(s);
+        {
+          std::lock_guard<std::mutex> lk(hb_mu_);
+          hb_local_abort_msg_ =
+              "ranks down: " + csv +
+              " (no data-plane heartbeats within the detection window; "
+              "process(es) frozen or network partitioned, and the "
+              "coordinator is unreachable). The job was aborted; restart "
+              "it (e.g. hvdrun --max-restarts) to resume from the latest "
+              "checkpoint.";
+          hb_local_abort_.store(true);
+          ShutdownFd(hb_ctrl_wake_fd_);
+        }
+        if (flight_.Enabled())
+          flight_.Record(FL_HEARTBEAT_MISS, "local-abort", sorted[0]);
+        queue_cv_.notify_all();
+      }
+    }
+    // Nap, then drain whatever beacons arrived.  The nap paces the loop
+    // well under the beat interval so send jitter never costs a miss.
+    int nap_ms = hb_interval_ms_ / 4;
+    if (nap_ms < 1) nap_ms = 1;
+    if (nap_ms > 25) nap_ms = 25;
+    std::this_thread::sleep_for(std::chrono::milliseconds(nap_ms));
+    for (int i = 0; i < 2; ++i) {
+      if (cached_fds[i] < 0 || eofs[i]) continue;
+      if (!RecvAvailable(cached_fds[i], &bufs[i])) {
+        // EOF/error: a crashed peer.  Stop reading; its silence ages out
+        // through the same miss path a freeze takes.
+        eofs[i] = true;
+        continue;
+      }
+      size_t off = 0;
+      while (bufs[i].size() - off >= kHeartbeatFrameBytes) {
+        HeartbeatFrame in;
+        if (ParseHeartbeat(bufs[i].data() + off, &in) &&
+            static_cast<int64_t>(in.epoch) == ep) {
+          if (in.magic == kSuspectMagic) {
+            int s = static_cast<int>(in.seq);
+            if (s >= 0 && s < cur_size_.load() && s != cur_rank_.load())
+              flag(s);
+          } else {
+            hb_recv_.fetch_add(1);
+            int sender = static_cast<int>(in.sender_rank);
+            std::lock_guard<std::mutex> lk(hb_mu_);
+            hb_last_seen_us_[sender] = EpochNowUs();
+            hb_miss_counts_[sender] = 0;
+          }
+        }
+        off += kHeartbeatFrameBytes;
+      }
+      if (off > 0)
+        bufs[i].erase(bufs[i].begin(),
+                      bufs[i].begin() + static_cast<long>(off));
+    }
+  }
+}
+
+bool Engine::CheckHeartbeatLocalAbort() {
+  if (!hb_local_abort_.load()) return false;
+  std::string msg;
+  {
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    msg = hb_local_abort_msg_;
+  }
+  AbortLocal(ST_RANKS_DOWN, msg);
+  return true;
+}
+
+void Engine::CoordinatorDrainHeartbeatDeaths() {
+  if (!coord_) return;
+  std::vector<int> dead;
+  {
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    if (pending_hb_dead_.empty()) return;
+    dead.swap(pending_hb_dead_);
+  }
+  for (int r : dead) {
+    if (r <= 0 || r >= opts_.size || coord_->rank_dead[r]) continue;
+    hb_evictions_.fetch_add(1);
+    MarkRankDead(r,
+                 "no data-plane heartbeats at rank 0 for the miss window; "
+                 "process frozen or link partitioned");
+  }
+}
+
+bool Engine::SendHeartbeatReports(int fd) {
+  std::vector<int> reports;
+  {
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    if (pending_hb_report_.empty()) return true;
+    reports.swap(pending_hb_report_);
+  }
+  if (fd < 0) return true;
+  RequestList rl;
+  rl.membership_epoch = membership_epoch_.load();
+  rl.hb_report = true;
+  for (int r : reports) {
+    rl.dead_ranks.push_back(r);
+    if (flight_.Enabled()) flight_.Record(FL_HEARTBEAT_MISS, "report", r);
+  }
+  if (!SendFrame(fd, SerializeRequestList(rl))) return false;
+  ctrl_frames_sent_.fetch_add(1);
+  return true;
+}
+
+bool Engine::WaitParentSliced(int fd, double total_sec) {
+  // total_sec < 0 means "no deadline" (collective timeout disabled).
+  if (hb_interval_ms_ <= 0) {
+    if (total_sec < 0) {
+      while (!WaitReadable(fd, 3600.0)) {
+      }
+      return true;
+    }
+    return WaitReadable(fd, total_sec);
+  }
+  // Slice the blocking parent wait so the heartbeat escalation stays
+  // live inside it: pending reports go up (the coordinator handles
+  // out-of-band hb_report frames at any point in the alternation) and a
+  // monitor-latched local abort breaks the wait immediately.
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(total_sec);
+  for (;;) {
+    if (hb_local_abort_.load()) return false;
+    SendHeartbeatReports(fd);
+    double left =
+        total_sec < 0 ? 0.05
+                      : std::chrono::duration<double>(
+                            deadline - std::chrono::steady_clock::now())
+                            .count();
+    if (left <= 0.0) return false;
+    if (WaitReadable(fd, std::min(0.05, left))) return true;
+  }
+}
+
+std::string Engine::LivenessInfo() {
+  std::string out = std::to_string(hb_interval_ms_) + "|" +
+                    std::to_string(hb_miss_limit_) + "|" +
+                    std::to_string(hb_sent_.load()) + "|" +
+                    std::to_string(hb_recv_.load()) + "|" +
+                    std::to_string(hb_miss_events_.load()) + "|" +
+                    std::to_string(hb_evictions_.load()) + "|" +
+                    std::to_string(clock_fanin_.load()) + "|";
+  std::lock_guard<std::mutex> lk(hb_mu_);
+  std::vector<int> peers;
+  for (const auto& kv : hb_last_seen_us_) peers.push_back(kv.first);
+  std::sort(peers.begin(), peers.end());
+  int64_t now = EpochNowUs();
+  bool first = true;
+  for (int p : peers) {
+    if (!first) out += ' ';
+    first = false;
+    auto mit = hb_miss_counts_.find(p);
+    out += std::to_string(p) + ":" +
+           std::to_string(now - hb_last_seen_us_[p]) + ":" +
+           std::to_string(mit == hb_miss_counts_.end() ? 0 : mit->second);
+  }
+  return out;
 }
 
 void Engine::BackgroundLoop() {
@@ -1565,6 +2063,10 @@ void MergeFrameIntoAggregate(const RequestList& frame, int rank, int64_t ts,
 bool Engine::RunLoopOnce() {
   auto tick_start = std::chrono::steady_clock::now();
 
+  // Monitor-latched partition verdict: surface it before anything else
+  // touches a socket this pass.
+  if (CheckHeartbeatLocalAbort()) return false;
+
   // Reclaim the fusion buffer after a sustained idle stretch (it
   // previously only ever grew, pinning its high-water mark for the life
   // of the process): a burst of big fused allreduces no longer holds tens
@@ -1623,6 +2125,7 @@ bool Engine::RunLoopOnce() {
     // reshape barriers must still run — a job shrunk to one rank keeps
     // accepting standbys).
     CoordinatorAcceptJoiners();
+    CoordinatorDrainHeartbeatDeaths();
     coord_->shutdown_requested |= my_requests.shutdown;
     if (my_requests.steady_exit) NoteSteadyExit(0);
     CoordinatorHandle(my_requests, 0);
@@ -1650,52 +2153,109 @@ bool Engine::RunLoopOnce() {
       // Fall through: THIS pass builds and broadcasts the resume list —
       // frames already polled above, so skip the per-child recv loop.
     } else {
-      for (int r : coord_children_) {
-        if (coord_->rank_dead[r]) continue;
-        // Liveness: a healthy child's engine thread sends a frame every
-        // cycle (~5ms), so with a hard deadline configured, a deadline
-        // of control-plane silence means the child PROCESS is frozen
-        // (SIGSTOP, OOM thrash) or partitioned — a state socket EOF
-        // never reports, and one that would otherwise block this recv
-        // (and with it the timeout sweep below) forever.
-        bool sub_lead = tree_enabled_ && r >= opts_.local_size;
-        // A healthy sub-coordinator may itself block up to one deadline
-        // probing a frozen LEAF before its aggregate (naming the true
-        // dead rank) goes out — give it the same widened bound the
-        // workers give the coordinator, or rank 0 would misattribute a
-        // leaf freeze to the whole node.
-        double wait_sec = sub_lead ? 2 * opts_.collective_timeout_sec + 5.0
-                                   : opts_.collective_timeout_sec;
-        if (opts_.collective_timeout_sec > 0 &&
-            !WaitReadable(coord_fds_[r], wait_sec)) {
-          char why[112];
-          snprintf(why, sizeof(why),
-                   "no control-plane traffic for %.0fs; %s frozen or "
-                   "network partitioned",
-                   opts_.collective_timeout_sec,
-                   sub_lead ? "sub-coordinator" : "process");
-          MarkRankDead(r, why);
-          continue;
+      // One frame per live child per tick, collected in ARRIVAL order:
+      // a frozen child must not head-of-line-block its healthy siblings,
+      // whose frames (and heartbeat reports) are exactly what lets the
+      // sweep mark the frozen one dead in O(heartbeat) rather than its
+      // own O(collective-timeout) deadline.
+      //
+      // Liveness: a healthy child's engine thread sends a frame every
+      // cycle (~5ms), so with a hard deadline configured, a deadline of
+      // control-plane silence means the child PROCESS is frozen
+      // (SIGSTOP, OOM thrash) or partitioned — a state socket EOF never
+      // reports.  A healthy sub-coordinator may itself block up to one
+      // deadline probing a frozen LEAF before its aggregate (naming the
+      // true dead rank) goes out — it gets the same widened bound the
+      // workers give the coordinator, or rank 0 would misattribute a
+      // leaf freeze to the whole node.
+      std::vector<int> waiting;
+      for (int r : coord_children_)
+        if (!coord_->rank_dead[r]) waiting.push_back(r);
+      auto sweep_start = std::chrono::steady_clock::now();
+      const double T = opts_.collective_timeout_sec;
+      while (!waiting.empty()) {
+        CoordinatorDrainHeartbeatDeaths();
+        for (size_t i = 0; i < waiting.size();)
+          if (coord_->rank_dead[waiting[i]])
+            waiting.erase(waiting.begin() + i);
+          else
+            ++i;
+        bool progressed = false;
+        for (size_t i = 0; i < waiting.size();) {
+          int r = waiting[i];
+          int fd = coord_fds_[r];
+          bool sub_lead = tree_enabled_ && r >= opts_.local_size;
+          bool consumed_tick = false, lost = false;
+          while (WaitReadable(fd, 0.0)) {
+            std::vector<uint8_t> buf;
+            if (!RecvFrame(fd, &buf)) {
+              // A child died (control-socket EOF): escalate to a
+              // coordinated ABORT naming the missing rank and the
+              // tensors it left pending (sharpens the reference's
+              // SHUT_DOWN_ERROR path, operations.cc:1579-1605, into a
+              // structured status).
+              MarkRankDead(r, sub_lead
+                                  ? "sub-coordinator connection lost "
+                                    "(its node is unreachable)"
+                                  : "connection lost at the coordinator");
+              lost = true;
+              break;
+            }
+            ctrl_frames_recv_.fetch_add(1);
+            RequestList rl;
+            if (!ParseRequestList(buf, &rl)) continue;
+            coord_->last_frame_tick[r] = ticks_done_.load();
+            coord_->shutdown_requested |= rl.shutdown;
+            CoordinatorHandle(rl, r);
+            // Out-of-band heartbeat reports ride BETWEEN tick frames
+            // (wire.h RequestList.hb_report); keep waiting for the
+            // child's real frame.
+            if (rl.hb_report) continue;
+            consumed_tick = true;
+            break;
+          }
+          if (consumed_tick || lost) {
+            waiting.erase(waiting.begin() + i);
+            progressed = true;
+          } else {
+            ++i;
+          }
         }
-        std::vector<uint8_t> buf;
-        if (!RecvFrame(coord_fds_[r], &buf)) {
-          // A child died (control-socket EOF): escalate to a coordinated
-          // ABORT naming the missing rank and the tensors it left
-          // pending (sharpens the reference's SHUT_DOWN_ERROR path,
-          // operations.cc:1579-1605, into a structured status).
-          MarkRankDead(r, sub_lead
-                              ? "sub-coordinator connection lost (its "
-                                "node is unreachable)"
-                              : "connection lost at the coordinator");
-          continue;
+        if (waiting.empty() || progressed) continue;
+        double waited = std::chrono::duration<double>(
+                            std::chrono::steady_clock::now() - sweep_start)
+                            .count();
+        if (T > 0) {
+          bool timed_out = false;
+          for (size_t i = 0; i < waiting.size();) {
+            int r = waiting[i];
+            bool sub_lead = tree_enabled_ && r >= opts_.local_size;
+            if (waited > (sub_lead ? 2 * T + 5.0 : T)) {
+              char why[112];
+              snprintf(why, sizeof(why),
+                       "no control-plane traffic for %.0fs; %s frozen or "
+                       "network partitioned",
+                       T, sub_lead ? "sub-coordinator" : "process");
+              MarkRankDead(r, why);
+              waiting.erase(waiting.begin() + i);
+              timed_out = true;
+            } else {
+              ++i;
+            }
+          }
+          if (timed_out) continue;
         }
-        ctrl_frames_recv_.fetch_add(1);
-        RequestList rl;
-        if (ParseRequestList(buf, &rl)) {
-          coord_->last_frame_tick[r] = ticks_done_.load();
-          coord_->shutdown_requested |= rl.shutdown;
-          CoordinatorHandle(rl, r);
+        // Nothing ready and nobody over deadline: block on the first
+        // straggler, sliced so heartbeat deaths and other children's
+        // frames keep getting service.
+        double slice = hb_interval_ms_ > 0 ? 0.05 : 1.0;
+        if (T > 0) {
+          bool first_sub =
+              tree_enabled_ && waiting[0] >= opts_.local_size;
+          double left = (first_sub ? 2 * T + 5.0 : T) - waited;
+          if (left < slice) slice = std::max(left, 0.001);
         }
+        WaitReadable(coord_fds_[waiting[0]], slice);
       }
     }
     CheckCollectiveTimeout();
@@ -1757,29 +2317,55 @@ bool Engine::RunLoopOnce() {
     MergeFrameIntoAggregate(my_requests, opts_.rank,
                             EpochNowUs() - clock_offset_us_.load(), &agg,
                             &idx);
+    // This sub's own monitor flags ride up inside the aggregate's
+    // dead_ranks (the pending_dead_reports_ flush below), exactly like a
+    // child EOF it observed.
+    {
+      std::lock_guard<std::mutex> lk(hb_mu_);
+      for (int r : pending_hb_report_) pending_dead_reports_.push_back(r);
+      pending_hb_report_.clear();
+    }
     for (size_t i = 0; i < tree_child_fds_.size(); ++i) {
       if (tree_child_dead_[i]) continue;
       int fd = tree_child_fds_[i];
       int crank = tree_child_ranks_[i];
-      if (opts_.collective_timeout_sec > 0 &&
-          !WaitReadable(fd, opts_.collective_timeout_sec)) {
-        tree_child_dead_[i] = true;
-        agg.dead_ranks.push_back(crank);
-        continue;
-      }
-      std::vector<uint8_t> buf;
-      if (!RecvFrame(fd, &buf)) {
-        tree_child_dead_[i] = true;
-        agg.dead_ranks.push_back(crank);
-        continue;
-      }
-      ctrl_frames_recv_.fetch_add(1);
-      RequestList child;
-      if (ParseRequestList(buf, &child)) {
-        NoteChildSteadyExit(child, crank);
-        MergeFrameIntoAggregate(child, crank,
-                                EpochNowUs() - clock_offset_us_.load(),
-                                &agg, &idx);
+      // Sliced child wait: a child's out-of-band heartbeat report must
+      // relay upward (and this sub's own local-abort latch must fire)
+      // without waiting out a frozen leaf's full deadline.
+      auto child_start = std::chrono::steady_clock::now();
+      const double T = opts_.collective_timeout_sec;
+      for (;;) {
+        if (WaitReadable(fd, hb_interval_ms_ > 0 ? 0.05 : (T > 0 ? T : 1.0))) {
+          std::vector<uint8_t> buf;
+          if (!RecvFrame(fd, &buf)) {
+            tree_child_dead_[i] = true;
+            agg.dead_ranks.push_back(crank);
+            break;
+          }
+          ctrl_frames_recv_.fetch_add(1);
+          RequestList child;
+          if (!ParseRequestList(buf, &child)) continue;
+          if (child.hb_report) {
+            // Relay the report's dead_ranks in this tick's aggregate and
+            // keep waiting for the child's real frame.
+            for (int32_t r : child.dead_ranks) agg.dead_ranks.push_back(r);
+            continue;
+          }
+          NoteChildSteadyExit(child, crank);
+          MergeFrameIntoAggregate(child, crank,
+                                  EpochNowUs() - clock_offset_us_.load(),
+                                  &agg, &idx);
+          break;
+        }
+        if (hb_local_abort_.load()) break;  // surfaced next pass
+        double waited = std::chrono::duration<double>(
+                            std::chrono::steady_clock::now() - child_start)
+                            .count();
+        if (T > 0 && waited > T) {
+          tree_child_dead_[i] = true;
+          agg.dead_ranks.push_back(crank);
+          break;
+        }
       }
     }
     for (int32_t r : pending_dead_reports_) agg.dead_ranks.push_back(r);
@@ -1791,10 +2377,12 @@ bool Engine::RunLoopOnce() {
           "continue and should be restarted.";
     } else {
       ctrl_frames_sent_.fetch_add(1);
-      bool alive = opts_.collective_timeout_sec <= 0 ||
-                   WaitReadable(coord_fd_, ParentWaitSec());
+      bool alive = opts_.collective_timeout_sec <= 0
+                       ? WaitParentSliced(coord_fd_, -1.0)
+                       : WaitParentSliced(coord_fd_, ParentWaitSec());
       std::vector<uint8_t> buf;
       if (!alive) {
+        if (CheckHeartbeatLocalAbort()) return false;
         responses.abort_code = ST_RANKS_DOWN;
         responses.abort_message =
             "ranks down: 0 (coordinator unresponsive: no control-plane "
@@ -1803,6 +2391,7 @@ bool Engine::RunLoopOnce() {
             "restarted.";
       } else if (!RecvFrame(coord_fd_, &buf) ||
                  !ParseResponseList(buf, &responses)) {
+        if (CheckHeartbeatLocalAbort()) return false;
         responses.abort_code = ST_RANKS_DOWN;
         responses.abort_message =
             "ranks down: 0 (coordinator connection lost); this job cannot "
@@ -1815,7 +2404,11 @@ bool Engine::RunLoopOnce() {
       }
     }
   } else {
+    // Out-of-band heartbeat reports ride ahead of this tick's frame, so
+    // the send-one-wait-one alternation with the coordinator holds.
+    SendHeartbeatReports(coord_fd_);
     if (!SendFrame(coord_fd_, SerializeRequestList(my_requests))) {
+      if (CheckHeartbeatLocalAbort()) return false;
       responses.abort_code = ST_RANKS_DOWN;
       responses.abort_message =
           "ranks down: 0 (coordinator connection lost); this job cannot "
@@ -1825,10 +2418,12 @@ bool Engine::RunLoopOnce() {
       // Bound the response wait too: 2x the deadline plus slack, because
       // a healthy coordinator may itself block up to one deadline probing
       // a frozen THIRD rank before it aborts and responds.
-      bool alive = opts_.collective_timeout_sec <= 0 ||
-                   WaitReadable(coord_fd_, ParentWaitSec());
+      bool alive = opts_.collective_timeout_sec <= 0
+                       ? WaitParentSliced(coord_fd_, -1.0)
+                       : WaitParentSliced(coord_fd_, ParentWaitSec());
       std::vector<uint8_t> buf;
       if (!alive) {
+        if (CheckHeartbeatLocalAbort()) return false;
         responses.abort_code = ST_RANKS_DOWN;
         responses.abort_message =
             "ranks down: 0 (coordinator unresponsive: no control-plane "
@@ -1837,6 +2432,7 @@ bool Engine::RunLoopOnce() {
             "restarted.";
       } else if (!RecvFrame(coord_fd_, &buf) ||
                  !ParseResponseList(buf, &responses)) {
+        if (CheckHeartbeatLocalAbort()) return false;
         responses.abort_code = ST_RANKS_DOWN;
         responses.abort_message =
             "ranks down: 0 (coordinator connection lost); this job cannot "
@@ -2123,6 +2719,12 @@ bool Engine::CoordinatorSteadyPoll() {
   // without blocking; the collective-timeout sweep still covers
   // announced-but-incomplete negotiations (the mid-steady divergence
   // story), and socket EOF still covers crashes.
+  // Heartbeat escalation stays live mid-steady: rank 0's own monitor
+  // flags drain here (a frozen neighbour is evicted with zero control
+  // frames flowing), and workers' out-of-band hb_report frames arrive
+  // through the normal drain below — they parse as RequestLists whose
+  // dead_ranks CoordinatorHandle consumes.
+  CoordinatorDrainHeartbeatDeaths();
   for (int r : coord_children_) {
     if (coord_->rank_dead[r]) continue;
     int fd = coord_fds_[r];
@@ -2235,6 +2837,13 @@ bool Engine::SubRelayPass() {
   RequestList agg;
   SlotIndex idx;
   agg.membership_epoch = membership_epoch_.load();
+  // Own monitor flags ride up in this pass's aggregate dead_ranks, same
+  // as a child EOF this sub observed.
+  {
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    for (int r : pending_hb_report_) pending_dead_reports_.push_back(r);
+    pending_hb_report_.clear();
+  }
   for (size_t i = 0; i < tree_child_fds_.size(); ++i) {
     if (tree_child_dead_[i]) continue;
     int fd = tree_child_fds_[i];
@@ -2343,12 +2952,22 @@ bool Engine::SteadyLoopOnce() {
     if (rv < 0) return false;
     if (rv > 0) return true;  // revoked: next pass is a normal tick
   } else {
+    // A monitor-latched local abort (grace expired with the coordinator
+    // unreachable) surfaces here even with zero frames flowing.
+    if (hb_local_abort_.load()) {
+      ExitSteadyLocal("heartbeat-abort");
+      CheckHeartbeatLocalAbort();
+      return false;
+    }
     if (is_sub_coord_) {
       if (!SubRelayPass()) return false;
       // SubRelayPass may have exited steady (abort consumed elsewhere);
       // fall through so the normal loop takes over next pass.
       if (!steady_active_.load()) return true;
     } else {
+      // Out-of-band heartbeat reports flow mid-steady too — rank 0's
+      // steady poll drains them like fallback frames.
+      SendHeartbeatReports(coord_fd_);
       while (coord_fd_ >= 0 && WaitReadable(coord_fd_, 0.0)) {
         std::vector<uint8_t> buf;
         if (!RecvFrame(coord_fd_, &buf)) {
@@ -2624,10 +3243,18 @@ void Engine::CoordinatorHandle(const RequestList& rl, int from_rank) {
   for (int32_t r : rl.frames_from)
     if (r >= 0 && r < static_cast<int>(coord_->last_frame_tick.size()))
       coord_->last_frame_tick[r] = ticks_done_.load();
-  // Worker deaths the sub-coordinator observed (control-socket EOF).
+  // Worker deaths observed elsewhere: control-socket EOF at a
+  // sub-coordinator, or — when the frame is an out-of-band heartbeat
+  // report — a peer's data-plane beacons going silent.
   for (int32_t r : rl.dead_ranks)
-    if (r > 0 && r < opts_.size)
-      MarkRankDead(r, "connection lost at its sub-coordinator");
+    if (r > 0 && r < opts_.size) {
+      if (rl.hb_report && !coord_->rank_dead[r])
+        hb_evictions_.fetch_add(1);
+      MarkRankDead(r, rl.hb_report
+                          ? "missed data-plane heartbeats; process frozen "
+                            "or link partitioned"
+                          : "connection lost at its sub-coordinator");
+    }
   if (rl.steady_exit) {
     // The direct-frame exit marker carries the miss coordinates: land
     // them in rank 0's flight ring so the postmortem can say WHERE in
@@ -4002,6 +4629,31 @@ bool Engine::ApplyReshape(const ResponseList& rl) {
 }
 
 bool Engine::RebuildRing(std::string* err) {
+  // Quiesce the heartbeat monitor for the rebuild: clear the wake
+  // registry FIRST (the fds it lists are about to be closed), then move
+  // the old beat sockets to the graveyard — ShutdownFd kicks the monitor
+  // out of any blocking poll on them, and it closes the fds itself on
+  // its next pass after re-reading the swapped state (closing here would
+  // race fd reuse against its poll set).
+  {
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    hb_wake_fds_.clear();
+    hb_ctrl_wake_fd_ = -1;
+    if (beat_in_fd_ >= 0) {
+      ShutdownFd(beat_in_fd_);
+      hb_graveyard_.push_back(beat_in_fd_);
+    }
+    if (beat_out_fd_ >= 0) {
+      ShutdownFd(beat_out_fd_);
+      hb_graveyard_.push_back(beat_out_fd_);
+    }
+    beat_in_fd_ = beat_out_fd_ = -1;
+    beat_in_peer_ = beat_out_peer_ = -1;
+    hb_last_seen_us_.clear();
+    hb_miss_counts_.clear();
+    pending_hb_dead_.clear();
+    pending_hb_report_.clear();
+  }
   CloseFd(left_fd_);
   CloseFd(right_fd_);
   left_fd_ = right_fd_ = -1;
@@ -4012,7 +4664,7 @@ bool Engine::RebuildRing(std::string* err) {
   n_nodes_ = 1;
   topo_hier_.store(false);
   topo_nodes_.store(1);
-  if (opts_.size == 1) return true;
+  if (opts_.size == 1) return true;  // monitor idles on fd==-1
   const double kTimeout = 30.0;
   // Epoch-tagged hellos: a stale connect from a previous membership (or
   // a dying rank's last SYN in the backlog) parses as a mismatch and is
@@ -4022,6 +4674,8 @@ bool Engine::RebuildRing(std::string* err) {
   uint32_t hello = (3u << 24) | epoch_tag |
                    (static_cast<uint32_t>(opts_.rank) & 0xffff);
   int right = (opts_.rank + 1) % opts_.size;
+  const int beat_left = (opts_.rank + opts_.size - 1) % opts_.size;
+  const bool want_beats = hb_interval_ms_ > 0;
   std::string host;
   int port;
   if (!ParseEndpoint(opts_.data_endpoints[right], &host, &port)) {
@@ -4034,24 +4688,83 @@ bool Engine::RebuildRing(std::string* err) {
     *err = "ring-rebuild hello send failed";
     return false;
   }
-  for (int attempts = 0; attempts < 16 && left_fd_ < 0; ++attempts) {
+  // The beacon lane rebuilds with the ring, epoch-tagged the same way.
+  int new_beat_out = -1;
+  int new_beat_in = -1;
+  if (want_beats) {
+    uint32_t beat_hello = (6u << 24) | epoch_tag |
+                          (static_cast<uint32_t>(opts_.rank) & 0xffff);
+    new_beat_out = ConnectRetry(host, port, kTimeout, err);
+    if (new_beat_out < 0) return false;
+    if (!SendAll(new_beat_out, &beat_hello, 4)) {
+      CloseFd(new_beat_out);
+      *err = "beacon rebuild hello send failed";
+      return false;
+    }
+  }
+  for (int attempts = 0;
+       attempts < 32 && (left_fd_ < 0 || (want_beats && new_beat_in < 0));
+       ++attempts) {
     int fd = AcceptOne(data_listen_fd_, kTimeout, err);
-    if (fd < 0) return false;
+    if (fd < 0) {
+      if (new_beat_out >= 0 && beat_out_fd_ != new_beat_out)
+        CloseFd(new_beat_out);
+      return false;
+    }
     uint32_t peer = 0;
     if (!WaitReadable(fd, 2.0) || !RecvAll(fd, &peer, 4)) {
       CloseFd(fd);
       continue;
     }
-    if ((peer & 0xff000000u) == (3u << 24) &&
-        (peer & 0x00ff0000u) == epoch_tag) {
-      left_fd_ = fd;
-    } else {
+    if ((peer & 0x00ff0000u) != epoch_tag) {
       CloseFd(fd);  // stale pre-reshape connect
+      continue;
+    }
+    uint32_t kind = peer >> 24;
+    if (kind == 3 && left_fd_ < 0) {
+      left_fd_ = fd;
+    } else if (kind == 6 && want_beats && new_beat_in < 0 &&
+               (peer & 0xffffu) == static_cast<uint32_t>(beat_left)) {
+      new_beat_in = fd;
+    } else {
+      CloseFd(fd);
     }
   }
-  if (left_fd_ < 0) {
-    *err = "ring left neighbour never connected after the reshape";
+  if (left_fd_ < 0 || (want_beats && new_beat_in < 0)) {
+    if (new_beat_in >= 0) CloseFd(new_beat_in);
+    if (new_beat_out >= 0) CloseFd(new_beat_out);
+    *err = left_fd_ < 0
+               ? "ring left neighbour never connected after the reshape"
+               : "heartbeat beacon left neighbour never reconnected "
+                 "after the reshape";
     return false;
+  }
+  if (NetFaultActive()) {
+    NetFaultRegister(right_fd_, right);
+    NetFaultRegister(left_fd_, beat_left);
+    if (want_beats) {
+      NetFaultRegister(new_beat_out, right);
+      NetFaultRegister(new_beat_in, beat_left);
+    }
+  }
+  // Swap the new beacon lane in and re-arm the detector for the new
+  // membership in one atomic step (the monitor re-reads everything from
+  // hb_mu_-guarded state each pass).
+  {
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    beat_out_fd_ = new_beat_out;
+    beat_out_peer_ = want_beats ? right : -1;
+    beat_in_fd_ = new_beat_in;
+    beat_in_peer_ = want_beats ? beat_left : -1;
+    hb_epoch_ = static_cast<int>(membership_epoch_.load() & 0xff);
+    int64_t now = EpochNowUs();
+    if (want_beats) {
+      hb_last_seen_us_[right] = now;
+      hb_last_seen_us_[beat_left] = now;
+    }
+    hb_wake_fds_.push_back(left_fd_);
+    hb_wake_fds_.push_back(right_fd_);
+    hb_ctrl_wake_fd_ = opts_.rank == 0 ? -1 : coord_fd_;
   }
   return true;
 }

@@ -418,6 +418,17 @@ class Engine {
   bool SteadyActive() const { return steady_active_.load(); }
   int64_t CtrlFramesSent() const { return ctrl_frames_sent_.load(); }
 
+  // Liveness observability (docs/fault-tolerance.md#failure-detection).
+  // Serializes
+  // "hb_ms|hb_miss|sent|recv|miss_events|evictions|clock_fanin|
+  //  peer:age_us:misses peer:age_us:misses" — the detector config, the
+  // process-cumulative heartbeat counters (StallEvents contract), rank
+  // 0's init clock-sync probe fan-in (O(direct children), the tree-relay
+  // satellite's assert surface), and the per-monitored-peer last-seen
+  // age + consecutive-miss count at snapshot time.  Empty peer tail when
+  // the detector is off (HVD_TPU_HEARTBEAT_MS=0 or size 1).
+  std::string LivenessInfo();
+
   // Elastic-membership observability (docs/fault-tolerance.md).  The
   // epoch counts reshapes survived by THIS engine lifetime (0 until the
   // first); reshape/lost/joined totals are process-cumulative like
@@ -616,6 +627,41 @@ class Engine {
   // ranks and the tensors they left pending.
   void MarkRankDead(int r, const std::string& reason);
 
+  // Data-plane heartbeat failure detector (docs/fault-tolerance.md
+  // #failure-detection).  A dedicated monitor thread exchanges 16-byte
+  // typed beacons with BOTH ring neighbours over dedicated data-listener
+  // connections on the HVD_TPU_HEARTBEAT_MS cadence, entirely off the
+  // engine tick — a busy local ring cannot starve them, and a frozen
+  // peer's silence is observed in O(heartbeat) instead of
+  // O(collective-timeout).  The monitor NEVER touches control sockets or
+  // engine state: past HVD_TPU_HEARTBEAT_MISS silent intervals it
+  // records the miss, wakes the engine thread (ShutdownFd on the shared
+  // data fds), and queues the verdict for the engine thread to escalate
+  // through the existing machinery (MarkRankDead on rank 0; an
+  // out-of-band hb_report control frame on workers; a local typed abort
+  // when the report path itself is dark — the partition case).
+  void HeartbeatLoop();
+  // Stop + join the monitor and close the beat sockets (Teardown).
+  void StopHeartbeatMonitor();
+  // Engine thread, rank 0: drain monitor-flagged peers into MarkRankDead.
+  void CoordinatorDrainHeartbeatDeaths();
+  // Engine thread, workers: flush monitor-flagged peers upward as an
+  // out-of-band hb_report RequestList on `fd` (the parent control
+  // socket).  The frame carries ONLY dead_ranks — the receiver processes
+  // it and keeps waiting for this rank's real tick frame, preserving the
+  // send-one-wait-one alternation.  False on send failure.
+  bool SendHeartbeatReports(int fd);
+  // Sliced replacement for the blocking parent WaitReadable: flushes
+  // pending heartbeat reports between ~50ms slices and returns false
+  // early when the monitor latched a local abort.  Plain WaitReadable
+  // when the detector is off.
+  bool WaitParentSliced(int fd, double total_sec);
+  // Engine thread: when the monitor armed the local-abort verdict (its
+  // report window expired with the control plane equally dark), latch
+  // the typed abort here — AbortLocal clears the response cache, which
+  // is not safe from the monitor thread.  True when it aborted.
+  bool CheckHeartbeatLocalAbort();
+
   // Online autotuning (docs/performance.md#autotuning).  AttachTunedParams
   // runs at the coordinator after CoordinatorTick: it gives the
   // ParameterManager its per-tick chance to close a window / flush a
@@ -799,6 +845,54 @@ class Engine {
   // (peer node = node_id ^ (1 << k)).  Built only when n_nodes is a
   // power of two; empty otherwise (tree requests fall back to the ring).
   std::vector<int> cross_tree_fds_;
+
+  // Data-plane heartbeat detector state.  The beat fds ride the data
+  // listener (typed hello kind 6) to this rank's ring neighbours: rank r
+  // dials (r+1)%size (beat_out_fd_) and accepts (r-1+size)%size
+  // (beat_in_fd_); both sockets are full-duplex, so the monitor beats on
+  // and watches BOTH.  hb_mu_ guards every non-atomic field below — the
+  // monitor thread copies the fds/epoch under it each pass, the engine
+  // thread swaps them there at a reshape (old fds are shut down, parked
+  // in hb_graveyard_, and closed by the MONITOR on its next pass: the
+  // fd numbers stay allocated until the only thread that might still
+  // poll them has moved on).
+  std::mutex hb_mu_;
+  int beat_in_fd_ = -1, beat_out_fd_ = -1;
+  int beat_in_peer_ = -1, beat_out_peer_ = -1;
+  int64_t hb_epoch_ = 0;  // beats carry it; stale-epoch beats are ignored
+  std::vector<int> hb_graveyard_;
+  // Monitor-observed liveness per monitored peer rank: last-seen stamp
+  // (µs on the engine epoch clock; 0 = never) and consecutive misses.
+  std::unordered_map<int, int64_t> hb_last_seen_us_;
+  std::unordered_map<int, int> hb_miss_counts_;
+  // Monitor -> engine-thread escalation queues (hb_mu_):
+  std::vector<int> pending_hb_dead_;    // rank 0: MarkRankDead these
+  std::vector<int> pending_hb_report_;  // workers: hb_report these up
+  // Data-plane fds the monitor may ShutdownFd when it flags a peer, so a
+  // survivor blocked in a ring Exchange with the frozen rank wakes in
+  // O(heartbeat) instead of hanging.  Engine-maintained under hb_mu_ and
+  // CLEARED there before any CloseFd of a listed fd, so the monitor can
+  // never shut down a recycled fd number.  hb_ctrl_wake_fd_ is this
+  // rank's coordinator/parent control fd, shut down only at the
+  // local-abort escalation (the engine is then parked in a parent wait
+  // that must break before it can surface the typed verdict).
+  std::vector<int> hb_wake_fds_;
+  int hb_ctrl_wake_fd_ = -1;
+  std::string hb_local_abort_msg_;
+  std::atomic<bool> hb_local_abort_{false};
+  std::atomic<bool> hb_stop_{false};
+  std::thread hb_thread_;
+  int hb_interval_ms_ = 0;  // 0 = detector off (env HVD_TPU_HEARTBEAT_MS)
+  int hb_miss_limit_ = 10;  // env HVD_TPU_HEARTBEAT_MISS
+  // Process-cumulative liveness counters (StallEvents contract).
+  std::atomic<int64_t> hb_sent_{0};
+  std::atomic<int64_t> hb_recv_{0};
+  std::atomic<int64_t> hb_miss_events_{0};
+  std::atomic<int64_t> hb_evictions_{0};
+  // Rank 0: clock-sync probe fan-in of the last Init (number of peers
+  // rank 0 probed directly — O(hosts) under the tree relay, O(ranks)
+  // in the flat star).
+  std::atomic<int64_t> clock_fanin_{0};
 
   // Fusion buffer (lazily grown; analogue of the reference's persistent
   // fusion buffer, operations.cc:696-749).

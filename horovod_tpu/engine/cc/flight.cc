@@ -26,6 +26,7 @@ const char* FlightEventName(uint8_t event) {
     case FL_COMPRESS:  return "compress";
     case FL_TOPOLOGY:  return "topology";
     case FL_STEADY:    return "steady";
+    case FL_HEARTBEAT_MISS: return "heartbeat_miss";
     default:           return "unknown";
   }
 }

@@ -45,6 +45,11 @@ enum FlightEventType : uint8_t {
                      // exit reason with arg = the epoch it happened at) —
                      // the record that explains why a postmortem shows
                      // zero coordinator traffic before a hang
+  FL_HEARTBEAT_MISS = 13,  // data-plane heartbeat detector flagged a
+                           // silent peer (arg: the peer rank; name:
+                           // "flag" when first flagged, "report" when
+                           // the report frame went up, "local-abort"
+                           // when the grace deadline escalated locally)
 };
 
 const char* FlightEventName(uint8_t event);

@@ -12,9 +12,13 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
+#include <mutex>
 #include <thread>
+#include <unordered_map>
 
 namespace hvdtpu {
 
@@ -52,7 +56,274 @@ bool ResolveAddr(const std::string& host, int port, sockaddr_in* addr) {
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// Link-fault injection (HVD_TPU_NET_FAULT_SPEC).  One process-global
+// table: the spec is identical on every rank (each applies the clauses
+// touching its own links), clauses are parsed once per engine Init, and
+// every per-send decision is a mutex-guarded map lookup — zero cost when
+// no spec is armed (one relaxed atomic load).
+// ---------------------------------------------------------------------------
+
+struct FaultClause {
+  bool partition = false;          // partition=G1/G2 (drop across groups)
+  int a = -1, b = -1;              // link=A-B endpoints
+  std::vector<int> group_a, group_b;
+  bool drop = false;
+  double delay_ms = 0.0, jitter_ms = 0.0;
+  double flaky = 0.0;              // per-send chopped-write probability
+  double after_sec = 0.0;          // clause arms this long after Init
+};
+
+struct FaultFd {
+  int peer = -1;
+  int clause = -1;   // index into g_fault_clauses; -1 = no clause matches
+  uint32_t rng = 1;  // deterministic per-link LCG state
+};
+
+std::mutex g_fault_mu;
+std::vector<FaultClause> g_fault_clauses;
+std::unordered_map<int, FaultFd> g_fault_fds;
+int g_fault_rank = -1;
+uint32_t g_fault_seed = 0;
+double g_fault_t0 = 0.0;
+std::atomic<bool> g_fault_armed{false};
+
+bool ClauseMatches(const FaultClause& c, int me, int peer) {
+  if (c.partition) {
+    auto in = [](const std::vector<int>& g, int r) {
+      for (int x : g) if (x == r) return true;
+      return false;
+    };
+    return (in(c.group_a, me) && in(c.group_b, peer)) ||
+           (in(c.group_b, me) && in(c.group_a, peer));
+  }
+  return (c.a == me && c.b == peer) || (c.b == me && c.a == peer);
+}
+
+int ResolveClause(int me, int peer) {
+  for (size_t i = 0; i < g_fault_clauses.size(); ++i)
+    if (ClauseMatches(g_fault_clauses[i], me, peer))
+      return static_cast<int>(i);
+  return -1;
+}
+
+bool ClauseArmed(const FaultClause& c) {
+  return NowSec() - g_fault_t0 >= c.after_sec;
+}
+
+double NextRand01(uint32_t* state) {
+  *state = *state * 1664525u + 1013904223u;
+  return (*state >> 8) / double(1u << 24);
+}
+
+bool ParseRankCsv(const std::string& s, std::vector<int>* out) {
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t comma = s.find(',', pos);
+    std::string tok = s.substr(pos, comma == std::string::npos
+                                        ? std::string::npos
+                                        : comma - pos);
+    char* end = nullptr;
+    long r = strtol(tok.c_str(), &end, 10);
+    if (tok.empty() || *end != '\0' || r < 0) return false;
+    out->push_back(static_cast<int>(r));
+    if (comma == std::string::npos) break;
+    pos = comma + 1;
+  }
+  return !out->empty();
+}
+
+bool ParseFaultClause(std::string body, FaultClause* c, std::string* err) {
+  size_t after = body.rfind("@after=");
+  if (after != std::string::npos) {
+    char* end = nullptr;
+    c->after_sec = strtod(body.c_str() + after + 7, &end);
+    if (*end != '\0' || c->after_sec < 0) {
+      *err = "bad @after in '" + body + "'";
+      return false;
+    }
+    body = body.substr(0, after);
+  }
+  if (body.rfind("partition=", 0) == 0) {
+    c->partition = true;
+    c->drop = true;
+    std::string groups = body.substr(10);
+    size_t slash = groups.find('/');
+    if (slash == std::string::npos ||
+        !ParseRankCsv(groups.substr(0, slash), &c->group_a) ||
+        !ParseRankCsv(groups.substr(slash + 1), &c->group_b)) {
+      *err = "bad partition groups in '" + body + "'";
+      return false;
+    }
+    return true;
+  }
+  if (body.rfind("link=", 0) != 0) {
+    *err = "clause must start with link= or partition=: '" + body + "'";
+    return false;
+  }
+  size_t colon = body.find(':', 5);
+  if (colon == std::string::npos) {
+    *err = "link clause missing ':action' in '" + body + "'";
+    return false;
+  }
+  std::string pair = body.substr(5, colon - 5);
+  size_t dash = pair.find('-');
+  char* end = nullptr;
+  long a = strtol(pair.c_str(), &end, 10);
+  if (dash == std::string::npos || end != pair.c_str() + dash || a < 0) {
+    *err = "bad link endpoints in '" + body + "'";
+    return false;
+  }
+  long b = strtol(pair.c_str() + dash + 1, &end, 10);
+  if (*end != '\0' || b < 0 || a == b) {
+    *err = "bad link endpoints in '" + body + "'";
+    return false;
+  }
+  c->a = static_cast<int>(a);
+  c->b = static_cast<int>(b);
+  std::string actions = body.substr(colon + 1);
+  size_t pos = 0;
+  while (pos <= actions.size()) {
+    size_t bar = actions.find('|', pos);
+    std::string act = actions.substr(
+        pos, bar == std::string::npos ? std::string::npos : bar - pos);
+    if (act == "drop") {
+      c->drop = true;
+    } else if (act.rfind("delay=", 0) == 0) {
+      c->delay_ms = strtod(act.c_str() + 6, &end);
+      if (*end != '\0' || c->delay_ms < 0) {
+        *err = "bad delay in '" + body + "'";
+        return false;
+      }
+    } else if (act.rfind("jitter=", 0) == 0) {
+      c->jitter_ms = strtod(act.c_str() + 7, &end);
+      if (*end != '\0' || c->jitter_ms < 0) {
+        *err = "bad jitter in '" + body + "'";
+        return false;
+      }
+    } else if (act.rfind("flaky=", 0) == 0) {
+      c->flaky = strtod(act.c_str() + 6, &end);
+      if (*end != '\0' || c->flaky < 0 || c->flaky > 1) {
+        *err = "bad flaky probability in '" + body + "'";
+        return false;
+      }
+    } else {
+      *err = "unknown link action '" + act + "' in '" + body + "'";
+      return false;
+    }
+    if (bar == std::string::npos) break;
+    pos = bar + 1;
+  }
+  if (!c->drop && c->delay_ms == 0 && c->flaky == 0) {
+    *err = "link clause with no effect in '" + body + "'";
+    return false;
+  }
+  return true;
+}
+
 }  // namespace
+
+bool NetFaultInit(const std::string& spec, int my_rank, std::string* err) {
+  std::lock_guard<std::mutex> lk(g_fault_mu);
+  g_fault_clauses.clear();
+  g_fault_rank = my_rank;
+  g_fault_t0 = NowSec();
+  g_fault_seed = 2166136261u;
+  for (char ch : spec) g_fault_seed = (g_fault_seed ^ (uint8_t)ch) * 16777619u;
+  size_t pos = 0;
+  while (pos < spec.size()) {
+    size_t semi = spec.find(';', pos);
+    std::string body = spec.substr(
+        pos, semi == std::string::npos ? std::string::npos : semi - pos);
+    while (!body.empty() && body.front() == ' ') body.erase(body.begin());
+    while (!body.empty() && body.back() == ' ') body.pop_back();
+    if (!body.empty()) {
+      FaultClause c;
+      if (!ParseFaultClause(body, &c, err)) {
+        g_fault_clauses.clear();
+        g_fault_armed.store(false);
+        return false;
+      }
+      g_fault_clauses.push_back(std::move(c));
+    }
+    if (semi == std::string::npos) break;
+    pos = semi + 1;
+  }
+  // Re-resolve fds registered before a re-init against the fresh table.
+  for (auto& kv : g_fault_fds)
+    kv.second.clause = ResolveClause(g_fault_rank, kv.second.peer);
+  g_fault_armed.store(!g_fault_clauses.empty());
+  return true;
+}
+
+bool NetFaultActive() {
+  return g_fault_armed.load(std::memory_order_relaxed);
+}
+
+void NetFaultRegister(int fd, int peer_rank) {
+  if (fd < 0) return;
+  std::lock_guard<std::mutex> lk(g_fault_mu);
+  FaultFd f;
+  f.peer = peer_rank;
+  f.clause = ResolveClause(g_fault_rank, peer_rank);
+  // Seed from (spec, both endpoints) only — NOT the fd number — so a
+  // rerun draws the identical chop/jitter sequence per link.
+  int lo = std::min(g_fault_rank, peer_rank);
+  int hi = std::max(g_fault_rank, peer_rank);
+  f.rng = g_fault_seed ^ (static_cast<uint32_t>(lo) * 2654435761u) ^
+          (static_cast<uint32_t>(hi) * 40503u) ^ 1u;
+  g_fault_fds[fd] = f;
+}
+
+void NetFaultForget(int fd) {
+  // Erase even when disarmed: a stale entry on a recycled fd number would
+  // misattribute faults if a later NetFaultInit re-arms the table.
+  if (fd < 0) return;
+  std::lock_guard<std::mutex> lk(g_fault_mu);
+  g_fault_fds.erase(fd);
+}
+
+bool NetFaultDrops(int fd) {
+  if (!NetFaultActive()) return false;
+  std::lock_guard<std::mutex> lk(g_fault_mu);
+  auto it = g_fault_fds.find(fd);
+  if (it == g_fault_fds.end() || it->second.clause < 0) return false;
+  const FaultClause& c = g_fault_clauses[it->second.clause];
+  return c.drop && ClauseArmed(c);
+}
+
+void NetFaultDelay(int fd) {
+  if (!NetFaultActive()) return;
+  double sleep_ms = 0.0;
+  {
+    std::lock_guard<std::mutex> lk(g_fault_mu);
+    auto it = g_fault_fds.find(fd);
+    if (it == g_fault_fds.end() || it->second.clause < 0) return;
+    const FaultClause& c = g_fault_clauses[it->second.clause];
+    if (c.delay_ms <= 0 || !ClauseArmed(c)) return;
+    sleep_ms = c.delay_ms + c.jitter_ms * NextRand01(&it->second.rng);
+  }
+  std::this_thread::sleep_for(
+      std::chrono::duration<double, std::milli>(sleep_ms));
+}
+
+size_t NetFaultChop(int fd) {
+  if (!NetFaultActive()) return 0;
+  size_t chop = 0;
+  {
+    std::lock_guard<std::mutex> lk(g_fault_mu);
+    auto it = g_fault_fds.find(fd);
+    if (it == g_fault_fds.end() || it->second.clause < 0) return 0;
+    const FaultClause& c = g_fault_clauses[it->second.clause];
+    if (c.flaky <= 0 || !ClauseArmed(c)) return 0;
+    if (NextRand01(&it->second.rng) >= c.flaky) return 0;
+    chop = 1 + static_cast<size_t>(NextRand01(&it->second.rng) * 511);
+  }
+  // The "flaky" stall: long enough to exercise the partial-write retry
+  // paths, short enough that training completes (degradation, not fault).
+  std::this_thread::sleep_for(std::chrono::microseconds(200));
+  return chop;
+}
 
 bool ParseEndpoint(const std::string& ep, std::string* host, int* port) {
   size_t colon = ep.rfind(':');
@@ -180,9 +451,25 @@ int ConnectRetry(const std::string& host, int port, double timeout_sec,
 }
 
 bool SendAll(int fd, const void* buf, size_t len) {
+  size_t first_cap = 0;
+  if (NetFaultActive()) {
+    // A dropped link swallows the bytes but reports success: the sender
+    // keeps running and the receiver sees pure silence (never EOF) — the
+    // only observable is the heartbeat detector, exactly like a real
+    // blackholed route.
+    if (NetFaultDrops(fd)) return true;
+    NetFaultDelay(fd);
+    // Chop only the FIRST write of the call: one RNG draw per message,
+    // which is what "per send" means in the spec grammar, and the retry
+    // loop below transparently finishes the remainder.
+    first_cap = NetFaultChop(fd);
+  }
   const char* p = static_cast<const char*>(buf);
   while (len > 0) {
-    ssize_t n = send(fd, p, len, MSG_NOSIGNAL);
+    size_t want = len;
+    if (first_cap > 0 && first_cap < want) want = first_cap;
+    first_cap = 0;
+    ssize_t n = send(fd, p, want, MSG_NOSIGNAL);
     if (n <= 0) {
       if (n < 0 && (errno == EINTR || errno == EAGAIN)) continue;
       return false;
@@ -271,6 +558,14 @@ bool Exchange(int send_fd, const void* sbuf, size_t slen, int recv_fd,
   const char* sp = static_cast<const char*>(sbuf);
   char* rp = static_cast<char*>(rbuf);
   size_t sent = 0, recvd = 0;
+  bool flaky_send = false;
+  if (NetFaultActive()) {
+    if (NetFaultDrops(send_fd)) sent = slen;  // blackhole the send leg
+    if (sent < slen) {
+      NetFaultDelay(send_fd);
+      flaky_send = true;  // consult the chop table per send iteration
+    }
+  }
   // Same fd for both directions is fine: poll events are independent.
   while (sent < slen || recvd < rlen) {
     struct pollfd fds[2];
@@ -295,7 +590,12 @@ bool Exchange(int send_fd, const void* sbuf, size_t slen, int recv_fd,
       // would block until the whole remaining segment is buffered, stalling
       // the recv leg and deadlocking the ring when segments exceed kernel
       // socket buffering (all ranks sending, none draining).
-      ssize_t w = send(send_fd, sp + sent, slen - sent,
+      size_t want = slen - sent;
+      if (flaky_send) {
+        size_t cap = NetFaultChop(send_fd);
+        if (cap > 0 && cap < want) want = cap;
+      }
+      ssize_t w = send(send_fd, sp + sent, want,
                        MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EINTR && errno != EAGAIN) return false;
       if (w > 0) sent += static_cast<size_t>(w);
@@ -330,6 +630,14 @@ bool ExchangeBi(int right_fd, const void* send_r, size_t send_r_len,
   Leg sl{left_fd, static_cast<const char*>(send_l), nullptr, send_l_len};
   Leg rr{right_fd, nullptr, static_cast<char*>(recv_r), recv_r_len};
   Leg rl{left_fd, nullptr, static_cast<char*>(recv_l), recv_l_len};
+  bool flaky = false;
+  if (NetFaultActive()) {
+    if (NetFaultDrops(right_fd)) sr.done = sr.len;  // blackholed rightward
+    if (NetFaultDrops(left_fd)) sl.done = sl.len;   // blackholed leftward
+    if (sr.done < sr.len) NetFaultDelay(right_fd);
+    if (sl.done < sl.len) NetFaultDelay(left_fd);
+    flaky = true;
+  }
   auto pending = [](const Leg& l) { return l.done < l.len; };
   while (pending(sr) || pending(sl) || pending(rr) || pending(rl)) {
     struct pollfd fds[2];
@@ -345,11 +653,16 @@ bool ExchangeBi(int right_fd, const void* send_r, size_t send_r_len,
       return false;
     }
     if (r == 0) return false;  // 30s of total silence: peer is gone
-    auto drive_send = [](Leg& l, short revents) -> bool {
+    auto drive_send = [flaky](Leg& l, short revents) -> bool {
       if (!(l.done < l.len) ||
           !(revents & (POLLOUT | POLLERR | POLLHUP)))
         return true;
-      ssize_t w = send(l.fd, l.sp + l.done, l.len - l.done,
+      size_t want = l.len - l.done;
+      if (flaky) {
+        size_t cap = NetFaultChop(l.fd);
+        if (cap > 0 && cap < want) want = cap;
+      }
+      ssize_t w = send(l.fd, l.sp + l.done, want,
                        MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EINTR && errno != EAGAIN) return false;
       if (w > 0) l.done += static_cast<size_t>(w);
@@ -372,7 +685,9 @@ bool ExchangeBi(int right_fd, const void* send_r, size_t send_r_len,
 }
 
 void CloseFd(int fd) {
-  if (fd >= 0) close(fd);
+  if (fd < 0) return;
+  NetFaultForget(fd);
+  close(fd);
 }
 
 void ShutdownFd(int fd) {

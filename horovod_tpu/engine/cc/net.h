@@ -71,6 +71,45 @@ bool ExchangeBi(int right_fd, const void* send_r, size_t send_r_len,
 
 void CloseFd(int fd);
 
+// ---------------------------------------------------------------------------
+// Deterministic link-fault injection (HVD_TPU_NET_FAULT_SPEC, the chaos
+// harness of docs/fault-tolerance.md#failure-detection).  Semicolon-
+// separated clauses, each optionally suffixed `@after=S` (seconds after
+// NetFaultInit before the clause activates — stage faults past init
+// rendezvous):
+//   link=A-B:drop            blackhole the A<->B link (both endpoints
+//                            swallow their outbound bytes; receivers see
+//                            silence, never EOF — the partition shape
+//                            only the heartbeat detector can see)
+//   link=A-B:delay=MS        synchronous per-send delay on the link
+//   link=A-B:delay=MS|jitter=MS   + deterministic per-send jitter
+//   link=A-B:flaky=P         probability P per send of a chopped,
+//                            throttled partial write (absorbed by the
+//                            retry loops: degradation, not failure)
+//   partition=0,1/2,3        drop on EVERY link crossing the two groups
+// Every rank parses the same spec and applies the clauses whose link
+// touches it, so a dropped link is dark in BOTH directions without any
+// cross-rank coordination.  Faults key off the fd -> peer-rank registry
+// below; unregistered fds always pass through untouched.
+//
+// Parse + arm the table (idempotent per Init; empty spec disarms).
+// Returns false with *err set on a malformed spec.
+bool NetFaultInit(const std::string& spec, int my_rank, std::string* err);
+// Whether any clause is armed (cheap; callers may skip lookups).
+bool NetFaultActive();
+// Associate fd with the CURRENT-membership rank at the far end.
+void NetFaultRegister(int fd, int peer_rank);
+void NetFaultForget(int fd);
+// True when outbound bytes on fd must be swallowed right now (drop /
+// partition clause active for its link).
+bool NetFaultDrops(int fd);
+// Apply pre-send latency (delay/jitter clause) for fd; no-op otherwise.
+void NetFaultDelay(int fd);
+// Flaky-link verdict for one send on fd: returns a byte cap (> 0) for a
+// deliberately chopped write plus a tiny stall, or 0 for an untouched
+// send.  Deterministic per (spec, rank, link, send index).
+size_t NetFaultChop(int fd);
+
 // shutdown(2) both directions WITHOUT closing: any thread blocked in
 // poll/send/recv on the fd wakes with an error immediately, and the fd
 // number stays allocated — no close-vs-concurrent-use reuse race.  The

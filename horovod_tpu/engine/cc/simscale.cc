@@ -227,6 +227,17 @@ std::string SimScaleRun(int size, int local_size, int ops_per_cycle,
   }
   int64_t coord_children = InfoField(engines[0]->ControlInfo(), 1);
   int64_t negotiated = InfoField(engines[0]->ControlInfo(), 10);
+  // Heartbeat-overhead surface (docs/performance.md#control-plane-
+  // scaling): the detector rides env (HVD_TPU_HEARTBEAT_MS), so the
+  // bench toggles it per cell and compares steady p50s; the frame count
+  // proves which regime each cell actually ran in.  clock_fanin is rank
+  // 0's init clock-sync probe count — O(direct children), the
+  // tree-relay satellite's assert surface.
+  int64_t hb_frames_sent = 0;
+  for (int r = 0; r < size; ++r)
+    hb_frames_sent = std::max(
+        hb_frames_sent, InfoField(engines[r]->LivenessInfo(), 2));
+  int64_t clock_fanin = InfoField(engines[0]->LivenessInfo(), 6);
 
   bool failed = drive_fail.load();
   {
@@ -244,19 +255,22 @@ std::string SimScaleRun(int size, int local_size, int ops_per_cycle,
                             cycle_us.begin() + warm_cycles);
   std::vector<int64_t> steady(cycle_us.begin() + warm_cycles,
                               cycle_us.end());
-  char out[512];
+  char out[640];
   snprintf(out, sizeof(out),
            "{\"ok\":1,\"size\":%d,\"tree\":%d,\"steady_entered\":%d,"
            "\"warm_p50_us\":%.1f,\"warm_p90_us\":%.1f,"
            "\"steady_p50_us\":%.1f,\"steady_p90_us\":%.1f,"
            "\"steady_frames_delta\":%lld,\"steady_cycles\":%lld,"
-           "\"coord_children\":%lld,\"negotiated_cycles\":%lld}",
+           "\"coord_children\":%lld,\"negotiated_cycles\":%lld,"
+           "\"hb_frames_sent\":%lld,\"clock_fanin\":%lld}",
            size, coord_tree ? 1 : 0, steady_entered ? 1 : 0,
            Pct(warm, 0.5), Pct(warm, 0.9), Pct(steady, 0.5),
            Pct(steady, 0.9), static_cast<long long>(frames_delta_max),
            static_cast<long long>(steady_cycle_count),
            static_cast<long long>(coord_children),
-           static_cast<long long>(negotiated));
+           static_cast<long long>(negotiated),
+           static_cast<long long>(hb_frames_sent),
+           static_cast<long long>(clock_fanin));
   return out;
 }
 

@@ -1,5 +1,7 @@
 #include "wire.h"
 
+#include <cstring>
+
 namespace hvdtpu {
 
 size_t DataTypeSize(uint8_t dtype) {
@@ -153,6 +155,7 @@ std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
   w.I64(rl.steady_epoch);
   w.I64(rl.steady_pos);
   w.I64(rl.membership_epoch);
+  w.U8(rl.hb_report ? 1 : 0);
   return std::move(w.buf);
 }
 
@@ -209,7 +212,28 @@ bool ParseRequestList(const std::vector<uint8_t>& buf, RequestList* rl) {
   rl->steady_epoch = rd.I64();
   rl->steady_pos = rd.I64();
   rl->membership_epoch = rd.I64();
+  rl->hb_report = rd.U8() != 0;
   return rd.ok;
+}
+
+void SerializeHeartbeat(const HeartbeatFrame& hb, uint8_t out[16]) {
+  Writer w;
+  w.U32(hb.magic);
+  w.U32(hb.sender_rank);
+  w.U32(hb.epoch);
+  w.U32(hb.seq);
+  memcpy(out, w.buf.data(), kHeartbeatFrameBytes);
+}
+
+bool ParseHeartbeat(const uint8_t in[16], HeartbeatFrame* hb) {
+  std::vector<uint8_t> buf(in, in + kHeartbeatFrameBytes);
+  Reader rd(buf);
+  hb->magic = rd.U32();
+  hb->sender_rank = rd.U32();
+  hb->epoch = rd.U32();
+  hb->seq = rd.U32();
+  return rd.ok &&
+         (hb->magic == HeartbeatFrame().magic || hb->magic == kSuspectMagic);
 }
 
 std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {

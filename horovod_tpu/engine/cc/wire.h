@@ -142,6 +142,14 @@ struct RequestList {
   // (cache bits would name cleared slots, announces would double-count
   // into the new membership's table).  Static jobs stay at 0 == 0.
   int64_t membership_epoch = 0;
+  // Out-of-band heartbeat report (docs/fault-tolerance.md
+  // #failure-detection): this frame exists ONLY to deliver `dead_ranks`
+  // observed by the data-plane heartbeat detector and carries no
+  // announcements.  It rides the control socket BETWEEN regular tick
+  // frames, so the coordinator (and every sub-coordinator relay)
+  // processes it and keeps waiting for the sender's real frame — the
+  // send-one-wait-one alternation is preserved.
+  bool hb_report = false;
 };
 
 enum ResponseType : uint8_t {
@@ -254,6 +262,39 @@ struct ResponseList {
   // coordinator's pattern detector restarts at this list.
   bool steady_revoke = false;
 };
+
+// Data-plane heartbeat frame (docs/fault-tolerance.md#failure-detection):
+// a fixed 16-byte liveness beacon exchanged between ring neighbours over
+// dedicated sockets on the data listeners, off the engine tick, so a busy
+// local ring never starves liveness.  A whole-process freeze (SIGSTOP, GC
+// pause, kernel wedge) stops the beacons without closing the socket —
+// the silence socket EOF can never report.  `epoch` pins the membership
+// the beacon was sent under; a beacon from a previous epoch is dropped
+// like a stale control frame.
+struct HeartbeatFrame {
+  uint32_t magic = 0x48564254;  // "HVBT"
+  uint32_t sender_rank = 0;
+  uint32_t epoch = 0;
+  uint32_t seq = 0;
+};
+
+// Suspect-gossip variant of the beacon (same 16-byte layout, this magic,
+// and `seq` reinterpreted as the SUSPECT rank): a rank that has flagged a
+// silent peer repeats the accusation to its live neighbours every beat
+// interval, and receivers re-gossip, so a suspicion hops around the ring
+// to rank 0 even when the frozen rank sits between them — the data-plane
+// analogue of the control plane's dead_ranks relay, needed mid-steady
+// when zero control frames flow.
+constexpr uint32_t kSuspectMagic = 0x48564253;  // "HVBS"
+
+constexpr size_t kHeartbeatFrameBytes = 16;
+
+// Fixed-size little-endian encode/decode (no length prefix: the frame is
+// its own framing, consumed in 16-byte chunks off a byte stream).
+// ParseHeartbeat accepts both magics (beacon and suspect gossip); the
+// caller dispatches on hb->magic.
+void SerializeHeartbeat(const HeartbeatFrame& hb, uint8_t out[16]);
+bool ParseHeartbeat(const uint8_t in[16], HeartbeatFrame* hb);
 
 std::vector<uint8_t> SerializeRequestList(const RequestList& rl);
 bool ParseRequestList(const std::vector<uint8_t>& buf, RequestList* rl);

@@ -834,6 +834,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--serve-port", type=int, default=None,
                         help="with --serve: the front-door port (sets "
                              "HVD_TPU_SERVE_PORT)")
+    parser.add_argument("--net-fault-spec", default=None, metavar="SPEC",
+                        help="network chaos harness (docs/fault-tolerance"
+                             ".md#failure-detection): deterministic link-"
+                             "fault injection for every rank (sets "
+                             "HVD_TPU_NET_FAULT_SPEC), e.g. "
+                             "'link=0-1:drop@after=2', "
+                             "'partition=0,1/2,3@after=1', "
+                             "'link=1-2:delay=5|jitter=3', "
+                             "'link=0-3:flaky=0.05'; composes with "
+                             "HVD_TPU_FAULT_SPEC process faults")
     parser.add_argument("--max-restarts", type=int, default=0,
                         help="on job failure (a rank died, or the engine "
                              "aborted on a dead/stalled rank), kill the "
@@ -874,6 +884,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         os.makedirs(args.state_dir, exist_ok=True)
         env = dict(env if env is not None else os.environ)
         env["HVD_TPU_STATE_DIR"] = args.state_dir
+    if args.net_fault_spec is not None:
+        env = dict(env if env is not None else os.environ)
+        env["HVD_TPU_NET_FAULT_SPEC"] = args.net_fault_spec
     if args.postmortem_dir:
         os.makedirs(args.postmortem_dir, exist_ok=True)
         env = dict(env if env is not None else os.environ)

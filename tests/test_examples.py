@@ -58,6 +58,8 @@ def test_tensorflow_mnist_estimator_example(tmp_path):
     assert "accuracy" in out
 
 
+@pytest.mark.slow  # ~19s; the example surface stays tier-1 in
+# test_pytorch_mnist; the jax binding itself is the core suite
 def test_jax_mnist_example():
     """Single process, virtual 8-device mesh."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -81,7 +83,8 @@ def test_word2vec_example_sparse_path():
 
 
 @pytest.mark.slow  # ~15s; the keras binding keeps tier-1 coverage in
-# test_keras.py (callbacks, optimizer sync, lr warmup)
+# test_keras.py (callbacks broadcast + metric average; optimizer sync
+# and lr warmup ride the slow tier)
 def test_keras_mnist_advanced_example():
     """BASELINE.json acceptance config 2: the advanced Keras path
     (epoch-scaled training, LR warmup + schedule callbacks, metric

@@ -29,7 +29,8 @@ def _env(**overrides):
     env.update({k: str(v) for k, v in overrides.items()})
     for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
                 "HVD_TPU_DATA", "HVD_TPU_FAULT_SPEC",
-                "HVD_TPU_RESTART_EPOCH"):
+                "HVD_TPU_RESTART_EPOCH", "HVD_TPU_NET_FAULT_SPEC",
+                "HVD_TPU_HEARTBEAT_MS", "HVD_TPU_HEARTBEAT_MISS"):
         env.setdefault(var, "")
         if not env[var]:
             env.pop(var, None)
@@ -185,9 +186,11 @@ def test_hang_fault_surfaces_collective_timeout_error():
 
 def test_freeze_fault_surfaces_ranks_down_error():
     """A SIGSTOP'd process keeps its sockets open but silent — EOF never
-    fires; only the coordinator's per-rank liveness probe (a deadline of
-    control-plane silence) can catch it.  The survivor gets RanksDownError
-    naming the frozen rank."""
+    fires.  The data-plane heartbeat detector (docs/fault-tolerance.md
+    #failure-detection) catches the silence in O(miss window); with the
+    detector off, the coordinator's control-plane liveness deadline still
+    does.  Either way the survivor gets RanksDownError naming the frozen
+    rank."""
     import time
 
     from horovod_tpu.runner import run_command
@@ -201,7 +204,8 @@ def test_freeze_fault_surfaces_ranks_down_error():
         "    os._exit(9)\n"
         "except RanksDownError as e:\n"
         "    assert 1 in e.ranks, (e.ranks, str(e))\n"
-        "    assert 'no control-plane traffic' in str(e), str(e)\n"
+        "    assert ('no data-plane heartbeats' in str(e)\n"
+        "            or 'no control-plane traffic' in str(e)), str(e)\n"
         "    os._exit(7)  # nonzero: arm the grace-kill of the frozen rank\n"
     )
     t0 = time.monotonic()
@@ -481,3 +485,157 @@ def test_clean_early_exit_counts_against_restarts_fast(tmp_path, monkeypatch):
     assert any("restarting (1/1)" in m for m in msgs), msgs
     # The stderr tail reaches the report (rank 0 was killed waiting).
     assert failure_report(results), results
+
+
+# ---------------------------------------------------------------------------
+# Network chaos (HVD_TPU_NET_FAULT_SPEC) + the data-plane heartbeat
+# failure detector (docs/fault-tolerance.md#failure-detection).
+# ---------------------------------------------------------------------------
+
+
+def test_net_fault_spec_rejects_bad_clause():
+    """A malformed HVD_TPU_NET_FAULT_SPEC must fail init() with a typed
+    message naming the bad clause — never arm a half-parsed table."""
+    from horovod_tpu.runner import run_command
+
+    code = (
+        "import horovod_tpu as hvd\n"
+        "from horovod_tpu.common import HorovodInternalError\n"
+        "try:\n"
+        "    hvd.init()\n"
+        "except HorovodInternalError as e:\n"
+        "    assert 'bad HVD_TPU_NET_FAULT_SPEC' in str(e), str(e)\n"
+        "    assert 'frobnicate' in str(e), str(e)\n"
+        "    raise SystemExit(0)\n"
+        "raise SystemExit(9)\n"
+    )
+    results = run_command(
+        [sys.executable, "-c", code], 1,
+        env=_env(HVD_TPU_NET_FAULT_SPEC="link=0-1:frobnicate"),
+        timeout=60.0, capture=True)
+    assert results[0].returncode == 0, \
+        (results[0].returncode, results[0].stderr[-800:])
+
+
+def test_nonelastic_freeze_detected_in_heartbeat_time():
+    """The ISSUE acceptance path, non-elastic arm: on a 4-rank job a
+    SIGSTOP'd rank 2 is silent but never EOFs, so only the data-plane
+    heartbeat detector can catch it quickly.  With the collective timeout
+    pushed way out (30s) every survivor must still get RanksDownError
+    naming exactly rank 2 in O(miss window) — proving detection is
+    O(heartbeat), not O(collective-timeout)."""
+    import time
+
+    from horovod_tpu.runner import run_command
+
+    code = (
+        "import numpy as np, os, time, horovod_tpu as hvd\n"
+        "from horovod_tpu.common import RanksDownError\n"
+        "hvd.init()\n"
+        "t0 = time.monotonic()\n"
+        "try:\n"
+        "    for i in range(200):\n"
+        "        hvd.allreduce(np.ones(8, np.float32), name=f'hb.{i}')\n"
+        "        time.sleep(0.02)\n"
+        "    os._exit(9)  # survivors must NOT complete\n"
+        "except RanksDownError as e:\n"
+        "    assert set(e.ranks) == {2}, (e.ranks, str(e))\n"
+        "    assert 'data-plane heartbeats' in str(e), str(e)\n"
+        "    # Detection window is miss*interval = 1s; promote poll adds\n"
+        "    # <=2s.  10s is generous slack yet far below the 30s timeout.\n"
+        "    assert time.monotonic() - t0 < 10.0, time.monotonic() - t0\n"
+        "    os._exit(7)  # nonzero: arm the grace-kill of the frozen rank\n"
+    )
+    t0 = time.monotonic()
+    results = run_command(
+        [sys.executable, "-c", code], 4,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=2:freeze@op=2",
+                 HVD_TPU_HEARTBEAT_MS="100", HVD_TPU_HEARTBEAT_MISS="10",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="30"),
+        timeout=90.0, capture=True)
+    assert time.monotonic() - t0 < 45.0
+    by_rank = {r.rank: r for r in results}
+    for r in (0, 1, 3):
+        assert by_rank[r].returncode == 7, \
+            (r, by_rank[r].returncode, by_rank[r].stderr[-800:])
+    assert by_rank[2].returncode == -9  # SIGKILL works on stopped procs
+
+
+def test_partition_aborts_both_sides():
+    """partition=0,1/2,3 mid-run: the fault layer silently swallows every
+    byte across the cut (no EOF — exactly what a switch partition looks
+    like), so BOTH sides must abort typed via heartbeats: the coordinator
+    side (0,1) through rank 0's sweep, the minority side (2,3) through
+    the local grace-expiry abort — the coordinator is unreachable from
+    there.  Each side names only unreachable ranks, within ~2x the
+    detection window (the 30s collective timeout never enters play)."""
+    import time
+
+    from horovod_tpu.runner import run_command
+
+    code = (
+        "import numpy as np, os, time, horovod_tpu as hvd\n"
+        "from horovod_tpu.common import RanksDownError\n"
+        "hvd.init()\n"
+        "t0 = time.monotonic()\n"
+        "me = hvd.rank()\n"
+        "far = {2, 3} if me in (0, 1) else {0, 1}\n"
+        "try:\n"
+        "    for i in range(400):\n"
+        "        hvd.allreduce(np.ones(8, np.float32), name=f'p.{i}')\n"
+        "        time.sleep(0.02)\n"
+        "    os._exit(9)  # nobody trains through a partition\n"
+        "except RanksDownError as e:\n"
+        "    assert e.ranks and set(e.ranks) <= far, (me, e.ranks, str(e))\n"
+        "    # @after=2 arming + 1s detection + grace + promote poll.\n"
+        "    assert time.monotonic() - t0 < 15.0, time.monotonic() - t0\n"
+        "    os._exit(7)\n"
+    )
+    t0 = time.monotonic()
+    results = run_command(
+        [sys.executable, "-c", code], 4,
+        env=_env(HVD_TPU_NET_FAULT_SPEC="partition=0,1/2,3@after=2",
+                 HVD_TPU_HEARTBEAT_MS="100", HVD_TPU_HEARTBEAT_MISS="10",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="30"),
+        timeout=90.0, capture=True)
+    assert time.monotonic() - t0 < 60.0
+    for r in results:
+        assert r.returncode == 7, \
+            (r.rank, r.returncode, r.stderr[-800:])
+
+
+def test_flaky_link_degrades_transparently():
+    """link=0-1:flaky=0.05 chops ~5% of sends into partial writes plus a
+    stall — the retry paths must absorb it with NO numeric or liveness
+    consequence: every step's averaged allreduce is exactly right (the
+    integer-valued float32 sums are bit-exact when nothing is lost), no
+    rank is evicted, and the liveness section shows the detector ran."""
+    from horovod_tpu.runner import run_command
+
+    code = (
+        "import numpy as np, horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "for i in range(30):\n"
+        "    x = (np.arange(512, dtype=np.float32) + hvd.rank())\n"
+        "    out = hvd.allreduce(x, average=False, name=f'fl.{i}')\n"
+        "    want = 2.0 * np.arange(512, dtype=np.float32) + 1.0\n"
+        "    assert np.array_equal(out, want), (i, out[:4], want[:4])\n"
+        "snap = hvd.metrics_snapshot()\n"
+        "lv = snap['liveness']\n"
+        "assert lv['interval_ms'] == 100 and lv['miss_limit'] == 10, lv\n"
+        "assert lv['frames']['sent'] > 0, lv\n"
+        "assert lv['frames']['received'] > 0, lv\n"
+        "assert lv['evictions'] == 0, lv\n"
+        "assert lv['peers'], lv\n"
+        "from horovod_tpu.common import metrics\n"
+        "text = metrics.prometheus_text(snap)\n"
+        "assert 'hvd_tpu_liveness_frames_total' in text\n"
+        "assert 'hvd_tpu_liveness_peer_age_us' in text\n"
+    )
+    results = run_command(
+        [sys.executable, "-c", code], 2,
+        env=_env(HVD_TPU_NET_FAULT_SPEC="link=0-1:flaky=0.05",
+                 HVD_TPU_HEARTBEAT_MS="100", HVD_TPU_HEARTBEAT_MISS="10"),
+        timeout=90.0, capture=True)
+    assert all(r.returncode == 0 for r in results), \
+        [(r.rank, r.returncode, r.stderr[-600:]) for r in results]

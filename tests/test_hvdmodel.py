@@ -3,7 +3,7 @@
 Three layers:
 
 * the tier-1 CLI contract: ``python -m tools.hvdmodel --quick`` explores
-  the three quick configs exhaustively (>= 50k states, < 60s), covers
+  the four quick configs exhaustively (>= 50k states, < 60s), covers
   every required protocol event, and exits 0 — so a protocol change that
   deadlocks, diverges membership, or accepts a stale-epoch frame fails
   the suite at the PR that introduces it;
@@ -50,12 +50,14 @@ def test_quick_is_clean_and_exhaustive():
     # No config may hit the state cap — quick is EXHAUSTIVE by contract.
     assert "truncated" not in proc.stdout, proc.stdout
     for event in ("steady_enter", "steady_exit", "reshape_shrink",
-                  "reshape_grow", "crash", "freeze", "stale_drop"):
+                  "reshape_grow", "crash", "freeze", "stale_drop",
+                  "hb_detect", "abort:ST_TIMEOUT"):
         assert event in proc.stdout, (event, proc.stdout)
 
 
 @pytest.mark.parametrize("bug", ["skip-revoke", "stale-epoch",
-                                 "no-requeue"])
+                                 "no-requeue",
+                                 "drop-heartbeat-revoke"])
 def test_seeded_bug_is_caught_with_trace(bug):
     proc = _run_cli("--bug", bug)
     assert proc.returncode == 1, (bug, proc.stdout, proc.stderr)
@@ -88,14 +90,19 @@ def test_explorer_finds_shortest_deadlock_in_process():
 
 
 def test_quick_configs_declare_distinct_regimes():
-    """quick() pins three regimes: the coordinator tree, the elastic
-    star, and the revoke-only liveness config (group_timeout disabled —
-    the revocation broadcast alone must keep survivors live)."""
+    """quick() pins four regimes: the coordinator tree, the elastic
+    star, the revoke-only liveness config (group_timeout disabled —
+    the revocation broadcast alone must keep survivors live), and the
+    heartbeat-off config (HVD_TPU_HEARTBEAT_MS=0 — the legacy
+    exchange-silence ST_TIMEOUT contract)."""
     cfgs = {c.name: c for c in configs.quick()}
     assert set(cfgs) == {"quick-tree", "quick-elastic",
-                         "quick-revoke-only"}
+                         "quick-revoke-only", "quick-hb-off"}
     assert not cfgs["quick-tree"].elastic
     assert cfgs["quick-elastic"].elastic
     assert cfgs["quick-revoke-only"].elastic
     assert cfgs["quick-revoke-only"].group_timeout is False
     assert cfgs["quick-tree"].group_timeout is True
+    assert cfgs["quick-tree"].heartbeat is True
+    assert cfgs["quick-hb-off"].heartbeat is False
+    assert "freeze:1" in cfgs["quick-hb-off"].faults

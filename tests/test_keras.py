@@ -20,6 +20,9 @@ def _init():
     return hvd
 
 
+@pytest.mark.slow  # ~16s; the distributed-keras seam stays tier-1 in
+# test_keras_callbacks_broadcast_and_metric_average, the optimizer math
+# in test_keras_momentum_correction
 @distributed_test(np_=2, timeout=400)
 def test_keras_distributed_optimizer_sync():
     import keras
@@ -76,6 +79,8 @@ def test_keras_callbacks_broadcast_and_metric_average():
     assert np.allclose(gathered, gathered[0], atol=1e-6), r
 
 
+@pytest.mark.slow  # ~16s; the keras callback machinery stays tier-1 in
+# test_keras_callbacks_broadcast_and_metric_average
 @distributed_test(np_=2, timeout=400)
 def test_keras_lr_warmup():
     import keras

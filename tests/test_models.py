@@ -120,6 +120,8 @@ def test_bench_smoke():
     assert rec["value"] > 0
 
 
+@pytest.mark.slow  # ~170s (resnet101 CPU compile); the bench JSON
+# contract stays tier-1 in test_bench_smoke
 def test_bench_headline_survives_failing_extra():
     """A failing extra must never erase the headline metric (the round-4
     failure mode: a 20 KB compile error inside the single JSON line pushed
@@ -173,6 +175,9 @@ def test_space_to_depth_stem_is_exact():
         np.testing.assert_allclose(got, want, atol=2e-6, err_msg=str(shape))
 
 
+@pytest.mark.slow  # ~27s; BN semantics stay tier-1 in
+# test_tiny_resnet_shapes_and_bn, the fused step in
+# test_packed_train_step_bit_identical
 def test_fused_ema_batchnorm_matches_flax_bn():
     """ResNet(fused_ema=True) + ema_batch_stats reproduces the stock flax
     BatchNorm path exactly (same logits, same running stats) over several

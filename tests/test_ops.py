@@ -496,6 +496,8 @@ def test_fused_ring_flash_oversized_shard_falls_back(monkeypatch):
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # ~15s; ring-flash numerics stay tier-1 in
+# test_fused_ring_flash_matches_dense
 def test_ring_flash_phase_stream_alternates(monkeypatch):
     """The fused ring kernels' barrier-namespace stream (collective_ids
     15/16, ops/ring_flash.py) must strictly alternate across the WHOLE

@@ -172,6 +172,9 @@ def test_crash_postmortem_dumps(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~20s; postmortem dump + diagnosis coverage stays
+# tier-1 in test_crash_postmortem_dumps and
+# test_postmortem_dump_tool_renders_story
 def test_hang_postmortem_cross_rank_diagnosis(tmp_path):
     from horovod_tpu.runner import run_command
 

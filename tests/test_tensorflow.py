@@ -20,6 +20,9 @@ def _init():
     return hvd
 
 
+@pytest.mark.slow  # ~29s; the eager TF binding seam stays tier-1 in
+# test_tf_allgather_and_broadcast, allreduce-through-optimizer in
+# test_tf_v1_optimizer_sparse_gradients
 @distributed_test(np_=2, timeout=300)
 def test_tf_allreduce_values_and_function():
     import tensorflow as tf
@@ -83,6 +86,8 @@ def test_tf_indexed_slices_allreduce():
                                         for i in (r2, r2 + 1)}
 
 
+@pytest.mark.slow  # ~30s; TF gradient aggregation stays tier-1 in
+# test_tf_distributed_gradient_tape_matches_full_batch
 @distributed_test(np_=2, timeout=300)
 def test_tf_gradients():
     import tensorflow as tf

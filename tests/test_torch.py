@@ -19,6 +19,8 @@ def _init():
     return hvd
 
 
+@pytest.mark.slow  # ~32s; eager torch allreduce values stay tier-1 in
+# test_torch_allreduce_inplace_and_average
 @distributed_test()
 def test_torch_allreduce_values():
     import torch

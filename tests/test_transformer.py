@@ -39,6 +39,9 @@ def test_forward_shape_and_finite():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow  # ~18s compile-bound parity sweep; the fused loss
+# stays tier-1 in test_dp_sp_train_step and
+# test_fused_loss_rejects_sequence_parallelism
 def test_fused_loss_matches_full_logits():
     """model.apply(..., targets=) — the chunked fused head+loss — matches
     next_token_loss on full logits in value and gradient, including when

@@ -59,6 +59,13 @@ SECTION_FAMILIES = {
                  "hvd_tpu_topology_cross_algo_threshold_bytes",
                  "hvd_tpu_topology_cross_ops_total",
                  "hvd_tpu_topology_bytes_total"),
+    "liveness": ("hvd_tpu_liveness_interval_ms",
+                 "hvd_tpu_liveness_miss_limit",
+                 "hvd_tpu_liveness_frames_total",
+                 "hvd_tpu_liveness_miss_events_total",
+                 "hvd_tpu_liveness_evictions_total",
+                 "hvd_tpu_liveness_clock_fanin",
+                 "hvd_tpu_liveness_peer_age_us"),
     "control": ("hvd_tpu_control_tree_depth",
                 "hvd_tpu_control_children",
                 "hvd_tpu_control_steady_active",
@@ -131,6 +138,10 @@ def populated_registry():
                                 "replays": 40, "cycles": 10},
                      "negotiated_ticks": 12,
                      "frames": {"sent": 24, "received": 24}})
+    reg.set_liveness({"interval_ms": 100, "miss_limit": 10,
+                      "frames": {"sent": 120, "received": 118},
+                      "miss_events": 1, "evictions": 1, "clock_fanin": 2,
+                      "peers": {1: {"age_us": 900, "misses": 0}}})
     reg.set_compression({
         "mode": "bf16", "min_bytes": 1024,
         "planes": {"engine": {"wire_bytes": 512, "payload_bytes": 1024,

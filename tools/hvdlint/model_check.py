@@ -41,7 +41,8 @@ MODEL_DIR = os.path.join("tools", "hvdmodel")
 FAMILIES = {
     "RequestList": (
         "MODELED_REQUEST_FIELDS",
-        re.compile(r"^(steady_.*|dead_ranks|membership_epoch)$")),
+        re.compile(r"^(steady_.*|dead_ranks|hb_report"
+                   r"|membership_epoch)$")),
     "ResponseList": (
         "MODELED_RESPONSE_FIELDS",
         re.compile(r"^(steady_.*|reshape_.*|member_.*|membership_epoch)$")),

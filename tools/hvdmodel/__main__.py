@@ -16,7 +16,7 @@ from .model import BUGS
 
 REQUIRED_QUICK_COVERAGE = (
     "steady_enter", "steady_exit", "reshape_shrink", "reshape_grow",
-    "crash", "freeze", "stale_drop",
+    "crash", "freeze", "stale_drop", "hb_detect", "abort:ST_TIMEOUT",
 )
 
 

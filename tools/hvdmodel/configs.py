@@ -33,6 +33,12 @@ def quick():
                elastic=True, min_size=1, threshold=1, ticks=3,
                fault_budget=1, faults=("crash:1", "crash:2"),
                group_timeout=False),
+        # HVD_TPU_HEARTBEAT_MS=0: with the detector off a frozen rank is
+        # only ever caught by the exchange-silence timeout — the legacy
+        # ST_TIMEOUT contract must survive the ISSUE 17 detector landing.
+        Config("quick-hb-off", hosts=((0,), (1,)),
+               threshold=2, ticks=3, fault_budget=1,
+               faults=("freeze:1",), heartbeat=False),
     ]
 
 
@@ -59,9 +65,18 @@ def seeded(bug):
     coordinator's AllSteadyExited hold keeps the reshape safe — the
     revocation's whole job is that the control plane does not DEPEND on
     the data-plane timeout, so that is the environment in which its
-    removal must (and does) deadlock."""
+    removal must (and does) deadlock.
+
+    ``drop-heartbeat-revoke`` injects a FREEZE instead of a crash and
+    severs the detector's escalation path (monitor flag -> hb_report ->
+    MarkRankDead): with the detector nominally on, the exchange-silence
+    timeout defers to it, so the frozen rank is never evicted and the
+    survivors stall forever — the missed-eviction trace the detector
+    exists to prevent (ISSUE 17)."""
     assert bug in BUGS, bug
+    fault = ("freeze:2" if bug == "drop-heartbeat-revoke" else "crash:2")
     return Config("seeded-%s" % bug, hosts=((0,), (1,), (2,)),
                   elastic=True, min_size=1, threshold=1, ticks=4,
-                  fault_budget=1, faults=("crash:2",), bug=bug,
-                  group_timeout=(bug != "skip-revoke"))
+                  fault_budget=1, faults=(fault,), bug=bug,
+                  group_timeout=(bug != "skip-revoke"
+                                 and bug != "drop-heartbeat-revoke"))

@@ -7,7 +7,7 @@ and compared BIDIRECTIONALLY with ``engine/cc/wire.h``:
   * ``MODELED_STATUS_CODES`` must equal the ``StatusCode`` enum;
   * ``MODELED_REQUEST_FIELDS`` must equal the steady/membership family
     of ``RequestList`` fields (``steady_*``, ``dead_ranks``,
-    ``membership_epoch``);
+    ``hb_report``, ``membership_epoch``);
   * ``MODELED_RESPONSE_FIELDS`` must equal the steady/reshape family of
     ``ResponseList`` fields (``steady_*``, ``reshape_*``, ``member_*``,
     ``membership_epoch``).
@@ -38,6 +38,7 @@ MODELED_REQUEST_FIELDS = {
     "steady_epoch",
     "steady_pos",
     "dead_ranks",
+    "hb_report",
     "membership_epoch",
 }
 

@@ -12,12 +12,12 @@
     rules for single-fault runs);
  4. no stale-epoch frame is ever accepted by the coordinator.
 
-Documented xfail (not a violation, reported separately):
-  * ``xfail_freeze_eviction`` — a frozen rank under an elastic config
-    ends in ST_TIMEOUT instead of an evict-and-reshape.  Eviction needs
-    the control-plane heartbeat of ROADMAP item 1 (the engine has no
-    way to distinguish a frozen peer from a slow one without one); the
-    model pins today's behaviour and names the follow-up.
+The former ``xfail_freeze_eviction`` limitation is GONE (ISSUE 17): the
+data-plane heartbeat detector (``act_hb_detect``) distinguishes a frozen
+peer from a slow one, so a freeze under an elastic config with enough
+survivors must now end in evict-and-reshape + completion — anything else
+(including the old ST_TIMEOUT abort) is a violation.  Heartbeat-off
+configs (HVD_TPU_HEARTBEAT_MS=0) keep the legacy timeout contract.
 """
 
 from .model import (R_ABORT, R_CRASH, R_DONE, R_FROZEN, R_RUN, R_STANDBY,
@@ -119,8 +119,13 @@ def classify_terminal(cfg, st):
                         "completed with crashed rank(s) %s still in the "
                         "membership (no reshape, no abort)" % crashed)
         if "freeze" in used:
-            return (False, None,
-                    "completed while a frozen rank was never detected")
+            frozen = [r for r in range(cfg.nranks)
+                      if ranks[r][0] == R_FROZEN]
+            if any(f in alive for f in frozen):
+                return (False, None,
+                        "completed with frozen rank(s) %s still in the "
+                        "membership (never detected, never evicted)"
+                        % frozen)
         return (True, None, "completed")
     # Typed abort terminal: must be justified by the faults on the path.
     if not used:
@@ -145,13 +150,29 @@ def classify_terminal(cfg, st):
                     % abort)
         return (True, None, "typed ST_ABORTED")
     if used == {"freeze"}:
+        if cfg.heartbeat:
+            # The detector owns freezes (act_hb_detect preempts the
+            # exchange-silence timeout): elastic jobs with enough
+            # survivors must EVICT and complete — they never reach this
+            # typed-abort branch — and every abort that remains is the
+            # coordinated RanksDownError.
+            if cfg.elastic:
+                survivors = [r for r in alive if ranks[r][0] != R_FROZEN]
+                if len(survivors) >= cfg.min_size:
+                    return (False, None,
+                            "elastic freeze with %d >= min_size=%d "
+                            "survivors must evict via reshape and "
+                            "complete, not abort (%d)"
+                            % (len(survivors), cfg.min_size, abort))
+            if abort != STATUS["ST_RANKS_DOWN"]:
+                return (False, None,
+                        "heartbeat-detected freeze must abort "
+                        "ST_RANKS_DOWN, got %d" % abort)
+            return (True, None, "typed ST_RANKS_DOWN")
         if abort != STATUS["ST_TIMEOUT"]:
             return (False, None,
-                    "freeze must abort ST_TIMEOUT, got %d" % abort)
-        if cfg.elastic:
-            return (True, "xfail_freeze_eviction",
-                    "typed ST_TIMEOUT (eviction needs the ROADMAP item 1 "
-                    "heartbeat)")
+                    "freeze without the heartbeat detector must abort "
+                    "ST_TIMEOUT, got %d" % abort)
         return (True, None, "typed ST_TIMEOUT")
     # Multi-fault (deep configs): any typed abort is acceptable.
     return (True, None, "typed abort %d under faults %s"

@@ -60,6 +60,8 @@ WIRE_BINDING = {
     "steady_epoch": "abstracted into the rank tick counter (replay pos)",
     "steady_pos": "abstracted into the rank tick counter (replay pos)",
     "dead_ranks": "sub dead set piggybacked on 'agg' frames",
+    "hb_report": "out-of-band dead-rank report; folded into act_hb_detect "
+                 "(the escalation path from monitor flag to MarkRankDead)",
     "membership_epoch": "frame epoch; stale guard in act_coord_recv",
     # ResponseList
     "steady_present": "'steady' broadcast kind (enter self-clocked mode)",
@@ -80,7 +82,12 @@ WIRE_BINDING = {
 
 # Seeded-bug switches (each disables one of the engine's defenses so the
 # explorer demonstrably catches the class of bug it guards against).
-BUGS = ("skip-revoke", "stale-epoch", "no-requeue")
+# ``drop-heartbeat-revoke`` severs the monitor-to-coordinator escalation
+# (flag -> hb_report -> MarkRankDead): the frozen rank is never evicted
+# and, with the detector owning freeze detection (act_timeout defers to
+# it), the job stalls forever — the missed-eviction trace of ISSUE 17.
+BUGS = ("skip-revoke", "stale-epoch", "no-requeue",
+        "drop-heartbeat-revoke")
 
 
 class Config:
@@ -88,7 +95,7 @@ class Config:
 
     def __init__(self, name, hosts, elastic=False, min_size=1, standby=(),
                  threshold=2, ticks=4, fault_budget=0, faults=(), bug=None,
-                 group_timeout=True):
+                 group_timeout=True, heartbeat=True):
         self.name = name
         self.hosts = tuple(tuple(h) for h in hosts)
         self.elastic = elastic
@@ -104,6 +111,11 @@ class Config:
         # backstop never fires (the revocation broadcast alone must
         # unblock every survivor).
         self.group_timeout = group_timeout
+        # The data-plane heartbeat detector (HVD_TPU_HEARTBEAT_MS, ISSUE
+        # 17): on by default like the engine.  ``heartbeat=False`` models
+        # HVD_TPU_HEARTBEAT_MS=0 — frozen ranks are then only caught by
+        # the exchange-silence timeout (act_timeout).
+        self.heartbeat = heartbeat
         self.bug = bug
         assert bug in (None,) + BUGS, bug
         self.nranks = max(max(h) for h in self.hosts) + 1
@@ -631,33 +643,76 @@ def act_eof_detect(cfg, st):
     return out
 
 
+def act_hb_detect(cfg, st):
+    """The data-plane heartbeat detector (HeartbeatLoop + hb_report,
+    ISSUE 17): a frozen rank stops beating, its beat-ring neighbours
+    count the misses past HVD_TPU_HEARTBEAT_MISS and the escalation
+    reaches rank 0 — directly (rank 0's own monitor), as an hb_report
+    frame between ticks, or through the steady poll (the tentpole case:
+    zero control frames flowing).  Time-abstracted to an always-enabled
+    action; the effect is exactly MarkRankDead — the frozen rank joins
+    the coordinator's dead set and its host's gathering excludes it, so
+    the existing reshape/abort machinery resolves it."""
+    out = []
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    if not cfg.heartbeat or cfg.bug == "drop-heartbeat-revoke":
+        return []
+    if coord[8]:
+        return []
+    for r in range(cfg.nranks):
+        if ranks[r][0] != R_FROZEN or r not in coord[7] or r in coord[4]:
+            continue
+        h = cfg.host_of[r]
+        gathered, sdead = subs[h]
+        ev = {"hb_detect"}
+        nsubs = list(subs)
+        # A frame the frozen rank sent BEFORE freezing may already be
+        # gathered or in flight; like the EOF path, the dead-mark drops
+        # it from the gathering and dead_drop swallows stragglers.
+        nsubs[h] = (tuple(g for g in gathered if g[0] != r),
+                    tuple(sorted(set(sdead) | {r})))
+        ncoord = _coord(coord, dead=tuple(sorted(set(coord[4]) | {r})))
+        out.append(("hb_detect(%d)" % r,
+                    (ranks, tuple(nsubs), ncoord, up, down, newt, fb,
+                     stale), ev))
+    return out
+
+
 def act_coord_abort(cfg, st):
-    """Non-elastic EOF cascade: peer death is unrecoverable, broadcast a
-    typed ST_ABORTED so every survivor exits the same way."""
+    """Non-elastic death cascade: a dead peer is unrecoverable, so rank 0
+    broadcasts a typed abort every survivor exits with.  EOF deaths keep
+    the model's ST_ABORTED binding; a heartbeat-detected freeze carries
+    the engine's actual RanksDownError status (MarkRankDead always
+    raises ST_RANKS_DOWN — 'ranks down: N (no data-plane heartbeats
+    ...)') so the invariant can tell the two detectors apart."""
     ranks, subs, coord, up, down, newt, fb, stale = st
     (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp) = coord
     if cfg.elastic or not dead or abort:
         return []
-    ev = {"abort:ST_ABORTED"}
-    ncoord = _coord(coord, abort=STATUS["ST_ABORTED"], got=(),
+    code = ("ST_RANKS_DOWN"
+            if any(ranks[r][0] == R_FROZEN for r in dead) else "ST_ABORTED")
+    ev = {"abort:" + code}
+    ncoord = _coord(coord, abort=STATUS[code], got=(),
                     steady=False, exits=())
     nranks, ndown = _broadcast(cfg, ranks, down, alive,
-                               ("abort", cep, "ST_ABORTED"), ev,
+                               ("abort", cep, code), ev,
                                skip=set(dead))
-    return [("coord_abort(eof)",
+    return [("coord_abort(%s)" % code.lower(),
              (nranks, subs, ncoord, up, ndown, newt, fb, stale), ev)]
 
 
 def act_timeout(cfg, st):
     """Time-abstracted exchange-silence timeout: a frozen rank blocks
     progress (no frame, no EOF) until CheckCollectiveTimeout fires a
-    typed ST_TIMEOUT.  Model limitation, pinned as xfail in
-    invariants.py: under elastic the desirable end state would be
-    evict-and-reshape, which needs the control-plane heartbeat of
-    ROADMAP item 1 — the engine today aborts, and so does the model."""
+    typed ST_TIMEOUT.  With the heartbeat detector on (the default) this
+    action defers to act_hb_detect: the miss window is configured far
+    below the collective timeout, so the detector always wins the race
+    — the former ``xfail_freeze_eviction`` limitation is gone.  The
+    timeout remains the only freeze detector when HVD_TPU_HEARTBEAT_MS=0
+    (``heartbeat=False`` configs)."""
     ranks, subs, coord, up, down, newt, fb, stale = st
     (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp) = coord
-    if abort:
+    if abort or cfg.heartbeat:
         return []
     if not any(ranks[r][0] == R_FROZEN for r in alive):
         return []
@@ -715,8 +770,8 @@ def act_fault(cfg, st):
 
 ACTIONS = (act_send, act_deliver_up, act_sub_flush, act_coord_tick,
            act_deliver_down, act_steady_replay, act_steady_exit,
-           act_coord_revoke_reshape, act_eof_detect, act_coord_abort,
-           act_timeout, act_fault)
+           act_coord_revoke_reshape, act_eof_detect, act_hb_detect,
+           act_coord_abort, act_timeout, act_fault)
 
 
 def successors(cfg, st):

@@ -266,6 +266,26 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
             f"frames sent {frames.get('sent', 0)}, received "
             f"{frames.get('received', 0)}")
 
+    # Heartbeat failure detector (docs/fault-tolerance.md
+    # #failure-detection); only rendered when the detector is armed
+    # (HVD_TPU_HEARTBEAT_MS > 0), so detector-off dumps stay unchanged.
+    live = snap.get("liveness", {})
+    if live.get("interval_ms"):
+        frames = live.get("frames", {})
+        peers = live.get("peers", {})
+        worst = max((p.get("misses", 0) for p in peers.values()),
+                    default=0)
+        lines.append("== liveness ==")
+        lines.append(
+            f"heartbeat every {live.get('interval_ms', 0)} ms, miss limit "
+            f"{live.get('miss_limit', 0)}; beacons sent "
+            f"{frames.get('sent', 0)}, received "
+            f"{frames.get('received', 0)}; {len(peers)} peer(s), worst "
+            f"miss streak {worst}; miss events "
+            f"{live.get('miss_events', 0)}, evictions "
+            f"{live.get('evictions', 0)}; clock fan-in "
+            f"{live.get('clock_fanin', 0)}")
+
     # Elastic membership (docs/fault-tolerance.md#elastic-membership);
     # only rendered once the job reshaped, so pre-elastic dumps stay
     # unchanged.
