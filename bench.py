@@ -675,9 +675,10 @@ def bench_negotiation_scale() -> None:
     Engine with its own sockets and background thread), driving OP_NOOP
     negotiation cycles so the measured latency is pure control plane.
 
-    Five measured cells: {small, large} ranks x {star baseline,
-    tree+steady} plus the large tree cell rerun with the heartbeat
-    detector disabled.  The headline is steady-state cycles/sec at the
+    Six measured cells: {small, large} ranks x {star baseline,
+    tree+steady} plus the large tree cell rerun twice — once with the
+    heartbeat detector disabled, once with the perf-introspection plane
+    (link accounting + anomaly detector) disabled.  The headline is steady-state cycles/sec at the
     LARGE size; extras carry the per-cell p50s, the steady-vs-small
     flatness ratio (the acceptance bar: within 1.5x of the small size,
     where the star grows superlinearly), the steady-window control-frame
@@ -723,13 +724,21 @@ def bench_negotiation_scale() -> None:
         return 1
 
     def run(size: int, use_tree: bool, use_steady: bool, port: int,
-            hb_ms: int = 100) -> dict:
-        # The simulated engines read the heartbeat knobs from the real
-        # environment at Init (same contract as launched ranks), so the
-        # on/off cells toggle the detector via os.environ — putenv makes
-        # the change visible to the in-process C++ getenv.
-        saved = os.environ.get("HVD_TPU_HEARTBEAT_MS")
+            hb_ms: int = 100, introspection: bool = True) -> dict:
+        # The simulated engines read the heartbeat / introspection knobs
+        # from the real environment at Init (same contract as launched
+        # ranks), so the on/off cells toggle them via os.environ —
+        # putenv makes the change visible to the in-process C++ getenv.
+        # introspection=False turns off the whole perf-introspection
+        # plane: link accounting (HVD_TPU_LINK_STATS=0) and the anomaly
+        # detector thread (HVD_TPU_ANOMALY_SIGMA=0).
+        saved = {k: os.environ.get(k)
+                 for k in ("HVD_TPU_HEARTBEAT_MS", "HVD_TPU_LINK_STATS",
+                           "HVD_TPU_ANOMALY_SIGMA")}
         os.environ["HVD_TPU_HEARTBEAT_MS"] = str(hb_ms)
+        if not introspection:
+            os.environ["HVD_TPU_LINK_STATS"] = "0"
+            os.environ["HVD_TPU_ANOMALY_SIGMA"] = "0"
         buf = ctypes.create_string_buffer(2048)
         try:
             for attempt in range(3):  # port collisions retry on a new base
@@ -742,10 +751,11 @@ def bench_negotiation_scale() -> None:
                     return rep
             raise RuntimeError(f"simscale run failed: {rep}")
         finally:
-            if saved is None:
-                os.environ.pop("HVD_TPU_HEARTBEAT_MS", None)
-            else:
-                os.environ["HVD_TPU_HEARTBEAT_MS"] = saved
+            for key, value in saved.items():
+                if value is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = value
 
     base_port = 45000 + (os.getpid() % 400) * 16
     cells = {}
@@ -755,6 +765,8 @@ def bench_negotiation_scale() -> None:
         cells[(size, "tree")] = run(size, True, True, base_port)
         base_port += size + 64
     hb_off = run(large, True, True, base_port, hb_ms=0)
+    base_port += large + 64
+    intro_off = run(large, True, True, base_port, introspection=False)
     base_port += large + 64
 
     t_small, t_large = cells[(small, "tree")], cells[(large, "tree")]
@@ -777,6 +789,27 @@ def bench_negotiation_scale() -> None:
         f"heartbeat detector inflated steady p50 at {large} ranks by "
         f"{100.0 * (hb_inflation - 1.0):.1f}% (want <= {hb_max_pct:g}%): "
         f"{hb_off['steady_p50_us']:.1f}us off -> "
+        f"{t_large['steady_p50_us']:.1f}us on")
+    # Perf-introspection overhead must be unmeasurable too: link
+    # accounting is one short mutex hold per transport call and the
+    # anomaly detector wakes off the tick, so steady p50 with the plane
+    # on stays within BENCH_LINK_MAX_OVERHEAD_PCT (default 5%, the same
+    # bar as the heartbeat detector) of the plane-off run.  link_sends
+    # is process-cumulative across cells, so the off cell is proven by
+    # ZERO GROWTH over the cell that ran just before it, and the on
+    # cells by a nonzero total.
+    assert t_large["link_sends"] > 0, t_large
+    assert intro_off["link_sends"] == hb_off["link_sends"], (
+        f"link accounting grew while HVD_TPU_LINK_STATS=0: "
+        f"{hb_off['link_sends']} -> {intro_off['link_sends']}")
+    link_max_pct = float(os.environ.get(
+        "BENCH_LINK_MAX_OVERHEAD_PCT", "5"))
+    link_inflation = (t_large["steady_p50_us"]
+                      / max(intro_off["steady_p50_us"], 300.0))
+    assert link_inflation <= 1.0 + link_max_pct / 100.0, (
+        f"perf-introspection plane inflated steady p50 at {large} ranks "
+        f"by {100.0 * (link_inflation - 1.0):.1f}% (want <= "
+        f"{link_max_pct:g}%): {intro_off['steady_p50_us']:.1f}us off -> "
         f"{t_large['steady_p50_us']:.1f}us on")
     # Init clock-sync fan-in at rank 0 is O(hosts) on the tree: the
     # sub-coordinator relay probes only direct children (own-host ranks
@@ -818,6 +851,9 @@ def bench_negotiation_scale() -> None:
         f"hb_off_steady_p50_us_{large}": hb_off["steady_p50_us"],
         "hb_overhead_inflation": round(hb_inflation, 4),
         f"hb_frames_sent_{large}": t_large["hb_frames_sent"],
+        f"intro_off_steady_p50_us_{large}": intro_off["steady_p50_us"],
+        "link_overhead_inflation": round(link_inflation, 4),
+        f"link_sends_{large}": t_large["link_sends"],
         f"clock_fanin_tree_{large}": fanin,
         f"clock_fanin_star_{large}": s_large["clock_fanin"],
     }

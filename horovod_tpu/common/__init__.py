@@ -216,6 +216,12 @@ def _load_lib():
         lib.hvd_tpu_clock_rtt_us.argtypes = []
         lib.hvd_tpu_liveness_info.restype = ctypes.c_char_p
         lib.hvd_tpu_liveness_info.argtypes = []
+        lib.hvd_tpu_link_info.restype = ctypes.c_char_p
+        lib.hvd_tpu_link_info.argtypes = []
+        lib.hvd_tpu_anomaly_info.restype = ctypes.c_char_p
+        lib.hvd_tpu_anomaly_info.argtypes = []
+        lib.hvd_tpu_anomaly_log.restype = ctypes.c_char_p
+        lib.hvd_tpu_anomaly_log.argtypes = []
         lib.hvd_tpu_announce_count.restype = ctypes.c_longlong
         lib.hvd_tpu_announce_count.argtypes = []
         lib.hvd_tpu_announce_log.restype = ctypes.c_char_p
@@ -1001,6 +1007,83 @@ def _sync_engine_liveness() -> None:
         })
 
 
+def _sync_engine_links() -> None:
+    """Mirror the engine's per-peer link telemetry into the registry's
+    ungated ``"links"`` section (docs/metrics.md#links): transport byte /
+    stall counters, the timed-send latency histogram, and the
+    heartbeat-echo RTT estimate for every TCP link this rank holds.  A
+    state copy — the net-layer counters are cumulative, so overwriting is
+    idempotent."""
+    if _lib is None:
+        return
+    with _stall_sync_lock:
+        info = _lib.hvd_tpu_link_info().decode()
+        parts = info.split("|")
+        if len(parts) < 2:
+            return
+        peers = {}
+        for tok in parts[1].split(";"):
+            fields = tok.split(":")
+            if len(fields) != 13:
+                continue
+            try:
+                peers[int(fields[0])] = {
+                    "bytes_out": int(fields[1]),
+                    "bytes_in": int(fields[2]),
+                    "sends": int(fields[3]),
+                    "recvs": int(fields[4]),
+                    "stalls": int(fields[5]),
+                    "short_writes": int(fields[6]),
+                    "send_us_sum": int(fields[7]),
+                    "send_us_count": int(fields[8]),
+                    "send_us_buckets": [int(b) for b in
+                                        fields[9].split(",") if b],
+                    "rtt_last_us": int(fields[10]),
+                    "rtt_ewma_us": int(fields[11]),
+                    "rtt_samples": int(fields[12]),
+                }
+            except ValueError:
+                continue
+        metrics.registry.set_links({"enabled": parts[0] == "1",
+                                    "peers": peers})
+
+
+def _sync_engine_anomalies() -> None:
+    """Mirror the engine's online anomaly detector into the registry's
+    ungated ``"anomalies"`` section (docs/metrics.md#anomalies): the
+    configured sigma/interval, cumulative verdict counts per kind, and
+    the bounded typed-verdict log.  A state copy — idempotent."""
+    if _lib is None:
+        return
+    with _stall_sync_lock:
+        info = _lib.hvd_tpu_anomaly_info().decode()
+        parts = info.split("|")
+        if len(parts) < 6:
+            return
+        try:
+            sigma, interval_ms = int(parts[0]), int(parts[1])
+            counts = [int(p) for p in parts[2:6]]
+        except ValueError:
+            return
+        log = []
+        for tok in _lib.hvd_tpu_anomaly_log().decode().split(";"):
+            fields = tok.split("|")
+            if len(fields) != 4:
+                continue
+            try:
+                age_us = int(fields[3])
+            except ValueError:
+                continue
+            log.append({"kind": fields[0], "subject": fields[1],
+                        "detail": fields[2], "age_us": age_us})
+        metrics.registry.set_anomalies({
+            "sigma": sigma,
+            "interval_ms": interval_ms,
+            "verdicts": dict(zip(metrics.ANOMALY_KINDS, counts)),
+            "log": log,
+        })
+
+
 def _sync_engine_autotune() -> None:
     """Mirror the engine's autotuning state into the registry's ungated
     ``"autotune"`` section (docs/performance.md#autotuning).  Unlike the
@@ -1036,6 +1119,8 @@ def metrics_snapshot() -> dict:
     _sync_engine_topology()
     _sync_engine_control()
     _sync_engine_liveness()
+    _sync_engine_links()
+    _sync_engine_anomalies()
     return metrics.registry.snapshot()
 
 

@@ -180,6 +180,22 @@ class Config:
     heartbeat_ms: int = 100
     heartbeat_miss: int = 10
     net_fault_spec: str = ""
+    # Perf-introspection plane (docs/metrics.md#links, #anomalies).
+    # link_stats (HVD_TPU_LINK_STATS, default on): per-peer transport
+    # telemetry — bytes, write stalls, timed-send latency histograms,
+    # heartbeat-echo RTT — accounted at the net layer and exposed via
+    # metrics_snapshot()["links"] / hvd_tpu_link_* families; 0 disables
+    # the accounting (one relaxed atomic per transport call remains).
+    # anomaly_sigma (HVD_TPU_ANOMALY_SIGMA, default 5): robust-excursion
+    # threshold (median + sigma * MAD) of the online anomaly detector
+    # that turns those baselines into typed verdicts — slow_link(A-B),
+    # straggler(rank), cache_degraded, slow_phase(phase); 0 disables the
+    # detector thread.  anomaly_interval_ms
+    # (HVD_TPU_ANOMALY_INTERVAL_MS, default 500): detector sweep cadence,
+    # floored at 10ms.
+    link_stats: bool = True
+    anomaly_sigma: int = 5
+    anomaly_interval_ms: int = 500
 
     @property
     def compression_code(self) -> int:
@@ -262,4 +278,10 @@ class Config:
             heartbeat_miss=int(os.environ.get(
                 "HVD_TPU_HEARTBEAT_MISS") or 10),
             net_fault_spec=os.environ.get("HVD_TPU_NET_FAULT_SPEC", ""),
+            link_stats=_flag(os.environ.get("HVD_TPU_LINK_STATS", "1")),
+            anomaly_sigma=int(os.environ.get("HVD_TPU_ANOMALY_SIGMA")
+                              if os.environ.get("HVD_TPU_ANOMALY_SIGMA")
+                              not in (None, "") else 5),
+            anomaly_interval_ms=int(os.environ.get(
+                "HVD_TPU_ANOMALY_INTERVAL_MS") or 500),
         )

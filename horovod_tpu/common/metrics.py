@@ -110,6 +110,17 @@ _MAX_TENANTS = 256
 SERVING_EVENTS = ("requests", "admitted", "rejected", "retired", "failed",
                   "preempted", "reformed")
 
+# Anomaly-verdict kinds — the keys of the "anomalies" snapshot section's
+# verdict counts and the `kind` label values of
+# hvd_tpu_anomaly_verdicts_total.  Order matches the engine's verdict-kind
+# indices (engine/cc/flight.h FL_ANOMALY).
+ANOMALY_KINDS = ("slow_link", "straggler", "cache_degraded", "slow_phase")
+
+# Per-link timed-send latency bucket upper bounds (µs) — must match
+# kNetLinkBucketUs in engine/cc/net.cc; the engine serializes one extra
+# +Inf overflow bucket after these.
+LINK_SEND_BUCKETS_US = (50, 100, 250, 500, 1000, 2500, 5000, 10000, 50000)
+
 
 class Histogram:
     """Fixed-bucket histogram; Prometheus-compatible (le upper bounds plus
@@ -261,6 +272,20 @@ class MetricsRegistry:
             "frames": {"sent": 0, "received": 0},
             "miss_events": 0, "evictions": 0, "clock_fanin": 0,
             "peers": {},
+        }
+        # Per-peer link telemetry (docs/metrics.md#links): transport
+        # counters and latency/RTT estimates for every TCP link this rank
+        # holds, mirrored from the engine's net-layer accounting.
+        # Ungated, like stalls: the chaos-localization test asserts
+        # per-link latency without enabling full metrics.
+        self._links = {"enabled": False, "peers": {}}
+        # Anomaly detector (docs/metrics.md#anomalies): configuration,
+        # cumulative typed-verdict counts, and the bounded verdict log.
+        # Ungated — verdicts exist to be seen.
+        self._anomalies = {
+            "sigma": 0, "interval_ms": 0,
+            "verdicts": {k: 0 for k in ANOMALY_KINDS},
+            "log": [],
         }
         # State plane (docs/fault-tolerance.md#state-plane): snapshot /
         # peer-copy / restore counters and the checkpoint lifecycle.
@@ -445,6 +470,50 @@ class MetricsRegistry:
                 "peers": {int(r): {"age_us": int(v.get("age_us", 0)),
                                    "misses": int(v.get("misses", 0))}
                           for r, v in state.get("peers", {}).items()},
+            }
+
+    def set_links(self, state: dict) -> None:
+        """Mirror the engine's per-peer link telemetry (a state copy —
+        the net-layer counters are cumulative, so overwriting is
+        idempotent, like the liveness mirror).  Ungated."""
+        with self._lock:
+            self._links = {
+                "enabled": bool(state.get("enabled", False)),
+                "peers": {
+                    str(r): {
+                        "bytes_out": int(v.get("bytes_out", 0)),
+                        "bytes_in": int(v.get("bytes_in", 0)),
+                        "sends": int(v.get("sends", 0)),
+                        "recvs": int(v.get("recvs", 0)),
+                        "stalls": int(v.get("stalls", 0)),
+                        "short_writes": int(v.get("short_writes", 0)),
+                        "send_us_sum": int(v.get("send_us_sum", 0)),
+                        "send_us_count": int(v.get("send_us_count", 0)),
+                        "send_us_buckets": [
+                            int(b) for b in v.get("send_us_buckets", [])],
+                        "rtt_last_us": int(v.get("rtt_last_us", -1)),
+                        "rtt_ewma_us": int(v.get("rtt_ewma_us", 0)),
+                        "rtt_samples": int(v.get("rtt_samples", 0)),
+                    }
+                    for r, v in state.get("peers", {}).items()
+                },
+            }
+
+    def set_anomalies(self, state: dict) -> None:
+        """Mirror the engine's anomaly-detector state: config, cumulative
+        verdict counts, bounded verdict log (a state copy — idempotent).
+        Ungated."""
+        with self._lock:
+            self._anomalies = {
+                "sigma": int(state.get("sigma", 0)),
+                "interval_ms": int(state.get("interval_ms", 0)),
+                "verdicts": {k: int(state.get("verdicts", {}).get(k, 0))
+                             for k in ANOMALY_KINDS},
+                "log": [{"kind": str(e.get("kind", "")),
+                         "subject": str(e.get("subject", "")),
+                         "detail": str(e.get("detail", "")),
+                         "age_us": int(e.get("age_us", 0))}
+                        for e in state.get("log", [])][-64:],
             }
 
     def set_autotune(self, report: dict) -> None:
@@ -658,6 +727,18 @@ class MetricsRegistry:
                     "frames": dict(self._liveness["frames"]),
                     "peers": {r: dict(v) for r, v in
                               self._liveness["peers"].items()},
+                },
+                "links": {
+                    "enabled": self._links["enabled"],
+                    "peers": {r: {**v, "send_us_buckets":
+                                  list(v["send_us_buckets"])}
+                              for r, v in self._links["peers"].items()},
+                },
+                "anomalies": {
+                    "sigma": self._anomalies["sigma"],
+                    "interval_ms": self._anomalies["interval_ms"],
+                    "verdicts": dict(self._anomalies["verdicts"]),
+                    "log": [dict(e) for e in self._anomalies["log"]],
                 },
                 "state": {
                     **{k: v for k, v in self._state.items()
@@ -1035,6 +1116,82 @@ def prometheus_text(snapshot: dict) -> str:
         out.append(f'hvd_tpu_liveness_peer_age_us{{peer="{r}"}} '
                    f'{v.get("age_us", 0)}')
 
+    links = snapshot.get("links", {})
+    link_peers = links.get("peers", {})
+    out.append("# HELP hvd_tpu_link_stats_enabled per-peer link telemetry "
+               "armed on this rank (HVD_TPU_LINK_STATS)")
+    out.append("# TYPE hvd_tpu_link_stats_enabled gauge")
+    out.append("hvd_tpu_link_stats_enabled "
+               f"{int(links.get('enabled', False))}")
+    out.append("# HELP hvd_tpu_link_bytes_total bytes moved over each "
+               "peer link by direction (docs/metrics.md#links)")
+    out.append("# TYPE hvd_tpu_link_bytes_total counter")
+    for r, v in link_peers.items():
+        out.append(f'hvd_tpu_link_bytes_total{{peer="{r}",dir="out"}} '
+                   f'{v.get("bytes_out", 0)}')
+        out.append(f'hvd_tpu_link_bytes_total{{peer="{r}",dir="in"}} '
+                   f'{v.get("bytes_in", 0)}')
+    out.append("# HELP hvd_tpu_link_sends_total timed whole-frame sends "
+               "completed on each peer link")
+    out.append("# TYPE hvd_tpu_link_sends_total counter")
+    for r, v in link_peers.items():
+        out.append(f'hvd_tpu_link_sends_total{{peer="{r}"}} '
+                   f'{v.get("sends", 0)}')
+    out.append("# HELP hvd_tpu_link_stall_events_total transport "
+               "backpressure on each peer link (write stalls, short "
+               "writes)")
+    out.append("# TYPE hvd_tpu_link_stall_events_total counter")
+    for r, v in link_peers.items():
+        out.append(f'hvd_tpu_link_stall_events_total{{peer="{r}",'
+                   f'kind="stall"}} {v.get("stalls", 0)}')
+        out.append(f'hvd_tpu_link_stall_events_total{{peer="{r}",'
+                   f'kind="short_write"}} {v.get("short_writes", 0)}')
+    out.append("# HELP hvd_tpu_link_send_latency_us whole-frame send "
+               "latency per peer link (includes any injected chaos "
+               "delay)")
+    out.append("# TYPE hvd_tpu_link_send_latency_us histogram")
+    for r, v in link_peers.items():
+        buckets = v.get("send_us_buckets", [])
+        cumulative = 0
+        for bound, n in zip(LINK_SEND_BUCKETS_US, buckets):
+            cumulative += n
+            out.append(f'hvd_tpu_link_send_latency_us_bucket{{peer="{r}",'
+                       f'le="{_fmt(bound)}"}} {cumulative}')
+        out.append(f'hvd_tpu_link_send_latency_us_bucket{{peer="{r}",'
+                   f'le="+Inf"}} {v.get("send_us_count", 0)}')
+        out.append(f'hvd_tpu_link_send_latency_us_sum{{peer="{r}"}} '
+                   f'{v.get("send_us_sum", 0)}')
+        out.append(f'hvd_tpu_link_send_latency_us_count{{peer="{r}"}} '
+                   f'{v.get("send_us_count", 0)}')
+    out.append("# HELP hvd_tpu_link_rtt_us heartbeat-echo round-trip "
+               "estimate per peer link (last sample and EWMA)")
+    out.append("# TYPE hvd_tpu_link_rtt_us gauge")
+    for r, v in link_peers.items():
+        if v.get("rtt_samples", 0) > 0:
+            out.append(f'hvd_tpu_link_rtt_us{{peer="{r}",stat="last"}} '
+                       f'{v.get("rtt_last_us", -1)}')
+            out.append(f'hvd_tpu_link_rtt_us{{peer="{r}",stat="ewma"}} '
+                       f'{v.get("rtt_ewma_us", 0)}')
+    out.append("# HELP hvd_tpu_link_rtt_samples_total heartbeat-echo "
+               "round trips measured per peer link")
+    out.append("# TYPE hvd_tpu_link_rtt_samples_total counter")
+    for r, v in link_peers.items():
+        out.append(f'hvd_tpu_link_rtt_samples_total{{peer="{r}"}} '
+                   f'{v.get("rtt_samples", 0)}')
+
+    anomalies = snapshot.get("anomalies", {})
+    out.append("# HELP hvd_tpu_anomaly_sigma robust-excursion threshold "
+               "of the online anomaly detector (0 = disabled)")
+    out.append("# TYPE hvd_tpu_anomaly_sigma gauge")
+    out.append(f"hvd_tpu_anomaly_sigma {anomalies.get('sigma', 0)}")
+    out.append("# HELP hvd_tpu_anomaly_verdicts_total typed anomaly "
+               "verdicts emitted by the online detector "
+               "(docs/metrics.md#anomalies)")
+    out.append("# TYPE hvd_tpu_anomaly_verdicts_total counter")
+    for kind in ANOMALY_KINDS:
+        out.append(f'hvd_tpu_anomaly_verdicts_total{{kind="{kind}"}} '
+                   f'{anomalies.get("verdicts", {}).get(kind, 0)}')
+
     state = snapshot.get("state", {})
     out.append("# HELP hvd_tpu_state_armed state plane armed on this "
                "rank (docs/fault-tolerance.md#state-plane)")
@@ -1162,6 +1319,8 @@ def health_summary(snap: dict) -> dict:
     misses = sum(c.get("misses", 0)
                  for c in snap.get("cache", {}).values())
     serving = snap.get("serving", {})
+    links = snap.get("links", {})
+    anomalies = snap.get("anomalies", {})
     return {
         "live": True,
         "membership_epoch": member.get("epoch", 0),
@@ -1175,6 +1334,30 @@ def health_summary(snap: dict) -> dict:
         "serving_active": serving.get("active", 0),
         "flight_events": sum(
             snap.get("flight", {}).get("events", {}).values()),
+        # Compact per-link heat record (one row per peer this rank talks
+        # to) — what hvdtop's link table renders.  send_mean_us covers
+        # timed whole-frame sends; rtt_ewma_us is -1 until the first
+        # heartbeat echo lands.
+        "links": {
+            str(r): {
+                "send_mean_us": (v.get("send_us_sum", 0)
+                                 // max(v.get("send_us_count", 0), 1)
+                                 if v.get("send_us_count", 0) else -1),
+                "rtt_ewma_us": (v.get("rtt_ewma_us", 0)
+                                if v.get("rtt_samples", 0) else -1),
+                "stalls": (v.get("stalls", 0)
+                           + v.get("short_writes", 0)),
+                "bytes": (v.get("bytes_out", 0) + v.get("bytes_in", 0)),
+            }
+            for r, v in links.get("peers", {}).items()
+        },
+        # Typed anomaly verdicts (docs/metrics.md#anomalies): cumulative
+        # counts plus the tail of the verdict log, so /cluster can merge
+        # a job-wide anomaly feed.
+        "anomalies": {
+            "verdicts": dict(anomalies.get("verdicts", {})),
+            "log": [dict(e) for e in anomalies.get("log", [])[-8:]],
+        },
     }
 
 
@@ -1219,11 +1402,28 @@ def cluster_document(snapshot_fn: Callable[[], dict]) -> dict:
         t.join(timeout=2.0)
     live = [r for r in ranks.values() if r.get("live")]
     epochs = {r.get("membership_epoch") for r in live}
+    # Job-wide anomaly rollup: total verdicts per kind plus a merged,
+    # rank-attributed tail of every rank's verdict log (newest-by-age
+    # first) — the scrolling feed hvdtop renders.
+    verdict_totals: Dict[str, int] = {}
+    feed = []
+    for rank, entry in ranks.items():
+        anomalies = entry.get("anomalies", {}) or {}
+        for kind, n in anomalies.get("verdicts", {}).items():
+            verdict_totals[kind] = verdict_totals.get(kind, 0) + int(n)
+        for e in anomalies.get("log", []):
+            feed.append({"rank": rank, **e})
+    feed.sort(key=lambda e: e.get("age_us", 0))
     return {
         "ranks": ranks,
         "launched": len(targets),
         "live": len(live),
         "membership_epochs_agree": len(epochs) <= 1,
+        "anomalies": {
+            "total": sum(verdict_totals.values()),
+            "verdicts": verdict_totals,
+            "recent": feed[:32],
+        },
     }
 
 

@@ -283,6 +283,34 @@ const char* hvd_tpu_liveness_info() {
   return tl_liveness.c_str();
 }
 
+// Per-peer link telemetry (docs/metrics.md#links): "enabled|" then
+// semicolon-separated
+// "peer:bytes_out:bytes_in:sends:recvs:stalls:short_writes:send_us_sum:
+//  send_us_count:b0,..,b9:rtt_last_us:rtt_ewma_us:rtt_samples" entries.
+// rtt_last_us is -1 until the first heartbeat echo lands.
+const char* hvd_tpu_link_info() {
+  static thread_local std::string tl_link;
+  tl_link = GlobalEngine()->LinkInfo();
+  return tl_link.c_str();
+}
+
+// Anomaly detector config + cumulative verdict counts:
+// "sigma|interval_ms|slow_link|straggler|cache_degraded|slow_phase".
+// sigma 0 = detector disabled.
+const char* hvd_tpu_anomaly_info() {
+  static thread_local std::string tl_anomaly;
+  tl_anomaly = GlobalEngine()->AnomalyInfo();
+  return tl_anomaly.c_str();
+}
+
+// Bounded verdict log, oldest first: "kind|subject|detail|age_us;..."
+// (separators sanitized out of subject/detail).
+const char* hvd_tpu_anomaly_log() {
+  static thread_local std::string tl_anomaly_log;
+  tl_anomaly_log = GlobalEngine()->AnomalyLog();
+  return tl_anomaly_log.c_str();
+}
+
 // Announce-order observability for the Python metrics registry (straggler
 // attribution, rank-0 coordinator view): cumulative negotiation count, a
 // bounded log of the most recent ones as

@@ -7,6 +7,8 @@
 #include <cstdio>
 #include <cstdlib>
 #include <limits>
+#include <map>
+#include <set>
 
 #include "net.h"
 
@@ -709,6 +711,19 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
       *err = "bad HVD_TPU_NET_FAULT_SPEC: " + fault_err;
       return 1;
     }
+    // Perf-introspection plane (docs/metrics.md#links / #anomalies):
+    // per-link telemetry default-on (counters are process-cumulative and
+    // cost one mutex hold per transport call; HVD_TPU_LINK_STATS=0 is
+    // the kill switch), anomaly detector default sigma 5 (0 disables).
+    const char* ls_env = getenv("HVD_TPU_LINK_STATS");
+    NetLinkInit(!(ls_env && *ls_env && atoi(ls_env) == 0));
+    const char* as_env = getenv("HVD_TPU_ANOMALY_SIGMA");
+    anomaly_sigma_ = (as_env && *as_env) ? atoi(as_env) : 5;
+    if (anomaly_sigma_ < 0) anomaly_sigma_ = 0;
+    const char* ai_env = getenv("HVD_TPU_ANOMALY_INTERVAL_MS");
+    anomaly_interval_ms_ = (ai_env && *ai_env) ? atoi(ai_env) : 500;
+    if (anomaly_interval_ms_ < 10) anomaly_interval_ms_ = 10;
+    anomaly_stop_.store(false);
     std::lock_guard<std::mutex> lk(hb_mu_);
     hb_last_seen_us_.clear();
     hb_miss_counts_.clear();
@@ -829,6 +844,10 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
   // starts it too — the first grow's RebuildRing hands it beat sockets.
   if (hb_interval_ms_ > 0 && (opts_.size > 1 || opts_.elastic))
     hb_thread_ = std::thread([this]() { HeartbeatLoop(); });
+  // Anomaly detector: same off-the-tick construction.  Single-rank jobs
+  // skip it (no links, no announce order, nothing to localize).
+  if (anomaly_sigma_ > 0 && opts_.size > 1)
+    anomaly_thread_ = std::thread([this]() { AnomalyLoop(); });
   return 0;
 }
 
@@ -1292,12 +1311,15 @@ bool Engine::SetupSockets(std::string* err) {
     *err = "heartbeat beacon left neighbour never connected";
     return false;
   }
-  // Link-fault registry (net.h): every data/control/beat fd maps to the
-  // rank at its far end, so HVD_TPU_NET_FAULT_SPEC clauses naming ranks
-  // resolve to sockets.  The beat fds register too — a partitioned link
-  // MUST also silence its beacons, or the detector could never see the
-  // partition it exists to detect.
-  if (NetFaultActive()) {
+  // fd -> peer-rank registry (net.h): every data/control/beat fd maps to
+  // the rank at its far end.  HVD_TPU_NET_FAULT_SPEC clauses naming
+  // ranks resolve to sockets through it, and the per-link telemetry
+  // (NetLinkInfo) attributes bytes/latency through the SAME map — so
+  // registration is unconditional now (the fault hot path still costs
+  // one relaxed atomic when no spec is armed).  The beat fds register
+  // too — a partitioned link MUST also silence its beacons, or the
+  // detector could never see the partition it exists to detect.
+  {
     NetFaultRegister(right_fd_, right);
     NetFaultRegister(left_fd_, beat_left);
     if (hier) {
@@ -1574,9 +1596,15 @@ void Engine::Shutdown() {
   // once loop_exited_ flips under mu_).
   if (background_.joinable()) background_.join();
   StopHeartbeatMonitor();
+  StopAnomalyMonitor();
   timeline_.Shutdown();
   TeardownSockets();
   initialized_.store(false);
+}
+
+void Engine::StopAnomalyMonitor() {
+  anomaly_stop_.store(true);
+  if (anomaly_thread_.joinable()) anomaly_thread_.join();
 }
 
 void Engine::StopHeartbeatMonitor() {
@@ -1611,6 +1639,16 @@ void Engine::HeartbeatLoop() {
   int64_t grace_deadline_us = -1;  // -1 unarmed, -2 fired
   int64_t last_beat_us = 0;
   uint32_t seq = 0;
+  // Echo-RTT send-stamp ring (per-link RTT telemetry, net.h
+  // NetLinkRecordRtt): beacon seq -> send time, 256 deep — ~25s of
+  // beacons at the default cadence, far past any echo's plausible
+  // return, so a match is never a wrapped stale seq.
+  uint32_t echo_seq[256];
+  int64_t echo_ts[256];
+  for (int i = 0; i < 256; ++i) {
+    echo_seq[i] = 0xffffffffu;
+    echo_ts[i] = 0;
+  }
 
   auto flagged = [&](int peer) {
     for (int s : suspects)
@@ -1687,6 +1725,8 @@ void Engine::HeartbeatLoop() {
       hb.sender_rank = static_cast<uint32_t>(cur_rank_.load());
       hb.epoch = static_cast<uint32_t>(ep);
       hb.seq = seq++;
+      echo_seq[hb.seq & 0xffu] = hb.seq;
+      echo_ts[hb.seq & 0xffu] = now;
       uint8_t frame[kHeartbeatFrameBytes];
       SerializeHeartbeat(hb, frame);
       for (int i = 0; i < 2; ++i)
@@ -1773,12 +1813,30 @@ void Engine::HeartbeatLoop() {
             int s = static_cast<int>(in.seq);
             if (s >= 0 && s < cur_size_.load() && s != cur_rank_.load())
               flag(s);
+          } else if (in.magic == kEchoMagic) {
+            // Our own beacon, bounced back by the neighbour: one RTT
+            // sample for the link this echo arrived on.
+            int idx = static_cast<int>(in.seq & 0xffu);
+            if (static_cast<int>(in.sender_rank) == cur_rank_.load() &&
+                echo_seq[idx] == in.seq)
+              NetLinkRecordRtt(cached_peers[i],
+                               EpochNowUs() - echo_ts[idx]);
           } else {
             hb_recv_.fetch_add(1);
             int sender = static_cast<int>(in.sender_rank);
-            std::lock_guard<std::mutex> lk(hb_mu_);
-            hb_last_seen_us_[sender] = EpochNowUs();
-            hb_miss_counts_[sender] = 0;
+            {
+              std::lock_guard<std::mutex> lk(hb_mu_);
+              hb_last_seen_us_[sender] = EpochNowUs();
+              hb_miss_counts_[sender] = 0;
+            }
+            // Bounce the beacon straight back with the magic swapped
+            // (sender_rank/epoch/seq preserved) on the same full-duplex
+            // socket — the sender turns it into the link's RTT estimate.
+            HeartbeatFrame echo = in;
+            echo.magic = kEchoMagic;
+            uint8_t ef[kHeartbeatFrameBytes];
+            SerializeHeartbeat(echo, ef);
+            SendAll(cached_fds[i], ef, sizeof ef);
           }
         }
         off += kHeartbeatFrameBytes;
@@ -1890,6 +1948,264 @@ std::string Engine::LivenessInfo() {
            std::to_string(mit == hb_miss_counts_.end() ? 0 : mit->second);
   }
   return out;
+}
+
+namespace {
+// Verdict-kind names; index = the `kind` stored in AnomalyVerdict and
+// the arg carried by the FL_ANOMALY flight event.
+const char* const kAnomalyKinds[] = {"slow_link", "straggler",
+                                     "cache_degraded", "slow_phase"};
+
+double RobustMedian(std::vector<double> v) {
+  if (v.empty()) return 0.0;
+  std::sort(v.begin(), v.end());
+  size_t n = v.size();
+  return n % 2 ? v[n / 2] : 0.5 * (v[n / 2 - 1] + v[n / 2]);
+}
+
+double RobustMad(const std::vector<double>& v, double med) {
+  std::vector<double> devs;
+  devs.reserve(v.size());
+  for (double x : v) devs.push_back(std::fabs(x - med));
+  return RobustMedian(std::move(devs));
+}
+}  // namespace
+
+std::string Engine::LinkInfo() { return NetLinkInfo(); }
+
+void Engine::EmitAnomaly(int kind, const std::string& subject,
+                         const std::string& detail) {
+  std::string label = kAnomalyKinds[kind];
+  if (!subject.empty()) label += "(" + subject + ")";
+  {
+    std::lock_guard<std::mutex> lk(anomaly_mu_);
+    ++anomaly_counts_[kind];
+    anomaly_log_.push_back({EpochNowUs(), kind, subject, detail});
+    while (anomaly_log_.size() > 64) anomaly_log_.pop_front();
+  }
+  if (flight_.Enabled()) flight_.Record(FL_ANOMALY, label, kind);
+  timeline_.Instant("hvd_anomaly", label);
+}
+
+std::string Engine::AnomalyInfo() {
+  std::lock_guard<std::mutex> lk(anomaly_mu_);
+  std::string out = std::to_string(anomaly_sigma_) + "|" +
+                    std::to_string(anomaly_interval_ms_);
+  for (int i = 0; i < 4; ++i)
+    out += "|" + std::to_string(anomaly_counts_[i]);
+  return out;
+}
+
+std::string Engine::AnomalyLog() {
+  int64_t now = EpochNowUs();
+  std::lock_guard<std::mutex> lk(anomaly_mu_);
+  std::string out;
+  for (const auto& v : anomaly_log_) {
+    if (!out.empty()) out += ';';
+    std::string subj, det;
+    for (char c : v.subject) subj += (c == ';' || c == '|') ? '_' : c;
+    for (char c : v.detail) det += (c == ';' || c == '|') ? '_' : c;
+    out += std::string(kAnomalyKinds[v.kind]) + "|" + subj + "|" + det +
+           "|" + std::to_string(now - v.ts_us);
+  }
+  return out;
+}
+
+void Engine::AnomalyLoop() {
+  // Detector thread contract: all sweep state (windows, baselines,
+  // episode flags) is thread-local to this function; the only shared
+  // surfaces are atomics, the net.h link accessor, announce_mu_, and the
+  // verdict sink (EmitAnomaly).  One verdict per episode: a flagged
+  // subject re-arms only after a clean sweep.
+  const int kSustain = 3;  // consecutive excursion sweeps before a verdict
+  const double sigma = anomaly_sigma_;
+  std::map<int, std::deque<double>> link_win;
+  std::map<int, long long> link_sum, link_cnt;
+  std::map<int, int> link_hot;
+  std::set<int> link_flagged;
+  std::vector<int64_t> ann_last;
+  std::vector<int> ann_hot;
+  std::set<int> ann_flagged;
+  int64_t cache_hits_last = cache_hits_.load();
+  int64_t cache_misses_last = cache_misses_.load();
+  std::deque<double> cache_win;
+  int cache_hot = 0;
+  bool cache_flagged = false;
+  const char* phase_names[3] = {"local_rs", "cross", "local_ag"};
+  std::atomic<int64_t>* phase_src[3] = {&topo_rs_us_, &topo_cross_us_,
+                                        &topo_ag_us_};
+  int64_t phase_last[3] = {phase_src[0]->load(), phase_src[1]->load(),
+                           phase_src[2]->load()};
+  int64_t phase_ops_last = topo_timed_ops_.load();
+  std::deque<double> phase_win[3];
+  int phase_hot[3] = {0, 0, 0};
+  bool phase_flagged[3] = {false, false, false};
+
+  while (!anomaly_stop_.load()) {
+    // Sliced nap: shutdown joins within ~10ms regardless of interval.
+    for (int slept = 0;
+         slept < anomaly_interval_ms_ && !anomaly_stop_.load(); slept += 10)
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    if (anomaly_stop_.load()) break;
+    if (!initialized_.load()) continue;
+    const int me = cur_rank_.load();
+
+    // --- slow_link: CROSS-SECTIONAL robust baseline.  Each link's level
+    // (median of its per-sweep delta-mean timed-send latencies) is
+    // compared against the median + MAD across ALL this rank's links —
+    // never against its own history — so a link that has been slow since
+    // init (a chaos delay clause with no @after, or a genuinely bad DCN
+    // route) still stands out.  Needs >= 3 links for the median to pin
+    // the healthy level; a 2-link rank cannot localize anyway.
+    for (const auto& lt : NetLinkLatencyTotals()) {
+      long long dsum = lt.sum_us - link_sum[lt.peer];
+      long long dcnt = lt.count - link_cnt[lt.peer];
+      link_sum[lt.peer] = lt.sum_us;
+      link_cnt[lt.peer] = lt.count;
+      if (dcnt <= 0) continue;  // idle sweep: window keeps its level
+      auto& w = link_win[lt.peer];
+      w.push_back(static_cast<double>(dsum) / static_cast<double>(dcnt));
+      while (w.size() > 16) w.pop_front();
+    }
+    std::vector<std::pair<int, double>> levels;
+    for (const auto& kv : link_win)
+      if (kv.second.size() >= 3)
+        levels.emplace_back(
+            kv.first, RobustMedian(std::vector<double>(kv.second.begin(),
+                                                       kv.second.end())));
+    if (levels.size() >= 3) {
+      std::vector<double> ls;
+      ls.reserve(levels.size());
+      for (const auto& p : levels) ls.push_back(p.second);
+      double med = RobustMedian(ls);
+      // 200µs floor under the MAD: loopback/veth sends jitter by tens of
+      // µs, and a near-zero MAD would turn that into false verdicts.
+      double scale = std::max(RobustMad(ls, med), 200.0);
+      for (const auto& p : levels) {
+        bool hot = (p.second - med) / scale > sigma;
+        int& streak = link_hot[p.first];
+        streak = hot ? streak + 1 : 0;
+        if (!hot) link_flagged.erase(p.first);
+        if (streak >= kSustain && !link_flagged.count(p.first)) {
+          link_flagged.insert(p.first);
+          char det[128];
+          snprintf(det, sizeof det,
+                   "timed-send level %.0fus vs cross-link median %.0fus",
+                   p.second, med);
+          int lo = std::min(me, p.first), hi = std::max(me, p.first);
+          EmitAnomaly(0, std::to_string(lo) + "-" + std::to_string(hi),
+                      det);
+        }
+      }
+    }
+
+    // --- straggler (rank 0): a rank closing >= 75% of a sweep's
+    // negotiations (the coordinator's exact last-to-announce counts)
+    // across kSustain busy sweeps is the straggler — share-based rather
+    // than sigma-based because with one bad rank the "population" of
+    // closers is degenerate (median = the straggler).
+    if (me == 0) {
+      std::vector<int64_t> counts;
+      {
+        std::lock_guard<std::mutex> lk(announce_mu_);
+        counts = last_announce_counts_;
+      }
+      if (ann_last.size() != counts.size()) {
+        ann_last.assign(counts.size(), 0);
+        ann_hot.assign(counts.size(), 0);
+        ann_flagged.clear();
+      }
+      int64_t total = 0;
+      std::vector<int64_t> delta(counts.size(), 0);
+      for (size_t r = 0; r < counts.size(); ++r) {
+        delta[r] = counts[r] - ann_last[r];
+        total += delta[r];
+        ann_last[r] = counts[r];
+      }
+      if (total >= 16) {
+        for (size_t r = 0; r < counts.size(); ++r) {
+          bool hot = delta[r] * 4 >= total * 3;
+          ann_hot[r] = hot ? ann_hot[r] + 1 : 0;
+          if (!hot) ann_flagged.erase(static_cast<int>(r));
+          if (ann_hot[r] >= kSustain &&
+              !ann_flagged.count(static_cast<int>(r))) {
+            ann_flagged.insert(static_cast<int>(r));
+            char det[96];
+            snprintf(det, sizeof det,
+                     "last to announce in %lld of %lld negotiations",
+                     static_cast<long long>(delta[r]),
+                     static_cast<long long>(total));
+            EmitAnomaly(1, std::to_string(r), det);
+          }
+        }
+      }
+    }
+
+    // --- cache_degraded: TEMPORAL baseline on the per-sweep hit rate
+    // (degradation over time is the failure mode; the cold-start climb
+    // can never fire it — early sweeps sit below no baseline).
+    {
+      int64_t h = cache_hits_.load(), m = cache_misses_.load();
+      int64_t dh = h - cache_hits_last, dm = m - cache_misses_last;
+      cache_hits_last = h;
+      cache_misses_last = m;
+      if (dh + dm >= 16) {
+        double rate =
+            static_cast<double>(dh) / static_cast<double>(dh + dm);
+        if (cache_win.size() >= 6) {
+          std::vector<double> v(cache_win.begin(), cache_win.end());
+          double med = RobustMedian(v);
+          bool hot =
+              (med - rate) / std::max(RobustMad(v, med), 0.02) > sigma;
+          cache_hot = hot ? cache_hot + 1 : 0;
+          if (!hot) cache_flagged = false;
+          if (cache_hot >= kSustain && !cache_flagged) {
+            cache_flagged = true;
+            char det[96];
+            snprintf(det, sizeof det, "hit rate %.2f vs baseline %.2f",
+                     rate, med);
+            EmitAnomaly(2, "", det);
+          }
+        }
+        cache_win.push_back(rate);
+        while (cache_win.size() > 32) cache_win.pop_front();
+      }
+    }
+
+    // --- slow_phase: temporal baselines on the two-level topology's
+    // per-phase mean times (local reduce-scatter / cross-node / local
+    // allgather) — localizes "the DCN hop got slow" separately from any
+    // single link verdict.
+    {
+      int64_t ops = topo_timed_ops_.load();
+      int64_t dops = ops - phase_ops_last;
+      phase_ops_last = ops;
+      for (int p = 0; p < 3; ++p) {
+        int64_t s = phase_src[p]->load();
+        int64_t ds = s - phase_last[p];
+        phase_last[p] = s;
+        if (dops <= 0) continue;
+        double mean = static_cast<double>(ds) / static_cast<double>(dops);
+        if (phase_win[p].size() >= 6) {
+          std::vector<double> v(phase_win[p].begin(), phase_win[p].end());
+          double med = RobustMedian(v);
+          bool hot =
+              (mean - med) / std::max(RobustMad(v, med), 100.0) > sigma;
+          phase_hot[p] = hot ? phase_hot[p] + 1 : 0;
+          if (!hot) phase_flagged[p] = false;
+          if (phase_hot[p] >= kSustain && !phase_flagged[p]) {
+            phase_flagged[p] = true;
+            char det[96];
+            snprintf(det, sizeof det,
+                     "phase mean %.0fus vs baseline %.0fus", mean, med);
+            EmitAnomaly(3, phase_names[p], det);
+          }
+        }
+        phase_win[p].push_back(mean);
+        while (phase_win[p].size() > 32) phase_win[p].pop_front();
+      }
+    }
+  }
 }
 
 void Engine::BackgroundLoop() {
@@ -4739,13 +5055,13 @@ bool Engine::RebuildRing(std::string* err) {
                  "after the reshape";
     return false;
   }
-  if (NetFaultActive()) {
-    NetFaultRegister(right_fd_, right);
-    NetFaultRegister(left_fd_, beat_left);
-    if (want_beats) {
-      NetFaultRegister(new_beat_out, right);
-      NetFaultRegister(new_beat_in, beat_left);
-    }
+  // Unconditional (like SetupSockets): the link telemetry rides the same
+  // fd -> peer registry as the fault clauses.
+  NetFaultRegister(right_fd_, right);
+  NetFaultRegister(left_fd_, beat_left);
+  if (want_beats) {
+    NetFaultRegister(new_beat_out, right);
+    NetFaultRegister(new_beat_in, beat_left);
   }
   // Swap the new beacon lane in and re-arm the detector for the new
   // membership in one atomic step (the monitor re-reads everything from
@@ -5944,6 +6260,12 @@ void Engine::RecordTopologyOp(const std::string& name, bool tree,
   topo_log_.push_back(std::move(entry));
   while (topo_log_.size() > 256) topo_log_.pop_front();
   ++topo_log_total_;
+  // Cumulative phase sums: the anomaly detector's per-phase input (sweep
+  // deltas -> mean phase time per interval, no log parsing).
+  topo_rs_us_.fetch_add(local_rs_us);
+  topo_cross_us_.fetch_add(cross_us);
+  topo_ag_us_.fetch_add(local_ag_us);
+  topo_timed_ops_.fetch_add(1);
 }
 
 std::string Engine::TopologyInfo() {

@@ -429,6 +429,19 @@ class Engine {
   // the detector is off (HVD_TPU_HEARTBEAT_MS=0 or size 1).
   std::string LivenessInfo();
 
+  // Perf-introspection plane (docs/metrics.md#links / #anomalies).
+  // LinkInfo passes through the transport layer's per-peer telemetry
+  // (net.h NetLinkInfo: bytes, timed-send latency histogram, stall /
+  // short-write counts, heartbeat-echo RTT).  AnomalyInfo serializes the
+  // online detector's config + process-cumulative verdict counts as
+  // "sigma|interval_ms|slow_link|straggler|cache_degraded|slow_phase";
+  // AnomalyLog the bounded verdict log as
+  // "kind|subject|detail|age_us;..." (newest last, separators sanitized
+  // out of details) — the registry mirrors it whole, it is small.
+  std::string LinkInfo();
+  std::string AnomalyInfo();
+  std::string AnomalyLog();
+
   // Elastic-membership observability (docs/fault-tolerance.md).  The
   // epoch counts reshapes survived by THIS engine lifetime (0 until the
   // first); reshape/lost/joined totals are process-cumulative like
@@ -662,6 +675,24 @@ class Engine {
   // is not safe from the monitor thread.  True when it aborted.
   bool CheckHeartbeatLocalAbort();
 
+  // Online anomaly detector (docs/metrics.md#anomalies).  A second
+  // off-the-tick monitor thread (the HeartbeatLoop pattern) sweeps the
+  // observability counters every HVD_TPU_ANOMALY_INTERVAL_MS: per-link
+  // timed-send latency (cross-sectional robust baseline — each link's
+  // level against the median + MAD of ALL links, so a link that is slow
+  // FROM INIT still stands out), per-rank last-to-announce shares
+  // (rank 0), response-cache hit rate, and per-phase topology timing
+  // (temporal self-baselines).  A sustained excursion past
+  // HVD_TPU_ANOMALY_SIGMA robust deviations emits one typed verdict per
+  // episode through EmitAnomaly.  Reads only atomics, the net.h link
+  // accessor, and mutex-guarded logs — never engine-thread state.
+  void AnomalyLoop();
+  void StopAnomalyMonitor();
+  // Append a verdict: bounded log + cumulative count (anomaly_mu_), an
+  // FL_ANOMALY flight event, and an ANOMALY timeline instant.
+  void EmitAnomaly(int kind, const std::string& subject,
+                   const std::string& detail);
+
   // Online autotuning (docs/performance.md#autotuning).  AttachTunedParams
   // runs at the coordinator after CoordinatorTick: it gives the
   // ParameterManager its per-tick chance to close a window / flush a
@@ -894,6 +925,25 @@ class Engine {
   // in the flat star).
   std::atomic<int64_t> clock_fanin_{0};
 
+  // Online anomaly detector (docs/metrics.md#anomalies).  Sweep state
+  // (windows, baselines) lives as AnomalyLoop locals — single-threaded,
+  // no locking; only the verdict surface below is shared.  Verdict
+  // counts are process-cumulative (StallEvents contract); the log is
+  // bounded at 64 entries so an unread registry cannot grow it.
+  std::thread anomaly_thread_;
+  std::atomic<bool> anomaly_stop_{false};
+  int anomaly_sigma_ = 5;         // env HVD_TPU_ANOMALY_SIGMA; 0 = off
+  int anomaly_interval_ms_ = 500; // env HVD_TPU_ANOMALY_INTERVAL_MS
+  struct AnomalyVerdict {
+    int64_t ts_us;
+    int kind;  // index into kAnomalyKinds
+    std::string subject;
+    std::string detail;
+  };
+  mutable std::mutex anomaly_mu_;
+  std::deque<AnomalyVerdict> anomaly_log_;
+  int64_t anomaly_counts_[4] = {0, 0, 0, 0};
+
   // Fusion buffer (lazily grown; analogue of the reference's persistent
   // fusion buffer, operations.cc:696-749).
   std::vector<char> fusion_buffer_;
@@ -1048,6 +1098,13 @@ class Engine {
   std::atomic<int64_t> topo_local_bytes_{0};
   std::atomic<int64_t> topo_cross_bytes_{0};
   std::atomic<int> topo_last_algo_{-1};
+  // Cumulative per-phase time sums + timed-op count (process-cumulative):
+  // the anomaly detector's per-phase input — sweep deltas give a mean
+  // phase time per interval without parsing the bounded log.
+  std::atomic<int64_t> topo_rs_us_{0};
+  std::atomic<int64_t> topo_cross_us_{0};
+  std::atomic<int64_t> topo_ag_us_{0};
+  std::atomic<int64_t> topo_timed_ops_{0};
   std::mutex topo_mu_;  // guards topo_log_, topo_log_total_
   std::deque<std::string> topo_log_;  // "name|algo|rs_us|cross_us|ag_us"
   int64_t topo_log_total_ = 0;

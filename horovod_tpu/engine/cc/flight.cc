@@ -27,6 +27,7 @@ const char* FlightEventName(uint8_t event) {
     case FL_TOPOLOGY:  return "topology";
     case FL_STEADY:    return "steady";
     case FL_HEARTBEAT_MISS: return "heartbeat_miss";
+    case FL_ANOMALY:   return "anomaly";
     default:           return "unknown";
   }
 }

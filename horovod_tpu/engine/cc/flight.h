@@ -50,6 +50,11 @@ enum FlightEventType : uint8_t {
                            // "flag" when first flagged, "report" when
                            // the report frame went up, "local-abort"
                            // when the grace deadline escalated locally)
+  FL_ANOMALY = 14,  // online anomaly detector emitted a typed verdict
+                    // (name: "slow_link(A-B)" / "straggler(rank)" /
+                    // "cache_degraded" / "slow_phase(phase)"; arg: the
+                    // verdict-kind index) — the postmortem record that
+                    // says WHERE the job was slow before it died
 };
 
 const char* FlightEventName(uint8_t event);

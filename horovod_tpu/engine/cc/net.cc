@@ -16,6 +16,7 @@
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
+#include <map>
 #include <mutex>
 #include <thread>
 #include <unordered_map>
@@ -87,6 +88,64 @@ int g_fault_rank = -1;
 uint32_t g_fault_seed = 0;
 double g_fault_t0 = 0.0;
 std::atomic<bool> g_fault_armed{false};
+
+// ---------------------------------------------------------------------------
+// Per-peer link telemetry (net.h NetLink*).  Shares g_fault_mu with the
+// fd -> peer registry above so one lock hold covers both the lookup and
+// the stat update; keyed by PEER RANK (stats survive fd churn and
+// re-init — the StallEvents process-cumulative contract).
+// ---------------------------------------------------------------------------
+
+struct LinkStats {
+  long long bytes_out = 0, bytes_in = 0;
+  long long sends = 0, recvs = 0;
+  long long stalls = 0;        // EAGAIN retry events on a send path
+  long long short_writes = 0;  // kernel accepted fewer bytes than asked
+  long long send_us_sum = 0;
+  long long send_us_count = 0;
+  long long send_us_buckets[10] = {0};
+  long long rtt_last_us = -1;
+  double rtt_ewma_us = 0.0;
+  long long rtt_samples = 0;
+};
+
+std::map<int, LinkStats> g_link_stats;  // guarded by g_fault_mu
+std::atomic<bool> g_link_enabled{false};
+
+long long LinkNowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int LinkBucket(long long us) {
+  for (int i = 0; i < kNetLinkBuckets - 1; ++i)
+    if (us <= kNetLinkBucketUs[i]) return i;
+  return kNetLinkBuckets - 1;
+}
+
+// One locked update per transport CALL (never per byte): bytes in/out,
+// stall/short-write counts, and — when lat_us >= 0 — one timed-send
+// histogram sample.  Unregistered fds (pre-registration rendezvous
+// traffic, joiner handshakes) fall through untouched.
+void LinkRecord(int fd, long long bytes_out, long long bytes_in,
+                long long stalls, long long shorts, long long lat_us) {
+  std::lock_guard<std::mutex> lk(g_fault_mu);
+  auto it = g_fault_fds.find(fd);
+  if (it == g_fault_fds.end() || it->second.peer < 0) return;
+  LinkStats& s = g_link_stats[it->second.peer];
+  s.bytes_out += bytes_out;
+  s.bytes_in += bytes_in;
+  s.stalls += stalls;
+  s.short_writes += shorts;
+  if (bytes_in > 0) ++s.recvs;
+  if (lat_us >= 0) {
+    ++s.sends;
+    s.send_us_sum += lat_us;
+    ++s.send_us_count;
+    ++s.send_us_buckets[LinkBucket(lat_us)];
+  }
+}
 
 bool ClauseMatches(const FaultClause& c, int me, int peer) {
   if (c.partition) {
@@ -452,6 +511,13 @@ int ConnectRetry(const std::string& host, int port, double timeout_sec,
 
 bool SendAll(int fd, const void* buf, size_t len) {
   size_t first_cap = 0;
+  // The latency clock starts BEFORE the fault hooks: an injected
+  // `link=A-B:delay=MS` sleep is part of what this link costs, and the
+  // telemetry must see it the way a real slow route would look.
+  const bool track = NetLinkEnabled();
+  const long long t0 = track ? LinkNowUs() : 0;
+  long long stalls = 0, shorts = 0;
+  const size_t total = len;
   if (NetFaultActive()) {
     // A dropped link swallows the bytes but reports success: the sender
     // keeps running and the receiver sees pure silence (never EOF) — the
@@ -471,16 +537,24 @@ bool SendAll(int fd, const void* buf, size_t len) {
     first_cap = 0;
     ssize_t n = send(fd, p, want, MSG_NOSIGNAL);
     if (n <= 0) {
-      if (n < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+      if (n < 0 && (errno == EINTR || errno == EAGAIN)) {
+        if (n < 0 && errno == EAGAIN) ++stalls;
+        continue;
+      }
       return false;
     }
+    if (static_cast<size_t>(n) < want) ++shorts;
     p += n;
     len -= static_cast<size_t>(n);
   }
+  if (track)
+    LinkRecord(fd, static_cast<long long>(total), 0, stalls, shorts,
+               LinkNowUs() - t0);
   return true;
 }
 
 bool RecvAll(int fd, void* buf, size_t len) {
+  const size_t total = len;
   char* p = static_cast<char*>(buf);
   while (len > 0) {
     ssize_t n = recv(fd, p, len, 0);
@@ -491,6 +565,8 @@ bool RecvAll(int fd, void* buf, size_t len) {
     p += n;
     len -= static_cast<size_t>(n);
   }
+  if (NetLinkEnabled())
+    LinkRecord(fd, 0, static_cast<long long>(total), 0, 0, -1);
   return true;
 }
 
@@ -558,6 +634,7 @@ bool Exchange(int send_fd, const void* sbuf, size_t slen, int recv_fd,
   const char* sp = static_cast<const char*>(sbuf);
   char* rp = static_cast<char*>(rbuf);
   size_t sent = 0, recvd = 0;
+  long long stalls = 0, shorts = 0;
   bool flaky_send = false;
   if (NetFaultActive()) {
     if (NetFaultDrops(send_fd)) sent = slen;  // blackhole the send leg
@@ -598,7 +675,11 @@ bool Exchange(int send_fd, const void* sbuf, size_t slen, int recv_fd,
       ssize_t w = send(send_fd, sp + sent, want,
                        MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EINTR && errno != EAGAIN) return false;
-      if (w > 0) sent += static_cast<size_t>(w);
+      if (w < 0 && errno == EAGAIN) ++stalls;
+      if (w > 0) {
+        if (static_cast<size_t>(w) < want) ++shorts;
+        sent += static_cast<size_t>(w);
+      }
     }
     if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
       ssize_t g = recv(recv_fd, rp + recvd, rlen - recvd, 0);
@@ -606,6 +687,15 @@ bool Exchange(int send_fd, const void* sbuf, size_t slen, int recv_fd,
       if (g < 0 && errno != EINTR && errno != EAGAIN) return false;
       if (g > 0) recvd += static_cast<size_t>(g);
     }
+  }
+  // Bytes and stall counts only — the poll-multiplexed legs overlap, so a
+  // wall-clock span here would measure the slower DIRECTION, not this
+  // link's send cost (the timed samples come from SendAll callers).
+  if (NetLinkEnabled()) {
+    if (slen > 0)
+      LinkRecord(send_fd, static_cast<long long>(slen), 0, stalls, shorts,
+                 -1);
+    if (rlen > 0) LinkRecord(recv_fd, 0, static_cast<long long>(rlen), 0, 0, -1);
   }
   return true;
 }
@@ -625,6 +715,7 @@ bool ExchangeBi(int right_fd, const void* send_r, size_t send_r_len,
     const char* sp = nullptr;
     char* rp = nullptr;
     size_t len, done = 0;
+    long long stalls = 0, shorts = 0;  // send legs only
   };
   Leg sr{right_fd, static_cast<const char*>(send_r), nullptr, send_r_len};
   Leg sl{left_fd, static_cast<const char*>(send_l), nullptr, send_l_len};
@@ -665,7 +756,11 @@ bool ExchangeBi(int right_fd, const void* send_r, size_t send_r_len,
       ssize_t w = send(l.fd, l.sp + l.done, want,
                        MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EINTR && errno != EAGAIN) return false;
-      if (w > 0) l.done += static_cast<size_t>(w);
+      if (w < 0 && errno == EAGAIN) ++l.stalls;
+      if (w > 0) {
+        if (static_cast<size_t>(w) < want) ++l.shorts;
+        l.done += static_cast<size_t>(w);
+      }
       return true;
     };
     auto drive_recv = [](Leg& l, short revents) -> bool {
@@ -681,6 +776,14 @@ bool ExchangeBi(int right_fd, const void* send_r, size_t send_r_len,
         !drive_send(sl, fds[1].revents) || !drive_recv(rl, fds[1].revents))
       return false;
   }
+  // One folded update per fd (out + in together; no latency sample — the
+  // four legs overlap, see Exchange).
+  if (NetLinkEnabled()) {
+    LinkRecord(right_fd, static_cast<long long>(sr.len),
+               static_cast<long long>(rr.len), sr.stalls, sr.shorts, -1);
+    LinkRecord(left_fd, static_cast<long long>(sl.len),
+               static_cast<long long>(rl.len), sl.stalls, sl.shorts, -1);
+  }
   return true;
 }
 
@@ -692,6 +795,79 @@ void CloseFd(int fd) {
 
 void ShutdownFd(int fd) {
   if (fd >= 0) shutdown(fd, SHUT_RDWR);
+}
+
+// Bucket bounds chosen for a TCP control/data plane: sub-100µs loopback
+// sends up through multi-ms injected (or real DCN) delays; the last
+// bucket is +inf.
+const long long kNetLinkBucketUs[] = {50,   100,  250,   500,  1000,
+                                      2500, 5000, 10000, 50000};
+const int kNetLinkBuckets = 10;
+static_assert(sizeof(kNetLinkBucketUs) / sizeof(kNetLinkBucketUs[0]) ==
+                  kNetLinkBuckets - 1,
+              "bucket bounds must be kNetLinkBuckets - 1 entries");
+
+void NetLinkInit(bool enabled) {
+  g_link_enabled.store(enabled, std::memory_order_relaxed);
+}
+
+bool NetLinkEnabled() {
+  return g_link_enabled.load(std::memory_order_relaxed);
+}
+
+void NetLinkRecordRtt(int peer_rank, long long rtt_us) {
+  if (peer_rank < 0 || rtt_us < 0 || !NetLinkEnabled()) return;
+  std::lock_guard<std::mutex> lk(g_fault_mu);
+  LinkStats& s = g_link_stats[peer_rank];
+  s.rtt_last_us = rtt_us;
+  ++s.rtt_samples;
+  // EWMA (alpha 0.2): smooth enough to ride out scheduler jitter, fresh
+  // enough that a developing slow link moves it within a few beats.
+  s.rtt_ewma_us = s.rtt_samples == 1
+                      ? static_cast<double>(rtt_us)
+                      : s.rtt_ewma_us + 0.2 * (rtt_us - s.rtt_ewma_us);
+}
+
+long long NetLinkSendsTotal() {
+  std::lock_guard<std::mutex> lk(g_fault_mu);
+  long long total = 0;
+  for (const auto& kv : g_link_stats) total += kv.second.sends;
+  return total;
+}
+
+std::vector<NetLinkLatencyTotal> NetLinkLatencyTotals() {
+  std::lock_guard<std::mutex> lk(g_fault_mu);
+  std::vector<NetLinkLatencyTotal> out;
+  out.reserve(g_link_stats.size());
+  for (const auto& kv : g_link_stats)
+    out.push_back({kv.first, kv.second.send_us_sum, kv.second.send_us_count,
+                   kv.second.rtt_last_us});
+  return out;
+}
+
+std::string NetLinkInfo() {
+  std::lock_guard<std::mutex> lk(g_fault_mu);
+  std::string out = NetLinkEnabled() ? "1|" : "0|";
+  bool first = true;
+  for (const auto& kv : g_link_stats) {
+    const LinkStats& s = kv.second;
+    if (!first) out += ';';
+    first = false;
+    out += std::to_string(kv.first) + ":" + std::to_string(s.bytes_out) +
+           ":" + std::to_string(s.bytes_in) + ":" + std::to_string(s.sends) +
+           ":" + std::to_string(s.recvs) + ":" + std::to_string(s.stalls) +
+           ":" + std::to_string(s.short_writes) + ":" +
+           std::to_string(s.send_us_sum) + ":" +
+           std::to_string(s.send_us_count) + ":";
+    for (int i = 0; i < kNetLinkBuckets; ++i) {
+      if (i) out += ',';
+      out += std::to_string(s.send_us_buckets[i]);
+    }
+    out += ":" + std::to_string(s.rtt_last_us) + ":" +
+           std::to_string(static_cast<long long>(s.rtt_ewma_us + 0.5)) +
+           ":" + std::to_string(s.rtt_samples);
+  }
+  return out;
 }
 
 }  // namespace hvdtpu

@@ -116,4 +116,51 @@ size_t NetFaultChop(int fd);
 // owner still calls CloseFd afterwards (after joining helpers).
 void ShutdownFd(int fd);
 
+// ---------------------------------------------------------------------------
+// Per-peer link telemetry (docs/metrics.md#links): byte / send / stall
+// counters and a fixed-bucket send-latency histogram per PEER RANK,
+// aggregated across every registered fd to that peer (control star, ring,
+// beat lane).  Accounting rides the same fd -> peer registry the fault
+// layer keys off — NetFaultRegister is the single registration point —
+// and costs one mutex hold per SendAll/RecvAll/Exchange call (never per
+// byte), so the chaos layer's injected delays land INSIDE the measured
+// send latency: a `link=A-B:delay=MS` clause is directly observable as a
+// latency excursion on exactly that link.  Counters are process-
+// cumulative (the engine.h StallEvents contract: they survive re-init).
+// HVD_TPU_LINK_STATS=0 disarms everything but the relaxed-atomic gate.
+
+// Arm/disarm the accounting (called from Engine::Init with the parsed
+// HVD_TPU_LINK_STATS gate; stats persist across re-inits).
+void NetLinkInit(bool enabled);
+bool NetLinkEnabled();
+
+// Serialized per-peer snapshot for the c_api:
+//   "enabled|peer:bytes_out:bytes_in:sends:recvs:stalls:short_writes:
+//    send_us_sum:send_us_count:b0,b1,...,b9:rtt_last_us:rtt_ewma_us:
+//    rtt_samples;peer:..." (peers sorted; empty list when nothing flowed).
+std::string NetLinkInfo();
+
+// Histogram bucket upper bounds (µs); the last bucket is +inf.  Exposed
+// so the Python registry renders `le` labels that match the C++ counts.
+extern const long long kNetLinkBucketUs[];
+extern const int kNetLinkBuckets;
+
+// Fold one heartbeat-echo round-trip sample into peer's RTT estimate
+// (last + EWMA).  Called from the heartbeat monitor thread.
+void NetLinkRecordRtt(int peer_rank, long long rtt_us);
+
+// Cumulative timed-send count across all peers (simscale report surface:
+// proves which regime an overhead-bench cell actually ran in).
+long long NetLinkSendsTotal();
+
+// Detector-side accessor (anomaly monitor): per-peer cumulative send-
+// latency totals, cheap enough to poll every sweep.
+struct NetLinkLatencyTotal {
+  int peer;
+  long long sum_us;
+  long long count;
+  long long rtt_last_us;
+};
+std::vector<NetLinkLatencyTotal> NetLinkLatencyTotals();
+
 }  // namespace hvdtpu

@@ -14,6 +14,7 @@
 #include <vector>
 
 #include "engine.h"
+#include "net.h"
 
 namespace hvdtpu {
 
@@ -262,7 +263,8 @@ std::string SimScaleRun(int size, int local_size, int ops_per_cycle,
            "\"steady_p50_us\":%.1f,\"steady_p90_us\":%.1f,"
            "\"steady_frames_delta\":%lld,\"steady_cycles\":%lld,"
            "\"coord_children\":%lld,\"negotiated_cycles\":%lld,"
-           "\"hb_frames_sent\":%lld,\"clock_fanin\":%lld}",
+           "\"hb_frames_sent\":%lld,\"clock_fanin\":%lld,"
+           "\"link_sends\":%lld}",
            size, coord_tree ? 1 : 0, steady_entered ? 1 : 0,
            Pct(warm, 0.5), Pct(warm, 0.9), Pct(steady, 0.5),
            Pct(steady, 0.9), static_cast<long long>(frames_delta_max),
@@ -270,7 +272,8 @@ std::string SimScaleRun(int size, int local_size, int ops_per_cycle,
            static_cast<long long>(coord_children),
            static_cast<long long>(negotiated),
            static_cast<long long>(hb_frames_sent),
-           static_cast<long long>(clock_fanin));
+           static_cast<long long>(clock_fanin),
+           static_cast<long long>(NetLinkSendsTotal()));
   return out;
 }
 

@@ -233,7 +233,8 @@ bool ParseHeartbeat(const uint8_t in[16], HeartbeatFrame* hb) {
   hb->epoch = rd.U32();
   hb->seq = rd.U32();
   return rd.ok &&
-         (hb->magic == HeartbeatFrame().magic || hb->magic == kSuspectMagic);
+         (hb->magic == HeartbeatFrame().magic || hb->magic == kSuspectMagic ||
+          hb->magic == kEchoMagic);
 }
 
 std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {

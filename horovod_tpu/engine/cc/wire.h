@@ -287,12 +287,21 @@ struct HeartbeatFrame {
 // when zero control frames flow.
 constexpr uint32_t kSuspectMagic = 0x48564253;  // "HVBS"
 
+// Echo variant (same 16-byte layout, this magic): a rank that receives a
+// beacon bounces it straight back on the same full-duplex beat socket
+// with the magic swapped, preserving sender_rank / epoch / seq.  The
+// original sender matches `seq` against its send-timestamp ring and folds
+// the round trip into the per-link RTT estimate (net.h NetLinkRecordRtt)
+// — continuous link telemetry riding the existing beacons, no extra
+// frames on the data or control planes and no wire-format growth.
+constexpr uint32_t kEchoMagic = 0x48564245;  // "HVBE"
+
 constexpr size_t kHeartbeatFrameBytes = 16;
 
 // Fixed-size little-endian encode/decode (no length prefix: the frame is
 // its own framing, consumed in 16-byte chunks off a byte stream).
-// ParseHeartbeat accepts both magics (beacon and suspect gossip); the
-// caller dispatches on hb->magic.
+// ParseHeartbeat accepts all three magics (beacon, suspect gossip, echo);
+// the caller dispatches on hb->magic.
 void SerializeHeartbeat(const HeartbeatFrame& hb, uint8_t out[16]);
 bool ParseHeartbeat(const uint8_t in[16], HeartbeatFrame* hb);
 

@@ -403,3 +403,39 @@ def test_bench_compare(tmp_path):
     assert lower_is_better("negotiation_p50_us_cached")
     assert lower_is_better("token_p50_ms")
     assert not lower_is_better("cache_hit_rate")
+
+
+def test_bench_compare_history(tmp_path):
+    """Satellite: `bench_compare.py --history BENCH_r0*.json` renders the
+    round-over-round trajectory — one line per driver round record, with
+    deltas computed across gaps (a round whose `parsed` is null, like the
+    real BENCH_r04.json, renders as a gap line and is skipped)."""
+    from tools.bench_compare import main, render_history
+
+    rounds = []
+    for i, parsed in enumerate([
+            {"metric": "steady_p50", "value": 100.0, "unit": "us",
+             "vs_baseline": 1.0},
+            {"metric": "steady_p50", "value": 80.0, "unit": "us",
+             "vs_baseline": 1.25},
+            None,  # a crashed round: rc nonzero, nothing parsed
+            {"metric": "steady_p50", "value": 60.0, "unit": "us",
+             "vs_baseline": 1.67}]):
+        p = tmp_path / f"BENCH_r{i + 1:02d}.json"
+        p.write_text(json.dumps({"n": i + 1, "rc": 0 if parsed else 1,
+                                 "parsed": parsed}))
+        rounds.append(str(p))
+    lines, parsed_rounds = render_history(rounds)
+    assert parsed_rounds == 3
+    text = "\n".join(lines)
+    assert "BENCH_r03.json" in text and "no parsed record, rc 1" in text
+    # Delta of round 2 vs round 1: 80 vs 100 = -20%; round 4's delta
+    # skips the gap and compares against round 2 (60 vs 80 = -25%).
+    assert "-20.0%" in text and "-25.0%" in text, text
+    assert "1.25x" in text and "1.67x" in text, text
+    # CLI: exit 0 with at least one parseable round, 2 with none.
+    assert main(["--history"] + rounds) == 0
+    empty = tmp_path / "BENCH_r99.json"
+    empty.write_text(json.dumps({"rc": 1, "parsed": None}))
+    assert main(["--history", str(empty)]) == 2
+    assert main(["--history"]) == 2  # no files at all

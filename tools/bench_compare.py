@@ -9,6 +9,15 @@ when the new run regressed by more than the threshold — usable locally
     python bench.py > /tmp/new.json
     python tools/bench_compare.py BENCH_r05.json /tmp/new.json --threshold 5
 
+``--history`` renders the round-over-round trajectory instead of a gate:
+
+    python tools/bench_compare.py --history BENCH_r0*.json
+
+one line per round — headline value, vs_baseline ratio, and the delta
+against the previous parseable round.  Rounds whose record failed to
+parse (a driver crash leaves ``parsed`` empty) render as a gap line
+rather than aborting the view.
+
 Accepted file shapes (all produced in this repo):
 
 * raw ``bench.py`` output — one or more JSON lines; the LAST line carrying
@@ -134,7 +143,63 @@ def compare(old: dict, new: dict, threshold_pct: float,
     return regressions, lines
 
 
+def render_history(paths: list) -> Tuple[list, int]:
+    """(report lines, parseable-round count) for the --history view: one
+    line per round file, in the order given (BENCH_r0*.json globs sort
+    chronologically).  A round whose record cannot be parsed — e.g. a
+    driver crash left ``parsed`` null — renders as a gap line; the
+    trajectory deltas skip over it."""
+    import os
+
+    lines = [f"{'round':<18}{'value':>12}  {'unit':<18}"
+             f"{'vs_baseline':>12}{'delta':>9}"]
+    prev = None
+    parsed_rounds = 0
+    for path in paths:
+        label = os.path.basename(path)[:17]
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            lines.append(f"{label:<18}(unreadable: "
+                         f"{type(exc).__name__})")
+            continue
+        record = raw.get("parsed") if isinstance(raw, dict) else None
+        if not isinstance(record, dict) or \
+                _numeric(record.get("value")) is None:
+            rc = raw.get("rc") if isinstance(raw, dict) else None
+            lines.append(f"{label:<18}(no parsed record"
+                         f"{f', rc {rc}' if rc is not None else ''})")
+            continue
+        parsed_rounds += 1
+        value = _numeric(record["value"])
+        vs_base = _numeric(record.get("vs_baseline"))
+        delta = (f"{(value - prev) / prev * 100.0:+.1f}%"
+                 if prev else "-")
+        lines.append(
+            f"{label:<18}{value:>12g}  {record.get('unit', ''):<18}"
+            f"{vs_base:>11.2f}x{delta:>9}" if vs_base is not None else
+            f"{label:<18}{value:>12g}  {record.get('unit', ''):<18}"
+            f"{'-':>12}{delta:>9}")
+        prev = value
+    return lines, parsed_rounds
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--history" in argv:
+        argv.remove("--history")
+        paths = [a for a in argv if not a.startswith("-")]
+        if not paths:
+            print("bench_compare: --history wants one or more round "
+                  "files (BENCH_r0*.json)", file=sys.stderr)
+            return 2
+        lines, parsed_rounds = render_history(paths)
+        print(f"bench_compare: history over {len(paths)} round(s)")
+        for line in lines:
+            print(line)
+        return 0 if parsed_rounds else 2
+
     parser = argparse.ArgumentParser(
         description="diff two bench records; exit 1 on a >threshold% "
                     "throughput regression")

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# One-shot local CI gate (docs/contributing.md#running-the-gate): the
+# same three checks a PR must pass, in the order that fails fastest —
+#
+#   1. hvdlint   — repo-contract static checks (metrics/env/c_api/wire
+#                  coverage, a few seconds)
+#   2. hvdmodel  — control-plane protocol model checker, --quick tier
+#   3. tier-1    — the full not-slow pytest suite (~2-5 min; the same
+#                  command ROADMAP.md pins, minus the log scraping)
+#
+# Run it from the repo root before pushing:
+#
+#   bash tools/ci.sh            # everything
+#   bash tools/ci.sh --fast     # hvdlint + hvdmodel only (skip pytest)
+#
+# Exits non-zero on the first failing stage.
+set -o pipefail
+
+cd "$(dirname "$0")/.." || exit 1
+
+fast=0
+[ "$1" = "--fast" ] && fast=1
+
+echo "== ci: hvdlint =="
+python -m tools.hvdlint || exit 1
+
+echo "== ci: hvdmodel --quick =="
+python -m tools.hvdmodel --quick || exit 1
+
+if [ "$fast" = "1" ]; then
+    echo "== ci: OK (fast mode — tier-1 pytest skipped) =="
+    exit 0
+fi
+
+echo "== ci: tier-1 pytest =="
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly || exit 1
+
+echo "== ci: OK =="

@@ -91,6 +91,8 @@ DOC_DEFAULTS: Dict[str, Tuple[str, str]] = {
     "HVD_TPU_RESTART_EPOCH": ("config", "restart_epoch"),
     "HVD_TPU_STEADY_THRESHOLD": ("config", "steady_threshold"),
     "HVD_TPU_STEADY_MAX_PERIOD": ("config", "steady_max_period"),
+    "HVD_TPU_ANOMALY_SIGMA": ("config", "anomaly_sigma"),
+    "HVD_TPU_ANOMALY_INTERVAL_MS": ("config", "anomaly_interval_ms"),
     "HVD_TPU_SERVE_PORT": ("serve", "port"),
     "HVD_TPU_SERVE_MAX_BATCH": ("serve", "max_batch"),
     "HVD_TPU_SERVE_PREFILL_CHUNK": ("serve", "prefill_chunk"),

@@ -66,6 +66,15 @@ SECTION_FAMILIES = {
                  "hvd_tpu_liveness_evictions_total",
                  "hvd_tpu_liveness_clock_fanin",
                  "hvd_tpu_liveness_peer_age_us"),
+    "links": ("hvd_tpu_link_stats_enabled",
+              "hvd_tpu_link_bytes_total",
+              "hvd_tpu_link_sends_total",
+              "hvd_tpu_link_stall_events_total",
+              "hvd_tpu_link_send_latency_us",
+              "hvd_tpu_link_rtt_us",
+              "hvd_tpu_link_rtt_samples_total"),
+    "anomalies": ("hvd_tpu_anomaly_sigma",
+                  "hvd_tpu_anomaly_verdicts_total"),
     "control": ("hvd_tpu_control_tree_depth",
                 "hvd_tpu_control_children",
                 "hvd_tpu_control_steady_active",
@@ -142,6 +151,17 @@ def populated_registry():
                       "frames": {"sent": 120, "received": 118},
                       "miss_events": 1, "evictions": 1, "clock_fanin": 2,
                       "peers": {1: {"age_us": 900, "misses": 0}}})
+    reg.set_links({"enabled": True, "peers": {
+        1: {"bytes_out": 4096, "bytes_in": 2048, "sends": 32,
+            "recvs": 30, "stalls": 1, "short_writes": 0,
+            "send_us_sum": 640, "send_us_count": 32,
+            "send_us_buckets": [30, 2, 0, 0, 0, 0, 0, 0, 0, 0],
+            "rtt_last_us": 210, "rtt_ewma_us": 200, "rtt_samples": 5}}})
+    reg.set_anomalies({"sigma": 5, "interval_ms": 500,
+                       "verdicts": {"slow_link": 1, "straggler": 0,
+                                    "cache_degraded": 0, "slow_phase": 0},
+                       "log": [{"kind": "slow_link", "subject": "0-1",
+                                "detail": "lint", "age_us": 1000}]})
     reg.set_compression({
         "mode": "bf16", "min_bytes": 1024,
         "planes": {"engine": {"wire_bytes": 512, "payload_bytes": 1024,

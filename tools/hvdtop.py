@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Live terminal view of a running job's /cluster aggregation (hvdtop).
+
+Polls rank 0's monitor (HVD_TPU_MONITOR_PORT; hvdrun arms /cluster on
+rank 0 automatically) and renders one screen per interval: a per-rank
+table (liveness, membership epoch, stalls/aborts, cache hit rate,
+control-plane activity rate, serving occupancy), a per-link heat table
+merged across every rank's telemetry (worst-direction send latency,
+heartbeat-echo RTT, backpressure, bytes), and a scrolling feed of the
+online anomaly detector's typed verdicts (docs/metrics.md#anomalies).
+
+    python tools/hvdtop.py --port 9090                 # live view
+    python tools/hvdtop.py --port 9090 --once          # one plain frame
+    python tools/hvdtop.py --host tpu-host-0 --port 9090 --interval 2
+
+``--once`` prints a single plain-text frame and exits — scriptable (the
+chaos-localization test drives it) and safe for dumb terminals.  The
+live view repaints with ANSI clear codes; Ctrl-C exits.
+
+No dependencies beyond the standard library: the tool speaks plain HTTP
+to the monitor, so it runs on a laptop far from the job.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def fetch_cluster(host: str, port: int, timeout: float = 3.0) -> dict:
+    """The /cluster document, or a synthetic dead-job document when the
+    monitor is unreachable (the view must render the outage, not
+    crash)."""
+    url = f"http://{host}:{port}/cluster"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except Exception as exc:  # connection refused, timeout, bad JSON
+        return {"ranks": {}, "launched": 0, "live": 0,
+                "membership_epochs_agree": True,
+                "anomalies": {"total": 0, "verdicts": {}, "recent": []},
+                "error": f"{type(exc).__name__}: {exc}"}
+
+
+def _fmt_us(us) -> str:
+    if us is None or us < 0:
+        return "-"
+    if us >= 10000:
+        return f"{us / 1000.0:.0f}ms"
+    return f"{us}us"
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def merge_links(ranks: dict) -> dict:
+    """Fold every rank's per-peer telemetry into undirected links keyed
+    "lo-hi": worst-direction send latency and RTT (a slow direction must
+    not hide behind a fast one), summed backpressure and bytes."""
+    links: dict = {}
+    for rank, entry in ranks.items():
+        if not entry.get("live"):
+            continue
+        for peer, v in (entry.get("links") or {}).items():
+            try:
+                lo, hi = sorted((int(rank), int(peer)))
+            except ValueError:
+                continue
+            key = f"{lo}-{hi}"
+            agg = links.setdefault(key, {"send_mean_us": -1,
+                                         "rtt_ewma_us": -1,
+                                         "stalls": 0, "bytes": 0})
+            agg["send_mean_us"] = max(agg["send_mean_us"],
+                                      v.get("send_mean_us", -1))
+            agg["rtt_ewma_us"] = max(agg["rtt_ewma_us"],
+                                     v.get("rtt_ewma_us", -1))
+            agg["stalls"] += v.get("stalls", 0)
+            agg["bytes"] += v.get("bytes", 0)
+    return links
+
+
+def render(doc: dict, prev: dict, now: float, target: str) -> str:
+    """One frame of the view.  `prev` carries the previous poll's
+    per-rank flight-event counts and timestamp, so the activity column
+    is a rate (control-plane events per second since the last frame),
+    not a lifetime total."""
+    lines = []
+    agree = "epochs agree" if doc.get("membership_epochs_agree") \
+        else "EPOCHS DISAGREE"
+    lines.append(f"hvdtop — {target}   live {doc.get('live', 0)}/"
+                 f"{doc.get('launched', 0)}   {agree}   "
+                 f"{time.strftime('%H:%M:%S', time.localtime(now))}")
+    if doc.get("error"):
+        lines.append(f"  monitor unreachable: {doc['error']}")
+        return "\n".join(lines)
+
+    ranks = doc.get("ranks", {})
+    lines.append("")
+    lines.append(f"{'rank':<6}{'state':<7}{'epoch':>6}{'stalls':>8}"
+                 f"{'aborts':>8}{'cache%':>8}{'act/s':>8}{'occ%':>7}")
+    prev_events = prev.get("events", {})
+    prev_ts = prev.get("ts")
+    dt = (now - prev_ts) if prev_ts else 0.0
+    for rank in sorted(ranks, key=lambda r: int(r) if r.isdigit() else 0):
+        entry = ranks[rank]
+        if not entry.get("live"):
+            lines.append(f"{rank:<6}{'DOWN':<7}"
+                         f"  ({entry.get('error', 'no response')})")
+            continue
+        events = entry.get("flight_events", 0)
+        rate = "-"
+        if dt > 0 and rank in prev_events:
+            rate = f"{max(events - prev_events[rank], 0) / dt:.0f}"
+        occ = entry.get("serving_occupancy", 0.0)
+        lines.append(
+            f"{rank:<6}{'up':<7}{entry.get('membership_epoch', 0):>6}"
+            f"{entry.get('stalls', 0):>8}{entry.get('aborts', 0):>8}"
+            f"{100.0 * entry.get('cache_hit_rate', 0.0):>8.1f}"
+            f"{rate:>8}"
+            f"{f'{100.0 * occ:.0f}' if entry.get('serving_active') else '-':>7}")
+
+    links = merge_links(ranks)
+    if links:
+        lines.append("")
+        lines.append(f"{'link':<8}{'send':>8}{'rtt':>8}{'stalls':>8}"
+                     f"{'bytes':>10}")
+        slow = {e.get("subject") for e in
+                doc.get("anomalies", {}).get("recent", [])
+                if e.get("kind") == "slow_link"}
+        for key in sorted(links, key=lambda k: [int(x) for x in
+                                                k.split("-")]):
+            v = links[key]
+            mark = "  << slow_link" if key in slow else ""
+            lines.append(f"{key:<8}{_fmt_us(v['send_mean_us']):>8}"
+                         f"{_fmt_us(v['rtt_ewma_us']):>8}"
+                         f"{v['stalls']:>8}"
+                         f"{_fmt_bytes(v['bytes']):>10}{mark}")
+
+    anomalies = doc.get("anomalies", {})
+    lines.append("")
+    lines.append(f"anomalies ({anomalies.get('total', 0)} verdict(s))")
+    recent = anomalies.get("recent", [])
+    if not recent:
+        lines.append("  (none)")
+    for e in recent[:10]:
+        subject = f"({e.get('subject')})" if e.get("subject") else ""
+        lines.append(f"  [rank {e.get('rank')}] "
+                     f"{e.get('kind')}{subject}: {e.get('detail', '')} "
+                     f"[{e.get('age_us', 0) / 1e6:.1f}s ago]")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="live cluster view over rank 0's /cluster monitor")
+    parser.add_argument("--host", default="localhost",
+                        help="rank 0 monitor host (default localhost)")
+    parser.add_argument("--port", type=int,
+                        default=int(os.environ.get(
+                            "HVD_TPU_MONITOR_PORT") or 0),
+                        help="rank 0 monitor port (default "
+                             "$HVD_TPU_MONITOR_PORT)")
+    parser.add_argument("--interval", type=float, default=1.0,
+                        help="poll cadence in seconds (default 1)")
+    parser.add_argument("--once", action="store_true",
+                        help="print one plain frame and exit")
+    args = parser.parse_args(argv)
+    if not args.port:
+        parser.error("no monitor port: pass --port or set "
+                     "HVD_TPU_MONITOR_PORT")
+    target = f"{args.host}:{args.port}"
+    prev: dict = {}
+    try:
+        while True:
+            now = time.time()
+            doc = fetch_cluster(args.host, args.port)
+            frame = render(doc, prev, now, target)
+            if args.once:
+                print(frame)
+                return 0 if not doc.get("error") else 1
+            # Full-screen repaint: clear + home, like top(1).
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            prev = {"ts": now,
+                    "events": {r: e.get("flight_events", 0)
+                               for r, e in doc.get("ranks", {}).items()
+                               if e.get("live")}}
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,7 @@ hvd.metrics_snapshot() returns.
     python tools/metrics_dump.py before.json.0 after.json.0   # diff (B - A)
     python tools/metrics_dump.py --stragglers run.json.0      # skew view
     python tools/metrics_dump.py --tenants run.json.0  # serving tenants
+    python tools/metrics_dump.py --links run.json.0    # per-link table
 
 Prints the per-op table (ops and bytes per data plane), fusion-batch
 counters, stall events, response-cache hit rates (docs/performance.md),
@@ -286,6 +287,30 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
             f"{live.get('evictions', 0)}; clock fan-in "
             f"{live.get('clock_fanin', 0)}")
 
+    # Anomaly verdicts (docs/metrics.md#anomalies); only rendered when
+    # the detector saw something (or is explicitly disabled), so clean
+    # dumps stay unchanged.  Full per-link detail lives behind --links.
+    anomalies = snap.get("anomalies", {})
+    verdicts = {k: v for k, v in anomalies.get("verdicts", {}).items()
+                if v}
+    if base:
+        for k, v in (base or {}).get("anomalies", {}).get(
+                "verdicts", {}).items():
+            if k in verdicts:
+                verdicts[k] -= v
+        verdicts = {k: v for k, v in verdicts.items() if v}
+    if verdicts:
+        lines.append("== anomalies ==")
+        lines.append(
+            "verdicts " + ", ".join(f"{k}x{v}" for k, v in
+                                    sorted(verdicts.items()))
+            + f" (sigma {anomalies.get('sigma', 0)})")
+        for e in anomalies.get("log", [])[-4:]:
+            subject = f"({e.get('subject')})" if e.get("subject") else ""
+            lines.append(f"  {e.get('kind')}{subject}: "
+                         f"{e.get('detail', '')} "
+                         f"[{e.get('age_us', 0) / 1e6:.1f}s ago]")
+
     # Elastic membership (docs/fault-tolerance.md#elastic-membership);
     # only rendered once the job reshaped, so pre-elastic dumps stay
     # unchanged.
@@ -451,6 +476,54 @@ def render_stragglers(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_links(snap: dict) -> str:
+    """The --links view: one row per peer link — bytes each way, timed
+    sends with mean/p99 latency estimated from the fixed buckets,
+    heartbeat-echo RTT, and transport backpressure
+    (docs/metrics.md#links)."""
+    lines = ["== links (per-peer transport telemetry) =="]
+    links = snap.get("links", {})
+    peers = links.get("peers", {})
+    if not links.get("enabled", False):
+        lines.append("(link telemetry disabled — HVD_TPU_LINK_STATS=0, "
+                     "or a pre-telemetry dump)")
+        return "\n".join(lines)
+    if not peers:
+        lines.append("(no links — single rank)")
+        return "\n".join(lines)
+    # Bucket bounds mirror LINK_SEND_BUCKETS_US (common/metrics.py) so
+    # the tool stays importable without the package on scrape hosts.
+    bounds = [50, 100, 250, 500, 1000, 2500, 5000, 10000, 50000]
+    lines.append(f"{'peer':<6}{'out':>10}{'in':>10}{'sends':>8}"
+                 f"{'mean':>9}{'p99':>9}{'rtt':>9}{'stalls':>8}")
+    for r in sorted(peers, key=int):
+        v = peers[r]
+        count = v.get("send_us_count", 0)
+        mean = (f"{v.get('send_us_sum', 0) / count:.0f}us"
+                if count else "-")
+        hist = {"buckets": bounds,
+                "counts": v.get("send_us_buckets", [])[:len(bounds)],
+                "count": count}
+        p99 = quantile(hist, 0.99) if count else None
+        rtt = (f"{v.get('rtt_ewma_us', 0)}us"
+               if v.get("rtt_samples", 0) else "-")
+        stalls = v.get("stalls", 0) + v.get("short_writes", 0)
+        lines.append(
+            f"{r:<6}{_fmt_bytes(v.get('bytes_out', 0)):>10}"
+            f"{_fmt_bytes(v.get('bytes_in', 0)):>10}"
+            f"{v.get('sends', 0):>8}{mean:>9}"
+            f"{'-' if p99 is None else f'{p99:.0f}us':>9}"
+            f"{rtt:>9}{stalls:>8}")
+    verdicts = snap.get("anomalies", {}).get("verdicts", {})
+    slow = [e for e in snap.get("anomalies", {}).get("log", [])
+            if e.get("kind") == "slow_link"]
+    if verdicts.get("slow_link"):
+        lines.append("slow-link verdicts: " + "; ".join(
+            f"{e.get('subject')} ({e.get('detail', '')})"
+            for e in slow[-4:]))
+    return "\n".join(lines)
+
+
 def main(argv) -> int:
     argv = list(argv)
     stragglers = "--stragglers" in argv
@@ -459,12 +532,15 @@ def main(argv) -> int:
     tenants = "--tenants" in argv
     if tenants:
         argv.remove("--tenants")
+    links = "--links" in argv
+    if links:
+        argv.remove("--links")
     if len(argv) not in (2, 3) or argv[1] in ("-h", "--help"):
         print(__doc__)
         return 2
-    if (stragglers or tenants) and len(argv) != 2:
-        print("--stragglers/--tenants take a single dump (the "
-              "coordinator's, rank 0)", file=sys.stderr)
+    if (stragglers or tenants or links) and len(argv) != 2:
+        print("--stragglers/--tenants/--links take a single dump",
+              file=sys.stderr)
         return 2
     with open(argv[1]) as f:
         a = json.load(f)
@@ -473,6 +549,9 @@ def main(argv) -> int:
         return 0
     if tenants:
         print(render_tenants(a))
+        return 0
+    if links:
+        print(render_links(a))
         return 0
     if len(argv) == 3:
         with open(argv[2]) as f:
