@@ -563,9 +563,13 @@ def bench_hier_allreduce() -> None:
     per-phase mean times (``_ms`` extras gate lower-is-better in
     tools/bench_compare.py), the per-hop wire bytes (``_bytes`` extras,
     same convention), the bf16 cross-hop run and its DCN byte reduction
-    (asserted >= 1.8x in-bench), and the flat-vs-two-level bit identity
+    (asserted >= 1.8x in-bench), the flat-vs-two-level bit identity
     with compression off (exact integer payloads; the kill-switch
-    identity bar PR 9 set)."""
+    identity bar PR 9 set), and the shared-memory transport cells
+    (docs/performance.md#transport): the two-level run repeated with
+    HVD_TPU_SHM=force vs the HVD_TPU_SHM=0 kill switch — asserted
+    bit-identical and reported as shm_transport_speedup with both
+    transports' local-hop phase times."""
     import subprocess
     import sys
 
@@ -605,6 +609,7 @@ if hvd.rank() == 0:
     print("HIER_JSON " + json.dumps({{
         "ops_per_sec": {iters} / dt,
         "digest": hashlib.sha256(out.tobytes()).hexdigest(),
+        "local_transport": topo1.get("local_transport", "tcp"),
         "local_bytes": topo1["bytes"]["local"] - topo0["bytes"]["local"],
         "cross_bytes": topo1["bytes"]["cross"] - topo0["bytes"]["cross"],
         "local_rs_ms": round(phase_ms("topology_local_rs_sec"), 3),
@@ -614,12 +619,13 @@ if hvd.rank() == 0:
 hvd.shutdown()
 """
 
-    def run(hier: bool, mode: str) -> dict:
+    def run(hier: bool, mode: str, shm: str = "0") -> dict:
         env = dict(os.environ,
                    PYTHONPATH=repo + os.pathsep
                    + os.environ.get("PYTHONPATH", ""),
                    BENCH_HIER="1" if hier else "0",
-                   HVD_TPU_COMPRESSION=mode)
+                   HVD_TPU_COMPRESSION=mode,
+                   HVD_TPU_SHM=shm)
         env.pop("HOROVOD_HIERARCHICAL_ALLREDUCE", None)
         out = subprocess.run(
             [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
@@ -633,10 +639,18 @@ hvd.shutdown()
     flat = run(False, "off")
     hier = run(True, "off")
     hier16 = run(True, "bf16")
+    shm = run(True, "off", shm="force")
     # Kill-switch identity: flat and two-level agree BITWISE with
     # compression off (exact payloads).
     assert flat["digest"] == hier["digest"], (
         "flat vs two-level results diverged bitwise with compression off")
+    # Transport identity: the shm rings carry the same bits the sockets
+    # did (force, so a silent TCP demotion cannot fake the pass).
+    assert shm["local_transport"] == "shm", shm
+    assert hier["local_transport"] == "tcp", hier
+    assert shm["digest"] == hier["digest"], (
+        "shm vs TCP two-level results diverged bitwise with compression "
+        "off")
     ratio16 = hier["cross_bytes"] / max(hier16["cross_bytes"], 1)
     floor = float(os.environ.get("BENCH_HIER_MIN_CROSS_RATIO", "1.8"))
     assert ratio16 >= floor, (
@@ -664,6 +678,11 @@ hvd.shutdown()
             "local_rs_ms": hier["local_rs_ms"],
             "cross_ms": hier["cross_ms"],
             "local_ag_ms": hier["local_ag_ms"],
+            "shm_ops_per_sec": round(shm["ops_per_sec"], 2),
+            "shm_transport_speedup": round(
+                shm["ops_per_sec"] / max(hier["ops_per_sec"], 1e-9), 3),
+            "shm_local_rs_ms": shm["local_rs_ms"],
+            "shm_local_ag_ms": shm["local_ag_ms"],
         },
     }))
 

@@ -907,6 +907,9 @@ def _sync_engine_topology() -> None:
                 int(p) for p in parts[:9])
         except ValueError:
             return
+        # 10th field (PR-19): the node-local hop's transport ("shm" once
+        # the segment armed, else "tcp"); tolerate 9-field engines.
+        local_transport = parts[9] if len(parts) > 9 else "tcp"
         metrics.registry.set_topology({
             "hierarchical": bool(hier),
             "nodes": nodes,
@@ -914,6 +917,7 @@ def _sync_engine_topology() -> None:
             "cross_algo_threshold": threshold,
             "cross_ops": {"ring": ops_ring, "tree": ops_tree},
             "bytes": {"local": local_bytes, "cross": cross_bytes},
+            "local_transport": local_transport,
         })
         new = log_total - _engine_topo_seen
         if new <= 0:
@@ -1024,7 +1028,7 @@ def _sync_engine_links() -> None:
         peers = {}
         for tok in parts[1].split(";"):
             fields = tok.split(":")
-            if len(fields) != 13:
+            if len(fields) != 20:
                 continue
             try:
                 peers[int(fields[0])] = {
@@ -1041,6 +1045,14 @@ def _sync_engine_links() -> None:
                     "rtt_last_us": int(fields[10]),
                     "rtt_ewma_us": int(fields[11]),
                     "rtt_samples": int(fields[12]),
+                    "shm_bytes_out": int(fields[13]),
+                    "shm_bytes_in": int(fields[14]),
+                    "shm_handoffs": int(fields[15]),
+                    "shm_us_sum": int(fields[16]),
+                    "shm_us_count": int(fields[17]),
+                    "shm_us_buckets": [int(b) for b in
+                                       fields[18].split(",") if b],
+                    "transport": fields[19],
                 }
             except ValueError:
                 continue

@@ -196,6 +196,19 @@ class Config:
     link_stats: bool = True
     anomaly_sigma: int = 5
     anomaly_interval_ms: int = 500
+    # Pluggable data-plane transport (docs/performance.md#transport).
+    # shm (HVD_TPU_SHM=auto|off|force, default auto): the node-local
+    # hops of the two-level allreduce hand fused-bucket segments through
+    # mmap'd per-node shared-memory rings (no serialization, no syscall
+    # per segment) when every rank of a node shares a host; "off" pins
+    # every hop to TCP (kill switch — the data path is bit-identical
+    # either way), "force" fails init with a typed error when shm
+    # cannot arm.  Agreed job-wide at init like compression (a mixed-env
+    # launch is a typed error).  shm_ring_bytes
+    # (HVD_TPU_SHM_RING_BYTES, default 1 MiB, floor 64 KiB): payload
+    # capacity of each direction's ring, rounded up to a power of two.
+    shm: str = "auto"
+    shm_ring_bytes: int = 1 << 20
 
     @property
     def compression_code(self) -> int:
@@ -284,4 +297,7 @@ class Config:
                               not in (None, "") else 5),
             anomaly_interval_ms=int(os.environ.get(
                 "HVD_TPU_ANOMALY_INTERVAL_MS") or 500),
+            shm=os.environ.get("HVD_TPU_SHM", "auto") or "auto",
+            shm_ring_bytes=int(os.environ.get(
+                "HVD_TPU_SHM_RING_BYTES") or (1 << 20)),
         )

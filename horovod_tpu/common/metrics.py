@@ -245,6 +245,7 @@ class MetricsRegistry:
             "cross_algo_threshold": 0,
             "cross_ops": {"ring": 0, "tree": 0},
             "bytes": {"local": 0, "cross": 0},
+            "local_transport": "tcp",
         }
         # Control plane (docs/performance.md#control-plane-scaling): the
         # coordinator-tree shape this rank sees, the decentralized
@@ -427,6 +428,8 @@ class MetricsRegistry:
                               for a in ("ring", "tree")},
                 "bytes": {h: int(state.get("bytes", {}).get(h, 0))
                           for h in ("local", "cross")},
+                "local_transport": str(
+                    state.get("local_transport", "tcp")),
             }
 
     def set_control(self, state: dict) -> None:
@@ -494,6 +497,14 @@ class MetricsRegistry:
                         "rtt_last_us": int(v.get("rtt_last_us", -1)),
                         "rtt_ewma_us": int(v.get("rtt_ewma_us", 0)),
                         "rtt_samples": int(v.get("rtt_samples", 0)),
+                        "shm_bytes_out": int(v.get("shm_bytes_out", 0)),
+                        "shm_bytes_in": int(v.get("shm_bytes_in", 0)),
+                        "shm_handoffs": int(v.get("shm_handoffs", 0)),
+                        "shm_us_sum": int(v.get("shm_us_sum", 0)),
+                        "shm_us_count": int(v.get("shm_us_count", 0)),
+                        "shm_us_buckets": [
+                            int(b) for b in v.get("shm_us_buckets", [])],
+                        "transport": str(v.get("transport", "tcp")),
                     }
                     for r, v in state.get("peers", {}).items()
                 },
@@ -730,8 +741,11 @@ class MetricsRegistry:
                 },
                 "links": {
                     "enabled": self._links["enabled"],
-                    "peers": {r: {**v, "send_us_buckets":
-                                  list(v["send_us_buckets"])}
+                    "peers": {r: {**v,
+                                  "send_us_buckets":
+                                  list(v["send_us_buckets"]),
+                                  "shm_us_buckets":
+                                  list(v.get("shm_us_buckets", []))}
                               for r, v in self._links["peers"].items()},
                 },
                 "anomalies": {
@@ -1020,6 +1034,11 @@ def prometheus_text(snapshot: dict) -> str:
                "ranks per node in the two-level topology")
     out.append("# TYPE hvd_tpu_topology_local_size gauge")
     out.append(f"hvd_tpu_topology_local_size {topo.get('local_size', 1)}")
+    out.append("# HELP hvd_tpu_topology_local_transport transport carrying "
+               "the node-local hops (docs/performance.md#transport)")
+    out.append("# TYPE hvd_tpu_topology_local_transport gauge")
+    out.append("hvd_tpu_topology_local_transport{transport="
+               f"\"{topo.get('local_transport', 'tcp')}\"}} 1")
     out.append("# HELP hvd_tpu_topology_cross_algo_threshold_bytes "
                "ring-vs-tree boundary for the cross-node hop "
                "(buckets under it take the tree)")
@@ -1178,6 +1197,47 @@ def prometheus_text(snapshot: dict) -> str:
     for r, v in link_peers.items():
         out.append(f'hvd_tpu_link_rtt_samples_total{{peer="{r}"}} '
                    f'{v.get("rtt_samples", 0)}')
+    out.append("# HELP hvd_tpu_link_transport data-plane transport "
+               "carrying each peer link (1 for the labeled transport; "
+               "docs/performance.md#transport)")
+    out.append("# TYPE hvd_tpu_link_transport gauge")
+    for r, v in link_peers.items():
+        out.append(f'hvd_tpu_link_transport{{peer="{r}",'
+                   f'transport="{v.get("transport", "tcp")}"}} 1')
+    out.append("# HELP hvd_tpu_link_shm_bytes_total bytes handed off "
+               "through the shared-memory rings per peer by direction")
+    out.append("# TYPE hvd_tpu_link_shm_bytes_total counter")
+    for r, v in link_peers.items():
+        out.append(f'hvd_tpu_link_shm_bytes_total{{peer="{r}",dir="out"}} '
+                   f'{v.get("shm_bytes_out", 0)}')
+        out.append(f'hvd_tpu_link_shm_bytes_total{{peer="{r}",dir="in"}} '
+                   f'{v.get("shm_bytes_in", 0)}')
+    out.append("# HELP hvd_tpu_link_shm_handoffs_total segment handoffs "
+               "completed through the shared-memory rings per peer")
+    out.append("# TYPE hvd_tpu_link_shm_handoffs_total counter")
+    for r, v in link_peers.items():
+        out.append(f'hvd_tpu_link_shm_handoffs_total{{peer="{r}"}} '
+                   f'{v.get("shm_handoffs", 0)}')
+    out.append("# HELP hvd_tpu_link_shm_handoff_latency_us time for one "
+               "send leg to fully enter the peer's ring (includes any "
+               "injected chaos delay)")
+    out.append("# TYPE hvd_tpu_link_shm_handoff_latency_us histogram")
+    for r, v in link_peers.items():
+        buckets = v.get("shm_us_buckets", [])
+        cumulative = 0
+        for bound, n in zip(LINK_SEND_BUCKETS_US, buckets):
+            cumulative += n
+            out.append(
+                f'hvd_tpu_link_shm_handoff_latency_us_bucket{{peer="{r}",'
+                f'le="{_fmt(bound)}"}} {cumulative}')
+        out.append(
+            f'hvd_tpu_link_shm_handoff_latency_us_bucket{{peer="{r}",'
+            f'le="+Inf"}} {v.get("shm_us_count", 0)}')
+        out.append(f'hvd_tpu_link_shm_handoff_latency_us_sum{{peer="{r}"}} '
+                   f'{v.get("shm_us_sum", 0)}')
+        out.append(
+            f'hvd_tpu_link_shm_handoff_latency_us_count{{peer="{r}"}} '
+            f'{v.get("shm_us_count", 0)}')
 
     anomalies = snapshot.get("anomalies", {})
     out.append("# HELP hvd_tpu_anomaly_sigma robust-excursion threshold "
@@ -1347,7 +1407,14 @@ def health_summary(snap: dict) -> dict:
                                 if v.get("rtt_samples", 0) else -1),
                 "stalls": (v.get("stalls", 0)
                            + v.get("short_writes", 0)),
-                "bytes": (v.get("bytes_out", 0) + v.get("bytes_in", 0)),
+                "bytes": (v.get("bytes_out", 0) + v.get("bytes_in", 0)
+                          + v.get("shm_bytes_out", 0)
+                          + v.get("shm_bytes_in", 0)),
+                "transport": v.get("transport", "tcp"),
+                "shm_handoff_mean_us": (
+                    v.get("shm_us_sum", 0)
+                    // max(v.get("shm_us_count", 0), 1)
+                    if v.get("shm_us_count", 0) else -1),
             }
             for r, v in links.get("peers", {}).items()
         },

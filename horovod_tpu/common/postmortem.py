@@ -44,7 +44,7 @@ from typing import List, Optional
 # this list for tools/docs, not for parsing.
 EVENTS = ("enqueue", "announce", "cache_hit", "execute", "error", "tick",
           "stall", "abort", "reshape", "tune", "compress", "topology",
-          "steady")
+          "steady", "heartbeat_miss", "anomaly", "transport")
 
 DEFAULT_RING_EVENTS = 512
 
@@ -265,6 +265,18 @@ def _write(directory: str, reason: str,
         doc["metrics"] = common.metrics_snapshot()
     except Exception:
         doc["metrics"] = {}
+    # Active data-plane transport, top-level: which path (shm rings vs TCP
+    # sockets) the node-local hops ran on, and per peer — so the failure
+    # report and renderer answer "was shared memory in play?" without
+    # digging through the embedded metrics snapshot.
+    metrics = doc["metrics"] if isinstance(doc["metrics"], dict) else {}
+    doc["transport"] = {
+        "local": str(metrics.get("topology", {})
+                     .get("local_transport", "tcp")),
+        "peers": {str(r): str(v.get("transport", "tcp"))
+                  for r, v in metrics.get("links", {})
+                                     .get("peers", {}).items()},
+    }
     os.makedirs(directory, exist_ok=True)
     epoch = common.restart_epoch()
     suffix = f".e{epoch}" if epoch else ""

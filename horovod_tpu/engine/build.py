@@ -27,7 +27,7 @@ import tempfile
 from typing import List, Optional
 
 _CC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cc")
-_SOURCES = ["net.cc", "wire.cc", "timeline.cc", "autotune.cc", "flight.cc",
+_SOURCES = ["net.cc", "transport.cc", "wire.cc", "timeline.cc", "autotune.cc", "flight.cc",
             "engine.cc", "simscale.cc", "c_api.cc"]
 _LIB_NAME = "libhvdtpu.so"
 
@@ -129,7 +129,7 @@ def _build_stamp(mode: str = "") -> str:
                     break
     except OSError:
         pass
-    payload = " ".join(_flags(mode)) + "|" + cpu
+    payload = " ".join(_flags(mode)) + " -lrt" + "|" + cpu
     return hashlib.sha256(payload.encode()).hexdigest()
 
 
@@ -203,7 +203,10 @@ def build(verbose: bool = False) -> str:
     stage = None
     try:
         out = os.path.join(tmpdir, os.path.basename(lib))
-        cmd = [cxx] + _flags(mode) + ["-o", out] + srcs
+        # -lrt after the sources: shm_open/shm_unlink live in librt on
+        # glibc < 2.34 (newer glibc keeps them in libc and the flag is a
+        # harmless no-op).
+        cmd = [cxx] + _flags(mode) + ["-o", out] + srcs + ["-lrt"]
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(

@@ -724,6 +724,18 @@ int Engine::Init(const EngineOptions& opts, std::string* err) {
     anomaly_interval_ms_ = (ai_env && *ai_env) ? atoi(ai_env) : 500;
     if (anomaly_interval_ms_ < 10) anomaly_interval_ms_ = 10;
     anomaly_stop_.store(false);
+    // Transport seam policy (docs/performance.md#transport).  Env-read
+    // here like the knobs above, but the MODE becomes part of the init
+    // job-wide agreement in SetupSockets — a per-rank divergence (one
+    // rank with the kill switch thrown) would otherwise split the job
+    // between transports mid-ring.
+    shm_mode_ = ParseShmMode(getenv("HVD_TPU_SHM"));
+    const char* srb_env = getenv("HVD_TPU_SHM_RING_BYTES");
+    shm_ring_bytes_ = (srb_env && *srb_env) ? atoll(srb_env) : (1 << 20);
+    if (shm_ring_bytes_ < (64 << 10)) shm_ring_bytes_ = 64 << 10;
+    shm_agreed_ = false;
+    shm_active_ = false;
+    topo_shm_.store(false);
     std::lock_guard<std::mutex> lk(hb_mu_);
     hb_last_seen_us_.clear();
     hb_miss_counts_.clear();
@@ -955,21 +967,27 @@ bool Engine::SetupSockets(std::string* err) {
         std::max<int64_t>(opts_.cache_capacity, 0), 0x7fffffff));
     uint32_t cmin32 = static_cast<uint32_t>(std::min<int64_t>(
         std::max<int64_t>(opts_.compression_min_bytes, 0), 0x7fffffff));
-    uint32_t mine[7] = {(uint32_t)opts_.local_rank, (uint32_t)opts_.local_size,
+    // Slot 7 carries the HVD_TPU_SHM transport choice, with the same
+    // IDENTICAL-everywhere contract as compression: a split would put
+    // some ranks of a node ring on the segment and others on the socket,
+    // which deadlocks the first local hop.  Like compression, mismatch
+    // is a typed init error, never a vote.
+    uint32_t mine[8] = {(uint32_t)opts_.local_rank, (uint32_t)opts_.local_size,
                         opts_.hierarchical_allreduce ? 1u : 0u, cap32,
                         (uint32_t)opts_.compression_mode, cmin32,
-                        opts_.coord_tree ? 1u : 0u};
+                        opts_.coord_tree ? 1u : 0u, (uint32_t)shm_mode_};
     // {hierarchical decision, capacity, compression mismatch flag,
-    //  coordinator-tree decision, pad}
-    uint32_t reply[5] = {0, cap32, 0, 0, 0};
+    //  coordinator-tree decision, transport mismatch flag, shm verdict}
+    uint32_t reply[6] = {0, cap32, 0, 0, 0, 0};
     if (opts_.rank == 0) {
       std::vector<uint32_t> lr(opts_.size), ls(opts_.size), hr(opts_.size);
       lr[0] = mine[0]; ls[0] = mine[1]; hr[0] = mine[2];
       bool tree_want = mine[6] != 0;
       uint32_t agreed_cap = cap32;
       std::string comp_mismatch;
+      std::string shm_mismatch;
       for (int r = 1; r < opts_.size; ++r) {
-        uint32_t peer[7];
+        uint32_t peer[8];
         if (!RecvAll(coord_fds_[r], peer, sizeof peer)) {
           *err = "topology agreement recv failed";
           return false;
@@ -988,6 +1006,14 @@ bool Engine::SetupSockets(std::string* err) {
               " (min bytes " + std::to_string(peer[5]) +
               "); wire compression must be configured identically on "
               "every rank.";
+        if (shm_mismatch.empty() && peer[7] != mine[7])
+          shm_mismatch =
+              "HVD_TPU_SHM mismatch: rank 0 configured mode " +
+              std::string(ShmModeName(shm_mode_)) + " but rank " +
+              std::to_string(r) + " configured mode " +
+              ShmModeName(static_cast<ShmMode>(peer[7] <= 2 ? peer[7] : 1)) +
+              "; the data-plane transport must be configured identically "
+              "on every rank.";
       }
       bool want = true, valid = true;
       for (int r = 0; r < opts_.size; ++r) want = want && hr[r] != 0;
@@ -1016,6 +1042,15 @@ bool Engine::SetupSockets(std::string* err) {
                   opts_.size / (int)L >= 2)
                      ? 1
                      : 0;
+      reply[4] = shm_mismatch.empty() ? 0 : 1;
+      // Shm verdict: the segment rings carry the NODE-LOCAL hops, so shm
+      // can only arm on the agreed two-level topology (want && valid),
+      // and never on elastic jobs (reshapes force the flat ring).  The
+      // mode itself is identical job-wide when reply[4] == 0.
+      reply[5] = (shm_mode_ != ShmMode::kOff && shm_mismatch.empty() &&
+                  want && valid && !opts_.elastic)
+                     ? 1
+                     : 0;
       for (int r = 1; r < opts_.size; ++r) {
         if (!SendAll(coord_fds_[r], reply, sizeof reply)) {
           *err = "topology agreement send failed";
@@ -1026,6 +1061,10 @@ bool Engine::SetupSockets(std::string* err) {
         // The verdict was sent (workers fail with the same typed error);
         // fail init on the coordinator with the full who-said-what story.
         *err = comp_mismatch;
+        return false;
+      }
+      if (!shm_mismatch.empty()) {
+        *err = shm_mismatch;
         return false;
       }
     } else {
@@ -1066,10 +1105,24 @@ bool Engine::SetupSockets(std::string* err) {
                "identically on every rank.";
         return false;
       }
+      if (reply[4] != 0) {
+        *err = "HVD_TPU_SHM mismatch: the ranks disagree on the data-plane "
+               "transport mode; set HVD_TPU_SHM identically on every rank.";
+        return false;
+      }
     }
     opts_.hierarchical_allreduce = reply[0] != 0;
     opts_.cache_capacity = static_cast<int64_t>(reply[1]);
     opts_.coord_tree = reply[3] != 0;
+    shm_agreed_ = reply[5] != 0;
+    if (shm_mode_ == ShmMode::kForce && !shm_agreed_) {
+      *err = "HVD_TPU_SHM=force but the shared-memory transport cannot arm: "
+             "it requires the two-level topology (hierarchical allreduce "
+             "agreed job-wide: equal local_size >= 2, ranks in contiguous "
+             "blocks of local_size) on a non-elastic job; use HVD_TPU_SHM="
+             "auto to fall back to TCP instead.";
+      return false;
+    }
   }
   // (Clock alignment runs at the END of socket setup, AFTER the tree
   // restructure and the data-plane accept loop: under the coordinator
@@ -1353,6 +1406,11 @@ bool Engine::SetupSockets(std::string* err) {
     NetFaultRegister(beat_out_fd_, beat_out_peer_);
     NetFaultRegister(beat_in_fd_, beat_in_peer_);
   }
+  // Transport seam: wrap the topology fds in channels and run the shm
+  // segment rendezvous when the job-wide agreement armed it.  Before the
+  // monitor wake registry (the segment joins it) and before ClockSync
+  // (a force-mode failure must surface as the init verdict).
+  if (!SetupShmTransport(err)) return false;
   // Arm the monitor's wake registry: the data-plane fds the engine thread
   // can block in (ring exchanges), shut down by the monitor when it
   // flags a silent peer so a survivor wakes in O(heartbeat) instead of
@@ -1370,6 +1428,7 @@ bool Engine::SetupSockets(std::string* err) {
     if (cross_right_fd_ >= 0) hb_wake_fds_.push_back(cross_right_fd_);
     for (int fd : cross_tree_fds_)
       if (fd >= 0) hb_wake_fds_.push_back(fd);
+    hb_wake_shm_ = shm_active_ ? &shm_seg_ : nullptr;
     hb_ctrl_wake_fd_ = opts_.rank == 0 ? -1 : coord_fd_;
     // Monitored peers start "just seen": the first miss window opens at
     // init, not at the epoch of the clock.
@@ -1383,6 +1442,164 @@ bool Engine::SetupSockets(std::string* err) {
   return true;
 }
 
+namespace {
+// Attach-token relay words (ASCII-tagged for strace readability).
+constexpr uint32_t kShmRound1Ok = 0x53484d31;   // "SHM1"
+constexpr uint32_t kShmRound1Bad = 0x53484d30;  // "SHM0"
+constexpr uint32_t kShmRound2Arm = 0x53484d41;  // "SHMA"
+constexpr uint32_t kShmRound2Tcp = 0x53484d54;  // "SHMT"
+
+bool ShmTokenSend(int fd, uint32_t tok) { return SendAll(fd, &tok, 4); }
+bool ShmTokenRecv(int fd, uint32_t* tok) {
+  return WaitReadable(fd, 30.0) && RecvAll(fd, tok, 4);
+}
+}  // namespace
+
+bool Engine::SetupShmTransport(std::string* err) {
+  const int L = opts_.hierarchical_allreduce ? opts_.local_size : 1;
+  const int node_base = node_id_ * L;
+  const int lr = opts_.local_rank;
+  // The channels wrap every topology fd unconditionally — the TCP path
+  // is simply a channel with no rings — so the data-plane code has ONE
+  // seam instead of an fd path and a ring path.
+  left_ch_ = Channel{left_fd_, nullptr, nullptr,
+                     (opts_.rank + opts_.size - 1) % opts_.size};
+  right_ch_ = Channel{right_fd_, nullptr, nullptr,
+                      (opts_.rank + 1) % opts_.size};
+  local_left_ch_ = Channel{local_left_fd_, nullptr, nullptr,
+                           node_base + (lr + L - 1) % L};
+  local_right_ch_ = Channel{local_right_fd_, nullptr, nullptr,
+                            node_base + (lr + 1) % L};
+  cross_left_ch_ = Channel{
+      cross_left_fd_, nullptr, nullptr,
+      ((node_id_ + n_nodes_ - 1) % n_nodes_) * L + lr};
+  cross_right_ch_ = Channel{cross_right_fd_, nullptr, nullptr,
+                            ((node_id_ + 1) % n_nodes_) * L + lr};
+  if (!shm_agreed_) return true;
+  // Chaos interop (the ISSUE's never-silently-ignored contract): a
+  // fault clause naming ANY in-node ring link decides the node's
+  // transport before the segment exists.  delay/jitter clauses apply at
+  // the shm seam (NetFaultDelayPeer per handoff); drop/flaky/partition
+  // shapes cannot be expressed by a memory fence, so they demote the
+  // node to TCP (auto) or fail init typed (force).  Every local rank
+  // scans ALL in-node links, so the whole node reaches one verdict with
+  // no extra rendezvous round.
+  bool chaos_tcp = false;
+  for (int i = 0; i < L && !chaos_tcp; ++i) {
+    std::string clause;
+    int verdict = NetFaultQueryLink(node_base + i, node_base + (i + 1) % L,
+                                    &clause);
+    if (verdict == 2) {
+      if (shm_mode_ == ShmMode::kForce) {
+        *err = "HVD_TPU_SHM=force but HVD_TPU_NET_FAULT_SPEC clause '" +
+               clause + "' injects a drop/flaky/partition fault on the "
+               "same-host link " + std::to_string(node_base + i) + "-" +
+               std::to_string(node_base + (i + 1) % L) +
+               ", which the shared-memory transport cannot express; "
+               "drop the clause or use HVD_TPU_SHM=auto (TCP fallback).";
+        return false;
+      }
+      if (lr == 0)
+        fprintf(stderr,
+                "[horovod_tpu] WARNING: HVD_TPU_NET_FAULT_SPEC clause "
+                "'%s' injects a drop/flaky fault on a same-host link; "
+                "node %d keeps the TCP transport (HVD_TPU_SHM=auto "
+                "demotes, it never silently ignores a clause).\n",
+                clause.c_str(), node_id_);
+      chaos_tcp = true;
+    }
+  }
+  if (chaos_tcp) return true;
+  // Segment name: job tag (coordinator endpoint — unique per job on a
+  // host) + node + epoch (launcher restart epoch composed with the
+  // elastic membership epoch), so restarts and reshapes can never
+  // attach a stale generation's segment.
+  const char* re_env = getenv("HVD_TPU_RESTART_EPOCH");
+  long long restart_epoch = (re_env && *re_env) ? atoll(re_env) : 0;
+  long long epoch = restart_epoch * 1000000 + membership_epoch_.load();
+  std::string name = ShmSegmentName(opts_.coord_endpoint, node_id_, epoch);
+  // Two-round token relay over the node-local ring sockets (already
+  // connected, already chaos-registered).  Round 1 (attach): local rank
+  // 0 creates, then an Ok token circulates rightward with every rank
+  // attaching before forwarding (any failure flips it to Bad).  Round 2
+  // (verdict): the creator UNLINKS THE NAME FIRST — every rank is
+  // attached or the node is abandoning shm, so from here no abort,
+  // typed death, or SIGKILL can leak a /dev/shm entry — then circulates
+  // Arm/Tcp so every rank flips its channels in the same tick.
+  uint32_t tok = 0;
+  std::string seg_err;
+  bool attached = false;
+  if (lr == 0) {
+    attached = shm_seg_.Create(name, L, (size_t)shm_ring_bytes_, &seg_err);
+    if (!ShmTokenSend(local_right_fd_, attached ? kShmRound1Ok
+                                                : kShmRound1Bad) ||
+        !ShmTokenRecv(local_left_fd_, &tok)) {
+      *err = "shm attach-token relay failed on the node-local ring";
+      return false;
+    }
+    shm_seg_.Unlink();
+    bool arm = attached && tok == kShmRound1Ok;
+    uint32_t verdict = arm ? kShmRound2Arm : kShmRound2Tcp;
+    if (!ShmTokenSend(local_right_fd_, verdict) ||
+        !ShmTokenRecv(local_left_fd_, &tok) || tok != verdict) {
+      *err = "shm verdict-token relay failed on the node-local ring";
+      return false;
+    }
+  } else {
+    if (!ShmTokenRecv(local_left_fd_, &tok)) {
+      *err = "shm attach-token relay failed on the node-local ring";
+      return false;
+    }
+    if (tok == kShmRound1Ok) {
+      attached = shm_seg_.Attach(name, L, (size_t)shm_ring_bytes_, &seg_err);
+      if (!attached) tok = kShmRound1Bad;
+    }
+    if (!ShmTokenSend(local_right_fd_, tok) ||
+        !ShmTokenRecv(local_left_fd_, &tok) ||
+        !ShmTokenSend(local_right_fd_, tok)) {
+      *err = "shm verdict-token relay failed on the node-local ring";
+      return false;
+    }
+  }
+  if (tok != kShmRound2Arm) {
+    shm_seg_.Unmap();
+    if (shm_mode_ == ShmMode::kForce) {
+      *err = "HVD_TPU_SHM=force but the node " + std::to_string(node_id_) +
+             " segment could not arm" +
+             (seg_err.empty() ? std::string(" (a peer failed to attach)")
+                              : ": " + seg_err) +
+             "; use HVD_TPU_SHM=auto to fall back to TCP instead.";
+      return false;
+    }
+    if (lr == 0)
+      fprintf(stderr,
+              "[horovod_tpu] WARNING: shared-memory transport could not "
+              "arm on node %d (%s); falling back to TCP.\n",
+              node_id_, seg_err.empty() ? "a peer failed to attach"
+                                        : seg_err.c_str());
+    return true;
+  }
+  // Armed: point the node-local channels at the segment rings.  Ring
+  // (r, 0) flows rightward (r writes, (r+1)%L reads), ring (r, 1)
+  // leftward — so this rank SENDS right on (lr, 0) and left on (lr, 1),
+  // RECEIVES from the left neighbour's rightward ring and the right
+  // neighbour's leftward ring.
+  local_right_ch_.tx = shm_seg_.Ring(lr, 0);
+  local_right_ch_.rx = shm_seg_.Ring((lr + 1) % L, 1);
+  local_left_ch_.tx = shm_seg_.Ring(lr, 1);
+  local_left_ch_.rx = shm_seg_.Ring((lr + L - 1) % L, 0);
+  shm_active_ = true;
+  topo_shm_.store(true);
+  if (flight_.Enabled())
+    flight_.Record(FL_TRANSPORT, "shm", (int64_t)shm_ring_bytes_);
+  if (lr == 0)
+    fprintf(stderr,
+            "[horovod_tpu] node %d local ring on shared-memory transport "
+            "(%d ranks, %lld-byte rings, segment unlinked).\n",
+            node_id_, L, (long long)shm_ring_bytes_);
+  return true;
+}
+
 void Engine::TeardownSockets() {
   {
     // The monitor is already joined (Shutdown) or was never started
@@ -1391,6 +1608,7 @@ void Engine::TeardownSockets() {
     // beat fds it never got to.
     std::lock_guard<std::mutex> lk(hb_mu_);
     hb_wake_fds_.clear();
+    hb_wake_shm_ = nullptr;
     hb_ctrl_wake_fd_ = -1;
     CloseFd(beat_in_fd_);
     CloseFd(beat_out_fd_);
@@ -1423,6 +1641,8 @@ void Engine::TeardownSockets() {
   CloseFd(right_fd_);
   CloseTopologyFds();
   coord_listen_fd_ = coord_fd_ = data_listen_fd_ = left_fd_ = right_fd_ = -1;
+  left_ch_ = Channel{};
+  right_ch_ = Channel{};
 }
 
 void Engine::ShutdownTopologyFds() {
@@ -1431,6 +1651,10 @@ void Engine::ShutdownTopologyFds() {
   ShutdownFd(cross_left_fd_);
   ShutdownFd(cross_right_fd_);
   for (int fd : cross_tree_fds_) ShutdownFd(fd);
+  // Shm analogue of ShutdownFd: a helper (or peer) blocked in a ring
+  // drive loop wakes within one poll iteration.  Unmap stays with
+  // CloseTopologyFds, after the helpers joined.
+  shm_seg_.CloseRings();
 }
 
 void Engine::CloseTopologyFds() {
@@ -1442,6 +1666,27 @@ void Engine::CloseTopologyFds() {
   cross_tree_fds_.clear();
   local_left_fd_ = local_right_fd_ = -1;
   cross_left_fd_ = cross_right_fd_ = -1;
+  // Segment teardown.  The name was already unlinked the moment the
+  // attach token round-tripped; the extra Unlink here covers the
+  // create-to-attach window on an init failure, so no typed death path
+  // can leak a /dev/shm entry.  De-register from the monitor BEFORE
+  // unmapping (it may be mid-CloseRings on the mapping).
+  {
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    hb_wake_shm_ = nullptr;
+  }
+  shm_seg_.Unlink();
+  shm_seg_.Unmap();
+  shm_active_ = false;
+  topo_shm_.store(false);
+  // Only the TOPOLOGY channels: the flat-ring pair (left_ch_/right_ch_)
+  // tracks left_fd_/right_fd_, which outlive a two-level teardown (the
+  // flat ring keeps serving broadcast/allgather after a failed
+  // hierarchical collective latched the topology closed).
+  local_left_ch_ = Channel{};
+  local_right_ch_ = Channel{};
+  cross_left_ch_ = Channel{};
+  cross_right_ch_ = Channel{};
 }
 
 int64_t Engine::EpochNowUs() const {
@@ -1674,6 +1919,11 @@ void Engine::HeartbeatLoop() {
       // engine (under this same mutex) before any of these fds is
       // closed, so a recycled fd number can never be hit.
       for (int fd : hb_wake_fds_) ShutdownFd(fd);
+      // Same wake for the shm transport: closing the segment's rings
+      // breaks any drive loop blocked on a full/empty ring within one
+      // poll iteration.  The registry entry is cleared (under this
+      // mutex) before the engine unmaps, so no use-after-unmap.
+      if (hb_wake_shm_) hb_wake_shm_->CloseRings();
     }
     if (grace_deadline_us == -1) {
       // One more miss window for the coordinated path (reports up, typed
@@ -4973,6 +5223,8 @@ bool Engine::RebuildRing(std::string* err) {
   CloseFd(left_fd_);
   CloseFd(right_fd_);
   left_fd_ = right_fd_ = -1;
+  left_ch_ = Channel{};
+  right_ch_ = Channel{};
   // Elastic jobs run the flat ring only; make sure no stale two-level
   // topology outlives a reshape.
   CloseTopologyFds();
@@ -5082,6 +5334,10 @@ bool Engine::RebuildRing(std::string* err) {
     hb_wake_fds_.push_back(right_fd_);
     hb_ctrl_wake_fd_ = opts_.rank == 0 ? -1 : coord_fd_;
   }
+  // Re-wrap the rebuilt ring in channels (elastic jobs run TCP-only —
+  // the shm agreement excludes them — so no rings to re-attach).
+  left_ch_ = Channel{left_fd_, nullptr, nullptr, beat_left};
+  right_ch_ = Channel{right_fd_, nullptr, nullptr, right};
   return true;
 }
 
@@ -5458,7 +5714,7 @@ void Engine::ExecuteAllreduce(const Response& resp,
                              wire, use_tree, entries[0].name, &err);
     } else {
       ok = RingAllreduceWire(fb, total_elems, wire, opts_.size, opts_.rank,
-                             left_fd_, right_fd_, &err);
+                             left_ch_, right_ch_, &err);
     }
     timeline_.ActivityEnd(entries[0].name);
     if (ok) {
@@ -5634,8 +5890,8 @@ void Engine::CompleteEntry(const TableEntry& e, int32_t code,
 
 bool Engine::RingAllreduce(void* buf, int64_t count, uint8_t dtype,
                            std::string* err) {
-  return RingAllreduceOn(buf, count, dtype, opts_.size, opts_.rank, left_fd_,
-                         right_fd_, err);
+  return RingAllreduceOn(buf, count, dtype, opts_.size, opts_.rank, left_ch_,
+                         right_ch_, err);
 }
 
 namespace {
@@ -5679,8 +5935,8 @@ struct HalfRing {
 }  // namespace
 
 bool Engine::RingAllreduceOn(void* buf, int64_t count, uint8_t dtype, int N,
-                             int index, int left_fd, int right_fd,
-                             std::string* err) {
+                             int index, const Channel& left,
+                             const Channel& right, std::string* err) {
   // Bidirectional ring: the buffer splits into two halves that travel in
   // opposite directions simultaneously — half A rightward (send on
   // right_fd, receive on left_fd) and half B leftward on the mirrored ring
@@ -5703,11 +5959,11 @@ bool Engine::RingAllreduceOn(void* buf, int64_t count, uint8_t dtype, int N,
   // Phase 1: reduce-scatter both halves.  After N-1 steps this rank owns
   // fully reduced segment (index+1) of A and (mirror+1) of B.
   for (int step = 0; step < N - 1; ++step) {
-    if (!ExchangeBi(right_fd, A.send_ptr(step, false),
-                    A.send_len(step, false), tmpB.data(),
-                    B.recv_len(step, false), left_fd,
-                    B.send_ptr(step, false), B.send_len(step, false),
-                    tmpA.data(), A.recv_len(step, false))) {
+    if (!ChannelExchangeBi(right, A.send_ptr(step, false),
+                           A.send_len(step, false), tmpB.data(),
+                           B.recv_len(step, false), left,
+                           B.send_ptr(step, false), B.send_len(step, false),
+                           tmpA.data(), A.recv_len(step, false))) {
       *err = "neighbour exchange failed (reduce-scatter step " +
              std::to_string(step) + ")";
       return false;
@@ -5719,11 +5975,11 @@ bool Engine::RingAllreduceOn(void* buf, int64_t count, uint8_t dtype, int N,
   }
   // Phase 2: allgather of reduced segments, both directions.
   for (int step = 0; step < N - 1; ++step) {
-    if (!ExchangeBi(right_fd, A.send_ptr(step, true),
-                    A.send_len(step, true), B.recv_ptr(step, true),
-                    B.recv_len(step, true), left_fd,
-                    B.send_ptr(step, true), B.send_len(step, true),
-                    A.recv_ptr(step, true), A.recv_len(step, true))) {
+    if (!ChannelExchangeBi(right, A.send_ptr(step, true),
+                           A.send_len(step, true), B.recv_ptr(step, true),
+                           B.recv_len(step, true), left,
+                           B.send_ptr(step, true), B.send_len(step, true),
+                           A.recv_ptr(step, true), A.recv_len(step, true))) {
       *err = "neighbour exchange failed (allgather step " +
              std::to_string(step) + ")";
       return false;
@@ -5733,8 +5989,8 @@ bool Engine::RingAllreduceOn(void* buf, int64_t count, uint8_t dtype, int N,
 }
 
 bool Engine::RingAllreduceWire(float* buf, int64_t count, uint8_t wire,
-                               int N, int index, int left_fd, int right_fd,
-                               std::string* err) {
+                               int N, int index, const Channel& left,
+                               const Channel& right, std::string* err) {
   // The bidirectional ring of RingAllreduceOn with the wire narrowed:
   // the local buffer stays f32 (every hop accumulates in f32), segments
   // are compressed at the send boundary and decompressed at the receive
@@ -5767,10 +6023,11 @@ bool Engine::RingAllreduceWire(float* buf, int64_t count, uint8_t wire,
                   wire);
       CompressBuf(bufB + B.seg_start(B.send_seg(step, g)), send_b.data(), sb,
                   wire);
-      if (!ExchangeBi(right_fd, send_a.data(), static_cast<size_t>(sa) * wsz,
-                      recv_b.data(), static_cast<size_t>(rb) * wsz, left_fd,
-                      send_b.data(), static_cast<size_t>(sb) * wsz,
-                      recv_a.data(), static_cast<size_t>(ra) * wsz)) {
+      if (!ChannelExchangeBi(right, send_a.data(),
+                             static_cast<size_t>(sa) * wsz, recv_b.data(),
+                             static_cast<size_t>(rb) * wsz, left,
+                             send_b.data(), static_cast<size_t>(sb) * wsz,
+                             recv_a.data(), static_cast<size_t>(ra) * wsz)) {
         *err = std::string("neighbour exchange failed (compressed ") +
                (g ? "allgather" : "reduce-scatter") + " step " +
                std::to_string(step) + ")";
@@ -5907,9 +6164,10 @@ bool Engine::LocalReduceScatter(char* data, int64_t n, uint8_t dtype,
                   sendw.data(), part.cnt(ss), wire);
       sp = sendw.data();
     }
-    if (!Exchange(local_right_fd_, sp,
-                  static_cast<size_t>(part.cnt(ss)) * unit, local_left_fd_,
-                  recvw.data(), static_cast<size_t>(part.cnt(rs)) * unit)) {
+    if (!ChannelExchange(local_right_ch_, sp,
+                         static_cast<size_t>(part.cnt(ss)) * unit,
+                         local_left_ch_, recvw.data(),
+                         static_cast<size_t>(part.cnt(rs)) * unit)) {
       *err = "node-local reduce-scatter exchange failed (step " +
              std::to_string(step) + ")";
       return false;
@@ -5956,9 +6214,10 @@ bool Engine::LocalAllgather(char* data, int64_t n, uint8_t dtype,
       sp = sendw.data();
       rp = recvw.data();
     }
-    if (!Exchange(local_right_fd_, sp,
-                  static_cast<size_t>(part.cnt(ss)) * unit, local_left_fd_,
-                  rp, static_cast<size_t>(part.cnt(rs)) * unit)) {
+    if (!ChannelExchange(local_right_ch_, sp,
+                         static_cast<size_t>(part.cnt(ss)) * unit,
+                         local_left_ch_, rp,
+                         static_cast<size_t>(part.cnt(rs)) * unit)) {
       *err = "node-local allgather exchange failed (step " +
              std::to_string(step) + ")";
       return false;
@@ -5998,8 +6257,13 @@ bool Engine::CrossTreeAllreduce(char* seg, int64_t n, uint8_t dtype,
       CompressBuf(f, sendw.data(), n, wire);
       sp = sendw.data();
     }
-    if (!Exchange(fd, sp, static_cast<size_t>(n) * unit, fd, recvw.data(),
-                  static_cast<size_t>(n) * unit)) {
+    // Ad-hoc channel: tree partners are TCP-only (they live on other
+    // hosts by construction), but routing through the seam keeps the
+    // telemetry and chaos hooks uniform.
+    Channel tc{fd, nullptr, nullptr,
+               (node_id_ ^ (1 << k)) * opts_.local_size + opts_.local_rank};
+    if (!ChannelExchange(tc, sp, static_cast<size_t>(n) * unit, tc,
+                         recvw.data(), static_cast<size_t>(n) * unit)) {
       *err = "cross-node tree exchange failed (level " +
              std::to_string(k) + ")";
       return false;
@@ -6031,10 +6295,10 @@ bool Engine::CrossShardAllreduce(char* seg, int64_t n, uint8_t dtype,
   bool ok =
       wire == 255
           ? RingAllreduceOn(seg, n, dtype, n_nodes_, node_id_,
-                            cross_left_fd_, cross_right_fd_, err)
+                            cross_left_ch_, cross_right_ch_, err)
           : RingAllreduceWire(reinterpret_cast<float*>(seg), n, wire,
-                              n_nodes_, node_id_, cross_left_fd_,
-                              cross_right_fd_, err);
+                              n_nodes_, node_id_, cross_left_ch_,
+                              cross_right_ch_, err);
   if (ok)
     *bytes_moved += 2 * static_cast<int64_t>(n_nodes_ - 1) *
                     ((n + n_nodes_ - 1) / n_nodes_) *
@@ -6283,7 +6547,8 @@ std::string Engine::TopologyInfo() {
          std::to_string(topo_ops_tree_.load()) + "|" +
          std::to_string(topo_local_bytes_.load()) + "|" +
          std::to_string(topo_cross_bytes_.load()) + "|" +
-         std::to_string(log_total);
+         std::to_string(log_total) + "|" +
+         (topo_shm_.load() ? "shm" : "tcp");
 }
 
 std::string Engine::TopologyLog() {
@@ -6306,9 +6571,9 @@ bool Engine::RingAllgather(char* buf, const std::vector<int64_t>& block_bytes,
   for (int step = 0; step < N - 1; ++step) {
     int ss = ((r - step) % N + N) % N;
     int rs = ((r - step - 1) % N + N) % N;
-    if (!Exchange(right_fd_, buf + off[ss],
-                  static_cast<size_t>(block_bytes[ss]), left_fd_,
-                  buf + off[rs], static_cast<size_t>(block_bytes[rs]))) {
+    if (!ChannelExchange(right_ch_, buf + off[ss],
+                         static_cast<size_t>(block_bytes[ss]), left_ch_,
+                         buf + off[rs], static_cast<size_t>(block_bytes[rs]))) {
       *err = "neighbour exchange failed (allgather step " +
              std::to_string(step) + ")";
       return false;
@@ -6328,11 +6593,13 @@ bool Engine::RingBroadcast(void* buf, int64_t nbytes, int root,
   char* p = static_cast<char*>(buf);
   for (int64_t o = 0; o < nbytes; o += kChunk) {
     int64_t len = std::min(kChunk, nbytes - o);
-    if (recv_from_left && !RecvAll(left_fd_, p + o, static_cast<size_t>(len))) {
+    if (recv_from_left &&
+        !ChannelRecvAll(left_ch_, p + o, static_cast<size_t>(len))) {
       *err = "broadcast recv failed";
       return false;
     }
-    if (send_to_right && !SendAll(right_fd_, p + o, static_cast<size_t>(len))) {
+    if (send_to_right &&
+        !ChannelSendAll(right_ch_, p + o, static_cast<size_t>(len))) {
       *err = "broadcast send failed";
       return false;
     }

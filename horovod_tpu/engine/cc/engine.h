@@ -33,6 +33,7 @@
 #include "autotune.h"
 #include "flight.h"
 #include "timeline.h"
+#include "transport.h"
 #include "wire.h"
 
 namespace hvdtpu {
@@ -497,6 +498,14 @@ class Engine {
   void BackgroundLoop();
   bool RunLoopOnce();
   bool SetupSockets(std::string* err);
+  // Transport seam bring-up (end of SetupSockets): wrap every topology
+  // fd in a Channel, and — when the job-wide HVD_TPU_SHM agreement armed
+  // shm — create/attach the per-node segment via a token relay over the
+  // node-local ring sockets and point the local-ring channels at its
+  // rings.  Chaos clauses naming an in-node link demote to TCP (auto) or
+  // fail init with a typed error naming the clause (force / unsupported
+  // drop-flaky shapes).
+  bool SetupShmTransport(std::string* err);
   // Standby path (opts_.rejoin): connect to the coordinator, announce the
   // data endpoint, block until the admitting reshape broadcast arrives,
   // adopt the new membership, and build the ring.
@@ -733,12 +742,12 @@ class Engine {
   // allgather phase loses nothing beyond the per-hop quantization the
   // format implies.
   bool RingAllreduceWire(float* buf, int64_t count, uint8_t wire,
-                         int N, int index, int left_fd, int right_fd,
-                         std::string* err);
+                         int N, int index, const Channel& left,
+                         const Channel& right, std::string* err);
   // Ring allreduce over an arbitrary participant ring (used for both the
   // global ring and the per-shard cross-node rings).
   bool RingAllreduceOn(void* buf, int64_t count, uint8_t dtype, int n,
-                       int index, int left_fd, int right_fd,
+                       int index, const Channel& left, const Channel& right,
                        std::string* err);
   // Two-level allreduce (docs/performance.md#two-level-topology): local
   // reduce-scatter over the node ring -> every local rank drives a
@@ -877,6 +886,24 @@ class Engine {
   // power of two; empty otherwise (tree requests fall back to the ring).
   std::vector<int> cross_tree_fds_;
 
+  // Pluggable transport seam (docs/performance.md#transport).  Channels
+  // wrap the fds above; the node-local pair additionally carries shm
+  // rings when the segment armed.  shm_mode_/shm_ring_bytes_ come from
+  // HVD_TPU_SHM / HVD_TPU_SHM_RING_BYTES; shm_agreed_ is the job-wide
+  // init-agreement verdict (every rank must request the same mode, and
+  // the topology must be two-level + non-elastic); shm_active_ is the
+  // post-rendezvous truth for THIS node's ring.  topo_shm_ mirrors it
+  // for lock-free TopologyInfo reads.
+  ShmMode shm_mode_ = ShmMode::kAuto;
+  int64_t shm_ring_bytes_ = 1 << 20;
+  bool shm_agreed_ = false;
+  bool shm_active_ = false;
+  std::atomic<bool> topo_shm_{false};
+  ShmSegment shm_seg_;
+  Channel left_ch_, right_ch_;              // flat/global ring
+  Channel local_left_ch_, local_right_ch_;  // node-local ring (shm-capable)
+  Channel cross_left_ch_, cross_right_ch_;  // cross-node shard ring
+
   // Data-plane heartbeat detector state.  The beat fds ride the data
   // listener (typed hello kind 6) to this rank's ring neighbours: rank r
   // dials (r+1)%size (beat_out_fd_) and accepts (r-1+size)%size
@@ -908,6 +935,11 @@ class Engine {
   // local-abort escalation (the engine is then parked in a parent wait
   // that must break before it can surface the typed verdict).
   std::vector<int> hb_wake_fds_;
+  // Shm analogue of the wake registry: when the node segment is armed
+  // the monitor also closes its rings (CloseRings) so a survivor blocked
+  // in a shm drive loop wakes as fast as one blocked in a socket.
+  // Cleared (under hb_mu_) before the segment is unmapped.
+  ShmSegment* hb_wake_shm_ = nullptr;
   int hb_ctrl_wake_fd_ = -1;
   std::string hb_local_abort_msg_;
   std::atomic<bool> hb_local_abort_{false};

@@ -28,6 +28,7 @@ const char* FlightEventName(uint8_t event) {
     case FL_STEADY:    return "steady";
     case FL_HEARTBEAT_MISS: return "heartbeat_miss";
     case FL_ANOMALY:   return "anomaly";
+    case FL_TRANSPORT: return "transport";
     default:           return "unknown";
   }
 }

@@ -55,6 +55,8 @@ enum FlightEventType : uint8_t {
                     // "cache_degraded" / "slow_phase(phase)"; arg: the
                     // verdict-kind index) — the postmortem record that
                     // says WHERE the job was slow before it died
+  FL_TRANSPORT = 15,  // shared-memory transport armed for the node-local
+                      // ring (name: "shm"; arg: per-direction ring bytes)
 };
 
 const char* FlightEventName(uint8_t event);

@@ -9,6 +9,7 @@
 #include <poll.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -73,6 +74,7 @@ struct FaultClause {
   double delay_ms = 0.0, jitter_ms = 0.0;
   double flaky = 0.0;              // per-send chopped-write probability
   double after_sec = 0.0;          // clause arms this long after Init
+  std::string text;                // source clause (typed-error messages)
 };
 
 struct FaultFd {
@@ -84,6 +86,9 @@ struct FaultFd {
 std::mutex g_fault_mu;
 std::vector<FaultClause> g_fault_clauses;
 std::unordered_map<int, FaultFd> g_fault_fds;
+// Peer-rank-keyed clause state for the shm seam (rings have no fd);
+// lazily resolved, reset by NetFaultInit like the fd registry.
+std::unordered_map<int, FaultFd> g_fault_peers;
 int g_fault_rank = -1;
 uint32_t g_fault_seed = 0;
 double g_fault_t0 = 0.0;
@@ -107,6 +112,14 @@ struct LinkStats {
   long long rtt_last_us = -1;
   double rtt_ewma_us = 0.0;
   long long rtt_samples = 0;
+  // Shm-hop counters (docs/metrics.md#links): ring-handoff bytes and
+  // the segment-handoff latency histogram, same bucket bounds as the
+  // timed-send histogram so one `le` label set serves both.
+  long long shm_bytes_out = 0, shm_bytes_in = 0;
+  long long shm_handoffs = 0;
+  long long shm_us_sum = 0;
+  long long shm_us_count = 0;
+  long long shm_us_buckets[10] = {0};
 };
 
 std::map<int, LinkStats> g_link_stats;  // guarded by g_fault_mu
@@ -303,6 +316,7 @@ bool NetFaultInit(const std::string& spec, int my_rank, std::string* err) {
         g_fault_armed.store(false);
         return false;
       }
+      c.text = body;  // full clause incl. @after, for typed messages
       g_fault_clauses.push_back(std::move(c));
     }
     if (semi == std::string::npos) break;
@@ -311,6 +325,7 @@ bool NetFaultInit(const std::string& spec, int my_rank, std::string* err) {
   // Re-resolve fds registered before a re-init against the fresh table.
   for (auto& kv : g_fault_fds)
     kv.second.clause = ResolveClause(g_fault_rank, kv.second.peer);
+  g_fault_peers.clear();
   g_fault_armed.store(!g_fault_clauses.empty());
   return true;
 }
@@ -382,6 +397,50 @@ size_t NetFaultChop(int fd) {
   // paths, short enough that training completes (degradation, not fault).
   std::this_thread::sleep_for(std::chrono::microseconds(200));
   return chop;
+}
+
+int NetFaultQueryLink(int rank_a, int rank_b, std::string* text) {
+  if (!NetFaultActive()) return 0;
+  std::lock_guard<std::mutex> lk(g_fault_mu);
+  int verdict = 0;
+  for (const FaultClause& c : g_fault_clauses) {
+    if (!ClauseMatches(c, rank_a, rank_b)) continue;
+    // Arming time is irrelevant here: an @after clause that will fire
+    // mid-run must shape the transport choice made at init.
+    const int v = (c.drop || c.flaky > 0) ? 2 : 1;
+    if (v > verdict) {
+      verdict = v;
+      if (text != nullptr) *text = c.text;
+    }
+  }
+  return verdict;
+}
+
+void NetFaultDelayPeer(int peer_rank) {
+  if (!NetFaultActive() || peer_rank < 0) return;
+  double sleep_ms = 0.0;
+  {
+    std::lock_guard<std::mutex> lk(g_fault_mu);
+    auto it = g_fault_peers.find(peer_rank);
+    if (it == g_fault_peers.end()) {
+      FaultFd f;
+      f.peer = peer_rank;
+      f.clause = ResolveClause(g_fault_rank, peer_rank);
+      const int lo = std::min(g_fault_rank, peer_rank);
+      const int hi = std::max(g_fault_rank, peer_rank);
+      // Distinct stream from the fd-keyed registry (^2u vs ^1u) so the
+      // shm jitter draw order never aliases a TCP lane's.
+      f.rng = g_fault_seed ^ (static_cast<uint32_t>(lo) * 2654435761u) ^
+              (static_cast<uint32_t>(hi) * 40503u) ^ 2u;
+      it = g_fault_peers.emplace(peer_rank, f).first;
+    }
+    if (it->second.clause < 0) return;
+    const FaultClause& c = g_fault_clauses[it->second.clause];
+    if (c.delay_ms <= 0 || !ClauseArmed(c)) return;
+    sleep_ms = c.delay_ms + c.jitter_ms * NextRand01(&it->second.rng);
+  }
+  std::this_thread::sleep_for(
+      std::chrono::duration<double, std::milli>(sleep_ms));
 }
 
 bool ParseEndpoint(const std::string& ep, std::string* host, int* port) {
@@ -553,6 +612,67 @@ bool SendAll(int fd, const void* buf, size_t len) {
   return true;
 }
 
+bool SendVec(int fd, const struct iovec* iov_in, int iovcnt) {
+  size_t total = 0;
+  for (int i = 0; i < iovcnt; ++i) total += iov_in[i].iov_len;
+  if (total == 0) return true;
+  const bool track = NetLinkEnabled();
+  const long long t0 = track ? LinkNowUs() : 0;
+  long long stalls = 0, shorts = 0;
+  size_t first_cap = 0;
+  if (NetFaultActive()) {
+    if (NetFaultDrops(fd)) return true;  // blackhole, like SendAll
+    NetFaultDelay(fd);
+    first_cap = NetFaultChop(fd);
+  }
+  std::vector<struct iovec> iov(iov_in, iov_in + iovcnt);
+  size_t idx = 0, left = total;
+  while (left > 0) {
+    while (idx < iov.size() && iov[idx].iov_len == 0) ++idx;
+    ssize_t n;
+    size_t asked;
+    if (first_cap > 0) {
+      // Chopped first write: emulate the flaky clause on the leading
+      // iovec only; the loop below finishes the remainder gathered.
+      asked = std::min(first_cap, iov[idx].iov_len);
+      first_cap = 0;
+      n = send(fd, iov[idx].iov_base, asked, MSG_NOSIGNAL);
+    } else {
+      asked = left;
+      struct msghdr msg;
+      memset(&msg, 0, sizeof(msg));
+      msg.msg_iov = &iov[idx];
+      msg.msg_iovlen = iov.size() - idx;
+      n = sendmsg(fd, &msg, MSG_NOSIGNAL);
+    }
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR || errno == EAGAIN)) {
+        if (errno == EAGAIN) ++stalls;
+        continue;
+      }
+      return false;
+    }
+    if (static_cast<size_t>(n) < asked) ++shorts;
+    left -= static_cast<size_t>(n);
+    size_t adv = static_cast<size_t>(n);
+    while (adv > 0 && idx < iov.size()) {
+      if (adv >= iov[idx].iov_len) {
+        adv -= iov[idx].iov_len;
+        iov[idx].iov_len = 0;
+        ++idx;
+      } else {
+        iov[idx].iov_base = static_cast<char*>(iov[idx].iov_base) + adv;
+        iov[idx].iov_len -= adv;
+        adv = 0;
+      }
+    }
+  }
+  if (track)
+    LinkRecord(fd, static_cast<long long>(total), 0, stalls, shorts,
+               LinkNowUs() - t0);
+  return true;
+}
+
 bool RecvAll(int fd, void* buf, size_t len) {
   const size_t total = len;
   char* p = static_cast<char*>(buf);
@@ -599,8 +719,15 @@ bool SendFrame(int fd, const std::vector<uint8_t>& payload) {
   uint8_t hdr[4] = {static_cast<uint8_t>(len), static_cast<uint8_t>(len >> 8),
                     static_cast<uint8_t>(len >> 16),
                     static_cast<uint8_t>(len >> 24)};
-  if (!SendAll(fd, hdr, 4)) return false;
-  return payload.empty() || SendAll(fd, payload.data(), payload.size());
+  // One gathered sendmsg instead of two sends: the 4-byte header and the
+  // payload leave straight from their own buffers in a single syscall —
+  // no stage copy and no header-only segment on the wire.
+  struct iovec iov[2];
+  iov[0].iov_base = hdr;
+  iov[0].iov_len = sizeof(hdr);
+  iov[1].iov_base = const_cast<uint8_t*>(payload.data());
+  iov[1].iov_len = payload.size();
+  return SendVec(fd, iov, payload.empty() ? 1 : 2);
 }
 
 bool RecvFrame(int fd, std::vector<uint8_t>* payload) {
@@ -815,6 +942,21 @@ bool NetLinkEnabled() {
   return g_link_enabled.load(std::memory_order_relaxed);
 }
 
+void NetLinkRecordShm(int peer_rank, long long bytes_out, long long bytes_in,
+                      long long handoff_us) {
+  if (peer_rank < 0 || !NetLinkEnabled()) return;
+  std::lock_guard<std::mutex> lk(g_fault_mu);
+  LinkStats& s = g_link_stats[peer_rank];
+  s.shm_bytes_out += bytes_out;
+  s.shm_bytes_in += bytes_in;
+  if (bytes_out > 0) ++s.shm_handoffs;
+  if (handoff_us >= 0) {
+    s.shm_us_sum += handoff_us;
+    ++s.shm_us_count;
+    ++s.shm_us_buckets[LinkBucket(handoff_us)];
+  }
+}
+
 void NetLinkRecordRtt(int peer_rank, long long rtt_us) {
   if (peer_rank < 0 || rtt_us < 0 || !NetLinkEnabled()) return;
   std::lock_guard<std::mutex> lk(g_fault_mu);
@@ -866,6 +1008,20 @@ std::string NetLinkInfo() {
     out += ":" + std::to_string(s.rtt_last_us) + ":" +
            std::to_string(static_cast<long long>(s.rtt_ewma_us + 0.5)) +
            ":" + std::to_string(s.rtt_samples);
+    out += ":" + std::to_string(s.shm_bytes_out) + ":" +
+           std::to_string(s.shm_bytes_in) + ":" +
+           std::to_string(s.shm_handoffs) + ":" +
+           std::to_string(s.shm_us_sum) + ":" +
+           std::to_string(s.shm_us_count) + ":";
+    for (int i = 0; i < kNetLinkBuckets; ++i) {
+      if (i) out += ',';
+      out += std::to_string(s.shm_us_buckets[i]);
+    }
+    // The data-plane label: ring handoffs mean this peer's collective
+    // hops ride shm (the TCP bytes that remain are rendezvous/heartbeat
+    // control traffic, which always stays on the socket).
+    out += std::string(":") +
+           (s.shm_bytes_out + s.shm_bytes_in > 0 ? "shm" : "tcp");
   }
   return out;
 }

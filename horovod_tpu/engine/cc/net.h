@@ -11,6 +11,8 @@
 #include <string>
 #include <vector>
 
+struct iovec;  // <sys/uio.h>; kept out of this header on purpose
+
 namespace hvdtpu {
 
 // Parse "host:port".  Returns false on malformed input.
@@ -30,6 +32,13 @@ int ConnectRetry(const std::string& host, int port, double timeout_sec,
 // Blocking full-buffer send/recv.  Return false on error/EOF.
 bool SendAll(int fd, const void* buf, size_t len);
 bool RecvAll(int fd, void* buf, size_t len);
+
+// Scatter-gather blocking send: the iovec array goes out in one
+// sendmsg(2) per kernel acceptance (header + payload straight from
+// their source buffers, no stage copy), with the same fault hooks and
+// timed-send telemetry as SendAll.  The iovecs are copied internally;
+// the caller's array is never mutated.
+bool SendVec(int fd, const struct iovec* iov, int iovcnt);
 
 // Wait until fd is readable (or in error/EOF, which a subsequent recv will
 // surface).  False on timeout — the liveness probe for the coordinator's
@@ -110,6 +119,20 @@ void NetFaultDelay(int fd);
 // send.  Deterministic per (spec, rank, link, send index).
 size_t NetFaultChop(int fd);
 
+// Shm-seam interrogation (Engine::SetupShmTransport): the strongest
+// clause naming the rank_a<->rank_b link, independent of @after arming
+// (a clause that would arm later still decides transport choice at
+// init).  Returns 0 = no clause, 1 = delay/jitter only (appliable at
+// the shm seam), 2 = drop/flaky/partition (shm cannot express it — the
+// caller must fall back to TCP or fail init with a typed error).  *text
+// gets the deciding clause's source text for those messages.
+int NetFaultQueryLink(int rank_a, int rank_b, std::string* text);
+
+// Apply a delay/jitter clause keyed by PEER RANK rather than fd — the
+// shm transport's per-handoff hook (rings have no fd).  Deterministic
+// jitter stream per (spec, link), independent of the fd-keyed stream.
+void NetFaultDelayPeer(int peer_rank);
+
 // shutdown(2) both directions WITHOUT closing: any thread blocked in
 // poll/send/recv on the fd wakes with an error immediately, and the fd
 // number stays allocated — no close-vs-concurrent-use reuse race.  The
@@ -137,8 +160,19 @@ bool NetLinkEnabled();
 // Serialized per-peer snapshot for the c_api:
 //   "enabled|peer:bytes_out:bytes_in:sends:recvs:stalls:short_writes:
 //    send_us_sum:send_us_count:b0,b1,...,b9:rtt_last_us:rtt_ewma_us:
-//    rtt_samples;peer:..." (peers sorted; empty list when nothing flowed).
+//    rtt_samples:shm_bytes_out:shm_bytes_in:shm_handoffs:shm_us_sum:
+//    shm_us_count:s0,...,s9:transport;peer:..." (peers sorted; empty
+// list when nothing flowed).  `transport` labels what carries this
+// peer's data-plane bytes: "shm" once any ring handoff flowed (the
+// remaining TCP bytes are rendezvous/heartbeat control), else "tcp".
 std::string NetLinkInfo();
+
+// Fold one shm-ring handoff into peer's stats: bytes in each direction
+// plus — when handoff_us >= 0 — one segment-handoff latency histogram
+// sample (time for a send leg to fully enter the peer's ring,
+// including injected chaos delay, mirroring the SendAll clock).
+void NetLinkRecordShm(int peer_rank, long long bytes_out, long long bytes_in,
+                      long long handoff_us);
 
 // Histogram bucket upper bounds (µs); the last bucket is +inf.  Exposed
 // so the Python registry renders `le` labels that match the C++ counts.

@@ -89,17 +89,71 @@ def _postmortem_lines(directory: str, first_rank: int) -> List[str]:
                     f"with tools/postmortem_dump.py {directory})"
                     if len(all_dumps) > 1 else ""))
     diagnosis = None
+    transport = None
     for candidate in ([path] + [p for p in all_dumps if p != path]):
         try:
             with open(candidate) as f:
-                diagnosis = json.load(f).get("diagnosis")
+                doc = json.load(f)
         except (OSError, ValueError):
             continue
-        if diagnosis:
+        if transport is None:
+            transport = doc.get("transport")
+        if diagnosis is None:
+            diagnosis = doc.get("diagnosis")
+        if diagnosis and transport:
             break
     if diagnosis:
         lines.append(f"cross-rank diagnosis: {diagnosis}")
+    # Which data-plane transport each link ran on when the rank died
+    # (docs/performance.md#transport): a fault on a same-host link behaves
+    # differently over shm rings than over TCP sockets, so the report
+    # names the active path per peer up front.
+    if transport:
+        peers = transport.get("peers") or {}
+        peer_part = ("  peers: " + "  ".join(
+            f"{p}={peers[p]}" for p in sorted(
+                peers, key=lambda x: int(x) if x.isdigit() else 0))
+            if peers else "")
+        lines.append(f"transport: local hops on "
+                     f"{transport.get('local', 'tcp')}{peer_part}")
     return lines
+
+
+def _shm_job_prefix(coord: str) -> str:
+    """FNV-1a-32 of the coordinator endpoint, matching the engine's
+    ``ShmSegmentName`` (engine/cc/transport.cc): every shared-memory
+    segment a job keyed on this coordinator can create is named
+    ``hvdtpu_<hash>_n<node>_e<epoch>`` under /dev/shm."""
+    h = 2166136261
+    for b in coord.encode():
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return f"hvdtpu_{h:08x}_"
+
+
+def sweep_shm_segments(coord: str) -> List[str]:
+    """Unlink every /dev/shm segment left by the job keyed on ``coord``;
+    returns the names removed.  The engine unlinks its own segment the
+    moment all local ranks have attached, and again on every typed-death
+    path, so residue is only possible when a rank dies inside the narrow
+    create-to-attach window (e.g. SIGKILL from an injected crash).  The
+    launcher sweeps after every attempt — success included, where it is a
+    no-op — so even that window cannot leak across a --max-restarts
+    relaunch or past job exit.  Local filesystem only: remote (ssh) ranks
+    rely on the engine's own unlink paths."""
+    removed: List[str] = []
+    prefix = _shm_job_prefix(coord)
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return removed
+    for name in names:
+        if name.startswith(prefix):
+            try:
+                os.unlink(os.path.join("/dev/shm", name))
+                removed.append(name)
+            except OSError:
+                pass
+    return removed
 
 
 def make_rank_env(rank: int, size: int, coord: str, data: Sequence[str],
@@ -256,7 +310,12 @@ def run_command(cmd: Sequence[str], np: int,
         # failure report).  Capture: communicate() drains it as before.
         tees.append(None if capture else _StderrTee(p.stderr))
         procs.append(p)
-    return _wait_all(cmd, procs, timeout, tees)
+    try:
+        return _wait_all(cmd, procs, timeout, tees)
+    finally:
+        # Typed aborts, injected crashes, timeouts, clean exits alike:
+        # no attempt may strand a /dev/shm segment (see sweep docstring).
+        sweep_shm_segments(coord)
 
 
 def run_hosts(cmd: Sequence[str], np: int, hosts_spec: str,
@@ -301,7 +360,13 @@ def run_hosts(cmd: Sequence[str], np: int, hosts_spec: str,
             text=True, start_new_session=True)
         tees.append(None if capture else _StderrTee(proc.stderr))
         procs.append(proc)
-    return _wait_all(cmd, procs, timeout, tees)
+    try:
+        return _wait_all(cmd, procs, timeout, tees)
+    finally:
+        # Local ranks' segments only; remote hosts clean their own via
+        # the engine's unlink-on-death paths.
+        sweep_shm_segments(placements[0].env.get("HVD_TPU_COORD", "")
+                           if placements else "")
 
 
 def _kill_rank(p) -> None:
@@ -703,12 +768,14 @@ def run_membership(cmd: Sequence[str], np: int,
         for p in procs:
             if p.poll() is None:
                 _kill_rank(p)
+        sweep_shm_segments(coord)
         raise
     if fatal:
         for p in procs:
             if p.poll() is None:
                 _kill_rank(p)
     results = _collect_results(procs, tees)
+    sweep_shm_segments(coord)
     # Flag the CHRONOLOGICALLY first death for the failure report — the
     # lowest-index nonzero exit is often the launcher's own fatal-path
     # kill cascade, not the root cause.  (Success itself is judged by

@@ -204,6 +204,10 @@ print("MEMBER", hvd.rank(), hvd.size(), m["epoch"], m["size"],
 """
 
 
+@pytest.mark.slow  # ~22s; heartbeat freeze detection stays tier-1 in
+# test_nonelastic_freeze_detected_in_heartbeat_time, the steady-state
+# revoke+reshape path in test_control_plane's crash-mid-steady test,
+# and the freeze-eviction transition is model-checked (hvdmodel quick)
 def test_freeze_mid_steady_evicts_and_survivors_match(tmp_path):
     """ISSUE 17 acceptance: 4 ranks deep in steady state (no control
     frames at all), rank 2 SIGSTOPs.  The heartbeat monitors on its beat

@@ -423,6 +423,7 @@ def test_topology_section_is_ungated():
     assert snap["topology"] == {
         "hierarchical": False, "nodes": 1, "local_size": 1,
         "cross_algo_threshold": 0,
+        "local_transport": "tcp",
         "cross_ops": {"ring": 0, "tree": 0},
         "bytes": {"local": 0, "cross": 0},
     }

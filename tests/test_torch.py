@@ -66,6 +66,9 @@ def test_torch_async_poll_synchronize():
             sum(i + j for j in range(n)))))
 
 
+@pytest.mark.slow  # ~11s; the torch allgather binding stays tier-1 in
+# test_torch_allgather_grad, and ragged-dim0 gather semantics in the
+# engine suite (test_ops/test_basics allgather cases)
 @distributed_test()
 def test_torch_allgather_variable_dim0():
     import torch

@@ -1,0 +1,340 @@
+"""Transport-seam tests (docs/performance.md#transport).
+
+The pluggable data-plane transport under test: the node-local hops of the
+two-level allreduce run over mmap'd shared-memory segment rings
+(HVD_TPU_SHM), with TCP as the always-available fallback behind the same
+Channel seam.  Covered here:
+
+* kill-switch bit-identity: HVD_TPU_SHM=0 and the armed shm path produce
+  bit-identical results (compression off), with the transport label,
+  link telemetry, and flight event proving which path ran;
+* segment lifecycle: zero /dev/shm residue after clean shutdown, after
+  an injected rank crash, across a --max-restarts relaunch (which
+  re-arms shm under the new restart epoch), and under elastic membership
+  (which keeps the flat ring, so shm never arms);
+* typed configuration errors: job-wide HVD_TPU_SHM agreement mismatch,
+  HVD_TPU_SHM=force on a flat topology, and force vs a chaos clause the
+  shm seam cannot express (drop/flaky on a same-host link) — never
+  silently ignored; auto demotes the node to TCP instead;
+* the launcher's /dev/shm sweep helper (FNV-keyed by coordinator
+  endpoint, matching the engine's ShmSegmentName).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from distributed import distributed_test  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _hier_env(local_size, **extra):
+    """Re-shape this rank's env into `local_size`-sized nodes and enable
+    the two-level allreduce, before hvd.init() reads it."""
+    rank = int(os.environ["HVD_TPU_RANK"])
+    os.environ["HVD_TPU_LOCAL_SIZE"] = str(local_size)
+    os.environ["HVD_TPU_LOCAL_RANK"] = str(rank % local_size)
+    os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    for k, v in extra.items():
+        os.environ[k] = v
+
+
+def _env(**overrides):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.setdefault("HVD_TPU_KILL_GRACE_SEC", "3")
+    env.update({k: str(v) for k, v in overrides.items()})
+    for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_COORD",
+                "HVD_TPU_DATA", "HVD_TPU_FAULT_SPEC",
+                "HVD_TPU_NET_FAULT_SPEC", "HVD_TPU_RESTART_EPOCH",
+                "HVD_TPU_SHM", "HVD_TPU_SHM_RING_BYTES"):
+        env.setdefault(var, "")
+        if not env[var]:
+            env.pop(var, None)
+    return env
+
+
+def _shm_residue():
+    return glob.glob("/dev/shm/hvdtpu_*")
+
+
+# ---------------------------------------------------------------------------
+# Launcher sweep helper (pure, in-process).
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_shm_segments_unit(tmp_path):
+    """The launcher's /dev/shm sweep removes exactly the segments keyed
+    on the given coordinator endpoint (the FNV-1a-32 prefix the engine's
+    ShmSegmentName uses) and leaves every other entry alone."""
+    from horovod_tpu.runner.launch import _shm_job_prefix, sweep_shm_segments
+
+    coord = "127.0.0.1:45991"
+    prefix = _shm_job_prefix(coord)
+    assert prefix.startswith("hvdtpu_") and len(prefix) == len("hvdtpu_") + 9
+    assert prefix == _shm_job_prefix(coord)  # deterministic
+    assert prefix != _shm_job_prefix("127.0.0.1:45992")
+    mine = os.path.join("/dev/shm", prefix + "n0_e0")
+    other = os.path.join("/dev/shm", "hvdtpu_deadbeef_n0_e0")
+    for p in (mine, other):
+        with open(p, "w") as f:
+            f.write("x")
+    try:
+        removed = sweep_shm_segments(coord)
+        assert os.path.basename(mine) in removed, removed
+        assert not os.path.exists(mine)
+        assert os.path.exists(other)  # another job's segment is not ours
+    finally:
+        for p in (mine, other):
+            if os.path.exists(p):
+                os.unlink(p)
+
+
+# ---------------------------------------------------------------------------
+# Kill-switch bit-identity + telemetry (the acceptance bar).
+# ---------------------------------------------------------------------------
+
+
+@distributed_test(np_=4, timeout=240.0)
+def test_shm_bit_identical_to_tcp_with_telemetry():
+    """Two 2-rank nodes: the shm run (HVD_TPU_SHM=force, so a silent TCP
+    demotion cannot fake the pass) must bit-compare equal to the
+    HVD_TPU_SHM=0 kill-switch run with compression off, while the
+    topology label, per-peer link telemetry, and flight event prove the
+    rings actually carried the local hops — and the unlink-at-arm
+    discipline leaves /dev/shm clean even while the job is running."""
+    import horovod_tpu as hvd
+
+    def run_suite(tag):
+        outs = []
+        for i in range(10):
+            x = ((np.arange(96 + 11 * i) % 97) + hvd.rank() + i).astype(
+                np.float32)
+            outs.append(hvd.allreduce(
+                x, average=(i % 2 == 1), name=f"{tag}.mix.{i}"))
+        big = (np.arange(1 << 17) % 241 + hvd.rank()).astype(np.float32)
+        outs.append(hvd.allreduce(big, average=False, name=f"{tag}.big"))
+        return outs
+
+    _hier_env(local_size=2, HVD_TPU_SHM="force")
+    hvd.init()
+    rank = hvd.rank()
+    shm_out = run_suite("shm")
+    # The segment is unlinked before the rings arm: residue-free even
+    # mid-run, not just after teardown.
+    assert not _shm_residue(), _shm_residue()
+    snap = hvd.metrics_snapshot()
+    assert snap["topology"]["local_transport"] == "shm", snap["topology"]
+    peers = snap["links"]["peers"]
+    local_peer = str(rank + 1 if rank % 2 == 0 else rank - 1)
+    lp = peers[local_peer]
+    assert lp["transport"] == "shm", peers
+    assert lp["shm_bytes_out"] > 0 and lp["shm_bytes_in"] > 0, lp
+    assert lp["shm_handoffs"] > 0 and lp["shm_us_count"] > 0, lp
+    assert sum(lp["shm_us_buckets"]) == lp["shm_us_count"], lp
+    cross_peer = str(rank + 2 if rank < 2 else rank - 2)
+    assert peers[cross_peer]["transport"] == "tcp", peers
+    from horovod_tpu.common import _load_lib
+
+    assert "|transport|shm|" in _load_lib().hvd_tpu_flight_dump().decode()
+    hvd.shutdown()
+
+    _hier_env(local_size=2, HVD_TPU_SHM="0")
+    hvd.init()
+    snap = hvd.metrics_snapshot()
+    assert snap["topology"]["local_transport"] == "tcp", snap["topology"]
+    tcp_out = run_suite("shm")  # same names: fresh engine, fresh cache
+    for a, b in zip(shm_out, tcp_out):
+        assert np.array_equal(a, b), "shm vs TCP results differ bitwise"
+    hvd.shutdown()
+    assert not _shm_residue(), _shm_residue()
+
+
+# ---------------------------------------------------------------------------
+# Typed configuration errors (never a silent split or silent demote).
+# ---------------------------------------------------------------------------
+
+
+@distributed_test(np_=2, timeout=120.0)
+def test_shm_agreement_mismatch_typed_error():
+    """The transport choice is init job-wide agreement state, like the
+    compression mode: ranks configured with different HVD_TPU_SHM modes
+    must fail init with a typed error on EVERY rank, not run a job half
+    on rings and half on sockets."""
+    import horovod_tpu as hvd
+
+    os.environ["HVD_TPU_SHM"] = (
+        "auto" if int(os.environ["HVD_TPU_RANK"]) == 0 else "0")
+    with pytest.raises(Exception, match="HVD_TPU_SHM mismatch"):
+        hvd.init()
+
+
+@distributed_test(np_=2, timeout=120.0)
+def test_shm_force_on_flat_topology_typed_error():
+    """HVD_TPU_SHM=force without the two-level topology cannot arm and
+    must say so (auto would silently and correctly stay on TCP)."""
+    import horovod_tpu as hvd
+
+    os.environ["HVD_TPU_SHM"] = "force"
+    os.environ.pop("HOROVOD_HIERARCHICAL_ALLREDUCE", None)
+    with pytest.raises(Exception, match="HVD_TPU_SHM=force"):
+        hvd.init()
+
+
+@distributed_test(np_=2, timeout=120.0)
+def test_shm_force_chaos_drop_typed_error():
+    """A chaos clause injecting drop/flaky on a same-host link cannot be
+    expressed by a memory ring: with HVD_TPU_SHM=force, init fails with
+    a typed error naming the unsupported clause."""
+    import horovod_tpu as hvd
+
+    _hier_env(local_size=2, HVD_TPU_SHM="force",
+              HVD_TPU_NET_FAULT_SPEC="link=0-1:drop@after=100000")
+    with pytest.raises(Exception) as exc:
+        hvd.init()
+    msg = str(exc.value)
+    assert "HVD_TPU_SHM=force" in msg and "link=0-1" in msg, msg
+    assert "drop" in msg, msg
+
+
+@distributed_test(np_=2, timeout=120.0)
+def test_shm_auto_demotes_on_chaos_drop():
+    """The same clause under HVD_TPU_SHM=auto demotes the node to TCP
+    (with a warning — never silently ignored) and the job runs correctly
+    over the sockets the clause can actually shape."""
+    import horovod_tpu as hvd
+
+    # @after high enough that the drop itself never fires in this test;
+    # the clause still decides the transport at init.
+    _hier_env(local_size=2, HVD_TPU_SHM="auto",
+              HVD_TPU_NET_FAULT_SPEC="link=0-1:drop@after=100000")
+    hvd.init()
+    assert hvd.metrics_snapshot()["topology"]["local_transport"] == "tcp"
+    out = hvd.allreduce(np.ones(64, np.float32), average=False, name="d.0")
+    assert np.array_equal(out, np.full(64, float(hvd.size()), np.float32))
+    hvd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Segment lifecycle across deaths, relaunches, and reshapes.
+# ---------------------------------------------------------------------------
+
+
+def test_no_shm_residue_after_injected_crash():
+    """The acceptance criterion: a rank SIGKILLed mid-run (fault spec
+    crash) on an armed-shm job leaves ZERO /dev/shm residue — the
+    segment was unlinked at arm time, the heartbeat monitor closes the
+    rings so survivors fail typed instead of spinning, and the launcher
+    sweep covers even the create-to-attach window."""
+    from horovod_tpu.common.faults import CRASH_EXIT_CODE
+    from horovod_tpu.runner import run_command
+
+    code = (
+        "import os, numpy as np\n"
+        "os.environ['HOROVOD_HIERARCHICAL_ALLREDUCE'] = '1'\n"
+        "os.environ['HVD_TPU_SHM'] = 'force'\n"
+        "import horovod_tpu as hvd\n"
+        "from horovod_tpu.common import RanksDownError\n"
+        "hvd.init()\n"
+        "assert hvd.metrics_snapshot()['topology']['local_transport'] "
+        "== 'shm'\n"
+        "try:\n"
+        "    for i in range(8):\n"
+        "        hvd.allreduce(np.ones(4096, np.float32), name=f's.{i}')\n"
+        "    raise SystemExit(9)  # survivors must NOT complete\n"
+        "except RanksDownError:\n"
+        "    raise SystemExit(0)\n"
+    )
+    results = run_command(
+        [sys.executable, "-c", code], 4,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=1:crash@op=3",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20"),
+        timeout=90.0, capture=True)
+    by_rank = {r.rank: r for r in results}
+    assert by_rank[1].returncode == CRASH_EXIT_CODE, by_rank[1]
+    for r in (0, 2, 3):
+        assert by_rank[r].returncode == 0, \
+            (r, by_rank[r].returncode, by_rank[r].stderr[-800:])
+    assert not _shm_residue(), _shm_residue()
+
+
+def test_max_restarts_relaunch_rebuilds_shm(tmp_path):
+    """A --max-restarts relaunch must re-arm the shm transport under the
+    new restart epoch's segment name (stale generations can never be
+    attached) and still leave /dev/shm clean."""
+    from horovod_tpu.runner import run_elastic
+
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os\n"
+        "os.environ['HOROVOD_HIERARCHICAL_ALLREDUCE'] = '1'\n"
+        "os.environ['HVD_TPU_SHM'] = 'force'\n"
+        "import numpy as np\n"
+        "import horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "for i in range(8):\n"
+        "    hvd.allreduce(np.ones(256, np.float32), name=f's.{i}')\n"
+        "print('TRANSPORT', hvd.restart_epoch(),\n"
+        "      hvd.metrics_snapshot()['topology']['local_transport'],\n"
+        "      flush=True)\n"
+        "hvd.shutdown()\n")
+    results, restarts = run_elastic(
+        [sys.executable, str(script)], 4, max_restarts=1,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=1:crash@op=5",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20"),
+        timeout=120.0, capture=True, report=lambda msg: None)
+    assert restarts == 1
+    assert all(r.returncode == 0 for r in results), \
+        [(r.rank, r.returncode, r.stderr[-400:]) for r in results]
+    for r in results:
+        assert "TRANSPORT 1 shm" in r.stdout, (r.rank, r.stdout)
+    assert not _shm_residue(), _shm_residue()
+
+
+def test_elastic_forces_tcp_and_shrinks_clean(tmp_path):
+    """Elastic membership keeps the flat ring, so HVD_TPU_SHM=auto never
+    arms the rings there: a 4->3 shrink completes on TCP with zero
+    /dev/shm residue (the reshape path has no segment to rebuild or
+    leak)."""
+    from horovod_tpu.common.faults import CRASH_EXIT_CODE
+    from horovod_tpu.runner import membership_succeeded, run_membership
+
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import numpy as np\n"
+        "import horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "state = hvd.ElasticState(weights=np.zeros(8, np.float32), step=0)\n"
+        "def train(state):\n"
+        "    while state.step < 20:\n"
+        "        s = state.step\n"
+        "        state.weights = state.weights + hvd.allreduce(\n"
+        "            np.ones(8, np.float32), average=True, name=f'g.{s}')\n"
+        "        state.step = s + 1\n"
+        "    return state.weights\n"
+        "w = hvd.run_elastic(train, state)\n"
+        "assert np.allclose(w, 20.0), (hvd.rank(), w)\n"
+        "assert hvd.metrics_snapshot()['topology']['local_transport'] "
+        "== 'tcp'\n")
+    results = run_membership(
+        [sys.executable, str(script)], 4, min_np=2, max_np=4,
+        max_rejoins=0,
+        env=_env(HVD_TPU_FAULT_SPEC="rank=2:crash@op=8",
+                 HVD_TPU_COLLECTIVE_TIMEOUT_SEC="20",
+                 HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                 HVD_TPU_SHM="auto"),
+        timeout=90.0, capture=True, report=lambda msg: None)
+    by_slot = {r.rank: r for r in results}
+    assert by_slot[2].returncode == CRASH_EXIT_CODE, by_slot[2]
+    for slot in (0, 1, 3):
+        assert by_slot[slot].returncode == 0, \
+            (slot, by_slot[slot].returncode, by_slot[slot].stderr[-800:])
+    assert membership_succeeded(results, 2)
+    assert not _shm_residue(), _shm_residue()

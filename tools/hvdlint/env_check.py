@@ -93,6 +93,11 @@ DOC_DEFAULTS: Dict[str, Tuple[str, str]] = {
     "HVD_TPU_STEADY_MAX_PERIOD": ("config", "steady_max_period"),
     "HVD_TPU_ANOMALY_SIGMA": ("config", "anomaly_sigma"),
     "HVD_TPU_ANOMALY_INTERVAL_MS": ("config", "anomaly_interval_ms"),
+    # Transport knobs (docs/performance.md#transport).  HVD_TPU_SHM's
+    # default is the string "auto" — the numeric comparison skips it, but
+    # the entry keeps the registry exhaustive.
+    "HVD_TPU_SHM": ("config", "shm"),
+    "HVD_TPU_SHM_RING_BYTES": ("config", "shm_ring_bytes"),
     "HVD_TPU_SERVE_PORT": ("serve", "port"),
     "HVD_TPU_SERVE_MAX_BATCH": ("serve", "max_batch"),
     "HVD_TPU_SERVE_PREFILL_CHUNK": ("serve", "prefill_chunk"),

@@ -35,6 +35,11 @@ WHITELIST = {
     # Lifecycle: single-threaded bring-up/teardown, no peers in flight.
     "Engine::Init": "bring-up before the background loop starts",
     "Engine::SetupSockets": "job-wide agreement exchange during bring-up",
+    "Engine::SetupShmTransport": "transport agreement (token relay) and "
+                                 "ring attach during bring-up",
+    "Engine::CloseTopologyFds": "coordinated two-level teardown; every "
+                                "rank demotes the topology on the same "
+                                "failed collective",
     "Engine::Shutdown": "teardown after the background loop exits",
     "Engine::BackgroundLoop": "exit drain after the loop stopped ticking",
     "Engine::AbortLocal": "abort latch; every rank aborts the same tick",
@@ -95,6 +100,12 @@ PROTECTED = (
     r"\+\+\s*(steady_pos_|steady_group_idx_|steady_epoch_)",
     r"\bsteady_exit_pending_\s*=[^=]",
     r"\b(steady_active_|steady_pattern_len_)\.(store|exchange)\s*\(",
+    # Data-plane transport choice (docs/performance.md#transport): armed
+    # only by the init job-wide agreement + token relay, torn down only on
+    # coordinated topology teardown — a rank-local flip would split the
+    # job between shm rings and TCP sockets mid-collective.
+    r"\b(shm_mode_|shm_agreed_|shm_active_)\s*=[^=]",
+    r"\btopo_shm_\.(store|exchange)\s*\(",
 )
 
 # Definitions start at column 0 (`bool Engine::ApplyReshape(...) {`);

@@ -5,8 +5,9 @@ Polls rank 0's monitor (HVD_TPU_MONITOR_PORT; hvdrun arms /cluster on
 rank 0 automatically) and renders one screen per interval: a per-rank
 table (liveness, membership epoch, stalls/aborts, cache hit rate,
 control-plane activity rate, serving occupancy), a per-link heat table
-merged across every rank's telemetry (worst-direction send latency,
-heartbeat-echo RTT, backpressure, bytes), and a scrolling feed of the
+merged across every rank's telemetry (transport in use, worst-direction
+send latency, heartbeat-echo RTT, shm handoff latency, backpressure,
+bytes), and a scrolling feed of the
 online anomaly detector's typed verdicts (docs/metrics.md#anomalies).
 
     python tools/hvdtop.py --port 9090                 # live view
@@ -79,13 +80,22 @@ def merge_links(ranks: dict) -> dict:
             key = f"{lo}-{hi}"
             agg = links.setdefault(key, {"send_mean_us": -1,
                                          "rtt_ewma_us": -1,
-                                         "stalls": 0, "bytes": 0})
+                                         "stalls": 0, "bytes": 0,
+                                         "transport": "tcp",
+                                         "shm_mean_us": -1})
             agg["send_mean_us"] = max(agg["send_mean_us"],
                                       v.get("send_mean_us", -1))
             agg["rtt_ewma_us"] = max(agg["rtt_ewma_us"],
                                      v.get("rtt_ewma_us", -1))
             agg["stalls"] += v.get("stalls", 0)
             agg["bytes"] += v.get("bytes", 0)
+            # A link is shm once either endpoint moved bytes through the
+            # rings; the handoff latency column shows the worst direction,
+            # same policy as send/rtt.
+            if v.get("transport") == "shm":
+                agg["transport"] = "shm"
+            agg["shm_mean_us"] = max(agg["shm_mean_us"],
+                                     v.get("shm_handoff_mean_us", -1))
     return links
 
 
@@ -132,8 +142,8 @@ def render(doc: dict, prev: dict, now: float, target: str) -> str:
     links = merge_links(ranks)
     if links:
         lines.append("")
-        lines.append(f"{'link':<8}{'send':>8}{'rtt':>8}{'stalls':>8}"
-                     f"{'bytes':>10}")
+        lines.append(f"{'link':<8}{'tpt':>5}{'send':>8}{'rtt':>8}"
+                     f"{'shm':>8}{'stalls':>8}{'bytes':>10}")
         slow = {e.get("subject") for e in
                 doc.get("anomalies", {}).get("recent", [])
                 if e.get("kind") == "slow_link"}
@@ -141,8 +151,10 @@ def render(doc: dict, prev: dict, now: float, target: str) -> str:
                                                 k.split("-")]):
             v = links[key]
             mark = "  << slow_link" if key in slow else ""
-            lines.append(f"{key:<8}{_fmt_us(v['send_mean_us']):>8}"
+            lines.append(f"{key:<8}{v.get('transport', 'tcp'):>5}"
+                         f"{_fmt_us(v['send_mean_us']):>8}"
                          f"{_fmt_us(v['rtt_ewma_us']):>8}"
+                         f"{_fmt_us(v.get('shm_mean_us', -1)):>8}"
                          f"{v['stalls']:>8}"
                          f"{_fmt_bytes(v['bytes']):>10}{mark}")
 

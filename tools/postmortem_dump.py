@@ -110,6 +110,15 @@ def render(dumps: Dict[int, dict], events: int = 12,
         if d.get("exception"):
             lines.append(f"    exception: {d['exception']['type']}: "
                          f"{d['exception']['message'][:200]}")
+        transport = d.get("transport") or {}
+        if transport:
+            peers = transport.get("peers") or {}
+            peer_part = ("  peers: " + "  ".join(
+                f"{p}={peers[p]}" for p in sorted(
+                    peers, key=lambda x: int(x) if x.isdigit() else 0))
+                if peers else "")
+            lines.append(f"    transport: local hops on "
+                         f"{transport.get('local', 'tcp')}{peer_part}")
         pending = d.get("pending", {}).get("local", [])
         if pending:
             lines.append("    in-flight collectives at death:")
