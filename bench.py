@@ -18,7 +18,7 @@ BENCH_EXTRA_CONFIGS="seq:batch,..." overrides the sweep.
 
 Env knobs: BENCH_MODEL (resnet101|resnet50|resnet18|vgg16|inception_v3|
 mnist|transformer|allreduce|small_allreduce|big_allreduce|hier_allreduce|
-negotiation_scale|serve_decode|checkpoint|scaling), BENCH_BATCH,
+negotiation_scale|serve_decode|checkpoint|scaling|pipeline), BENCH_BATCH,
 BENCH_STEPS, BENCH_WARMUP, BENCH_IMAGE (side
 length); transformer adds BENCH_SEQ/BENCH_VOCAB/BENCH_D_MODEL/BENCH_LAYERS/
 BENCH_HEADS; allreduce adds BENCH_NP/BENCH_BYTES/BENCH_ITERS;
@@ -29,7 +29,9 @@ BENCH_NP/BENCH_BYTES/BENCH_ITERS; negotiation_scale (the simulated-scale
 control-plane bench, docs/performance.md#control-plane-scaling) adds
 BENCH_SCALE_RANKS/BENCH_OPS/BENCH_WARM_CYCLES/BENCH_STEADY_CYCLES;
 serve_decode (the serving-plane continuous-batching bench,
-docs/inference.md) adds BENCH_NP/BENCH_REQUESTS.
+docs/inference.md) adds BENCH_NP/BENCH_REQUESTS; pipeline (the 1F1B
+pipeline-parallel sweep, docs/pipeline.md) adds BENCH_NP/BENCH_STAGES/
+BENCH_CHUNKS/BENCH_MICROBATCHES plus the transformer size knobs.
 """
 
 from __future__ import annotations
@@ -1193,6 +1195,140 @@ if hvd.rank() == 0:
     }))
 
 
+def bench_pipeline() -> None:
+    """Pipeline-parallel 1F1B training throughput over the engine's p2p
+    plane (docs/pipeline.md): a BENCH_STAGES x DP grid (world BENCH_NP)
+    trains the stage-partitioned transformer LM with BENCH_MICROBATCHES
+    micro-batches per step, activations crossing stage boundaries as
+    send/recv buckets and gradients DP-averaging inside each stage group.
+
+    Headline is end-to-end tokens/sec across the whole grid.  Extras
+    carry the schedule's bubble fraction (config-determined:
+    (S-1)/(S-1+M*V), informational), the per-stage p2p wire bytes for
+    the timed window (``_bytes`` extras gate lower-is-better in
+    tools/bench_compare.py), and the steady-state response-cache hit
+    rate measured AFTER the warmup steps (the >= 0.9 acceptance bar of
+    docs/pipeline.md#steady-state; a rate extra gates higher-is-better).
+    BENCH_CHUNKS > 1 switches to the interleaved schedule."""
+    import subprocess
+    import sys
+
+    np_ = int(os.environ.get("BENCH_NP", "4"))
+    stages = int(os.environ.get("BENCH_STAGES", "2"))
+    chunks = int(os.environ.get("BENCH_CHUNKS", "1"))
+    micro = int(os.environ.get("BENCH_MICROBATCHES", "4"))
+    steps = int(os.environ.get("BENCH_STEPS", "6"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "1"))
+    seq = int(os.environ.get("BENCH_SEQ", "32"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    d_model = int(os.environ.get("BENCH_D_MODEL", "64"))
+    n_layers = int(os.environ.get("BENCH_LAYERS", "4"))
+    n_heads = int(os.environ.get("BENCH_HEADS", "4"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "256"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = f"""
+import json, time, numpy as np
+import jax, jax.numpy as jnp, optax
+import horovod_tpu as hvd
+from horovod_tpu.jax.train import run_pipeline
+from horovod_tpu.models import TransformerLM, next_token_loss
+from horovod_tpu.parallel import (PipelineGrid, partition_params,
+                                  partition_transformer)
+hvd.init()
+S, V, M, B, SEQ = {stages}, {chunks}, {micro}, {batch}, {seq}
+grid = PipelineGrid(S, hvd.size(), hvd.rank())
+full = TransformerLM(
+    vocab_size={vocab}, d_model={d_model}, n_layers={n_layers},
+    n_heads={n_heads}, dtype=jnp.float32, use_flash=False).init(
+    jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32))["params"]
+modules = partition_transformer(
+    {vocab}, {d_model}, {n_layers}, {n_heads}, n_stages=S, n_chunks=V,
+    dtype=jnp.float32, use_flash=False)[grid.stage]
+params = partition_params(full, {n_layers}, S, n_chunks=V)[grid.stage]
+tokens = np.random.RandomState(grid.dp_index).randint(
+    0, {vocab}, (B, SEQ + 1)).astype(np.int32)
+inputs, targets = tokens[:, :-1], tokens[:, 1:]
+tx = optax.adamw(1e-3)
+batches = [(inputs, targets)]
+params, _, _ = run_pipeline(modules, params, tx, batches * {warmup},
+                            n_stages=S, n_microbatches=M,
+                            loss_fn=next_token_loss)
+snap0 = hvd.metrics_snapshot()
+t0 = time.perf_counter()
+params, _, losses = run_pipeline(modules, params, tx, batches * {steps},
+                                 n_stages=S, n_microbatches=M,
+                                 loss_fn=next_token_loss)
+dt = time.perf_counter() - t0
+snap1 = hvd.metrics_snapshot()
+p0, p1 = snap0["p2p"], snap1["p2p"]
+print("PIPE_RANK_JSON " + json.dumps({{
+    "rank": hvd.rank(), "stage": grid.stage,
+    "p2p_bytes_out": p1["bytes"]["out"] - p0["bytes"]["out"],
+    "p2p_bytes_in": p1["bytes"]["in"] - p0["bytes"]["in"],
+    "sends": p1["sends"] - p0["sends"],
+    "recvs": p1["recvs"] - p0["recvs"]}}), flush=True)
+if hvd.rank() == 0:
+    c0 = snap0["cache"]["engine"]
+    c1 = snap1["cache"]["engine"]
+    dh = c1["hits"] - c0["hits"]
+    dm = c1["misses"] - c0["misses"]
+    print("PIPE_JSON " + json.dumps({{
+        "tokens_per_sec": B * grid.dp * SEQ * {steps} / dt,
+        "steady_cache_hit_rate": round(dh / max(dh + dm, 1), 4),
+        "steady_cache_hits": dh, "steady_cache_misses": dm}}), flush=True)
+hvd.shutdown()
+"""
+    env = dict(os.environ,
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.setdefault("HVD_TPU_METRICS", "1")
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_), "--",
+         sys.executable, "-c", code],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+
+    def _scan(marker):
+        # Rank stdout merges without line discipline: two ranks' prints
+        # can land on one line, so find every marker and raw_decode from
+        # it rather than trusting startswith + whole-line json.loads.
+        dec = json.JSONDecoder()
+        for line in out.stdout.splitlines():
+            start = 0
+            while True:
+                idx = line.find(marker, start)
+                if idx < 0:
+                    break
+                obj, start = dec.raw_decode(line, idx + len(marker))
+                yield obj
+
+    head = next(_scan("PIPE_JSON "))
+    from horovod_tpu.parallel import bubble_fraction
+    extras = {
+        "bubble_fraction": round(bubble_fraction(stages, micro, chunks), 4),
+        "steady_cache_hit_rate": head["steady_cache_hit_rate"],
+        "steady_cache_hits": head["steady_cache_hits"],
+        "steady_cache_misses": head["steady_cache_misses"],
+    }
+    # Per-stage wire volume for the timed window: sum the stage's DP
+    # ranks so the extra is stable under BENCH_NP changes at fixed S.
+    per_stage = {}
+    for r in _scan("PIPE_RANK_JSON "):
+        agg = per_stage.setdefault(r["stage"], {"out": 0, "in": 0})
+        agg["out"] += r["p2p_bytes_out"]
+        agg["in"] += r["p2p_bytes_in"]
+    for stage, agg in sorted(per_stage.items()):
+        extras[f"stage{stage}_p2p_bytes_out"] = agg["out"]
+        extras[f"stage{stage}_p2p_bytes_in"] = agg["in"]
+    print(json.dumps({
+        "metric": (f"pipeline_train_tokens_per_sec_s{stages}"
+                   f"x{np_ // stages}dp"),
+        "value": round(head["tokens_per_sec"], 2),
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # the reference has no pipeline benchmark
+        "extra_metrics": extras,
+    }))
+
+
 def main() -> None:
     import jax
 
@@ -1225,6 +1361,8 @@ def main() -> None:
         return bench_serve_decode()
     if model_name == "checkpoint":
         return bench_checkpoint()
+    if model_name == "pipeline":
+        return bench_pipeline()
     if model_name == "scaling":
         return bench_scaling()
     batch = int(os.environ.get("BENCH_BATCH", "64"))

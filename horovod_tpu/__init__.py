@@ -26,6 +26,7 @@ from horovod_tpu.common import (  # noqa: F401
     HorovodNotInitializedError,
     MembershipChangedError,
     RanksDownError,
+    StageGroup,
     allgather,
     allgather_async,
     allreduce,
@@ -45,9 +46,14 @@ from horovod_tpu.common import (  # noqa: F401
     metrics_snapshot,
     mpi_threads_supported,
     rank,
+    recv,
+    recv_async,
     restart_epoch,
+    send,
+    send_async,
     shutdown,
     size,
+    stage_group,
     timeline_enabled,
     trace_marker,
     trace_span,

@@ -33,6 +33,12 @@ OP_BROADCAST = 2
 # path replays a verified cross-rank agreement through this op to keep
 # the global dispatch order without the metadata allreduce.
 OP_NOOP = 3
+# Point-to-point plane (docs/pipeline.md): negotiated pairwise transfers
+# for pipeline parallelism.  A send and its matching recv announce under
+# ONE wire name (``<name>.p2p.<src>-<dst>.t<tag>``) and execute when BOTH
+# sides are ready (paired readiness).
+OP_SEND = 4
+OP_RECV = 5
 
 # Status codes (engine/cc/wire.h StatusCode).
 ST_OK = 0
@@ -173,6 +179,18 @@ def _load_lib():
             ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int]
+        lib.hvd_tpu_enqueue_p2p.restype = ctypes.c_longlong
+        lib.hvd_tpu_enqueue_p2p.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int]
+        lib.hvd_tpu_enqueue_group.restype = ctypes.c_longlong
+        lib.hvd_tpu_enqueue_group.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+        lib.hvd_tpu_p2p_info.restype = ctypes.c_char_p
+        lib.hvd_tpu_p2p_info.argtypes = []
         lib.hvd_tpu_poll.restype = ctypes.c_int
         lib.hvd_tpu_poll.argtypes = [ctypes.c_longlong]
         lib.hvd_tpu_wait.restype = ctypes.c_int
@@ -1110,6 +1128,35 @@ def _sync_engine_autotune() -> None:
         metrics.registry.set_autotune(_autotune.report(_lib))
 
 
+def _sync_engine_p2p() -> None:
+    """Mirror the engine's point-to-point plane counters into the
+    registry's ungated ``"p2p"`` section (docs/pipeline.md
+    #observability): transfer/byte totals per direction, the matched
+    counter against the unmatched gauge, stage-group ops, and the open
+    dedicated-channel gauge.  A state copy — idempotent."""
+    if _lib is None:
+        return
+    with _stall_sync_lock:
+        info = _lib.hvd_tpu_p2p_info().decode()
+        parts = info.split("|")
+        if len(parts) != 8:
+            return
+        try:
+            (sends, recvs, bytes_out, bytes_in, matched, unmatched,
+             group_ops, channels) = (int(p) for p in parts)
+        except ValueError:
+            return
+        metrics.registry.set_p2p({
+            "sends": sends,
+            "recvs": recvs,
+            "bytes": {"out": bytes_out, "in": bytes_in},
+            "matched": matched,
+            "unmatched": unmatched,
+            "group_ops": group_ops,
+            "channels": channels,
+        })
+
+
 def metrics_snapshot() -> dict:
     """Plain nested dict of the collective metrics registry: op/byte
     counters per data plane, fusion-batch counters, latency/fill
@@ -1133,6 +1180,7 @@ def metrics_snapshot() -> dict:
     _sync_engine_liveness()
     _sync_engine_links()
     _sync_engine_anomalies()
+    _sync_engine_p2p()
     return metrics.registry.snapshot()
 
 
@@ -1511,7 +1559,8 @@ def _fault_hook(name: str) -> None:
 
 def allreduce_async(array: np.ndarray, average: bool = True,
                     name: Optional[str] = None,
-                    out: Optional[np.ndarray] = None) -> Handle:
+                    out: Optional[np.ndarray] = None,
+                    group: Optional["StageGroup"] = None) -> Handle:
     lib = _load_lib()
     _check_initialized(lib)
     array = _as_contig(array)
@@ -1519,6 +1568,27 @@ def allreduce_async(array: np.ndarray, average: bool = True,
         out = np.empty_like(array)
     else:
         _check_out(out, array)
+    if group is not None:
+        # Scoped collective (docs/pipeline.md#stage-groups): reduces only
+        # over the group's ranks — the data-parallel dimension inside one
+        # pipeline stage.  Always the engine path: the XLA plane compiles
+        # full-world collectives and knows nothing of membership subsets.
+        name = name or _auto_name("group_allreduce")
+        _fault_hook(name)
+        dims, ndim = _as_c_dims(array.shape)
+        members = (ctypes.c_longlong * len(group.ranks))(*group.ranks)
+        raw = lib.hvd_tpu_enqueue_group(
+            name.encode(),
+            array.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            dims, ndim, dtypes.numpy_to_code(array.dtype), int(average),
+            members, len(group.ranks))
+        if raw < 0:
+            raise HorovodInternalError("engine is shut down")
+        if metrics.registry.enabled:
+            metrics.registry.record_enqueue("engine", "allreduce",
+                                            array.nbytes)
+        return Handle(raw, OP_ALLREDUCE, array, out, name)
     name = name or _auto_name("allreduce")
     _fault_hook(name)
     if _plane_eligible(array):
@@ -1591,9 +1661,133 @@ def broadcast_async(array: np.ndarray, root_rank: int,
     return Handle(raw, OP_BROADCAST, array, out, name)
 
 
+class StageGroup:
+    """Immutable membership subset for scoped collectives
+    (docs/pipeline.md#stage-groups).  A pipeline job arranges its world
+    as a stages x data-parallel grid: collectives scoped to one group
+    reduce along the DP axis inside a stage, while the p2p plane
+    (``send``/``recv``) crosses groups along the PP axis.  Membership is
+    validated by the coordinator at negotiation time — every announcing
+    rank must list an identical group, and every listed rank must
+    announce — so a mismatched grid fails with a typed precondition
+    error instead of a hang."""
+
+    def __init__(self, ranks):
+        members = sorted({int(r) for r in ranks})
+        if not members:
+            raise ValueError("stage group must contain at least one rank")
+        if members[0] < 0:
+            raise ValueError(f"stage group rank {members[0]} is negative")
+        self.ranks = tuple(members)
+
+    @property
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def __contains__(self, r) -> bool:
+        return int(r) in self.ranks
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, StageGroup) and self.ranks == other.ranks
+
+    def __hash__(self) -> int:
+        return hash(self.ranks)
+
+    def __repr__(self) -> str:
+        return f"StageGroup(ranks={list(self.ranks)})"
+
+
+def stage_group(ranks) -> StageGroup:
+    """Build a :class:`StageGroup` from an iterable of global ranks."""
+    return StageGroup(ranks)
+
+
+def _p2p_wire_name(name: Optional[str], src: int, dst: int,
+                   tag: int) -> str:
+    """Canonical p2p wire name — the paired-readiness contract
+    (docs/pipeline.md#wire-protocol) keys a send and its matching recv
+    on ONE name, so both ends must construct it identically: the sender
+    stamps (rank -> peer), the receiver (peer -> rank), and both arrive
+    at the same ``<base>.p2p.<src>-<dst>.t<tag>``."""
+    base = name or "p2p"
+    return f"{base}.p2p.{src}-{dst}.t{tag}"
+
+
+def _enqueue_p2p(op: int, kind: str, array: np.ndarray,
+                 out: Optional[np.ndarray], peer: int, tag: int,
+                 wire_name: str) -> Handle:
+    lib = _load_lib()
+    if not (0 <= peer < size()):
+        raise ValueError(f"p2p peer rank {peer} out of range for world "
+                         f"size {size()}")
+    if peer == rank():
+        raise ValueError("p2p peer must be a different rank")
+    if tag < 0:
+        raise ValueError(f"p2p tag {tag} must be non-negative")
+    _fault_hook(wire_name)
+    # Always the engine path: p2p rides the Channel transport seam
+    # directly — there is no compiled-collective equivalent.
+    dims, ndim = _as_c_dims(array.shape)
+    raw = lib.hvd_tpu_enqueue_p2p(
+        op, wire_name.encode(),
+        array.ctypes.data_as(ctypes.c_void_p) if op == OP_SEND else None,
+        out.ctypes.data_as(ctypes.c_void_p) if out is not None else None,
+        dims, ndim, dtypes.numpy_to_code(array.dtype), peer, tag)
+    if raw < 0:
+        raise HorovodInternalError("engine is shut down")
+    # No record_enqueue here: snap["ops"] is collectives-only (pinned by
+    # test_snapshot_shape); the engine mirrors the canonical p2p counters
+    # into snap["p2p"] via set_p2p, bytes included.
+    # A send has no output buffer; hand the Handle the input so wait()'s
+    # byte accounting and return value stay uniform.
+    return Handle(raw, op, array, out if out is not None else array,
+                  wire_name)
+
+
+def send_async(array: np.ndarray, dest: int, tag: int = 0,
+               name: Optional[str] = None) -> Handle:
+    """Asynchronously send ``array`` to global rank ``dest``.  Completes
+    only once the matching :func:`recv` has announced — an unmatched
+    send surfaces as a collective-timeout naming this tensor and peer,
+    never a silent hang (docs/pipeline.md#faults)."""
+    lib = _load_lib()
+    _check_initialized(lib)
+    array = _as_contig(array)
+    wire_name = _p2p_wire_name(name, rank(), dest, tag)
+    return _enqueue_p2p(OP_SEND, "send", array, None, dest, tag, wire_name)
+
+
+def recv_async(out: np.ndarray, source: int, tag: int = 0,
+               name: Optional[str] = None) -> Handle:
+    """Asynchronously receive into caller-allocated ``out`` from global
+    rank ``source``.  The buffer is the shape/dtype contract: the
+    coordinator cross-checks it against the sender's announcement and
+    fails a mismatch with a typed precondition error.  Fixed-shape
+    buffers keep repeated micro-batch cycles cacheable
+    (docs/pipeline.md#steady-state)."""
+    lib = _load_lib()
+    _check_initialized(lib)
+    out = np.asarray(out)
+    if not out.flags["C_CONTIGUOUS"] or not out.flags["WRITEABLE"]:
+        raise ValueError("recv buffer must be C-contiguous and writeable")
+    wire_name = _p2p_wire_name(name, source, rank(), tag)
+    return _enqueue_p2p(OP_RECV, "recv", out, out, source, tag, wire_name)
+
+
+def send(array: np.ndarray, dest: int, tag: int = 0,
+         name: Optional[str] = None) -> None:
+    send_async(array, dest, tag, name).wait()
+
+
+def recv(out: np.ndarray, source: int, tag: int = 0,
+         name: Optional[str] = None) -> np.ndarray:
+    return recv_async(out, source, tag, name).wait()
+
+
 def allreduce(array: np.ndarray, average: bool = True,
-              name: Optional[str] = None) -> np.ndarray:
-    return allreduce_async(array, average, name).wait()
+              name: Optional[str] = None,
+              group: Optional[StageGroup] = None) -> np.ndarray:
+    return allreduce_async(array, average, name, group=group).wait()
 
 
 def allgather(array: np.ndarray, name: Optional[str] = None) -> np.ndarray:

@@ -243,3 +243,22 @@ def pick_free_port(host: str = "127.0.0.1") -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.bind((host, 0))
         return s.getsockname()[1]
+
+
+def pick_free_ports(n: int, host: str = "127.0.0.1") -> list:
+    """``n`` distinct currently-free TCP ports, all sockets held open
+    until every port is picked.  Sequential :func:`pick_free_port` calls
+    release each socket before the next bind, so the OS may hand the
+    same port out twice within one launch — a rank then dies with
+    EADDRINUSE (the bind/listen flake the suite used to see under
+    port-churn load)."""
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind((host, 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()

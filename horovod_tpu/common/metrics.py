@@ -280,6 +280,18 @@ class MetricsRegistry:
         # Ungated, like stalls: the chaos-localization test asserts
         # per-link latency without enabling full metrics.
         self._links = {"enabled": False, "peers": {}}
+        # Point-to-point plane (docs/metrics.md#p2p): send/recv transfer
+        # and byte totals, the matched counter against the unmatched
+        # gauge (enqueued transfers still waiting for their counterpart
+        # to announce), stage-group ops, and the open dedicated-channel
+        # gauge.  Ungated, like stalls: the pipeline fault tests assert
+        # unmatched counts without enabling full metrics.
+        self._p2p = {
+            "sends": 0, "recvs": 0,
+            "bytes": {"out": 0, "in": 0},
+            "matched": 0, "unmatched": 0,
+            "group_ops": 0, "channels": 0,
+        }
         # Anomaly detector (docs/metrics.md#anomalies): configuration,
         # cumulative typed-verdict counts, and the bounded verdict log.
         # Ungated — verdicts exist to be seen.
@@ -508,6 +520,22 @@ class MetricsRegistry:
                     }
                     for r, v in state.get("peers", {}).items()
                 },
+            }
+
+    def set_p2p(self, state: dict) -> None:
+        """Mirror the engine's point-to-point plane state (a state copy —
+        the engine counters are cumulative, so overwriting is idempotent,
+        like the links mirror).  Ungated."""
+        with self._lock:
+            self._p2p = {
+                "sends": int(state.get("sends", 0)),
+                "recvs": int(state.get("recvs", 0)),
+                "bytes": {d: int(state.get("bytes", {}).get(d, 0))
+                          for d in ("out", "in")},
+                "matched": int(state.get("matched", 0)),
+                "unmatched": int(state.get("unmatched", 0)),
+                "group_ops": int(state.get("group_ops", 0)),
+                "channels": int(state.get("channels", 0)),
             }
 
     def set_anomalies(self, state: dict) -> None:
@@ -747,6 +775,10 @@ class MetricsRegistry:
                                   "shm_us_buckets":
                                   list(v.get("shm_us_buckets", []))}
                               for r, v in self._links["peers"].items()},
+                },
+                "p2p": {
+                    **{k: v for k, v in self._p2p.items() if k != "bytes"},
+                    "bytes": dict(self._p2p["bytes"]),
                 },
                 "anomalies": {
                     "sigma": self._anomalies["sigma"],
@@ -1238,6 +1270,37 @@ def prometheus_text(snapshot: dict) -> str:
         out.append(
             f'hvd_tpu_link_shm_handoff_latency_us_count{{peer="{r}"}} '
             f'{v.get("shm_us_count", 0)}')
+
+    p2p = snapshot.get("p2p", {})
+    out.append("# HELP hvd_tpu_p2p_transfers_total point-to-point "
+               "transfers executed by direction (docs/pipeline.md)")
+    out.append("# TYPE hvd_tpu_p2p_transfers_total counter")
+    out.append(f'hvd_tpu_p2p_transfers_total{{dir="send"}} '
+               f'{p2p.get("sends", 0)}')
+    out.append(f'hvd_tpu_p2p_transfers_total{{dir="recv"}} '
+               f'{p2p.get("recvs", 0)}')
+    out.append("# HELP hvd_tpu_p2p_bytes_total point-to-point wire bytes "
+               "moved by direction (inter-stage activation/grad traffic)")
+    out.append("# TYPE hvd_tpu_p2p_bytes_total counter")
+    for d, n in p2p.get("bytes", {}).items():
+        out.append(f'hvd_tpu_p2p_bytes_total{{dir="{d}"}} {n}')
+    out.append("# HELP hvd_tpu_p2p_matched_total send/recv pairs this "
+               "rank completed after paired-readiness negotiation")
+    out.append("# TYPE hvd_tpu_p2p_matched_total counter")
+    out.append(f"hvd_tpu_p2p_matched_total {p2p.get('matched', 0)}")
+    out.append("# HELP hvd_tpu_p2p_unmatched enqueued transfers still "
+               "waiting for the counterpart rank to announce (a stuck "
+               "nonzero value names a pipeline schedule bug)")
+    out.append("# TYPE hvd_tpu_p2p_unmatched gauge")
+    out.append(f"hvd_tpu_p2p_unmatched {p2p.get('unmatched', 0)}")
+    out.append("# HELP hvd_tpu_p2p_group_ops_total stage-scoped "
+               "allreduce operations executed (DP inside one stage)")
+    out.append("# TYPE hvd_tpu_p2p_group_ops_total counter")
+    out.append(f"hvd_tpu_p2p_group_ops_total {p2p.get('group_ops', 0)}")
+    out.append("# HELP hvd_tpu_p2p_channels dedicated non-neighbour "
+               "p2p connections currently open")
+    out.append("# TYPE hvd_tpu_p2p_channels gauge")
+    out.append(f"hvd_tpu_p2p_channels {p2p.get('channels', 0)}")
 
     anomalies = snapshot.get("anomalies", {})
     out.append("# HELP hvd_tpu_anomaly_sigma robust-excursion threshold "

@@ -128,6 +128,45 @@ long long hvd_tpu_enqueue(int op, const char* name, const void* in, void* out,
                                  root_rank, average != 0);
 }
 
+// Point-to-point plane (docs/pipeline.md).  op: 4=send 5=recv; `peer` is
+// the counterpart rank, `tag` disambiguates concurrent transfers between
+// the same pair (it suffixes the negotiated name on the Python side).
+// Precondition failures (self-send, peer out of range) ride the returned
+// handle as a typed ST_PRECONDITION error.
+long long hvd_tpu_enqueue_p2p(int op, const char* name, const void* in,
+                              void* out, const long long* dims, int ndim,
+                              int dtype, int peer, int tag) {
+  std::vector<int64_t> d(dims, dims + ndim);
+  return GlobalEngine()->Enqueue(static_cast<uint8_t>(op), name ? name : "",
+                                 in, out, d, static_cast<uint8_t>(dtype), -1,
+                                 false, peer, tag);
+}
+
+// Stage-scoped allreduce: `ranks` (ascending, nranks of them, this rank
+// among them) restricts the reduction to a stage group's membership —
+// the data-parallel reduction inside one pipeline stage.
+long long hvd_tpu_enqueue_group(const char* name, const void* in, void* out,
+                                const long long* dims, int ndim, int dtype,
+                                int average, const long long* ranks,
+                                int nranks) {
+  std::vector<int64_t> d(dims, dims + ndim);
+  std::vector<int32_t> members;
+  members.reserve(nranks > 0 ? nranks : 0);
+  for (int i = 0; i < nranks; ++i)
+    members.push_back(static_cast<int32_t>(ranks[i]));
+  return GlobalEngine()->Enqueue(hvdtpu::OP_ALLREDUCE, name ? name : "", in,
+                                 out, d, static_cast<uint8_t>(dtype), -1,
+                                 average != 0, -1, 0, members);
+}
+
+// "sends|recvs|bytes_out|bytes_in|matched|unmatched|group_ops|channels"
+// (docs/metrics.md#p2p).
+const char* hvd_tpu_p2p_info() {
+  static thread_local std::string tl_p2p_info;
+  tl_p2p_info = GlobalEngine()->P2pInfo();
+  return tl_p2p_info.c_str();
+}
+
 int hvd_tpu_poll(long long handle) {
   return GlobalEngine()->Poll(handle);
 }

@@ -345,8 +345,68 @@ std::string DimsToString(const std::vector<int64_t>& dims) {
 bool FusesInto(const Response& group, int64_t group_bytes,
                uint8_t group_dtype, uint8_t dtype, int64_t bytes,
                int64_t threshold) {
-  return group.type == RESP_ALLREDUCE && group.names.size() < 1024 &&
-         group_dtype == dtype && group_bytes + bytes <= threshold;
+  // Stage-scoped buckets never fuse (docs/pipeline.md): a group op's
+  // participant set differs from its neighbours', so a merged bucket
+  // would have no single execution membership.  Both callers also check
+  // the CANDIDATE's stage_ranks before offering it here.
+  return group.type == RESP_ALLREDUCE && group.stage_ranks.empty() &&
+         group.names.size() < 1024 && group_dtype == dtype &&
+         group_bytes + bytes <= threshold;
+}
+
+// Participant count a pending negotiation must reach before its response
+// builds: the pair for send/recv, the stage group's membership for a
+// stage-scoped collective, the full world otherwise (docs/pipeline.md).
+int RequiredCount(const Request& req, int world) {
+  if (req.op == OP_SEND || req.op == OP_RECV) return 2;
+  if (!req.stage_ranks.empty())
+    return static_cast<int>(req.stage_ranks.size());
+  return world;
+}
+
+// Ranks expected to announce a pending negotiation — the denominator the
+// stall / timeout sweeps measure "missing" against.  For a p2p pair the
+// expected set is the announcer(s) plus the peer each one named; for a
+// stage group, its members; otherwise everyone.
+std::vector<bool> ExpectedRanks(const std::vector<Request>& reqs,
+                                int world) {
+  std::vector<bool> expected(world, false);
+  if (reqs.empty() ||
+      (reqs[0].op != OP_SEND && reqs[0].op != OP_RECV &&
+       reqs[0].stage_ranks.empty())) {
+    expected.assign(world, true);
+    return expected;
+  }
+  if (!reqs[0].stage_ranks.empty()) {
+    for (int32_t m : reqs[0].stage_ranks)
+      if (m >= 0 && m < world) expected[m] = true;
+    return expected;
+  }
+  for (const auto& r : reqs) {
+    if (r.rank >= 0 && r.rank < world) expected[r.rank] = true;
+    if (r.p2p_peer >= 0 && r.p2p_peer < world) expected[r.p2p_peer] = true;
+  }
+  return expected;
+}
+
+// Expected announcers of a cached slot's agreement (the cache_pending
+// analogue of ExpectedRanks): the stored pair for p2p, the stage members
+// for a group op, everyone otherwise.
+std::vector<bool> SlotExpectedRanks(const CacheSlot* s, int world) {
+  std::vector<bool> expected(world, true);
+  if (s == nullptr) return expected;
+  if (s->response.type == RESP_SENDRECV) {
+    expected.assign(world, false);
+    if (s->response.p2p_src >= 0 && s->response.p2p_src < world)
+      expected[s->response.p2p_src] = true;
+    if (s->response.p2p_dst >= 0 && s->response.p2p_dst < world)
+      expected[s->response.p2p_dst] = true;
+  } else if (!s->response.stage_ranks.empty()) {
+    expected.assign(world, false);
+    for (int32_t m : s->response.stage_ranks)
+      if (m >= 0 && m < world) expected[m] = true;
+  }
+  return expected;
 }
 
 // "1, 3" for the ranks NOT marked in `present`.
@@ -371,8 +431,24 @@ int ResponseCache::Lookup(const Request& req) const {
   auto it = by_name_.find(req.name);
   if (it == by_name_.end()) return -1;
   const CacheSlot& s = slots_[it->second];
+  // Point-to-point slots are stored from the broadcast response's
+  // metadata (identical on every rank, participant or not), so the
+  // signature match is role-aware: this rank's request matches when it
+  // restates the same pair orientation the agreement recorded.
+  if (s.response.type == RESP_SENDRECV) {
+    const Response& a = s.response;
+    bool as_send = req.op == OP_SEND && req.rank == a.p2p_src &&
+                   req.p2p_peer == a.p2p_dst;
+    bool as_recv = req.op == OP_RECV && req.rank == a.p2p_dst &&
+                   req.p2p_peer == a.p2p_src;
+    if (!(as_send || as_recv) || req.p2p_tag != a.p2p_tag ||
+        req.dtype != a.p2p_dtype || req.dims != a.p2p_dims)
+      return -1;
+    return it->second;
+  }
   if (s.op != req.op || s.dtype != req.dtype ||
-      s.root_rank != req.root_rank || s.dims != req.dims)
+      s.root_rank != req.root_rank || s.dims != req.dims ||
+      req.stage_ranks != s.response.stage_ranks)
     return -1;
   return it->second;
 }
@@ -1640,6 +1716,7 @@ void Engine::TeardownSockets() {
   CloseFd(left_fd_);
   CloseFd(right_fd_);
   CloseTopologyFds();
+  CloseP2pChannels();
   coord_listen_fd_ = coord_fd_ = data_listen_fd_ = left_fd_ = right_fd_ = -1;
   left_ch_ = Channel{};
   right_ch_ = Channel{};
@@ -1651,6 +1728,9 @@ void Engine::ShutdownTopologyFds() {
   ShutdownFd(cross_left_fd_);
   ShutdownFd(cross_right_fd_);
   for (int fd : cross_tree_fds_) ShutdownFd(fd);
+  // Dedicated p2p channels: a peer blocked mid-transfer wakes too.  The
+  // fds close with CloseP2pChannels (teardown / ring rebuild).
+  for (auto& kv : p2p_chans_) ShutdownFd(kv.second.fd);
   // Shm analogue of ShutdownFd: a helper (or peer) blocked in a ring
   // drive loop wakes within one poll iteration.  Unmap stays with
   // CloseTopologyFds, after the helpers joined.
@@ -2496,13 +2576,43 @@ void Engine::BackgroundLoop() {
 
 int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
                         void* out, const std::vector<int64_t>& dims,
-                        uint8_t dtype, int root_rank, bool average) {
+                        uint8_t dtype, int root_rank, bool average, int peer,
+                        int tag, const std::vector<int32_t>& stage_ranks) {
   if (!initialized_.load()) return -1;
   auto status = std::make_shared<HandleStatus>();
   int64_t handle = next_handle_.fetch_add(1);
   {
     std::lock_guard<std::mutex> lk(handles_mu_);
     handles_[handle] = status;
+  }
+  // Preconditions the coordinator could only report a tick later: a p2p
+  // op needs a real counterpart, and stage groups only scope allreduce.
+  if (op == OP_SEND || op == OP_RECV) {
+    if (peer < 0 || peer >= size() || peer == rank()) {
+      status->error = std::string(OpName(op)) + " '" + name +
+                      "' names peer rank " + std::to_string(peer) +
+                      ", which is not another rank of this " +
+                      std::to_string(size()) + "-rank job.";
+      status->code.store(ST_PRECONDITION);
+      return handle;
+    }
+  } else if (!stage_ranks.empty()) {
+    bool member = false;
+    bool in_range = true;
+    for (int32_t m : stage_ranks) {
+      if (m == rank()) member = true;
+      if (m < 0 || m >= size()) in_range = false;
+    }
+    if (op != OP_ALLREDUCE || !member || !in_range ||
+        stage_ranks.size() < 2) {
+      status->error =
+          "stage-group collectives support allreduce among >= 2 valid "
+          "member ranks including the caller; '" +
+          name + "' violates that (op " + OpName(op) + ", " +
+          std::to_string(stage_ranks.size()) + " members).";
+      status->code.store(ST_PRECONDITION);
+      return handle;
+    }
   }
   TableEntry e;
   e.name = name;
@@ -2513,6 +2623,9 @@ int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
   e.out = out;
   e.root_rank = root_rank;
   e.average = average;
+  e.p2p_peer = peer;
+  e.p2p_tag = tag;
+  e.stage_ranks = stage_ranks;
   e.handle = handle;
   e.enqueued_at = std::chrono::steady_clock::now();
   {
@@ -2561,6 +2674,9 @@ int64_t Engine::Enqueue(uint8_t op, const std::string& name, const void* in,
     req.root_rank = root_rank;
     req.name = name;
     req.dims = dims;
+    req.p2p_peer = peer;
+    req.p2p_tag = tag;
+    req.stage_ranks = stage_ranks;
     queue_.push_back(std::move(req));
   }
   // Wake a steady-state idle wait (no-op otherwise: nothing waits on
@@ -3845,6 +3961,18 @@ Request Engine::SynthesizeFromSlot(const CacheSlot& slot, int rank) const {
   if (slot.op == OP_ALLGATHER && !r.dims.empty() &&
       rank < static_cast<int>(slot.response.rank_dim0.size()))
     r.dims[0] = slot.response.rank_dim0[rank];
+  // A p2p slot stores the pair's agreement, not one rank's request:
+  // restore `rank`'s role (the sender re-announces OP_SEND naming the
+  // receiver, and vice versa) so renegotiation revalidates the pair.
+  if (slot.response.type == RESP_SENDRECV) {
+    const Response& a = slot.response;
+    r.op = rank == a.p2p_src ? OP_SEND : OP_RECV;
+    r.p2p_peer = rank == a.p2p_src ? a.p2p_dst : a.p2p_src;
+    r.p2p_tag = a.p2p_tag;
+    r.dtype = a.p2p_dtype;
+    r.dims = a.p2p_dims;
+  }
+  r.stage_ranks = slot.response.stage_ranks;
   return r;
 }
 
@@ -3925,7 +4053,14 @@ void Engine::HandleOneBit(uint32_t bit, int from_rank, int64_t announce_ts) {
       coord_->last_announce_name[from_rank] = s->name;
     }
   }
-  if (pb.count == opts_.size) {
+  // Slot-scoped full count (docs/pipeline.md): a cached p2p pair agrees
+  // at TWO bits, a cached stage-group collective at its membership.
+  int required = opts_.size;
+  if (s->response.type == RESP_SENDRECV)
+    required = 2;
+  else if (!s->response.stage_ranks.empty())
+    required = static_cast<int>(s->response.stage_ranks.size());
+  if (pb.count == required) {
     // Agreement by pure bit intersection: no strings were parsed, no
     // Requests rebuilt.  Keep the announce/straggler accounting live in
     // steady state, and mark the NEGOTIATE row as a cache hit.
@@ -4027,7 +4162,12 @@ void Engine::HandleOneRequest(const Request& req, int from_rank,
     pt.requests.push_back(req);
     // forced_error entries were already pushed to ready at detection; a
     // second push here would double-build (and double-erase) the entry.
-    if (static_cast<int>(pt.requests.size()) == opts_.size &&
+    // The full count is op-scoped (docs/pipeline.md): a send/recv pair
+    // completes at TWO announcements (paired readiness — sender and
+    // receiver must both have posted), a stage-scoped collective at its
+    // group's membership, everything else at the whole world.
+    if (static_cast<int>(pt.requests.size()) ==
+            RequiredCount(pt.requests[0], opts_.size) &&
         pt.forced_error.empty()) {
       if (pt.poison_deadline_tick != 0) {
         // Every rank re-announced consistently: the mismatch is resolved;
@@ -4068,6 +4208,56 @@ Response Engine::BuildResponse(const std::string& name) {
   auto& reqs = it->second.requests;
   const Request& first = reqs[0];
   std::string error;
+  if (first.op == OP_SEND || first.op == OP_RECV) {
+    // Point-to-point pair (docs/pipeline.md): exactly two announcements
+    // reached the full count — one OP_SEND and one OP_RECV, each naming
+    // the other rank as its peer, with equal tag, dtype and shape.  The
+    // agreement broadcasts to EVERY rank (caches mutate in lockstep);
+    // only the pair executes it.
+    const Request& a = reqs[0];
+    const Request& b = reqs[1];
+    const Request& snd = a.op == OP_SEND ? a : b;
+    const Request& rcv = a.op == OP_SEND ? b : a;
+    if (a.op == b.op)
+      error = std::string("Mismatched point-to-point operations for '") +
+              BaseName(name) + "': ranks " + std::to_string(a.rank) +
+              " and " + std::to_string(b.rank) + " both posted " +
+              OpName(a.op) +
+              "; a pair needs one send and one matching recv.";
+    else if (snd.p2p_peer != rcv.rank || rcv.p2p_peer != snd.rank)
+      error = "Mismatched point-to-point peers for '" + BaseName(name) +
+              "': rank " + std::to_string(snd.rank) +
+              " sends to rank " + std::to_string(snd.p2p_peer) +
+              " but rank " + std::to_string(rcv.rank) +
+              " receives from rank " + std::to_string(rcv.p2p_peer) + ".";
+    else if (snd.p2p_tag != rcv.p2p_tag)
+      error = "Mismatched point-to-point tags for '" + BaseName(name) +
+              "': send tag " + std::to_string(snd.p2p_tag) +
+              " vs recv tag " + std::to_string(rcv.p2p_tag) + ".";
+    else if (snd.dtype != rcv.dtype)
+      error = std::string("Mismatched point-to-point data types: the "
+                          "sender ships ") +
+              DataTypeName(snd.dtype) + ", the receiver expects " +
+              DataTypeName(rcv.dtype) + ".";
+    else if (snd.dims != rcv.dims)
+      error = "Mismatched point-to-point tensor shapes: the sender "
+              "ships " + DimsToString(snd.dims) +
+              ", the receiver expects " + DimsToString(rcv.dims) + ".";
+    if (!error.empty()) {
+      resp.type = RESP_ERROR;
+      resp.error_message = error;
+    } else {
+      resp.type = RESP_SENDRECV;
+      resp.p2p_src = snd.rank;
+      resp.p2p_dst = rcv.rank;
+      resp.p2p_tag = snd.p2p_tag;
+      resp.p2p_dtype = snd.dtype;
+      resp.p2p_dims = snd.dims;
+    }
+    // The NEGOTIATE row closed in HandleOneRequest at full count (2).
+    coord_->message_table.erase(it);
+    return resp;
+  }
   for (size_t i = 1; i < reqs.size() && error.empty(); ++i) {
     const Request& r = reqs[i];
     if (r.op != first.op) {
@@ -4095,6 +4285,12 @@ Response Engine::BuildResponse(const std::string& name) {
       error = std::string("Mismatched data types: one rank sent ") +
               DataTypeName(r.dtype) + ", another sent " +
               DataTypeName(first.dtype) + ".";
+    else if (r.stage_ranks != first.stage_ranks)
+      error = "Mismatched stage groups for '" + BaseName(name) +
+              "': ranks " + std::to_string(r.rank) + " and " +
+              std::to_string(first.rank) +
+              " scoped the collective to different member lists; every "
+              "member must pass the same stage group.";
     else if ((first.op == OP_ALLREDUCE || first.op == OP_NOOP) &&
              r.dims != first.dims)
       error = "Mismatched allreduce tensor shapes: one rank sent " +
@@ -4131,11 +4327,24 @@ Response Engine::BuildResponse(const std::string& name) {
       (first.root_rank < 0 || first.root_rank >= opts_.size))
     error = "Broadcast root rank " + std::to_string(first.root_rank) +
             " out of range [0, " + std::to_string(opts_.size) + ").";
+  if (error.empty() && !first.stage_ranks.empty() &&
+      first.op != OP_ALLREDUCE)
+    error = std::string("Stage groups scope only allreduce; '") +
+            BaseName(name) + "' requested " + OpName(first.op) + ".";
   if (!error.empty()) {
     resp.type = RESP_ERROR;
     resp.error_message = error;
   } else if (first.op == OP_ALLREDUCE) {
     resp.type = RESP_ALLREDUCE;
+    if (!first.stage_ranks.empty()) {
+      // Stage-scoped (docs/pipeline.md): the broadcast carries the
+      // membership plus the payload signature, so NON-members can mutate
+      // their response caches in lockstep without ever having seen a
+      // request for this name.
+      resp.stage_ranks = first.stage_ranks;
+      resp.p2p_dtype = first.dtype;
+      resp.p2p_dims = first.dims;
+    }
   } else if (first.op == OP_NOOP) {
     resp.type = RESP_NOOP;
   } else if (first.op == OP_BROADCAST) {
@@ -4202,7 +4411,8 @@ ResponseList Engine::CoordinatorTick() {
       tuner_.Record(r.type == RESP_NOOP ? 0 : bytes, 1);
     // Tensor fusion: merge consecutive same-dtype allreduces while the fused
     // payload stays under the threshold (operations.cc:1607-1642).
-    if (r.type == RESP_ALLREDUCE && !responses.empty() &&
+    if (r.type == RESP_ALLREDUCE && r.stage_ranks.empty() &&
+        !responses.empty() &&
         FusesInto(responses.back(), nbytes.back(), last_fused_dtype_, dtype,
                   bytes, opts_.fusion_threshold)) {
       responses.back().names.push_back(name);
@@ -4221,7 +4431,21 @@ ResponseList Engine::CoordinatorTick() {
   // each tensor's NEGOTIATE timeline row at the coordinator.
   for (size_t i = 0; i < responses.size(); ++i) {
     Response& r = responses[i];
-    if (r.type != RESP_ALLREDUCE) continue;
+    if (r.type == RESP_SENDRECV) {
+      // A p2p transfer compresses only when the pair spans nodes (the
+      // DCN hop, where bytes cost money) and the payload is fp32 — the
+      // same policy the two-level allreduce applies to its cross hop.
+      // The verdict is stored with the cached agreement and replayed
+      // verbatim: p2p never re-fuses, so there is no bucket geometry to
+      // recompute at replay time.
+      bool cross_node =
+          opts_.hierarchical_allreduce && opts_.local_size > 0 &&
+          r.p2p_src / opts_.local_size != r.p2p_dst / opts_.local_size;
+      if (cross_node && r.p2p_dtype == HVD_FLOAT32)
+        r.compression = ChooseCompression(r.p2p_dtype, nbytes[i]);
+      continue;
+    }
+    if (r.type != RESP_ALLREDUCE || !r.stage_ranks.empty()) continue;
     r.compression = ChooseCompression(ndtypes[i], nbytes[i]);
     if (r.compression != COMP_NONE && timeline_.Enabled())
       for (const auto& name : r.names)
@@ -4276,8 +4500,16 @@ void Engine::CheckForStalledTensors() {
     if (now - kv.second.first_seen <
         std::chrono::duration<double>(opts_.stall_warning_sec))
       continue;
+    // Ranks outside a partial-participation op's expected set are not
+    // "missing" — mask them present so the warning names only the
+    // genuinely absent participants (the p2p peer, the stage members).
     std::vector<bool> present(opts_.size, false);
-    for (const auto& r : kv.second.requests) present[r.rank] = true;
+    for (const auto& r : kv.second.requests)
+      if (r.rank >= 0 && r.rank < opts_.size) present[r.rank] = true;
+    std::vector<bool> expected =
+        ExpectedRanks(kv.second.requests, opts_.size);
+    for (int r = 0; r < opts_.size; ++r)
+      if (!expected[r]) present[r] = true;
     warn(kv.first, present, kv.second.first_seen);
   }
   for (const auto& kv : coord_->cache_pending) {
@@ -4285,8 +4517,13 @@ void Engine::CheckForStalledTensors() {
         std::chrono::duration<double>(opts_.stall_warning_sec))
       continue;
     const CacheSlot* s = cache_.Get(static_cast<int>(kv.first));
+    std::vector<bool> present = kv.second.ranks;
+    std::vector<bool> expected = SlotExpectedRanks(s, opts_.size);
+    for (int r = 0; r < opts_.size && r < static_cast<int>(present.size());
+         ++r)
+      if (!expected[r]) present[r] = true;
     warn(s ? s->name : "<cache slot " + std::to_string(kv.first) + ">",
-         kv.second.ranks, kv.second.first_seen);
+         present, kv.second.first_seen);
   }
 }
 
@@ -4314,14 +4551,27 @@ std::string Engine::StallInfo() {
 
 namespace {
 
-// "a, b [missing ranks: 1, 3]" for one pending tensor.
+// "a, b [missing ranks: 1, 3]" for one pending tensor.  An unmatched
+// p2p announce names the tensor AND the absent counterpart explicitly —
+// the paired-readiness diagnosis docs/pipeline.md#fault-semantics
+// promises ("rank 1's send of 'act_s0' waits on rank 2's recv").
 std::string DescribePending(const std::string& name,
                             const std::vector<Request>& reqs, int size) {
+  if (reqs.size() == 1 &&
+      (reqs[0].op == OP_SEND || reqs[0].op == OP_RECV)) {
+    const Request& r = reqs[0];
+    return "'" + name + "' [" + OpName(r.op) + " announced by rank " +
+           std::to_string(r.rank) + "; waiting for the matching " +
+           (r.op == OP_SEND ? "recv" : "send") + " from peer rank " +
+           std::to_string(r.p2p_peer) + "]";
+  }
   std::vector<bool> present(size, false);
-  for (const auto& r : reqs) present[r.rank] = true;
+  for (const auto& r : reqs)
+    if (r.rank >= 0 && r.rank < size) present[r.rank] = true;
+  std::vector<bool> expected = ExpectedRanks(reqs, size);
   std::string missing;
   for (int r = 0; r < size; ++r)
-    if (!present[r])
+    if (expected[r] && !present[r])
       missing += (missing.empty() ? "" : ", ") + std::to_string(r);
   return "'" + name + "' [missing ranks: " + missing + "]";
 }
@@ -4428,8 +4678,16 @@ void Engine::CheckCollectiveTimeout() {
     if (age < opts_.collective_timeout_sec) continue;
     worst = std::max(worst, age);
     ++n_stalled;
+    // Mask ranks outside the op's expected participant set (p2p pair /
+    // stage group): the abort must name the absent counterpart, not the
+    // whole uninvolved world.
     std::vector<bool> present(opts_.size, false);
-    for (const auto& r : kv.second.requests) present[r.rank] = true;
+    for (const auto& r : kv.second.requests)
+      if (r.rank >= 0 && r.rank < opts_.size) present[r.rank] = true;
+    std::vector<bool> expected =
+        ExpectedRanks(kv.second.requests, opts_.size);
+    for (int r = 0; r < opts_.size; ++r)
+      if (!expected[r]) present[r] = true;
     note_missing(present);
     if (n_stalled <= 8)
       stalled += (stalled.empty() ? "" : "; ") +
@@ -4441,12 +4699,17 @@ void Engine::CheckCollectiveTimeout() {
     if (age < opts_.collective_timeout_sec) continue;
     worst = std::max(worst, age);
     ++n_stalled;
-    note_missing(kv.second.ranks);
+    const CacheSlot* s = cache_.Get(static_cast<int>(kv.first));
+    std::vector<bool> present = kv.second.ranks;
+    std::vector<bool> expected = SlotExpectedRanks(s, opts_.size);
+    for (int r = 0; r < opts_.size && r < static_cast<int>(present.size());
+         ++r)
+      if (!expected[r]) present[r] = true;
+    note_missing(present);
     if (n_stalled <= 8) {
-      const CacheSlot* s = cache_.Get(static_cast<int>(kv.first));
       stalled += (stalled.empty() ? "" : "; ") + std::string("'") +
                  (s ? s->name : "<cache slot>") +
-                 "' [missing ranks: " + MissingRanks(kv.second.ranks) + "]";
+                 "' [missing ranks: " + MissingRanks(present) + "]";
     }
   }
   if (n_stalled == 0) return;
@@ -5228,6 +5491,9 @@ bool Engine::RebuildRing(std::string* err) {
   // Elastic jobs run the flat ring only; make sure no stale two-level
   // topology outlives a reshape.
   CloseTopologyFds();
+  // Dedicated p2p channels name ranks of the OLD membership; drop them
+  // and let the new membership redial lazily.
+  CloseP2pChannels();
   node_id_ = 0;
   n_nodes_ = 1;
   topo_hier_.store(false);
@@ -5459,7 +5725,8 @@ void Engine::ProcessCacheHits(const std::vector<uint32_t>& hits) {
     cache_.Touch(static_cast<int>(hit));
     int64_t bytes =
         NumElements(s->dims) * static_cast<int64_t>(DataTypeSize(s->dtype));
-    if (s->response.type == RESP_ALLREDUCE && !merged.empty() &&
+    if (s->response.type == RESP_ALLREDUCE &&
+        s->response.stage_ranks.empty() && !merged.empty() &&
         FusesInto(merged.back(), merged_bytes.back(), fused_dtype, s->dtype,
                   bytes, opts_.fusion_threshold)) {
       merged.back().names.push_back(s->name);
@@ -5477,8 +5744,10 @@ void Engine::ProcessCacheHits(const std::vector<uint32_t>& hits) {
   // state — so a replayed bucket compresses exactly like its fresh
   // negotiation would, on every rank, without putting the verdict back on
   // the wire.
+  // (RESP_SENDRECV slots replay their stored verdict verbatim — p2p
+  // never re-fuses — and stage-scoped allreduces never compress.)
   for (size_t i = 0; i < merged.size(); ++i)
-    if (merged[i].type == RESP_ALLREDUCE)
+    if (merged[i].type == RESP_ALLREDUCE && merged[i].stage_ranks.empty())
       merged[i].compression =
           ChooseCompression(merged_dtypes[i], merged_bytes[i]);
   for (const auto& resp : merged) PerformOperation(resp, /*from_cache=*/true);
@@ -5505,6 +5774,29 @@ void Engine::PerformOperation(const Response& resp, bool from_cache) {
     for (const auto& name : resp.names) cache_.Erase(name);
     cache_size_.store(cache_.size());
   }
+  // Partial-participation agreements (p2p pairs, stage-scoped
+  // collectives) mutate the cache from the RESPONSE metadata, before the
+  // no-local-entry return below: most ranks never enqueued the name, yet
+  // every cache must Put the slot at this list position or slot indices
+  // diverge and the next cache-bit announce is garbage (docs/pipeline.md
+  // #steady-state).  The slot is byte-identical on every rank (canonical
+  // op + the broadcast signature); Lookup restores the per-rank role.
+  if (cache_.enabled() && !from_cache &&
+      (resp.type == RESP_SENDRECV ||
+       (resp.type == RESP_ALLREDUCE && !resp.stage_ranks.empty()))) {
+    Response single = resp;  // p2p/stage responses are never fused
+    CacheSlot evicted;
+    uint8_t slot_op = resp.type == RESP_SENDRECV
+                          ? static_cast<uint8_t>(OP_SEND)
+                          : static_cast<uint8_t>(OP_ALLREDUCE);
+    int slot = cache_.Put(resp.names[0], slot_op, resp.p2p_dtype,
+                          resp.p2p_dims, -1, single, &evicted);
+    if (evicted.valid) {
+      cache_evictions_.fetch_add(1);
+      CoordinatorDrainSlot(slot, evicted);
+    }
+    cache_size_.store(cache_.size());
+  }
   if (entries.empty()) return;
   // Negotiation latency stamp (negotiation_sec histogram, both planes):
   // enqueue -> the agreed response reaching this rank, before execution.
@@ -5520,11 +5812,13 @@ void Engine::PerformOperation(const Response& resp, bool from_cache) {
     for (auto& e : entries) CompleteEntry(e, ST_PRECONDITION, resp.error_message);
     return;
   }
-  if (cache_.enabled() && !from_cache) {
+  if (cache_.enabled() && !from_cache && resp.type != RESP_SENDRECV &&
+      (resp.type != RESP_ALLREDUCE || resp.stage_ranks.empty())) {
     // Freshly negotiated: store each name's agreement so its next
     // signature-identical submission announces a compact cache bit.
     // Slot assignment and LRU order are driven by the broadcast list —
-    // lockstep on every rank.
+    // lockstep on every rank.  (p2p / stage-scoped responses stored
+    // above, from metadata, on every rank.)
     for (auto& e : entries) {
       Response single;
       single.type = resp.type;
@@ -5557,7 +5851,13 @@ void Engine::PerformOperation(const Response& resp, bool from_cache) {
   }
   switch (resp.type) {
     case RESP_ALLREDUCE:
-      ExecuteAllreduce(resp, entries);
+      if (!resp.stage_ranks.empty())
+        ExecuteGroupAllreduce(resp, entries);
+      else
+        ExecuteAllreduce(resp, entries);
+      break;
+    case RESP_SENDRECV:
+      ExecuteSendRecv(resp, entries[0]);
       break;
     case RESP_ALLGATHER:
       ExecuteAllgather(resp, entries[0]);
@@ -5855,6 +6155,320 @@ void Engine::ExecuteBroadcast(const Response& resp, TableEntry& e) {
     data_plane_failed_.store(true);
     CompleteEntry(e, ST_UNKNOWN, "ring broadcast failed: " + err);
   }
+}
+
+// ---------------------------------------------------------------------------
+// Point-to-point plane (docs/pipeline.md): negotiated pairwise transfers
+// for pipeline parallelism, executed over the same Channel seam the
+// collectives ride.
+// ---------------------------------------------------------------------------
+
+const Channel* Engine::GetP2pChannel(int peer, std::string* err) {
+  const int rank = opts_.rank;
+  const int size = opts_.size;
+  // Fabric reuse first: when the negotiated pair already sits on a
+  // topology channel the transfer rides it — the node-local ring is
+  // shm-capable, which is the whole point for intra-host activation
+  // traffic.  Safe because both ends execute the same broadcast
+  // response at the same list position, so the channel is quiet, and
+  // both sides pick the matching direction by the SAME symmetric rule
+  // (2-cycles, where the peer is both neighbours, tie-break by the
+  // lower id owning the rightward pair).
+  const bool hier = opts_.hierarchical_allreduce && opts_.local_size > 1;
+  if (hier) {
+    const int L = opts_.local_size;
+    const int node_base = opts_.rank - opts_.local_rank;
+    if (peer >= node_base && peer < node_base + L) {
+      int plr = peer - node_base;
+      int lr = opts_.local_rank;
+      bool at_right = plr == (lr + 1) % L;
+      bool at_left = plr == (lr + L - 1) % L;
+      if (at_right && at_left)
+        return rank < peer ? &local_right_ch_ : &local_left_ch_;
+      if (at_right) return &local_right_ch_;
+      if (at_left) return &local_left_ch_;
+    } else if (n_nodes_ > 1 && peer % L == opts_.local_rank) {
+      // Same shard on another node: the sharded cross ring when the
+      // node is adjacent.
+      int pnode = peer / L;
+      bool at_right = pnode == (node_id_ + 1) % n_nodes_;
+      bool at_left = pnode == (node_id_ + n_nodes_ - 1) % n_nodes_;
+      if (at_right && at_left)
+        return node_id_ < pnode ? &cross_right_ch_ : &cross_left_ch_;
+      if (at_right) return &cross_right_ch_;
+      if (at_left) return &cross_left_ch_;
+    }
+  }
+  {
+    bool at_right = peer == (rank + 1) % size;
+    bool at_left = peer == (rank + size - 1) % size;
+    if (at_right && at_left) return rank < peer ? &right_ch_ : &left_ch_;
+    if (at_right) return &right_ch_;
+    if (at_left) return &left_ch_;
+  }
+
+  // Non-neighbour pair: a dedicated TCP connection, dialed lazily at
+  // first use and cached for the job's lifetime (pipeline schedules
+  // reuse the same stage pairs every micro-batch).  The LOWER rank
+  // dials the higher rank's data listener with a typed hello; the
+  // higher rank accepts.  Deterministic rendezvous: both ends reach
+  // this call executing the same response at the same list position.
+  auto it = p2p_chans_.find(peer);
+  if (it != p2p_chans_.end()) return &it->second;
+  const uint32_t kHelloP2P = 7u << 24;
+  const double kDialTimeout = 120.0;
+  if (rank < peer) {
+    std::string host;
+    int port;
+    if (peer >= static_cast<int>(opts_.data_endpoints.size()) ||
+        !ParseEndpoint(opts_.data_endpoints[peer], &host, &port)) {
+      *err = "bad data endpoint for p2p peer " + std::to_string(peer);
+      return nullptr;
+    }
+    int fd = ConnectRetry(host, port, kDialTimeout, err);
+    if (fd < 0) {
+      *err = "p2p dial to rank " + std::to_string(peer) + " failed: " + *err;
+      return nullptr;
+    }
+    uint32_t hello = kHelloP2P | static_cast<uint32_t>(rank);
+    if (!SendAll(fd, &hello, 4)) {
+      *err = "p2p hello send to rank " + std::to_string(peer) + " failed";
+      CloseFd(fd);
+      return nullptr;
+    }
+    NetFaultRegister(fd, peer);
+    const Channel& ch =
+        p2p_chans_.emplace(peer, Channel{fd, nullptr, nullptr, peer})
+            .first->second;
+    p2p_channels_.store(static_cast<int64_t>(p2p_chans_.size()));
+    return &ch;
+  }
+  // Accept side.  A dial for a LATER response in this rank's list can
+  // land in the listen backlog first (the dialer's connect+hello does
+  // not wait for the accept), so unexpected p2p hellos from lower ranks
+  // are parked in the channel map — they are connections this rank will
+  // execute against at their own list position anyway.  Anything else
+  // (a stale or foreign hello) is dropped and the wait continues.
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(kDialTimeout);
+  while (true) {
+    double left = std::chrono::duration<double>(
+                      deadline - std::chrono::steady_clock::now())
+                      .count();
+    if (left <= 0.0) {
+      *err = "timed out accepting the p2p dial from rank " +
+             std::to_string(peer);
+      return nullptr;
+    }
+    int fd = AcceptOne(data_listen_fd_, left, err);
+    if (fd < 0) {
+      *err = "p2p accept from rank " + std::to_string(peer) +
+             " failed: " + *err;
+      return nullptr;
+    }
+    uint32_t hello = 0;
+    if (!RecvAll(fd, &hello, 4)) {
+      CloseFd(fd);
+      continue;
+    }
+    int from = static_cast<int>(hello & 0x00ffffffu);
+    if ((hello & 0xff000000u) != kHelloP2P || from < 0 || from >= rank ||
+        p2p_chans_.count(from)) {
+      CloseFd(fd);
+      continue;
+    }
+    NetFaultRegister(fd, from);
+    p2p_chans_.emplace(from, Channel{fd, nullptr, nullptr, from});
+    p2p_channels_.store(static_cast<int64_t>(p2p_chans_.size()));
+    if (from == peer) return &p2p_chans_.find(peer)->second;
+  }
+}
+
+void Engine::CloseP2pChannels() {
+  for (auto& kv : p2p_chans_) CloseFd(kv.second.fd);
+  p2p_chans_.clear();
+  p2p_channels_.store(0);
+}
+
+void Engine::ExecuteSendRecv(const Response& resp, TableEntry& e) {
+  const bool sender = opts_.rank == resp.p2p_src;
+  const int peer = sender ? resp.p2p_dst : resp.p2p_src;
+  timeline_.Start(e.name, sender ? "SEND" : "RECV");
+  int64_t n = NumElements(e.dims);
+  size_t esize = DataTypeSize(e.dtype);
+  int64_t nbytes = n * static_cast<int64_t>(esize);
+
+  // The coordinator's negotiated per-transfer compression verdict
+  // (fp32 cross-node pairs only; see CoordinatorTick).  Same wire
+  // formats and error-feedback residual discipline as the allreduce
+  // path, so a compressed activation stream never compounds rounding
+  // into drift across micro-batches.
+  uint8_t comp = e.dtype == HVD_FLOAT32 ? resp.compression : COMP_NONE;
+  uint8_t wire = 255;
+  if (comp == COMP_BF16)
+    wire = WIRE_BF16;
+  else if (comp == COMP_FP8)
+    wire = WIRE_FP8;
+  int64_t wire_bytes =
+      wire == 255 ? nbytes : n * static_cast<int64_t>(WireFormatSize(wire));
+  RecordCompressedOp(e.name, comp, nbytes, wire_bytes);
+
+  std::string err;
+  const Channel* ch = GetP2pChannel(peer, &err);
+  bool ok = ch != nullptr;
+  if (ok) {
+    timeline_.ActivityStart(e.name, sender ? "P2P_SEND" : "P2P_RECV");
+    if (wire == 255) {
+      ok = sender
+               ? ChannelSendAll(*ch, e.in, static_cast<size_t>(nbytes))
+               : ChannelRecvAll(*ch, e.out, static_cast<size_t>(nbytes));
+      if (!ok) err = "peer rank " + std::to_string(peer) + " closed";
+    } else if (sender) {
+      // Residual-map bound: same discipline as ExecuteAllreduce — a
+      // never-repeating name stream must not grow the map forever.
+      if (!residuals_.count(e.name) && residuals_.size() >= 4096) {
+        residuals_.clear();
+        residual_bytes_.store(0);
+      }
+      auto rit = residuals_.emplace(e.name, std::vector<float>()).first;
+      std::vector<float>& r = rit->second;
+      if (static_cast<int64_t>(r.size()) != n) {
+        residual_bytes_.fetch_add((n - static_cast<int64_t>(r.size())) * 4);
+        r.assign(static_cast<size_t>(n), 0.0f);
+      }
+      residual_tensors_.store(static_cast<int64_t>(residuals_.size()));
+      const float* src = static_cast<const float*>(e.in);
+      std::vector<float> q(static_cast<size_t>(n));
+      for (int64_t i = 0; i < n; ++i) {
+        float v = src[i] + r[i];
+        float w = QuantDequant(v, wire);
+        r[i] = v - w;
+        q[i] = w;
+      }
+      std::vector<char> wbuf(static_cast<size_t>(wire_bytes));
+      CompressBuf(q.data(), wbuf.data(), n, wire);
+      if (timeline_.Enabled())
+        timeline_.Instant(e.name,
+                          std::string("COMPRESS_") + CompressionName(comp));
+      ok = ChannelSendAll(*ch, wbuf.data(), wbuf.size());
+      if (!ok) err = "peer rank " + std::to_string(peer) + " closed";
+    } else {
+      std::vector<char> wbuf(static_cast<size_t>(wire_bytes));
+      ok = ChannelRecvAll(*ch, wbuf.data(), wbuf.size());
+      if (ok)
+        DecompressBuf(wbuf.data(), static_cast<float*>(e.out), n, wire);
+      else
+        err = "peer rank " + std::to_string(peer) + " closed";
+    }
+    timeline_.ActivityEnd(e.name);
+  }
+  timeline_.End(e.name, wire_bytes);
+  if (ok) {
+    if (sender) {
+      p2p_sends_.fetch_add(1);
+      p2p_bytes_out_.fetch_add(wire_bytes);
+    } else {
+      p2p_recvs_.fetch_add(1);
+      p2p_bytes_in_.fetch_add(wire_bytes);
+    }
+    p2p_matched_.fetch_add(1);
+    // One ring entry per transfer; a negative arg marks the receive so
+    // the postmortem distinguishes direction without a second code.
+    if (flight_.Enabled())
+      flight_.Record(FL_P2P, e.name, sender ? wire_bytes : -wire_bytes);
+    CompleteEntry(e, ST_OK, "");
+  } else {
+    data_plane_failed_.store(true);
+    CompleteEntry(e, ST_UNKNOWN,
+                  std::string("p2p ") + (sender ? "send" : "recv") +
+                      " failed: " + err);
+  }
+}
+
+void Engine::ExecuteGroupAllreduce(const Response& resp,
+                                   std::vector<TableEntry>& entries) {
+  // Stage-scoped allreduce (docs/pipeline.md): the DP reduction inside
+  // one pipeline stage.  Never fused (FusesInto), so exactly one entry.
+  // Leader-reduce: the first member gathers, accumulates in f32-free
+  // native width, and redistributes over p2p channels — stage groups
+  // are small (the DP width), so the O(members) star costs less than
+  // building a ring per group.
+  TableEntry& e = entries[0];
+  const std::vector<int32_t>& members = resp.stage_ranks;
+  const int leader = members[0];
+  timeline_.Start(e.name, "GROUP_ALLREDUCE");
+  int64_t n = NumElements(e.dims);
+  size_t esize = DataTypeSize(e.dtype);
+  int64_t nbytes = n * static_cast<int64_t>(esize);
+
+  std::string err;
+  bool ok = true;
+  timeline_.ActivityStart(e.name, "GROUP_ALLREDUCE");
+  if (opts_.rank == leader) {
+    if (e.out != e.in && e.out != nullptr)
+      memcpy(e.out, e.in, static_cast<size_t>(nbytes));
+    std::vector<char> tmp(static_cast<size_t>(nbytes));
+    for (int32_t m : members) {
+      if (m == leader) continue;
+      const Channel* ch = GetP2pChannel(m, &err);
+      if (!ch || !ChannelRecvAll(*ch, tmp.data(), tmp.size())) {
+        if (err.empty())
+          err = "stage member rank " + std::to_string(m) + " closed";
+        ok = false;
+        break;
+      }
+      AccumulateSum(e.out, tmp.data(), n, e.dtype);
+    }
+    if (ok && e.average)
+      DivideBuffer(e.out, n, e.dtype, static_cast<int>(members.size()));
+    if (ok) {
+      for (int32_t m : members) {
+        if (m == leader) continue;
+        const Channel* ch = GetP2pChannel(m, &err);
+        if (!ch || !ChannelSendAll(*ch, e.out, static_cast<size_t>(nbytes))) {
+          if (err.empty())
+            err = "stage member rank " + std::to_string(m) + " closed";
+          ok = false;
+          break;
+        }
+      }
+    }
+  } else {
+    const Channel* ch = GetP2pChannel(leader, &err);
+    ok = ch && ChannelSendAll(*ch, e.in, static_cast<size_t>(nbytes)) &&
+         ChannelRecvAll(*ch, e.out, static_cast<size_t>(nbytes));
+    if (!ok && err.empty())
+      err = "stage leader rank " + std::to_string(leader) + " closed";
+  }
+  timeline_.ActivityEnd(e.name);
+  timeline_.End(e.name, nbytes);
+  if (ok) {
+    p2p_group_ops_.fetch_add(1);
+    CompleteEntry(e, ST_OK, "");
+  } else {
+    data_plane_failed_.store(true);
+    CompleteEntry(e, ST_UNKNOWN, "stage-group allreduce failed: " + err);
+  }
+}
+
+std::string Engine::P2pInfo() {
+  // Unmatched gauge: enqueued send/recv entries still waiting for their
+  // counterpart to announce — the number the pipeline stall diagnosis
+  // starts from.
+  int64_t unmatched = 0;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& kv : table_)
+      if (kv.second.op == OP_SEND || kv.second.op == OP_RECV) ++unmatched;
+  }
+  return std::to_string(p2p_sends_.load()) + "|" +
+         std::to_string(p2p_recvs_.load()) + "|" +
+         std::to_string(p2p_bytes_out_.load()) + "|" +
+         std::to_string(p2p_bytes_in_.load()) + "|" +
+         std::to_string(p2p_matched_.load()) + "|" +
+         std::to_string(unmatched) + "|" +
+         std::to_string(p2p_group_ops_.load()) + "|" +
+         std::to_string(p2p_channels_.load());
 }
 
 void Engine::CompleteEntry(const TableEntry& e, int32_t code,

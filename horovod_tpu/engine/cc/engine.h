@@ -231,6 +231,14 @@ struct TableEntry {
   void* out = nullptr;
   int root_rank = -1;
   bool average = false;
+  // Point-to-point plane (docs/pipeline.md): the counterpart rank and
+  // disambiguation tag for OP_SEND/OP_RECV entries (-1/0 otherwise).
+  int32_t p2p_peer = -1;
+  int32_t p2p_tag = 0;
+  // Stage-group scoping for allreduce: the sorted member ranks this op is
+  // restricted to (empty = whole world).  Carried per-entry, never as
+  // persistent engine state — see wire.h Request.stage_ranks.
+  std::vector<int32_t> stage_ranks;
   int64_t handle = -1;
   std::chrono::steady_clock::time_point enqueued_at;
   // Negotiation latency (enqueue -> response arrival), stamped when the
@@ -267,9 +275,13 @@ class Engine {
   // Returns a handle (>=0) or -1 if the engine is not initialized / shut
   // down.  For allgather, `out` may be null; the result is kept engine-side
   // until CopyResult.  `average` divides the allreduce result by size.
+  // `peer`/`tag` scope OP_SEND/OP_RECV entries to their counterpart rank
+  // (docs/pipeline.md); `stage_ranks` scopes an allreduce to a stage
+  // group's sorted member ranks (empty = whole world).
   int64_t Enqueue(uint8_t op, const std::string& name, const void* in,
                   void* out, const std::vector<int64_t>& dims, uint8_t dtype,
-                  int root_rank, bool average);
+                  int root_rank, bool average, int peer = -1, int tag = 0,
+                  const std::vector<int32_t>& stage_ranks = {});
 
   // 1 = done, 0 = pending, -1 = unknown handle.
   int Poll(int64_t handle);
@@ -429,6 +441,16 @@ class Engine {
   // age + consecutive-miss count at snapshot time.  Empty peer tail when
   // the detector is off (HVD_TPU_HEARTBEAT_MS=0 or size 1).
   std::string LivenessInfo();
+
+  // Point-to-point plane observability (docs/pipeline.md,
+  // docs/metrics.md#p2p).  Serializes
+  // "sends|recvs|bytes_out|bytes_in|matched|unmatched|group_ops|channels"
+  // — process-cumulative send/recv completions and payload bytes
+  // (StallEvents contract), the matched-pair count, the live
+  // unmatched gauge (this rank's announced-but-unpaired p2p entries),
+  // stage-group allreduce count, and the number of dedicated lazy p2p
+  // channels currently dialed.
+  std::string P2pInfo();
 
   // Perf-introspection plane (docs/metrics.md#links / #anomalies).
   // LinkInfo passes through the transport layer's per-peer telemetry
@@ -719,6 +741,29 @@ class Engine {
                         std::vector<TableEntry>& entries);
   void ExecuteAllgather(const Response& resp, TableEntry& e);
   void ExecuteBroadcast(const Response& resp, TableEntry& e);
+  // Point-to-point plane (docs/pipeline.md).  ExecuteSendRecv moves one
+  // matched pair's payload over the p2p channel toward the counterpart:
+  // fp32 payloads honour the response's negotiated wire compression with
+  // per-name error feedback (the allreduce residual contract), so
+  // repeated micro-batch sends never accumulate rounding drift.
+  void ExecuteSendRecv(const Response& resp, TableEntry& e);
+  // Stage-scoped allreduce (DP inside one pipeline stage): leader
+  // gather-reduce-broadcast over p2p channels among resp.stage_ranks.
+  // O(G * bytes) at the leader — fine for the small per-stage DP groups
+  // pipeline parallelism produces; the global ring stays untouched.
+  void ExecuteGroupAllreduce(const Response& resp,
+                             std::vector<TableEntry>& entries);
+  // The channel to `peer`, picked identically on both ends: an existing
+  // topology channel when the peer is a fabric neighbour (node-local shm
+  // ring / cross ring / global ring), else a dedicated TCP connection
+  // dialed lazily at first use (lower rank connects with a kHelloP2P
+  // hello, higher rank accepts on the data listener — deterministic,
+  // because both ends execute the same broadcast response at the same
+  // list position).  nullptr + *err on dial failure.
+  const Channel* GetP2pChannel(int peer, std::string* err);
+  // Drop every dedicated p2p channel (Teardown + reshape: the membership
+  // renumbered, so cached peer fds are stale).
+  void CloseP2pChannels();
   void CompleteEntry(const TableEntry& e, int32_t code,
                      const std::string& error);
 
@@ -903,6 +948,21 @@ class Engine {
   Channel left_ch_, right_ch_;              // flat/global ring
   Channel local_left_ch_, local_right_ch_;  // node-local ring (shm-capable)
   Channel cross_left_ch_, cross_right_ch_;  // cross-node shard ring
+
+  // Point-to-point plane (docs/pipeline.md).  Dedicated lazy channels to
+  // non-neighbour peers, keyed by peer rank; engine thread only.  The
+  // counters are process-cumulative (StallEvents contract) except the
+  // matched/unmatched gauges, which Python reads live.
+  std::unordered_map<int, Channel> p2p_chans_;
+  std::atomic<int64_t> p2p_sends_{0};
+  std::atomic<int64_t> p2p_recvs_{0};
+  std::atomic<int64_t> p2p_bytes_out_{0};
+  std::atomic<int64_t> p2p_bytes_in_{0};
+  std::atomic<int64_t> p2p_matched_{0};
+  std::atomic<int64_t> p2p_group_ops_{0};
+  // Open dedicated-channel gauge (p2p_chans_ is engine-thread-only; the
+  // Python metrics reader sees this atomic mirror instead).
+  std::atomic<int64_t> p2p_channels_{0};
 
   // Data-plane heartbeat detector state.  The beat fds ride the data
   // listener (typed hello kind 6) to this rank's ring neighbours: rank r

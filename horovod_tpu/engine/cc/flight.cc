@@ -29,6 +29,7 @@ const char* FlightEventName(uint8_t event) {
     case FL_HEARTBEAT_MISS: return "heartbeat_miss";
     case FL_ANOMALY:   return "anomaly";
     case FL_TRANSPORT: return "transport";
+    case FL_P2P:       return "p2p";
     default:           return "unknown";
   }
 }

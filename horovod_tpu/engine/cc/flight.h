@@ -57,6 +57,9 @@ enum FlightEventType : uint8_t {
                     // says WHERE the job was slow before it died
   FL_TRANSPORT = 15,  // shared-memory transport armed for the node-local
                       // ring (name: "shm"; arg: per-direction ring bytes)
+  FL_P2P = 16,  // point-to-point transfer executed (docs/pipeline.md;
+                // name: the tensor; arg: payload bytes, negative for a
+                // receive so one ring entry distinguishes direction)
 };
 
 const char* FlightEventName(uint8_t event);

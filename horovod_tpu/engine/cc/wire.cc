@@ -47,6 +47,8 @@ const char* OpName(uint8_t op) {
     case OP_ALLGATHER: return "allgather";
     case OP_BROADCAST: return "broadcast";
     case OP_NOOP: return "cached-negotiation";
+    case OP_SEND: return "send";
+    case OP_RECV: return "recv";
     default: return "<unknown op>";
   }
 }
@@ -128,6 +130,10 @@ std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
     w.Str(r.name);
     w.U8(static_cast<uint8_t>(r.dims.size()));
     for (int64_t d : r.dims) w.I64(d);
+    w.I32(r.p2p_peer);
+    w.I32(r.p2p_tag);
+    w.U32(static_cast<uint32_t>(r.stage_ranks.size()));
+    for (int32_t sr : r.stage_ranks) w.I32(sr);
   }
   w.U32(static_cast<uint32_t>(rl.cache_bits.size()));
   for (uint32_t b : rl.cache_bits) w.U32(b);
@@ -174,6 +180,11 @@ bool ParseRequestList(const std::vector<uint8_t>& buf, RequestList* rl) {
     r.name = rd.Str();
     uint8_t nd = rd.U8();
     for (uint8_t j = 0; j < nd; ++j) r.dims.push_back(rd.I64());
+    r.p2p_peer = rd.I32();
+    r.p2p_tag = rd.I32();
+    uint32_t nsr = rd.U32();
+    for (uint32_t j = 0; j < nsr && rd.ok; ++j)
+      r.stage_ranks.push_back(rd.I32());
     rl->requests.push_back(std::move(r));
   }
   rl->cache_bits.clear();
@@ -251,6 +262,14 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
     w.U32(static_cast<uint32_t>(r.rank_dim0.size()));
     for (int64_t d : r.rank_dim0) w.I64(d);
     w.U8(r.compression);
+    w.I32(r.p2p_src);
+    w.I32(r.p2p_dst);
+    w.I32(r.p2p_tag);
+    w.U8(r.p2p_dtype);
+    w.U32(static_cast<uint32_t>(r.p2p_dims.size()));
+    for (int64_t d : r.p2p_dims) w.I64(d);
+    w.U32(static_cast<uint32_t>(r.stage_ranks.size()));
+    for (int32_t sr : r.stage_ranks) w.I32(sr);
   }
   w.U32(static_cast<uint32_t>(rl.cache_hits.size()));
   for (uint32_t h : rl.cache_hits) w.U32(h);
@@ -306,6 +325,16 @@ bool ParseResponseList(const std::vector<uint8_t>& buf, ResponseList* rl) {
     uint32_t ns = rd.U32();
     for (uint32_t j = 0; j < ns; ++j) r.rank_dim0.push_back(rd.I64());
     r.compression = rd.U8();
+    r.p2p_src = rd.I32();
+    r.p2p_dst = rd.I32();
+    r.p2p_tag = rd.I32();
+    r.p2p_dtype = rd.U8();
+    uint32_t npd = rd.U32();
+    for (uint32_t j = 0; j < npd && rd.ok; ++j)
+      r.p2p_dims.push_back(rd.I64());
+    uint32_t ngr = rd.U32();
+    for (uint32_t j = 0; j < ngr && rd.ok; ++j)
+      r.stage_ranks.push_back(rd.I32());
     rl->responses.push_back(std::move(r));
   }
   rl->cache_hits.clear();

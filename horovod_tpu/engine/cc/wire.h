@@ -34,6 +34,13 @@ enum OpType : uint8_t {
   // these instead of repeating the "__xp.*" metadata allreduce once every
   // rank holds the cached agreement (docs/performance.md).
   OP_NOOP = 3,
+  // Point-to-point plane (docs/pipeline.md): a send/recv pair announces
+  // the SAME tensor name from exactly two ranks, each naming the other in
+  // Request.p2p_peer; the coordinator matches them into one RESP_SENDRECV
+  // — the readiness contract collectives enforce across the world,
+  // narrowed to a pair.
+  OP_SEND = 4,
+  OP_RECV = 5,
 };
 
 // Status codes -- shared with Python.
@@ -85,6 +92,19 @@ struct Request {
   int32_t root_rank = -1;  // broadcast only
   std::string name;
   std::vector<int64_t> dims;
+  // Point-to-point plane (OP_SEND/OP_RECV only): the counterpart rank this
+  // announcement must pair with, and the sender/receiver-agreed channel
+  // tag disambiguating concurrent transfers between the same pair.  -1 /
+  // 0 on collectives.
+  int32_t p2p_peer = -1;
+  int32_t p2p_tag = 0;
+  // Stage-group scoping (docs/pipeline.md#stage-groups): the sorted dense
+  // ranks this collective is restricted to (the DP dimension within a
+  // pipeline stage).  Empty = whole world (every pre-existing op).
+  // Carried per-request rather than as persistent engine state so a
+  // reshape barrier — which clears caches and renumbers ranks — can never
+  // leave a stale membership armed anywhere.
+  std::vector<int32_t> stage_ranks;
 };
 
 // One cache slot's announcements folded across a node by its
@@ -158,6 +178,10 @@ enum ResponseType : uint8_t {
   RESP_BROADCAST = 2,
   RESP_ERROR = 3,
   RESP_NOOP = 4,  // negotiation-only (OP_NOOP): stamp completion, no data
+  // Matched send/recv pair (docs/pipeline.md): broadcast to EVERY rank so
+  // response caches mutate in lockstep, executed only by the two ranks
+  // named in p2p_src/p2p_dst.
+  RESP_SENDRECV = 5,
 };
 
 // Coordinator verdict: either an (optionally fused) operation every rank must
@@ -175,6 +199,25 @@ struct Response {
   // replays recompute it locally from the same lockstep-mutated state
   // (engine.cc ProcessCacheHits), so fresh and replayed buckets agree.
   uint8_t compression = COMP_NONE;
+  // Point-to-point plane (RESP_SENDRECV only): the matched pair and tag.
+  // Compression (above) applies to the inter-stage hop exactly as to an
+  // allreduce bucket: the coordinator stamps the verdict, the sender
+  // narrows, the receiver widens.
+  int32_t p2p_src = -1;
+  int32_t p2p_dst = -1;
+  int32_t p2p_tag = 0;
+  // Slot metadata for partial-participation ops (RESP_SENDRECV and
+  // stage-scoped RESP_ALLREDUCE): dtype + dims of the negotiated tensor,
+  // so ranks OUTSIDE the pair/group — which hold no table entry — can
+  // still Put an identical response-cache slot at the same index
+  // (docs/performance.md's lockstep-mutation contract; without this the
+  // bit protocol would desynchronize on the first p2p op).
+  uint8_t p2p_dtype = 0;
+  std::vector<int64_t> p2p_dims;
+  // Stage-group scoping for RESP_ALLREDUCE (empty = whole world); echoes
+  // the agreed Request.stage_ranks so replays and non-members see the
+  // membership without holding a request.
+  std::vector<int32_t> stage_ranks;
 };
 
 struct ResponseList {

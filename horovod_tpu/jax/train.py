@@ -263,3 +263,131 @@ def build_train_step(loss_fn: Callable, optimizer, mesh: Mesh,
         check_vma=check_vma)
     return _TimedStep(jax.jit(mapped, donate_argnums=(0, 1)
                               if donate else ()))
+
+
+# ---------------------------------------------------------------------------
+# Pipeline parallelism glue (docs/pipeline.md).
+# ---------------------------------------------------------------------------
+
+def run_pipeline(stage_modules, stage_params, optimizer, batches,
+                 n_stages: Optional[int] = None,
+                 n_microbatches: Optional[int] = None,
+                 loss_fn=None, prefix: str = "pipe",
+                 tag: Optional[int] = None):
+    """Pipelined training loop: 1F1B over the engine's p2p plane.
+
+    The world is a ``stages x data-parallel`` grid (contiguous ranks per
+    stage).  Each step runs the 1F1B (or interleaved, when
+    ``stage_modules`` holds several chunks) schedule through
+    :class:`~horovod_tpu.parallel.pipeline.PipelineRunner`, DP-averages
+    the accumulated parameter gradients over this stage's
+    ``hvd.stage_group`` — never the full world — and applies
+    ``optimizer`` (an ``optax.GradientTransformation``) locally.
+
+    ``stage_modules``/``stage_params`` are THIS rank's chunks (see
+    ``partition_transformer`` / ``partition_params``).  ``batches``
+    iterates ``(inputs, targets)`` per-DP-rank batches; every rank passes
+    its DP shard (the first stage consumes inputs, the last targets, and
+    every stage derives the fixed activation-bucket geometry from the
+    input shape).  ``loss_fn(logits, targets)`` runs on the last stage
+    (default ``models.next_token_loss``).
+
+    Knobs (overridable by argument): ``HVD_TPU_PIPELINE_STAGES``,
+    ``HVD_TPU_PIPELINE_MICROBATCHES`` (default 4),
+    ``HVD_TPU_PIPELINE_TAG`` (p2p tag base, default 0 — bump to isolate
+    concurrent pipelines' tensor namespaces).
+
+    Returns ``(stage_params, opt_state, losses)`` — ``losses`` carries
+    one mean micro-batch loss per step on last-stage ranks, Nones
+    elsewhere.
+    """
+    import os
+
+    import numpy as np
+    import optax
+
+    from horovod_tpu import common as hvd
+    from horovod_tpu.models.transformer import next_token_loss
+    from horovod_tpu.parallel.pipeline import (EngineTransport,
+                                               PipelineGrid,
+                                               PipelineRunner)
+
+    if n_stages is None:
+        n_stages = int(os.environ.get("HVD_TPU_PIPELINE_STAGES", "0"))
+    if n_stages < 1:
+        raise ValueError(
+            "pass n_stages= or set HVD_TPU_PIPELINE_STAGES (>= 1)")
+    if n_microbatches is None:
+        n_microbatches = int(
+            os.environ.get("HVD_TPU_PIPELINE_MICROBATCHES", "4"))
+    if tag is None:
+        tag = int(os.environ.get("HVD_TPU_PIPELINE_TAG", "0"))
+
+    grid = PipelineGrid(n_stages, hvd.size(), hvd.rank())
+    last = grid.stage == n_stages - 1
+    if loss_fn is None and last:
+        loss_fn = next_token_loss
+    runner = PipelineRunner(stage_modules, stage_params, grid,
+                            n_microbatches, EngineTransport(tag),
+                            loss_fn=loss_fn, prefix=prefix)
+    group = (hvd.stage_group(grid.stage_ranks()) if grid.dp > 1 else None)
+    opt_state = [optimizer.init(p) for p in runner.params]
+    losses = []
+    try:
+        for inputs, targets in batches:
+            runner.set_bucket_shape(inputs.shape[0] // n_microbatches,
+                                    inputs.shape[1])
+            loss, grads = runner.step(inputs if grid.stage == 0 else None,
+                                      targets if last else None)
+            for chunk, gtree in enumerate(grads):
+                if gtree is None:
+                    continue
+                if group is not None:
+                    # DP-average within the stage: scoped collective,
+                    # named per leaf so the cycle replays through the
+                    # response cache like the p2p stream does.  The
+                    # stage id is part of the name — stage groups are
+                    # disjoint, so the same leaf index negotiates
+                    # concurrently in every stage.
+                    leaves, treedef = jax.tree.flatten(gtree)
+                    reduced = [
+                        hvd.allreduce(
+                            np.asarray(leaf, np.float32),
+                            name=(f"{prefix}.s{grid.stage}.grad"
+                                  f".c{chunk}.l{i}"),
+                            group=group)
+                        for i, leaf in enumerate(leaves)]
+                    gtree = jax.tree.unflatten(treedef, reduced)
+                updates, opt_state[chunk] = optimizer.update(
+                    jax.tree.map(jnp_asarray, gtree), opt_state[chunk],
+                    runner.params[chunk])
+                runner.params[chunk] = optax.apply_updates(
+                    runner.params[chunk], updates)
+            losses.append(loss)
+        # Closing world barrier: stage groups are disjoint, so without
+        # it a fast stage can finish its last DP reduction and tear the
+        # job down (hvd.shutdown in the caller) while another stage's
+        # group collective is still in flight — which aborts that
+        # collective with a shutdown error instead of completing it.
+        hvd.allreduce(np.zeros(1, np.float32), name=f"{prefix}.barrier")
+    except hvd.RanksDownError as exc:
+        # PipelineRunner.step wraps aborts it sees, but a stage death
+        # can just as well surface in the DP grad reduction or the
+        # closing barrier (the survivors race the failure detector);
+        # every survivor must still read the dead STAGE, not just a
+        # rank number (docs/pipeline.md#faults).
+        if str(exc).startswith("pipeline aborted"):
+            raise
+        stages = sorted({grid.stage_of(r) for r in exc.ranks})
+        named = ", ".join(f"stage {s} (ranks {grid.stage_ranks(s)})"
+                          for s in stages) or "unknown stage"
+        raise hvd.RanksDownError(
+            f"pipeline aborted: {named} died: {exc}", exc.ranks) from exc
+    return runner.params, opt_state, losses
+
+
+def jnp_asarray(x):
+    """numpy -> jnp leaf cast for post-allreduce gradient trees."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)

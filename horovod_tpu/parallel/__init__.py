@@ -16,3 +16,18 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     replicate,
     shard_batch,
 )
+from horovod_tpu.parallel.pipeline import (  # noqa: F401
+    EngineTransport,
+    LocalTransport,
+    PipeAction,
+    PipelineGrid,
+    PipelineRunner,
+    TransformerStage,
+    bubble_fraction,
+    partition_params,
+    partition_transformer,
+    run_local_pipeline,
+    schedule_1f1b,
+    schedule_interleaved,
+    simulate_schedule,
+)

@@ -1,0 +1,712 @@
+"""Pipeline parallelism: 1F1B schedules over the p2p plane (docs/pipeline.md).
+
+The world is arranged as a ``stages x data-parallel`` grid
+(:class:`PipelineGrid`): collectives scoped to one stage's
+:func:`~horovod_tpu.common.stage_group` reduce along the DP axis, while
+activations and activation-gradients cross stages over the engine's
+point-to-point plane (``hvd.send``/``hvd.recv``).  The schedule layer is
+pure Python — :func:`schedule_1f1b` and :func:`schedule_interleaved`
+emit per-stage action lists, :func:`simulate_schedule` model-checks any
+schedule's cross-stage dependencies in-process — so schedule bugs are
+unit-test failures, not 4-rank hangs.
+
+Micro-batch activations travel on **fixed-shape float32 buckets**: every
+cycle re-announces the same (name, shape, dtype) sequence, so after the
+first step the PR-4 response cache serves the negotiation and the PR-13
+zero-frame steady state can take over the control plane entirely.
+
+:class:`TransformerStage` partitions ``models/transformer.py`` by layer
+range under the SAME parameter names, so :func:`partition_params` slices
+a full-model checkpoint into per-stage trees exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "PipeAction", "schedule_1f1b", "schedule_interleaved",
+    "bubble_fraction", "simulate_schedule", "PipelineGrid",
+    "TransformerStage", "partition_transformer", "partition_params",
+    "LocalTransport", "EngineTransport", "PipelineRunner",
+    "run_local_pipeline",
+]
+
+
+class PipeAction(NamedTuple):
+    """One slot of a stage's schedule: run ``kind`` ("fwd"/"bwd") for
+    micro-batch ``microbatch`` of model chunk ``chunk`` (always 0 without
+    interleaving)."""
+
+    kind: str
+    microbatch: int
+    chunk: int = 0
+
+
+def schedule_1f1b(stage: int, n_stages: int, n_micro: int) -> List[PipeAction]:
+    """The non-interleaved 1F1B schedule for one stage.
+
+    Warmup runs ``n_stages - 1 - stage`` forwards, the steady state
+    alternates one-forward-one-backward (peak activation stash is
+    ``warmup + 1`` micro-batches instead of GPipe's ``n_micro``), and the
+    cooldown drains the remaining backwards.
+    """
+    if not (0 <= stage < n_stages):
+        raise ValueError(f"stage {stage} out of range for {n_stages} stages")
+    if n_micro < 1:
+        raise ValueError(f"need at least one micro-batch, got {n_micro}")
+    warmup = min(n_stages - 1 - stage, n_micro)
+    actions = [PipeAction("fwd", i) for i in range(warmup)]
+    fwd, bwd = warmup, 0
+    for _ in range(n_micro - warmup):
+        actions.append(PipeAction("fwd", fwd))
+        fwd += 1
+        actions.append(PipeAction("bwd", bwd))
+        bwd += 1
+    for _ in range(warmup):
+        actions.append(PipeAction("bwd", bwd))
+        bwd += 1
+    return actions
+
+
+def schedule_interleaved(stage: int, n_stages: int, n_micro: int,
+                         n_chunks: int) -> List[PipeAction]:
+    """The interleaved (virtual-stage) 1F1B schedule.
+
+    Each rank holds ``n_chunks`` model chunks; virtual stage
+    ``v = chunk * n_stages + stage`` shrinks the bubble by ``1/n_chunks``
+    at the price of more p2p traffic.  Micro-batches advance in groups of
+    ``n_stages`` per chunk (the Megatron-LM ordering), which requires
+    ``n_micro`` to divide evenly.
+    """
+    if n_chunks == 1:
+        return schedule_1f1b(stage, n_stages, n_micro)
+    if n_chunks < 1:
+        raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
+    if n_micro % n_stages:
+        raise ValueError(
+            f"interleaved schedule needs n_micro ({n_micro}) divisible by "
+            f"n_stages ({n_stages})")
+    total = n_micro * n_chunks
+    group = n_stages * n_chunks
+
+    def fwd_at(k: int) -> PipeAction:
+        chunk = (k // n_stages) % n_chunks
+        mb = (k // group) * n_stages + k % n_stages
+        return PipeAction("fwd", mb, chunk)
+
+    def bwd_at(k: int) -> PipeAction:
+        chunk = n_chunks - 1 - (k // n_stages) % n_chunks
+        mb = (k // group) * n_stages + k % n_stages
+        return PipeAction("bwd", mb, chunk)
+
+    warmup = min((n_stages - stage - 1) * 2 + (n_chunks - 1) * n_stages,
+                 total)
+    actions = [fwd_at(k) for k in range(warmup)]
+    fwd, bwd = warmup, 0
+    for _ in range(total - warmup):
+        actions.append(fwd_at(fwd))
+        fwd += 1
+        actions.append(bwd_at(bwd))
+        bwd += 1
+    for _ in range(warmup):
+        actions.append(bwd_at(bwd))
+        bwd += 1
+    return actions
+
+
+def bubble_fraction(n_stages: int, n_micro: int, n_chunks: int = 1) -> float:
+    """Idle fraction of the 1F1B pipeline: ``(S-1) / (S-1 + M*V)`` —
+    the warmup/cooldown ramps amortized over the micro-batch stream."""
+    ramp = n_stages - 1
+    return ramp / (ramp + n_micro * n_chunks)
+
+
+def simulate_schedule(n_stages: int, n_micro: int, n_chunks: int = 1,
+                      schedule_fn: Optional[Callable] = None) -> int:
+    """Model-check a schedule's cross-stage dependencies in-process.
+
+    Runs every stage's action list against the data-dependency rules —
+    a forward needs the previous virtual stage's forward of the same
+    micro-batch, a backward needs the local forward plus the next virtual
+    stage's backward — and raises on deadlock, double execution, or an
+    unexecuted action.  Returns the number of lock-step ticks (each tick
+    every stage executes at most one ready action): the wall-clock shape
+    the bubble fraction predicts.
+    """
+    if schedule_fn is None:
+        schedule_fn = (schedule_1f1b if n_chunks == 1 else
+                       lambda s, S, M: schedule_interleaved(s, S, M,
+                                                            n_chunks))
+    plans = [schedule_fn(s, n_stages, n_micro) for s in range(n_stages)]
+    cursor = [0] * n_stages
+    done = set()  # (kind, microbatch, virtual_stage)
+    last_virtual = n_stages * n_chunks - 1
+    ticks = 0
+    while any(cursor[s] < len(plans[s]) for s in range(n_stages)):
+        progressed = False
+        for s in range(n_stages):
+            if cursor[s] >= len(plans[s]):
+                continue
+            kind, mb, chunk = plans[s][cursor[s]]
+            v = chunk * n_stages + s
+            if kind == "fwd":
+                ready = v == 0 or ("fwd", mb, v - 1) in done
+            else:
+                ready = ("fwd", mb, v) in done and (
+                    v == last_virtual or ("bwd", mb, v + 1) in done)
+            if not ready:
+                continue
+            key = (kind, mb, v)
+            if key in done:
+                raise AssertionError(f"duplicate action {key} at stage {s}")
+            done.add(key)
+            cursor[s] += 1
+            progressed = True
+        if not progressed:
+            stuck = {s: plans[s][cursor[s]] for s in range(n_stages)
+                     if cursor[s] < len(plans[s])}
+            raise AssertionError(f"schedule deadlock; blocked on {stuck}")
+        ticks += 1
+    expected = 2 * n_micro * n_chunks * n_stages
+    if len(done) != expected:
+        raise AssertionError(
+            f"schedule executed {len(done)} actions, expected {expected}")
+    return ticks
+
+
+class PipelineGrid:
+    """Rank layout of a ``stages x data-parallel`` job.
+
+    Stage-major and contiguous: stage ``s`` owns global ranks
+    ``[s*dp, (s+1)*dp)``, so a stage's DP group is a consecutive rank
+    range and the pipeline peer at the same DP index is ``rank ± dp``.
+    Contiguity matters for transport reuse: with ranks packed per host,
+    a stage's DP collectives stay on intra-host shm rings while only the
+    stage boundary crosses hosts.
+    """
+
+    def __init__(self, n_stages: int, world: int, rank: int):
+        if n_stages < 1:
+            raise ValueError(f"n_stages must be >= 1, got {n_stages}")
+        if world % n_stages:
+            raise ValueError(
+                f"world size {world} does not divide into {n_stages} "
+                f"pipeline stages")
+        if not (0 <= rank < world):
+            raise ValueError(f"rank {rank} out of range for world {world}")
+        self.n_stages = n_stages
+        self.world = world
+        self.rank = rank
+        self.dp = world // n_stages
+        self.stage = rank // self.dp
+        self.dp_index = rank % self.dp
+
+    def stage_ranks(self, stage: Optional[int] = None) -> List[int]:
+        stage = self.stage if stage is None else stage
+        return list(range(stage * self.dp, (stage + 1) * self.dp))
+
+    def rank_of(self, stage: int, dp_index: Optional[int] = None) -> int:
+        dp_index = self.dp_index if dp_index is None else dp_index
+        return stage * self.dp + dp_index
+
+    def stage_of(self, rank: int) -> int:
+        return rank // self.dp
+
+    @property
+    def next_rank(self) -> int:
+        """Peer holding the next pipeline stage (wraps for interleaved
+        chunk boundaries: the last stage's forward feeds stage 0's next
+        chunk)."""
+        return self.rank_of((self.stage + 1) % self.n_stages)
+
+    @property
+    def prev_rank(self) -> int:
+        return self.rank_of((self.stage - 1) % self.n_stages)
+
+
+# ---------------------------------------------------------------------------
+# Transformer partitioning (models/transformer.py -> stage submodules).
+# ---------------------------------------------------------------------------
+
+def _split_layers(n_layers: int, n_virtual: int) -> List[List[int]]:
+    """Contiguous, near-even layer assignment: the first
+    ``n_layers % n_virtual`` virtual stages take one extra layer."""
+    if n_virtual > n_layers:
+        raise ValueError(
+            f"cannot split {n_layers} layers over {n_virtual} virtual "
+            f"stages (stages x chunks)")
+    base, extra = divmod(n_layers, n_virtual)
+    out, at = [], 0
+    for v in range(n_virtual):
+        n = base + (1 if v < extra else 0)
+        out.append(list(range(at, at + n)))
+        at += n
+    return out
+
+
+def TransformerStage(*args, **kwargs):  # noqa: N802 - class factory
+    """Deferred import wrapper so ``pipeline``'s schedule layer stays
+    importable without flax; see :func:`_build_stage_cls`."""
+    return _build_stage_cls()(*args, **kwargs)
+
+
+_STAGE_CLS = None
+
+
+def _build_stage_cls():
+    global _STAGE_CLS
+    if _STAGE_CLS is not None:
+        return _STAGE_CLS
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import Block
+
+    class _TransformerStage(nn.Module):
+        """One pipeline stage of ``TransformerLM``: a contiguous layer
+        range, plus the embedding on the first virtual stage and
+        final-norm + lm-head on the last.  Parameter names match the
+        full model exactly (``embed``, ``layer_<i>``, ``final_norm``,
+        ``lm_head_kernel``), so :func:`partition_params` slices a
+        full-model tree into loadable stage trees."""
+
+        vocab_size: int
+        d_model: int
+        n_heads: int
+        layer_ids: tuple
+        d_ff: Optional[int] = None
+        dtype: Any = jnp.bfloat16
+        is_first: bool = False
+        is_last: bool = False
+        use_flash: bool = True
+
+        @nn.compact
+        def __call__(self, x):
+            d_ff = self.d_ff or 4 * self.d_model
+            if self.is_first:
+                x = nn.Embed(self.vocab_size, self.d_model,
+                             dtype=self.dtype, name="embed")(x)
+            for i in self.layer_ids:
+                x = Block(self.n_heads, d_ff, self.dtype,
+                          use_flash=self.use_flash, name=f"layer_{i}")(x)
+            if self.is_last:
+                x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
+                w = self.param(
+                    "lm_head_kernel",
+                    nn.initializers.variance_scaling(
+                        1.0, "fan_in", "truncated_normal"),
+                    (self.d_model, self.vocab_size), jnp.float32)
+                x = jnp.einsum(
+                    "bsd,dv->bsv", x.astype(self.dtype),
+                    w.astype(self.dtype),
+                    preferred_element_type=jnp.float32)
+            return x
+
+    _STAGE_CLS = _TransformerStage
+    return _STAGE_CLS
+
+
+def partition_transformer(vocab_size: int, d_model: int, n_layers: int,
+                          n_heads: int, n_stages: int, n_chunks: int = 1,
+                          d_ff: Optional[int] = None,
+                          dtype: Any = None, use_flash: bool = True
+                          ) -> List[List[Any]]:
+    """Stage submodules for a ``TransformerLM`` split over
+    ``n_stages x n_chunks`` virtual stages; returns
+    ``modules[stage][chunk]`` (virtual order ``chunk * n_stages +
+    stage``, matching the interleaved schedule)."""
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if dtype is None else dtype
+    n_virtual = n_stages * n_chunks
+    layers = _split_layers(n_layers, n_virtual)
+    cls = _build_stage_cls()
+    out: List[List[Any]] = [[] for _ in range(n_stages)]
+    for stage in range(n_stages):
+        for chunk in range(n_chunks):
+            v = chunk * n_stages + stage
+            out[stage].append(cls(
+                vocab_size=vocab_size, d_model=d_model, n_heads=n_heads,
+                layer_ids=tuple(layers[v]), d_ff=d_ff, dtype=dtype,
+                is_first=(v == 0), is_last=(v == n_virtual - 1),
+                use_flash=use_flash))
+    return out
+
+
+def partition_params(full_params: dict, n_layers: int, n_stages: int,
+                     n_chunks: int = 1) -> List[List[dict]]:
+    """Slice a full ``TransformerLM`` param tree into per-virtual-stage
+    trees (``params[stage][chunk]``) by the same layer split
+    :func:`partition_transformer` uses.  Loss parity against the
+    unpartitioned model is then exact: identical parameters, identical
+    math, just distributed."""
+    n_virtual = n_stages * n_chunks
+    layers = _split_layers(n_layers, n_virtual)
+    out: List[List[dict]] = [[] for _ in range(n_stages)]
+    for stage in range(n_stages):
+        for chunk in range(n_chunks):
+            v = chunk * n_stages + stage
+            tree = {f"layer_{i}": full_params[f"layer_{i}"]
+                    for i in layers[v]}
+            if v == 0:
+                tree["embed"] = full_params["embed"]
+            if v == n_virtual - 1:
+                tree["final_norm"] = full_params["final_norm"]
+                tree["lm_head_kernel"] = full_params["lm_head_kernel"]
+            out[stage].append(tree)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Transports: where activations/grads travel.
+# ---------------------------------------------------------------------------
+
+class LocalTransport:
+    """In-process transport for unit tests: every stage runner shares one
+    instance, sends append to named queues, receives drain them.  Peer
+    ranks are ignored — the canonical tensor names are globally unique
+    per step, exactly as on the wire."""
+
+    def __init__(self):
+        from collections import defaultdict, deque
+
+        self._queues = defaultdict(deque)
+
+    def send(self, array: np.ndarray, peer: int, name: str) -> None:
+        self._queues[name].append(np.array(array, copy=True))
+
+    def can_recv(self, name: str) -> bool:
+        return bool(self._queues.get(name))
+
+    def recv(self, out: np.ndarray, peer: int, name: str) -> None:
+        out[...] = self._queues[name].popleft()
+
+    def flush(self) -> None:
+        pass
+
+
+class EngineTransport:
+    """The real thing: p2p over the engine (docs/pipeline.md).  Sends are
+    enqueued asynchronously and flushed at step end — a blocking send
+    would deadlock against the blocking receive the 1F1B steady state
+    interleaves it with; the engine's paired-readiness negotiation
+    orders the actual transfers."""
+
+    def __init__(self, tag: int = 0):
+        self.tag = tag
+        self._pending: list = []
+
+    def send(self, array: np.ndarray, peer: int, name: str) -> None:
+        from horovod_tpu import common as hvd
+
+        # Keep the buffer referenced until flush: the engine reads it at
+        # execute time, after this call returned.
+        buf = np.ascontiguousarray(array)
+        self._pending.append(hvd.send_async(buf, peer, self.tag, name))
+
+    def can_recv(self, name: str) -> bool:
+        return True  # recv() blocks; the engine thread makes progress
+
+    def recv(self, out: np.ndarray, peer: int, name: str) -> None:
+        from horovod_tpu import common as hvd
+
+        hvd.recv(out, peer, self.tag, name)
+
+    def flush(self) -> None:
+        pending, self._pending = self._pending, []
+        for handle in pending:
+            handle.wait()
+
+
+# ---------------------------------------------------------------------------
+# The runner: one rank's schedule execution.
+# ---------------------------------------------------------------------------
+
+class PipelineRunner:
+    """Execute a 1F1B (or interleaved) schedule for one rank's stage.
+
+    ``stages``/``params`` are per-chunk lists (length 1 without
+    interleaving).  The runner stashes one VJP closure per in-flight
+    micro-batch (the 1F1B stash bound: ``warmup + 1``), accumulates
+    parameter gradients per chunk, and moves activations on fixed-shape
+    float32 buckets through the given transport.  ``loss_fn(logits,
+    targets)`` runs on the last virtual stage only.
+
+    A mid-schedule stage death surfaces from the engine as
+    :class:`~horovod_tpu.common.RanksDownError`; the runner re-raises it
+    naming the dead *stage* so pipeline operators see grid coordinates,
+    not just rank numbers.
+    """
+
+    def __init__(self, stages: Sequence, params: Sequence, grid: PipelineGrid,
+                 n_micro: int, transport, loss_fn=None,
+                 prefix: str = "pipe"):
+        if len(stages) != len(params):
+            raise ValueError("stages and params must pair per chunk")
+        self.stages = list(stages)
+        self.params = list(params)
+        self.grid = grid
+        self.n_chunks = len(self.stages)
+        self.n_micro = n_micro
+        self.transport = transport
+        self.loss_fn = loss_fn
+        if grid.stage == grid.n_stages - 1 and loss_fn is None:
+            raise ValueError(
+                "the last pipeline stage computes the loss: pass loss_fn=")
+        self.prefix = prefix
+        self.schedule = schedule_interleaved(
+            grid.stage, grid.n_stages, n_micro, self.n_chunks)
+        self._n_virtual = grid.n_stages * self.n_chunks
+        self._reset()
+
+    def _reset(self):
+        self._cursor = 0
+        self._stash = {}           # (mb, chunk) -> vjp closure
+        self._grads = [None] * self.n_chunks
+        self._losses: list = []
+        self._inputs = None
+        self._targets = None
+        self._recv_buf = {}        # chunk -> reusable activation bucket
+
+    def _virtual(self, chunk: int) -> int:
+        return chunk * self.grid.n_stages + self.grid.stage
+
+    def _fwd_name(self, v: int, mb: int) -> str:
+        # Named by the RECEIVING virtual stage: both ends derive it from
+        # the edge, so the sender of v-1 and the receiver at v agree.
+        return f"{self.prefix}.fwd.v{v}.mb{mb}"
+
+    def _bwd_name(self, v: int, mb: int) -> str:
+        return f"{self.prefix}.bwd.v{v}.mb{mb}"
+
+    def _needed_recv(self, action: PipeAction) -> Optional[str]:
+        v = self._virtual(action.chunk)
+        if action.kind == "fwd":
+            return None if v == 0 else self._fwd_name(v, action.microbatch)
+        return (None if v == self._n_virtual - 1
+                else self._bwd_name(v, action.microbatch))
+
+    # -- step drivers -------------------------------------------------------
+
+    def begin_step(self, inputs=None, targets=None) -> None:
+        """Arm one optimization step.  ``inputs`` (first stage) and
+        ``targets`` (last stage) are full per-DP-rank batches, split
+        into ``n_micro`` equal micro-batches along axis 0."""
+        self._reset()
+        if inputs is not None:
+            if inputs.shape[0] % self.n_micro:
+                raise ValueError(
+                    f"batch dim {inputs.shape[0]} does not split into "
+                    f"{self.n_micro} micro-batches")
+            self._inputs = np.split(np.asarray(inputs), self.n_micro)
+        if targets is not None:
+            if targets.shape[0] % self.n_micro:
+                raise ValueError(
+                    f"target dim {targets.shape[0]} does not split into "
+                    f"{self.n_micro} micro-batches")
+            self._targets = np.split(np.asarray(targets), self.n_micro)
+
+    @property
+    def done(self) -> bool:
+        return self._cursor >= len(self.schedule)
+
+    def try_next(self) -> bool:
+        """Execute the next scheduled action if its input is available
+        (cooperative mode: the in-process driver round-robins stages).
+        Returns False when blocked or done."""
+        if self.done:
+            return False
+        action = self.schedule[self._cursor]
+        needed = self._needed_recv(action)
+        if needed is not None and not self.transport.can_recv(needed):
+            return False
+        self._execute(action)
+        self._cursor += 1
+        return True
+
+    def finish_step(self):
+        """``(loss, grads)`` after the schedule drained: mean micro-batch
+        loss on the last virtual stage (None elsewhere), per-chunk
+        parameter-gradient trees everywhere."""
+        if not self.done:
+            raise RuntimeError(
+                f"schedule not drained: {self._cursor}/"
+                f"{len(self.schedule)} actions done")
+        self.transport.flush()
+        loss = (float(np.mean(self._losses)) if self._losses else None)
+        return loss, self._grads
+
+    def step(self, inputs=None, targets=None):
+        """Blocking end-to-end step (engine transport): run the whole
+        schedule, return :meth:`finish_step`'s ``(loss, grads)``."""
+        from horovod_tpu.common import RanksDownError
+
+        self.begin_step(inputs, targets)
+        try:
+            while not self.done:
+                if not self.try_next():
+                    raise RuntimeError(
+                        "pipeline blocked with a non-blocking transport; "
+                        "use run_local_pipeline to drive multiple stages "
+                        "in one process")
+            return self.finish_step()
+        except RanksDownError as exc:
+            stages = sorted({self.grid.stage_of(r) for r in exc.ranks})
+            named = ", ".join(f"stage {s} (ranks "
+                              f"{self.grid.stage_ranks(s)})"
+                              for s in stages) or "unknown stage"
+            raise RanksDownError(
+                f"pipeline aborted mid-schedule at action "
+                f"{self._cursor}/{len(self.schedule)}: {named} died: "
+                f"{exc}", exc.ranks) from exc
+
+    # -- action execution ---------------------------------------------------
+
+    def _bucket(self, chunk: int, shape, dtype) -> np.ndarray:
+        """The fixed-shape receive bucket for this chunk — allocated
+        once, reused every micro-batch, so the announced (name, shape,
+        dtype) stream repeats exactly and stays cacheable."""
+        buf = self._recv_buf.get(chunk)
+        if buf is None or buf.shape != tuple(shape) or buf.dtype != dtype:
+            buf = np.empty(shape, dtype)
+            self._recv_buf[chunk] = buf
+        return buf
+
+    def _stage_fn(self, chunk: int, v: int, mb: int):
+        import jax.numpy as jnp
+
+        stage = self.stages[chunk]
+        if v == self._n_virtual - 1 and self.loss_fn is not None:
+            if self._targets is None:
+                raise ValueError(
+                    "last pipeline stage needs targets= in begin_step")
+            tgt = jnp.asarray(self._targets[mb])
+
+            def fn(p, x):
+                return self.loss_fn(stage.apply({"params": p}, x), tgt)
+        else:
+            def fn(p, x):
+                return stage.apply({"params": p}, x)
+        return fn
+
+    def _act_shape(self, mb: int):
+        """Activation bucket geometry between virtual stages: (micro
+        batch, seq, d_model) float32 — model-dtype outputs upcast for
+        the wire (cross-host hops re-compress to bf16/fp8 under
+        HVD_TPU_COMPRESSION, with error feedback)."""
+        src = self._inputs[mb] if self._inputs is not None else None
+        if src is None:
+            raise ValueError("first pipeline stage needs inputs= in "
+                             "begin_step")
+        return (src.shape[0], src.shape[1], self.stages[0].d_model)
+
+    def _execute(self, action: PipeAction) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        mb, chunk = action.microbatch, action.chunk
+        v = self._virtual(chunk)
+        first_v, last_v = v == 0, v == self._n_virtual - 1
+        if action.kind == "fwd":
+            if first_v:
+                x = jnp.asarray(self._inputs[mb])
+            else:
+                shape = (*self._recv_shape_hint(), )
+                buf = self._bucket(chunk, shape, np.float32)
+                self.transport.recv(buf, self._fwd_peer(recv=True),
+                                    self._fwd_name(v, mb))
+                x = jnp.array(buf)
+            fn = self._stage_fn(chunk, v, mb)
+            if first_v:
+                out, vjp = jax.vjp(lambda p: fn(p, x), self.params[chunk])
+            else:
+                out, vjp = jax.vjp(fn, self.params[chunk], x)
+            self._stash[(mb, chunk)] = vjp
+            if last_v:
+                self._losses.append(float(out))
+            else:
+                self.transport.send(
+                    np.asarray(out, np.float32), self._fwd_peer(recv=False),
+                    self._fwd_name(v + 1, mb))
+        else:
+            vjp = self._stash.pop((mb, chunk))
+            if last_v:
+                # Seed 1/M: the step loss is the micro-batch mean, so the
+                # accumulated grads equal the full-batch mean gradient.
+                seed = jnp.float32(1.0 / self.n_micro)
+            else:
+                shape = (*self._recv_shape_hint(), )
+                buf = self._bucket(chunk, shape, np.float32)
+                self.transport.recv(buf, self._bwd_peer(recv=True),
+                                    self._bwd_name(v, mb))
+                seed = jnp.array(buf)
+            cots = vjp(seed)
+            dparams = cots[0]
+            acc = self._grads[chunk]
+            self._grads[chunk] = dparams if acc is None else jax.tree.map(
+                lambda a, b: a + b, acc, dparams)
+            if not first_v:
+                self.transport.send(
+                    np.asarray(cots[1], np.float32),
+                    self._bwd_peer(recv=False), self._bwd_name(v - 1, mb))
+
+    def _recv_shape_hint(self):
+        """Geometry of incoming buckets.  Every virtual stage moves
+        (micro_batch, seq, d_model); the first stage knows it from its
+        inputs, others carry it via set_bucket_shape."""
+        if self._bucket_shape is not None:
+            return self._bucket_shape
+        if self._inputs is not None:
+            return self._act_shape(0)
+        raise ValueError(
+            "pipeline stage needs set_bucket_shape(micro_batch, seq, "
+            "d_model) before stepping (fixed-shape bucket contract)")
+
+    _bucket_shape = None
+
+    def set_bucket_shape(self, micro_batch: int, seq: int,
+                         d_model: Optional[int] = None) -> None:
+        """Declare the fixed activation-bucket geometry.  Mandatory on
+        stages that never see ``inputs=``; the shape is part of the p2p
+        contract — the coordinator rejects a sender/receiver mismatch
+        with a typed precondition error rather than corrupting a
+        transfer."""
+        d_model = d_model if d_model is not None else self.stages[0].d_model
+        self._bucket_shape = (micro_batch, seq, d_model)
+
+    def _fwd_peer(self, recv: bool) -> int:
+        return self.grid.prev_rank if recv else self.grid.next_rank
+
+    def _bwd_peer(self, recv: bool) -> int:
+        return self.grid.next_rank if recv else self.grid.prev_rank
+
+
+def run_local_pipeline(runners: Sequence[PipelineRunner], inputs,
+                       targets) -> tuple:
+    """Drive every stage of a pipeline in ONE process over a shared
+    :class:`LocalTransport` — the unit-test harness: cooperative
+    round-robin until every schedule drains, deadlock detected when no
+    stage can move.  Returns ``(loss, [per-stage grads])``."""
+    for i, r in enumerate(runners):
+        r.begin_step(inputs if r.grid.stage == 0 else None,
+                     targets if r.grid.stage == r.grid.n_stages - 1
+                     else None)
+        if r.grid.stage != 0:
+            mb = inputs.shape[0] // r.n_micro
+            r.set_bucket_shape(mb, inputs.shape[1])
+    while not all(r.done for r in runners):
+        progressed = False
+        for r in runners:
+            if r.try_next():
+                progressed = True
+        if not progressed:
+            stuck = {r.grid.stage: r.schedule[r._cursor]
+                     for r in runners if not r.done}
+            raise AssertionError(
+                f"pipeline deadlock; stages blocked on {stuck}")
+    results = [r.finish_step() for r in runners]
+    loss = next((lo for lo, _ in results if lo is not None), None)
+    return loss, [g for _, g in results]

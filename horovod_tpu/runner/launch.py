@@ -221,9 +221,18 @@ def _warn_sanitize_once(msg: str) -> None:
         print(f"hvdrun: WARNING: {msg}", file=sys.stderr)
 
 
-def allocate_endpoints(size: int, host: str = "127.0.0.1"):
-    coord = f"{host}:{pick_free_port(host)}"
-    data = [f"{host}:{pick_free_port(host)}" for _ in range(size)]
+def allocate_endpoints(size: int, host: str = "127.0.0.1", extra: int = 0):
+    """Coordinator + per-rank data endpoints, picked as ONE held batch
+    (pick_free_ports) so no port is handed out twice within a launch.
+    ``extra`` reserves additional ports in the same batch; they come
+    back as a third element when requested."""
+    from horovod_tpu.common.basics import pick_free_ports
+
+    ports = pick_free_ports(size + 1 + extra, host)
+    coord = f"{host}:{ports[0]}"
+    data = [f"{host}:{p}" for p in ports[1:size + 1]]
+    if extra:
+        return coord, data, ports[size + 1:]
     return coord, data
 
 
@@ -285,13 +294,16 @@ def run_command(cmd: Sequence[str], np: int,
     failure.  Returns per-rank results (stdout/stderr only if capture).
     ``tpu_pin`` confines each rank's libtpu client to the chip matching
     its local_rank (runner/tpu_pin.py)."""
-    coord, data = allocate_endpoints(np, host)
-    xla_coord = f"{host}:{pick_free_port(host)}"
+    # One held batch for every port this launch needs — separate picks
+    # can collide with each other once their probe sockets close.
+    coord, data, spare = allocate_endpoints(
+        np, host, extra=1 + (np if tpu_pin else 0))
+    xla_coord = f"{host}:{spare[0]}"
     pin_envs = [{} for _ in range(np)]
     if tpu_pin:
         from horovod_tpu.runner.tpu_pin import pin_env
 
-        addresses = [f"{host}:{pick_free_port(host)}" for _ in range(np)]
+        addresses = [f"{host}:{p}" for p in spare[1:]]
         pin_envs = [pin_env(r, r, np, 0, 1, addresses, tpu_topology)
                     for r in range(np)]
     procs = []

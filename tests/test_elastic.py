@@ -500,6 +500,8 @@ def test_run_elastic_rejects_unsupported_combos():
         run_elastic(["true"], 2, min_np=1, tpu_pin=True)
 
 
+@pytest.mark.slow  # ~8s; probe/join machinery stays tier-1 in
+# test_standby_rejoins_and_grows_back
 def test_trickled_probe_cannot_stall_the_job(tmp_path, monkeypatch):
     """A connect to the elastic control port that sends a PARTIAL join
     hello and then goes idle (slow trickle, health check, port scanner
@@ -515,10 +517,10 @@ def test_trickled_probe_cannot_stall_the_job(tmp_path, monkeypatch):
     captured = {}
     real = launch.allocate_endpoints
 
-    def spy(size, host="127.0.0.1"):
-        coord, data = real(size, host)
-        captured["coord"] = coord
-        return coord, data
+    def spy(size, host="127.0.0.1", **kw):
+        out = real(size, host, **kw)
+        captured["coord"] = out[0]
+        return out
 
     monkeypatch.setattr(launch, "allocate_endpoints", spy)
 

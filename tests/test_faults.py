@@ -147,6 +147,9 @@ def test_crash_fault_surfaces_ranks_down_error():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~18s; the collective-timeout sweep + typed error stay
+# tier-1 in test_pipeline.py::test_unmatched_send_times_out_naming_tensor
+# _and_peer (same HVD_TPU_COLLECTIVE_TIMEOUT_SEC backstop, p2p plane)
 def test_hang_fault_surfaces_collective_timeout_error():
     """A hung rank keeps its engine ticking (liveness looks healthy), so
     only the HVD_TPU_COLLECTIVE_TIMEOUT_SEC deadline can catch it: the
@@ -319,6 +322,9 @@ if r == 0:
 """
 
 
+@pytest.mark.slow  # ~8s; the relaunch loop stays tier-1 in
+# test_transport.py::test_max_restarts_relaunch_rebuilds_shm and the
+# checkpoint-restore path in test_elastic.py::test_shrink_to_one_smoke
 def test_max_restarts_resumes_from_checkpoint(tmp_path):
     """The end-to-end restart contract: rank 1 crashes mid-run (epoch 0
     only — unepoched clauses are first-run-gated), hvdrun kills the
@@ -655,6 +661,9 @@ def test_flaky_link_degrades_transparently():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~11s; anomaly verdict plumbing stays tier-1 in
+# test_metrics.py::test_links_and_anomalies_sections and the chaos
+# transport demotion in test_transport.py
 def test_chaos_localization_names_the_slow_link():
     """link=0-2:delay=5 on a 4-rank job: the endpoints of the degraded
     link (ranks 0 and 2) must each emit a ``slow_link`` verdict whose

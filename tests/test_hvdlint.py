@@ -466,6 +466,28 @@ def test_hvdlint_clean_on_this_repo():
     assert "OK" in proc.stdout, proc.stdout
 
 
+def test_p2p_plane_is_registered_not_suppressed():
+    """The p2p plane extends the checker REGISTRIES (the sanctioned
+    path) rather than sprinkling inline suppressions: the sender-side
+    residual update is whitelisted by function, and the metrics `p2p`
+    section maps to its rendered Prometheus families."""
+    from tools.hvdlint.lockstep_check import WHITELIST
+    from tools.hvdlint.metrics_check import SECTION_FAMILIES
+
+    assert "Engine::ExecuteSendRecv" in WHITELIST
+    assert "p2p" in SECTION_FAMILIES
+    assert "hvd_tpu_p2p_transfers_total" in SECTION_FAMILIES["p2p"]
+    assert "hvd_tpu_p2p_unmatched" in SECTION_FAMILIES["p2p"]
+    # Zero inline escape hatches in the p2p work (the satellite bar).
+    cc = os.path.join(REPO, "horovod_tpu", "engine", "cc", "engine.cc")
+    with open(cc) as f:
+        text = f.read()
+    for fn in ("ExecuteSendRecv", "ExecuteGroupAllreduce", "GetP2pChannel"):
+        start = text.find(f"Engine::{fn}")
+        assert start != -1, fn
+        assert "hvdlint: lockstep-ok" not in text[start:start + 4000], fn
+
+
 def _scratch_copy(tmp_path):
     """Copy the lintable scope of this repo into a scratch root the text
     checkers can be pointed at (binaries and caches skipped)."""

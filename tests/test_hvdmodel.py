@@ -51,13 +51,19 @@ def test_quick_is_clean_and_exhaustive():
     assert "truncated" not in proc.stdout, proc.stdout
     for event in ("steady_enter", "steady_exit", "reshape_shrink",
                   "reshape_grow", "crash", "freeze", "stale_drop",
-                  "hb_detect", "abort:ST_TIMEOUT"):
+                  "hb_detect", "abort:ST_TIMEOUT",
+                  # The p2p plane (docs/pipeline.md#fault-semantics):
+                  # paired-readiness negotiation end to end, plus the
+                  # blocked-forever and timeout terminals.
+                  "p2p_announce", "p2p_match", "p2p_execute",
+                  "p2p_blocked", "p2p_timeout"):
         assert event in proc.stdout, (event, proc.stdout)
 
 
 @pytest.mark.parametrize("bug", ["skip-revoke", "stale-epoch",
                                  "no-requeue",
-                                 "drop-heartbeat-revoke"])
+                                 "drop-heartbeat-revoke",
+                                 "p2p-unmatched-send"])
 def test_seeded_bug_is_caught_with_trace(bug):
     proc = _run_cli("--bug", bug)
     assert proc.returncode == 1, (bug, proc.stdout, proc.stderr)
@@ -90,14 +96,17 @@ def test_explorer_finds_shortest_deadlock_in_process():
 
 
 def test_quick_configs_declare_distinct_regimes():
-    """quick() pins four regimes: the coordinator tree, the elastic
+    """quick() pins six regimes: the coordinator tree, the elastic
     star, the revoke-only liveness config (group_timeout disabled —
-    the revocation broadcast alone must keep survivors live), and the
+    the revocation broadcast alone must keep survivors live), the
     heartbeat-off config (HVD_TPU_HEARTBEAT_MS=0 — the legacy
-    exchange-silence ST_TIMEOUT contract)."""
+    exchange-silence ST_TIMEOUT contract), and the two p2p configs
+    (paired readiness under faults, and the lost-recv timeout path —
+    docs/pipeline.md#fault-semantics)."""
     cfgs = {c.name: c for c in configs.quick()}
     assert set(cfgs) == {"quick-tree", "quick-elastic",
-                         "quick-revoke-only", "quick-hb-off"}
+                         "quick-revoke-only", "quick-hb-off",
+                         "quick-p2p", "quick-p2p-lost"}
     assert not cfgs["quick-tree"].elastic
     assert cfgs["quick-elastic"].elastic
     assert cfgs["quick-revoke-only"].elastic
@@ -106,3 +115,7 @@ def test_quick_configs_declare_distinct_regimes():
     assert cfgs["quick-tree"].heartbeat is True
     assert cfgs["quick-hb-off"].heartbeat is False
     assert "freeze:1" in cfgs["quick-hb-off"].faults
+    assert cfgs["quick-p2p"].p2p == (1, 2)
+    assert not cfgs["quick-p2p"].p2p_lost_recv
+    assert cfgs["quick-p2p-lost"].p2p_lost_recv
+    assert cfgs["quick-p2p-lost"].fault_budget == 0  # pure liveness

@@ -97,6 +97,9 @@ def test_vgg_tiny_forward():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow  # ~25s; the shard_map DP training step stays tier-1 in
+# test_transformer.py::test_dp_sp_train_step (allreduce-averaged grads
+# over a device mesh) and driver hooks keep calling dryrun directly
 def test_dryrun_multichip_8():
     import __graft_entry__ as ge
 

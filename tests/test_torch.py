@@ -271,6 +271,8 @@ def test_torch_broadcast_parameters_and_optimizer_state():
         assert torch.allclose(gathered[i], gathered[0])
 
 
+@pytest.mark.slow  # ~9s; optimizer-state sync stays tier-1 in
+# test_torch_broadcast_optimizer_state_resume_asymmetry
 @distributed_test(np_=2)
 def test_torch_optimizer_state_bootstrap_empty():
     """broadcast_optimizer_state on a never-stepped optimizer initializes

@@ -51,6 +51,8 @@ WHITELIST = {
     "Engine::ProcessCacheHits": "replays broadcast cache hits in order",
     "Engine::PerformOperation": "cache insert/erase in response-list order",
     "Engine::ExecuteAllreduce": "residual update while executing the list",
+    "Engine::ExecuteSendRecv": "p2p residual update while executing the "
+                               "list (sender-side error feedback)",
     # Steady state (PR 13): the pattern is installed by a broadcast and
     # replayed self-clocked; its cursors move in canonical slot order on
     # every rank, so the replay loop IS the lockstep.

@@ -66,6 +66,12 @@ SECTION_FAMILIES = {
                  "hvd_tpu_liveness_evictions_total",
                  "hvd_tpu_liveness_clock_fanin",
                  "hvd_tpu_liveness_peer_age_us"),
+    "p2p": ("hvd_tpu_p2p_transfers_total",
+            "hvd_tpu_p2p_bytes_total",
+            "hvd_tpu_p2p_matched_total",
+            "hvd_tpu_p2p_unmatched",
+            "hvd_tpu_p2p_group_ops_total",
+            "hvd_tpu_p2p_channels"),
     "links": ("hvd_tpu_link_stats_enabled",
               "hvd_tpu_link_bytes_total",
               "hvd_tpu_link_sends_total",

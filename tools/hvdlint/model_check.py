@@ -46,6 +46,17 @@ FAMILIES = {
     "ResponseList": (
         "MODELED_RESPONSE_FIELDS",
         re.compile(r"^(steady_.*|reshape_.*|member_.*|membership_epoch)$")),
+    # Point-to-point plane (docs/pipeline.md): the per-item pairing fields
+    # drive the coordinator's paired-readiness negotiation, which the
+    # model's p2p announce/match/execute states abstract — a field added
+    # to either struct without extending the model would let the explorer
+    # verify a protocol the engine no longer speaks.
+    "Request": (
+        "MODELED_P2P_REQUEST_FIELDS",
+        re.compile(r"^(p2p_.*|stage_.*)$")),
+    "Response": (
+        "MODELED_P2P_RESPONSE_FIELDS",
+        re.compile(r"^(p2p_.*|stage_.*)$")),
 }
 
 STATUS_SET = "MODELED_STATUS_CODES"

@@ -16,7 +16,14 @@ checker parses the struct definitions out of wire.h and verifies:
    lockstep mid-run but not re-broadcast at the reshape barrier would
    leave admitted standbys running the env default while survivors run
    the tuned value (the divergence class docs/fault-tolerance.md's
-   re-agreement contract exists to prevent).
+   re-agreement contract exists to prevent).  The same twin rule covers
+   any LIST-LEVEL ``p2p_<knob>`` / ``stage_<knob>`` field of
+   ResponseList: persistent p2p/stage-membership state broadcast in
+   lockstep must be re-broadcast at the barrier.  (The per-item
+   ``Request.stage_ranks`` / ``Response.p2p_*`` fields deliberately
+   don't trip this: membership travels with each op and the barrier
+   clears every cache, so no stale stage state can survive a reshape —
+   the audit behind docs/pipeline.md#fault-semantics.)
 """
 
 from __future__ import annotations
@@ -140,9 +147,11 @@ def check(root: str) -> List[Violation]:
     rl_lines = dict(all_fields.get("ResponseList", []))
     if rl_names:
         for field in sorted(rl_names):
-            if not field.startswith("tuned_") or field in _TUNED_BOOKKEEPING:
+            prefix = next((p for p in ("tuned_", "p2p_", "stage_")
+                           if field.startswith(p)), None)
+            if prefix is None or field in _TUNED_BOOKKEEPING:
                 continue
-            want = "reshape_" + field[len("tuned_"):]
+            want = "reshape_" + field[len(prefix):]
             if want not in rl_names:
                 out.append(Violation(
                     "wire", WIRE_H, rl_lines[field],

@@ -17,6 +17,11 @@ from .model import BUGS
 REQUIRED_QUICK_COVERAGE = (
     "steady_enter", "steady_exit", "reshape_shrink", "reshape_grow",
     "crash", "freeze", "stale_drop", "hb_detect", "abort:ST_TIMEOUT",
+    # Point-to-point plane (docs/pipeline.md): the pair's full healthy
+    # lifecycle, the blocked-sender state, and the paired-readiness
+    # timeout sweep must all be reached by --quick.
+    "p2p_announce", "p2p_match", "p2p_execute", "p2p_blocked",
+    "p2p_timeout",
 )
 
 

@@ -39,6 +39,24 @@ def quick():
         Config("quick-hb-off", hosts=((0,), (1,)),
                threshold=2, ticks=3, fault_budget=1,
                faults=("freeze:1",), heartbeat=False),
+        # Point-to-point plane (docs/pipeline.md): a cross-host pair
+        # announced through the coordinator tree — announce/match/execute
+        # on the healthy path, and a crash/freeze of the receiver mid-
+        # negotiation must end in the existing typed aborts (the blocked
+        # sender, R_P2P, is released by the abort broadcast, never
+        # stranded).  Steady is disabled (threshold=0) to bound the
+        # product space — the steady x p2p interplay is covered by the
+        # engine's tier-1 replay tests, not the model.
+        Config("quick-p2p", hosts=((0, 1), (2, 3)),
+               threshold=0, ticks=3, fault_budget=1,
+               faults=("crash:2", "freeze:2"), p2p=(1, 2), p2p_tick=1),
+        # Paired-readiness liveness: the recv is NEVER posted (the peer
+        # stays alive and beating, invisible to EOF and heartbeat), so
+        # the only legal outcome is the collective-timeout sweep firing
+        # ST_TIMEOUT — act_p2p_timeout — on every rank.
+        Config("quick-p2p-lost", hosts=((0,), (1,), (2,)),
+               threshold=0, ticks=3, fault_budget=0,
+               p2p=(1, 2), p2p_tick=1, p2p_lost_recv=True),
     ]
 
 
@@ -72,8 +90,19 @@ def seeded(bug):
     MarkRankDead): with the detector nominally on, the exchange-silence
     timeout defers to it, so the frozen rank is never evicted and the
     survivors stall forever — the missed-eviction trace the detector
-    exists to prevent (ISSUE 17)."""
+    exists to prevent (ISSUE 17).
+
+    ``p2p-unmatched-send`` severs the paired-readiness backstop
+    (act_p2p_timeout, i.e. CheckCollectiveTimeout skipping p2p entries):
+    with the recv never posted, the announced send strands its rank in
+    R_P2P, the coordinator's shutdown gate holds forever, and the whole
+    job silently stalls — the shortest trace is send-announce, tick
+    close without a counterpart, everyone else finishing, stall."""
     assert bug in BUGS, bug
+    if bug == "p2p-unmatched-send":
+        return Config("seeded-%s" % bug, hosts=((0,), (1,), (2,)),
+                      threshold=0, ticks=3, fault_budget=0, bug=bug,
+                      p2p=(1, 2), p2p_tick=1, p2p_lost_recv=True)
     fault = ("freeze:2" if bug == "drop-heartbeat-revoke" else "crash:2")
     return Config("seeded-%s" % bug, hosts=((0,), (1,), (2,)),
                   elastic=True, min_size=1, threshold=1, ticks=4,

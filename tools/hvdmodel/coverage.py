@@ -10,7 +10,11 @@ and compared BIDIRECTIONALLY with ``engine/cc/wire.h``:
     ``hb_report``, ``membership_epoch``);
   * ``MODELED_RESPONSE_FIELDS`` must equal the steady/reshape family of
     ``ResponseList`` fields (``steady_*``, ``reshape_*``, ``member_*``,
-    ``membership_epoch``).
+    ``membership_epoch``);
+  * ``MODELED_P2P_REQUEST_FIELDS`` / ``MODELED_P2P_RESPONSE_FIELDS``
+    must equal the point-to-point/stage-group family (``p2p_*``,
+    ``stage_*``) of per-item ``Request`` / ``Response`` fields — the
+    paired-readiness negotiation the p2p states of the model abstract.
 
 Every name must also be referenced somewhere in the model source (see
 ``model.STATUS`` / ``model.WIRE_BINDING``) — deleting a modeled status
@@ -58,4 +62,19 @@ MODELED_RESPONSE_FIELDS = {
     "member_old_ranks",
     "member_endpoints",
     "reshape_lost",
+}
+
+MODELED_P2P_REQUEST_FIELDS = {
+    "p2p_peer",
+    "p2p_tag",
+    "stage_ranks",
+}
+
+MODELED_P2P_RESPONSE_FIELDS = {
+    "p2p_src",
+    "p2p_dst",
+    "p2p_tag",
+    "p2p_dtype",
+    "p2p_dims",
+    "stage_ranks",
 }

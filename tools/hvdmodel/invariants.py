@@ -20,8 +20,8 @@ survivors must now end in evict-and-reshape + completion — anything else
 configs (HVD_TPU_HEARTBEAT_MS=0) keep the legacy timeout contract.
 """
 
-from .model import (R_ABORT, R_CRASH, R_DONE, R_FROZEN, R_RUN, R_STANDBY,
-                    R_STEADY, R_STUCK, R_WAIT, STATUS)
+from .model import (R_ABORT, R_CRASH, R_DONE, R_FROZEN, R_P2P, R_RUN,
+                    R_STANDBY, R_STEADY, R_STUCK, R_WAIT, STATUS)
 
 TYPED = {STATUS[k] for k in
          ("ST_ABORTED", "ST_RANKS_DOWN", "ST_TIMEOUT")}
@@ -72,6 +72,11 @@ def _derived_faults(cfg, st):
         used.add("newt")
     if coord[9] or any(s in coord[7] for s in cfg.standby):
         used.add("join")
+    if cfg.p2p and cfg.p2p_lost_recv:
+        # The application-level mismatch (recv never posted) is a
+        # configured fault: a terminal must resolve it through the
+        # paired-readiness timeout sweep, never a silent hang.
+        used.add("p2p-lost")
     return used
 
 
@@ -95,6 +100,12 @@ def classify_terminal(cfg, st):
                 "rank(s) %s stranded with a dropped op"
                 % [r for r, m in modes.items() if m == R_STUCK])
     if not all_exited:
+        if any(m == R_P2P for m in modes.values()):
+            return (False, None,
+                    "rank(s) %s blocked forever on an unmatched p2p "
+                    "announce (paired-readiness deadlock: the send "
+                    "never reached the timeout sweep)"
+                    % [r for r, m in modes.items() if m == R_P2P])
         return (False, None,
                 "stalled with live ranks in modes %s, abort=%d"
                 % (sorted(modes.values()), abort))
@@ -174,6 +185,16 @@ def classify_terminal(cfg, st):
                     "freeze without the heartbeat detector must abort "
                     "ST_TIMEOUT, got %d" % abort)
         return (True, None, "typed ST_TIMEOUT")
+    if used == {"p2p-lost"}:
+        # Paired-readiness invariant: the peer is alive and beating, so
+        # the ONLY legal resolution for the unmatched announce is the
+        # coordinator's collective-timeout sweep (ST_TIMEOUT naming the
+        # tensor and the absent peer).
+        if abort != STATUS["ST_TIMEOUT"]:
+            return (False, None,
+                    "unmatched p2p announce must reach the timeout "
+                    "sweep (ST_TIMEOUT), got abort=%d" % abort)
+        return (True, None, "typed ST_TIMEOUT (paired-readiness)")
     # Multi-fault (deep configs): any typed abort is acceptable.
     return (True, None, "typed abort %d under faults %s"
             % (abort, sorted(used)))

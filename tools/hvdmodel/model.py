@@ -21,6 +21,22 @@ Abstractions (deliberate, documented):
     explored in non-elastic configurations.
   * Rank 0 / sub-coordinator crash is out of scope (host failure is the
     state plane's job, PR 11); crash/freeze faults target leaf ranks.
+  * Point-to-point plane (docs/pipeline.md): one send/recv pair per
+    config (``cfg.p2p``), announced on the participants' regular tick
+    frames at ``cfg.p2p_tick``.  The announce bit is stamped into the
+    coordinator state when the frame is BUILT (monotone early stamp:
+    the engine stamps its message table on frame receipt, but a tick
+    cannot close without that same frame, so the two are
+    indistinguishable at every tick boundary).  A participant whose
+    announce is still unmatched at its tick close blocks in
+    ``handle.wait()`` — mode ``R_P2P`` — and its engine thread's
+    subsequent EMPTY frames are folded away (the gatherings stop
+    expecting it) rather than enumerated.  ``cfg.p2p_lost_recv`` models
+    the application-level mismatch (the recv is never posted): the
+    counterpart stays alive and beating, so only the coordinator's
+    collective-timeout sweep can catch it — the paired-readiness
+    invariant (an unmatched send must reach ST_TIMEOUT, never a silent
+    hang).
 """
 
 # Rank modes.
@@ -33,6 +49,7 @@ R_ABORT = "A"    # consumed a typed abort broadcast
 R_DONE = "D"     # consumed the shutdown broadcast
 R_STUCK = "X"    # bug mode only: dropped a pending op (no-requeue bug)
 R_STANDBY = "B"  # standby: connected but not yet admitted by a reshape
+R_P2P = "P"      # announced a send/recv, blocked on the unmatched handle
 
 # Typed status codes mirrored from engine/cc/wire.h (model_check enforces
 # the full ST_* enum is listed here; see also coverage.py).  The protocol
@@ -80,6 +97,26 @@ WIRE_BINDING = {
     "reshape_cross_algo_threshold": "abstracted: autotune payload reset",
 }
 
+# Point-to-point wire binding (hvdlint model_check FAMILIES "Request" /
+# "Response"): the per-item pairing fields and the model concept each is
+# abstracted into.  The model tracks announce ROLES, not payloads: the
+# coordinator's paired-readiness check (exactly one send + one recv,
+# mutual peers, equal tag/dims/dtype) collapses to the two-bit coord
+# `p2p` field, and a validation mismatch is a RESP_ERROR the engine
+# surfaces pre-protocol (like ST_PRECONDITION, no transition).
+P2P_WIRE_BINDING = {
+    # Request
+    "p2p_peer": "participant identity: cfg.p2p = (src, dst)",
+    "p2p_tag": "abstracted: one pair per config, tag agreement implicit",
+    "stage_ranks": "abstracted: group scoping narrows the announce count "
+                   "the same way the pair does (act_coord_tick match)",
+    # Response
+    "p2p_src": "the matched pair broadcast: ('resp', ep, 'p2p') frame",
+    "p2p_dst": "the matched pair broadcast: ('resp', ep, 'p2p') frame",
+    "p2p_dtype": "abstracted: slot metadata for the lockstep cache Put",
+    "p2p_dims": "abstracted: slot metadata for the lockstep cache Put",
+}
+
 # Seeded-bug switches (each disables one of the engine's defenses so the
 # explorer demonstrably catches the class of bug it guards against).
 # ``drop-heartbeat-revoke`` severs the monitor-to-coordinator escalation
@@ -87,7 +124,7 @@ WIRE_BINDING = {
 # and, with the detector owning freeze detection (act_timeout defers to
 # it), the job stalls forever — the missed-eviction trace of ISSUE 17.
 BUGS = ("skip-revoke", "stale-epoch", "no-requeue",
-        "drop-heartbeat-revoke")
+        "drop-heartbeat-revoke", "p2p-unmatched-send")
 
 
 class Config:
@@ -95,7 +132,8 @@ class Config:
 
     def __init__(self, name, hosts, elastic=False, min_size=1, standby=(),
                  threshold=2, ticks=4, fault_budget=0, faults=(), bug=None,
-                 group_timeout=True, heartbeat=True):
+                 group_timeout=True, heartbeat=True, p2p=None, p2p_tick=1,
+                 p2p_lost_recv=False):
         self.name = name
         self.hosts = tuple(tuple(h) for h in hosts)
         self.elastic = elastic
@@ -116,6 +154,17 @@ class Config:
         # HVD_TPU_HEARTBEAT_MS=0 — frozen ranks are then only caught by
         # the exchange-silence timeout (act_timeout).
         self.heartbeat = heartbeat
+        # One send/recv pair per config: (src, dst) announcing on their
+        # tick-`p2p_tick` frames.  Rank 0 is excluded as a participant —
+        # a blocked rank 0 APP is engine-legal (its engine thread keeps
+        # ticking) but the model folds blocked ranks' empty frames away,
+        # and rank 0's in-process merge is the tick anchor.
+        self.p2p = tuple(p2p) if p2p else None
+        self.p2p_tick = p2p_tick
+        self.p2p_lost_recv = p2p_lost_recv
+        if self.p2p is not None:
+            assert len(self.p2p) == 2 and 0 not in self.p2p, p2p
+            assert self.p2p[0] != self.p2p[1], p2p
         self.bug = bug
         assert bug in (None,) + BUGS, bug
         self.nranks = max(max(h) for h in self.hosts) + 1
@@ -135,14 +184,18 @@ def initial_state(cfg):
         (R_STANDBY if r in cfg.standby else R_RUN, 0, 0, 0, -1)
         for r in range(cfg.nranks))
     subs = tuple(((), ()) for _ in cfg.hosts)
-    coord = (0, (), False, (), (), 0, False, cfg.initial_alive(), 0, False)
+    coord = (0, (), False, (), (), 0, False, cfg.initial_alive(), 0, False,
+             ())
     down = tuple(() for _ in range(cfg.nranks))
     return (ranks, subs, coord, (), down, -1, cfg.fault_budget, False)
 
 
 # -- tuple accessors (kept as plain indices for hashing speed) ----------
 # rank: (mode, epoch, tick, exitm, pat)
-# coord: (epoch, got, shut, exits, dead, hist, steady, alive, abort, joinp)
+# coord: (epoch, got, shut, exits, dead, hist, steady, alive, abort,
+#         joinp, p2p) — p2p is the announce-role latch for cfg.p2p:
+#         () nothing announced, ("r",)/("s",) partial, ("r","s")
+#         both in (matched at the next tick close), ("M",) matched.
 
 def _rank(ranks, r, **kw):
     m, e, t, x, p = ranks[r]
@@ -156,7 +209,7 @@ def _rank(ranks, r, **kw):
 
 def _coord(c, **kw):
     keys = ("epoch", "got", "shut", "exits", "dead", "hist", "steady",
-            "alive", "abort", "joinp")
+            "alive", "abort", "joinp", "p2p")
     vals = dict(zip(keys, c))
     vals.update(kw)
     return tuple(vals[k] for k in keys)
@@ -168,10 +221,14 @@ def _push_down(down, r, frame):
     return tuple(out)
 
 
-def _live_members(cfg, h, alive, dead_known):
-    """Host members the gatherer still expects a frame from."""
+def _live_members(cfg, h, alive, dead_known, ranks):
+    """Host members the gatherer still expects a frame from.  A rank
+    blocked on an unmatched p2p handle (R_P2P) keeps its engine thread
+    ticking but contributes only empty frames — folded away here rather
+    than enumerated (see the module docstring)."""
     return tuple(r for r in cfg.hosts[h]
-                 if r in alive and r not in dead_known)
+                 if r in alive and r not in dead_known
+                 and ranks[r][0] != R_P2P)
 
 
 # -- frame application on a rank (response consumption) -----------------
@@ -186,9 +243,34 @@ def _apply_down(cfg, ranks, r, frame, events):
     if kind == "abort":
         return _rank(ranks, r, mode=R_ABORT)
     if kind == "shut":
+        if mode == R_P2P:
+            # Shutdown with a pending p2p handle: the op is stranded
+            # (the gate in act_coord_tick makes this unreachable; kept
+            # so a gate regression screams instead of "completing").
+            return _rank(ranks, r, mode=R_STUCK)
         return _rank(ranks, r, mode=R_DONE)
+    if mode == R_P2P:
+        if kind == "resp" and payload == "p2p":
+            # The counterpart finally announced and the coordinator
+            # matched the pair: the blocked handle completes and the
+            # program resumes (ExecuteSendRecv + CompleteEntry).
+            events.add("p2p_execute")
+            return _rank(ranks, r, mode=R_RUN, tick=tick + 1)
+        return ranks  # empty tick / straggler while the app is blocked
     if mode == R_WAIT:
         if kind == "resp":
+            if (cfg.p2p and r in cfg.p2p and tick == cfg.p2p_tick
+                    and payload != "p2p"
+                    and not (r == cfg.p2p[1] and cfg.p2p_lost_recv)):
+                # This rank's tick-`p2p_tick` frame announced its half
+                # of the pair, but the tick closed without the
+                # counterpart: the app blocks in handle.wait() and this
+                # rank stops contributing work (R_P2P).
+                events.add("p2p_blocked")
+                return _rank(ranks, r, mode=R_P2P)
+            if (cfg.p2p and r in cfg.p2p and tick == cfg.p2p_tick
+                    and payload == "p2p"):
+                events.add("p2p_execute")
             return _rank(ranks, r, mode=R_RUN, tick=tick + 1)
         if kind == "steady":
             events.add("steady_enter")
@@ -241,7 +323,8 @@ def _coord_merge(cfg, st, agg, events):
     """Merge an aggregate into rank 0's gathering.  The stale-epoch guard
     and the duplicate-host guard live here (engine: CoordinatorHandle)."""
     ranks, subs, coord, up, down, newt, fb, stale = st
-    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp) = coord
+    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp,
+     p2p) = coord
     _, h, fep, fshut, fexits, fdead = agg
     if fep < cep and cfg.bug != "stale-epoch":
         events.add("stale_drop")
@@ -288,10 +371,28 @@ def act_send(cfg, st):
         mode, epoch, tick, exitm, pat = ranks[r]
         if mode != R_RUN or r not in alive or coord[8]:
             continue
+        if tick > cfg.ticks:
+            # The shutdown-signaling frame (tick == cfg.ticks) was sent
+            # and answered; further frames are empty keepalives the
+            # model folds away.  Unreachable while the shutdown
+            # broadcast is prompt; reachable when the p2p gate in
+            # act_coord_tick holds the shutdown back (seeded
+            # p2p-unmatched-send: the job must visibly STALL, not spin).
+            continue
         h = cfg.host_of[r]
         fshut = tick >= cfg.ticks
         nranks = _rank(ranks, r, mode=R_WAIT, exitm=0)
         ev = set()
+        ncoord = coord
+        if cfg.p2p and r in cfg.p2p and tick == cfg.p2p_tick:
+            role = "s" if r == cfg.p2p[0] else "r"
+            if (not (role == "r" and cfg.p2p_lost_recv)
+                    and role not in coord[10] and "M" not in coord[10]):
+                # The announce rides this frame; the bit is stamped at
+                # build time (monotone early stamp, module docstring).
+                ev.add("p2p_announce")
+                ncoord = _coord(coord, p2p=tuple(sorted(
+                    set(coord[10]) | {role})))
         if r == cfg.leaders[h]:
             gathered, sdead = subs[h]
             if any(g[0] == r for g in gathered):
@@ -301,12 +402,12 @@ def act_send(cfg, st):
                                      + ((r, epoch, fshut, exitm),))),
                         sdead)
             out.append(("send(%d)" % r,
-                        (nranks, tuple(nsubs), coord, up, down, newt, fb,
+                        (nranks, tuple(nsubs), ncoord, up, down, newt, fb,
                          stale), ev))
         else:
             frame = ("leaf", h, r, epoch, fshut, exitm)
             out.append(("send(%d)" % r,
-                        (nranks, subs, coord, up + (frame,), down, newt,
+                        (nranks, subs, ncoord, up + (frame,), down, newt,
                          fb, stale), ev))
     return out
 
@@ -365,7 +466,7 @@ def act_sub_flush(cfg, st):
         gathered, sdead = subs[h]
         if not gathered:
             continue
-        need = _live_members(cfg, h, alive, sdead)
+        need = _live_members(cfg, h, alive, sdead, ranks)
         have = tuple(g[0] for g in gathered)
         if not need or set(have) != set(need):
             continue
@@ -396,7 +497,8 @@ def act_coord_tick(cfg, st):
     order mirrors ProcessResponseList/CoordinatorMaybeReshape: reshape
     barrier first, then shutdown, then steady entry / normal response."""
     ranks, subs, coord, up, down, newt, fb, stale = st
-    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp) = coord
+    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp,
+     p2p) = coord
     if abort:
         return []
     if ranks[0][0] != R_WAIT:
@@ -404,7 +506,8 @@ def act_coord_tick(cfg, st):
         # its in-process frame and finished the per-child recv loop —
         # never while rank 0 is between passes (RunLoopOnce structure).
         return []
-    need_hosts = set(cfg.host_of[r] for r in alive if r not in dead)
+    need_hosts = set(cfg.host_of[r] for r in alive
+                     if r not in dead and ranks[r][0] != R_P2P)
     if not need_hosts or not set(got) >= need_hosts:
         return []
     live = tuple(r for r in alive if r not in dead)
@@ -439,7 +542,7 @@ def act_coord_tick(cfg, st):
             ev.add("reshape_shrink")
         ncoord = _coord(coord, epoch=cep + 1, got=(), shut=False,
                         exits=(), dead=(), hist=0, steady=False,
-                        alive=newalive, joinp=False)
+                        alive=newalive, joinp=False, p2p=())
         # Sub dead-marks are consumed by the barrier (membership reset).
         nsubs = tuple(((), ()) for _ in cfg.hosts)
         frame = ("reshape", cep + 1, newalive)
@@ -458,12 +561,33 @@ def act_coord_tick(cfg, st):
                  (nranks, nsubs, ncoord, up, ndown, newt, fb, stale), ev)]
     if not cfg.elastic and dead:
         return []  # handled by act_coord_abort (EOF cascade)
-    if shut:
+    if shut and p2p not in ((), ("M",)):
+        # An announced-but-unmatched pair sits in the message table: the
+        # coordinator refuses to take the shutdown branch while entries
+        # are outstanding (the op must resolve — match, typed abort, or
+        # the timeout sweep — before the job may end).  Fall through to
+        # a normal tick response.
+        pass
+    elif shut:
         ev.add("shutdown")
         ncoord = _coord(coord, got=(), steady=False, exits=(), shut=True)
         nranks, ndown = _broadcast(cfg, ranks, down, alive,
                                    ("shut", cep, 0), ev)
         return [(label + "(shutdown)",
+                 (nranks, subs, ncoord, up, ndown, newt, fb, stale), ev)]
+    # Paired-readiness match: both halves announced and both alive —
+    # this tick's response carries the RESP_SENDRECV (BuildResponse's
+    # exactly-two-complementary-requests arm).
+    if (cfg.p2p and set(p2p) == {"r", "s"}
+            and all(pr in alive and pr not in dead
+                    and ranks[pr][0] not in (R_CRASH, R_FROZEN)
+                    for pr in cfg.p2p)):
+        ev.add("p2p_match")
+        ncoord = _coord(coord, got=(), hist=0, steady=False, exits=(),
+                        p2p=("M",))
+        nranks, ndown = _broadcast(cfg, ranks, down, alive,
+                                   ("resp", cep, "p2p"), ev)
+        return [(label + "(p2p_match)",
                  (nranks, subs, ncoord, up, ndown, newt, fb, stale), ev)]
     resumed = steady
     nhist = 0 if resumed else hist + 1
@@ -581,7 +705,8 @@ def act_coord_revoke_reshape(cfg, st):
     negotiation, then let the barrier fire on the next regular tick
     (MaybeRevokeSteadyForReshape)."""
     ranks, subs, coord, up, down, newt, fb, stale = st
-    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp) = coord
+    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp,
+     p2p) = coord
     if (not cfg.elastic or not steady or abort
             or cfg.bug == "skip-revoke"):
         return []
@@ -686,7 +811,8 @@ def act_coord_abort(cfg, st):
     raises ST_RANKS_DOWN — 'ranks down: N (no data-plane heartbeats
     ...)') so the invariant can tell the two detectors apart."""
     ranks, subs, coord, up, down, newt, fb, stale = st
-    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp) = coord
+    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp,
+     p2p) = coord
     if cfg.elastic or not dead or abort:
         return []
     code = ("ST_RANKS_DOWN"
@@ -711,7 +837,8 @@ def act_timeout(cfg, st):
     timeout remains the only freeze detector when HVD_TPU_HEARTBEAT_MS=0
     (``heartbeat=False`` configs)."""
     ranks, subs, coord, up, down, newt, fb, stale = st
-    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp) = coord
+    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp,
+     p2p) = coord
     if abort or cfg.heartbeat:
         return []
     if not any(ranks[r][0] == R_FROZEN for r in alive):
@@ -722,6 +849,37 @@ def act_timeout(cfg, st):
     nranks, ndown = _broadcast(cfg, ranks, down, alive,
                                ("abort", cep, "ST_TIMEOUT"), ev)
     return [("timeout_fire",
+             (nranks, subs, ncoord, up, ndown, newt, fb, stale), ev)]
+
+
+def act_p2p_timeout(cfg, st):
+    """Paired-readiness backstop (CheckCollectiveTimeout over p2p
+    entries): an announced send whose counterpart recv is NEVER posted —
+    the peer is alive and beating, so neither EOF nor the heartbeat
+    detector can see anything wrong — must reach the coordinator's
+    timeout sweep as a typed ST_TIMEOUT naming the tensor and the absent
+    peer.  Time-abstracted like act_timeout; enabled only for the
+    application-level lost-recv config (a crashed/frozen counterpart is
+    the EOF/heartbeat detectors' job and this sweep defers to them).
+    The ``p2p-unmatched-send`` seeded bug severs exactly this action:
+    the unmatched send then strands its rank in R_P2P, the shutdown
+    gate holds, and the whole job stalls — the silent-hang trace the
+    invariant exists to forbid."""
+    ranks, subs, coord, up, down, newt, fb, stale = st
+    (cep, got, shut, exits, dead, hist, steady, alive, abort, joinp,
+     p2p) = coord
+    if abort or not cfg.p2p or not cfg.p2p_lost_recv:
+        return []
+    if cfg.bug == "p2p-unmatched-send":
+        return []
+    if "s" not in p2p or "M" in p2p or "r" in p2p:
+        return []
+    ev = {"p2p_timeout", "abort:ST_TIMEOUT"}
+    ncoord = _coord(coord, abort=STATUS["ST_TIMEOUT"], got=(),
+                    steady=False, exits=())
+    nranks, ndown = _broadcast(cfg, ranks, down, alive,
+                               ("abort", cep, "ST_TIMEOUT"), ev)
+    return [("p2p_timeout_fire",
              (nranks, subs, ncoord, up, ndown, newt, fb, stale), ev)]
 
 
@@ -738,7 +896,7 @@ def act_fault(cfg, st):
             kind, r = spec.split(":")
             r = int(r)
             if r not in alive or ranks[r][0] not in (R_RUN, R_WAIT,
-                                                     R_STEADY):
+                                                     R_STEADY, R_P2P):
                 continue
             nmode = R_CRASH if kind == "crash" else R_FROZEN
             out.append(("fault(%s)" % spec,
@@ -771,7 +929,7 @@ def act_fault(cfg, st):
 ACTIONS = (act_send, act_deliver_up, act_sub_flush, act_coord_tick,
            act_deliver_down, act_steady_replay, act_steady_exit,
            act_coord_revoke_reshape, act_eof_detect, act_hb_detect,
-           act_coord_abort, act_timeout, act_fault)
+           act_coord_abort, act_timeout, act_p2p_timeout, act_fault)
 
 
 def successors(cfg, st):

@@ -311,6 +311,30 @@ def render(snap: dict, base: Optional[dict] = None) -> str:
                          f"{e.get('detail', '')} "
                          f"[{e.get('age_us', 0) / 1e6:.1f}s ago]")
 
+    # Point-to-point plane (docs/pipeline.md#observability); only
+    # rendered when the rank moved p2p traffic, so pure data-parallel
+    # dumps stay unchanged.  Counters diff in two-file mode; the
+    # unmatched / open-channel gauges stay absolute — the B dump's live
+    # state.
+    p2p = dict(snap.get("p2p", {}))
+    pbytes = dict(p2p.get("bytes", {}))
+    if base:
+        b = base.get("p2p", {})
+        for k in ("sends", "recvs", "matched", "group_ops"):
+            p2p[k] = p2p.get(k, 0) - b.get(k, 0)
+        for d in pbytes:
+            pbytes[d] = pbytes.get(d, 0) - b.get("bytes", {}).get(d, 0)
+    if p2p.get("sends") or p2p.get("recvs") or p2p.get("group_ops"):
+        lines.append("== p2p ==")
+        lines.append(
+            f"sends {p2p.get('sends', 0)} "
+            f"({_fmt_bytes(pbytes.get('out', 0))}), recvs "
+            f"{p2p.get('recvs', 0)} ({_fmt_bytes(pbytes.get('in', 0))}); "
+            f"matched {p2p.get('matched', 0)}, unmatched in flight "
+            f"{p2p.get('unmatched', 0)}; stage-group ops "
+            f"{p2p.get('group_ops', 0)}; dedicated channels "
+            f"{p2p.get('channels', 0)}")
+
     # Elastic membership (docs/fault-tolerance.md#elastic-membership);
     # only rendered once the job reshaped, so pre-elastic dumps stay
     # unchanged.
